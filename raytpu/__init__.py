@@ -1,0 +1,96 @@
+"""raytpu — a TPU-native distributed AI runtime.
+
+A brand-new framework with the capabilities of Ray (reference surveyed in
+``SURVEY.md``), designed TPU-first: a host-process fabric providing tasks,
+actors, owned objects and placement groups (reference analogue:
+``python/ray/_private/worker.py``, ``src/ray/core_worker/``), where the
+schedulable resource is the TPU chip/slice with ICI topology as a first-class
+scheduling dimension, and where every numeric component is a compiled XLA
+program over a ``jax.sharding.Mesh`` — collectives ride ICI inside the
+program rather than NCCL outside it.
+
+Public API mirrors the reference's core surface (``ray.init/remote/get/put/
+wait``; reference: ``python/ray/_private/worker.py:1217,2554,2686``) so a
+Ray user can switch with minimal relearning.
+"""
+
+from raytpu._version import __version__
+from raytpu.core.errors import (
+    RayTpuError,
+    TaskError,
+    ActorError,
+    ActorDiedError,
+    ObjectLostError,
+    WorkerCrashedError,
+    GetTimeoutError,
+    RuntimeEnvError,
+)
+from raytpu.core.ids import ObjectID, TaskID, ActorID, NodeID, JobID, PlacementGroupID
+from raytpu.runtime.api import (
+    init,
+    shutdown,
+    is_initialized,
+    remote,
+    get,
+    put,
+    wait,
+    cancel,
+    kill,
+    get_actor,
+    method,
+    get_runtime_context,
+    available_resources,
+    cluster_resources,
+    nodes,
+    timeline,
+)
+from raytpu.runtime.object_ref import ObjectRef
+from raytpu.runtime.placement_group import (
+    placement_group,
+    PlacementGroup,
+    remove_placement_group,
+    get_current_placement_group,
+)
+
+# Subpackages (imported lazily by users): raytpu.data, raytpu.train,
+# raytpu.tune, raytpu.serve, raytpu.rllib, raytpu.parallel, raytpu.ops,
+# raytpu.collective, raytpu.util
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "cancel",
+    "kill",
+    "get_actor",
+    "method",
+    "get_runtime_context",
+    "available_resources",
+    "cluster_resources",
+    "nodes",
+    "timeline",
+    "ObjectRef",
+    "placement_group",
+    "PlacementGroup",
+    "remove_placement_group",
+    "get_current_placement_group",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "WorkerCrashedError",
+    "GetTimeoutError",
+    "RuntimeEnvError",
+    "ObjectID",
+    "TaskID",
+    "ActorID",
+    "NodeID",
+    "JobID",
+    "PlacementGroupID",
+]

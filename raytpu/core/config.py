@@ -1,0 +1,126 @@
+"""Runtime config registry.
+
+Reference analogue: ``src/ray/common/ray_config_def.h`` — 219 compile-time
+declared knobs, each overridable from the environment (``RAY_<name>``) and
+serialized to every process at startup. Same shape here: declared once,
+typed, env-overridable via ``RAYTPU_<name>``, snapshot-serializable so a
+head process can ship its view to workers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, "_ConfigEntry"] = {}
+
+
+class _ConfigEntry:
+    __slots__ = ("name", "default", "parser", "value")
+
+    def __init__(self, name: str, default: Any, parser: Callable[[str], Any]):
+        self.name = name
+        self.default = default
+        self.parser = parser
+        env = os.environ.get(f"RAYTPU_{name}")
+        self.value = parser(env) if env is not None else default
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def declare(name: str, default: Any) -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"config {name} declared twice")
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    _REGISTRY[name] = _ConfigEntry(name, default, parser)
+
+
+class _Config:
+    """Attribute access to declared knobs: ``cfg.scheduler_spread_threshold``."""
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return _REGISTRY[name].value
+        except KeyError:
+            raise AttributeError(f"unknown config knob {name!r}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown config knob {name!r}")
+        _REGISTRY[name].value = value
+
+    def snapshot(self) -> str:
+        """Serialize current values (to ship to spawned worker processes)."""
+        return json.dumps({k: e.value for k, e in _REGISTRY.items()})
+
+    def load_snapshot(self, blob: str) -> None:
+        for k, v in json.loads(blob).items():
+            if k in _REGISTRY:
+                _REGISTRY[k].value = v
+
+    def items(self):
+        return {k: e.value for k, e in _REGISTRY.items()}.items()
+
+
+cfg = _Config()
+
+# --- Declared knobs (reference: ray_config_def.h) ----------------------------
+
+# Scheduling. Hybrid policy packs nodes until utilization crosses this
+# threshold, then spreads by score (reference: ray_config_def.h:186
+# ``scheduler_spread_threshold`` = 0.5).
+declare("scheduler_spread_threshold", 0.5)
+declare("scheduler_top_k_fraction", 0.2)
+declare("max_pending_lease_requests_per_scheduling_category", 10)
+
+# Objects. Results larger than this go to the shared-memory store instead of
+# being returned inline (reference: ray_config_def.h:206
+# ``max_direct_call_object_size`` = 100 KiB).
+declare("max_direct_call_object_size", 100 * 1024)
+declare("object_store_memory_bytes", 2 * 1024 * 1024 * 1024)
+declare("object_store_fallback_directory", "")
+declare("object_spilling_threshold", 0.8)
+
+# Worker pool.
+declare("num_workers_soft_limit", 8)
+declare("worker_register_timeout_seconds", 60.0)
+declare("idle_worker_killing_time_threshold_ms", 1000 * 60 * 5)
+declare("prestart_workers", True)
+
+# Health / fault tolerance (reference: gcs_health_check_manager.cc).
+declare("health_check_period_ms", 1000)
+declare("health_check_timeout_ms", 10000)
+declare("health_check_failure_threshold", 5)
+declare("task_max_retries", 3)
+declare("actor_max_restarts", 0)
+declare("lineage_pinning_enabled", True)
+declare("max_lineage_bytes", 1024 * 1024 * 1024)
+
+# RPC.
+declare("rpc_connect_timeout_s", 10.0)
+declare("rpc_call_timeout_s", 120.0)
+declare("pubsub_batch_ms", 10)
+
+# Metrics / events.
+declare("metrics_report_interval_ms", 2500)
+declare("task_events_buffer_size", 100000)
+declare("enable_timeline", True)
+
+# TPU / mesh.
+declare("tpu_visible_chips_env", "TPU_VISIBLE_CHIPS")
+declare("mesh_dcn_axis", "dcn")
+declare("default_remote_chips", 0)
+
+# Memory monitor (reference: memory_monitor.h:52).
+declare("memory_usage_threshold", 0.95)
+declare("memory_monitor_refresh_ms", 250)

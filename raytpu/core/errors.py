@@ -1,0 +1,95 @@
+"""Exception hierarchy.
+
+Reference analogue: ``python/ray/exceptions.py`` (RayError, RayTaskError,
+RayActorError, ObjectLostError, WorkerCrashedError, GetTimeoutError).
+Task-raised user exceptions are wrapped in :class:`TaskError` carrying the
+remote traceback and re-raised at ``get()`` sites, with ``cause`` chaining.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A user task raised; re-raised on ray.get of its output.
+
+    Reference: ``python/ray/exceptions.py`` RayTaskError — carries remote
+    traceback text so the driver sees the worker-side stack.
+    """
+
+    def __init__(self, function_name: str, remote_traceback: str,
+                 cause: Optional[BaseException] = None):
+        self.function_name = function_name
+        self.remote_traceback = remote_traceback
+        self.cause = cause
+        super().__init__(
+            f"task {function_name} failed:\n{remote_traceback}"
+        )
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: BaseException) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return cls(function_name, tb, cause=exc)
+
+    def __reduce__(self):
+        # The cause may not be picklable (it carries a traceback); ship the
+        # formatted text only, like the reference's RayTaskError.
+        return (TaskError, (self.function_name, self.remote_traceback))
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id_hex: str, reason: str = ""):
+        self.actor_id_hex = actor_id_hex
+        super().__init__(f"actor {actor_id_hex} died: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str, reason: str = "owner or store lost"):
+        self.object_id_hex = object_id_hex
+        super().__init__(f"object {object_id_hex} lost: {reason}")
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass

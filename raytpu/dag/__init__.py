@@ -1,0 +1,3 @@
+from raytpu.dag.node import DAGNode, FunctionNode, ActorMethodNode, ClassNode, InputNode
+
+__all__ = ["DAGNode", "FunctionNode", "ActorMethodNode", "ClassNode", "InputNode"]

@@ -1,0 +1,215 @@
+"""Actor front-end: ``@raytpu.remote`` classes.
+
+Reference analogue: ``python/ray/actor.py`` — ``ActorClass`` (``:563``),
+``ActorClass._remote`` (``:851``), ``ActorHandle`` (``:1222``),
+``ActorMethod._remote`` (``:275``). Handles are serializable (passing one
+to a task shares the actor); named actors are looked up via the backend's
+directory (reference: GCS named-actor table).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from raytpu.core.config import cfg
+from raytpu.core.ids import ActorID, TaskID
+from raytpu.runtime.remote_function import (
+    build_resources,
+    build_scheduling,
+    serialize_args,
+    validate_options,
+)
+from raytpu.runtime.task_spec import ActorCreationSpec, TaskSpec
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        m = ActorMethod(self._handle, self._method_name,
+                        opts.get("num_returns", self._num_returns))
+        return m
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(
+            self._method_name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def bind(self, *args, **kwargs):
+        from raytpu.dag.node import ActorMethodNode
+
+        return ActorMethodNode(self._handle, self._method_name, args, kwargs)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"actor method {self._method_name!r} must be invoked with .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_meta: Dict[str, int],
+                 *, _register: bool = True):
+        self._actor_id = actor_id
+        self._method_meta = method_meta
+        self._registered = False
+        if _register:
+            from raytpu.runtime import api
+
+            backend = api._backend_or_none()
+            if backend is not None:
+                backend.actor_handle_added(actor_id)
+                self._registered = True
+
+    @property
+    def _id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_meta:
+            raise AttributeError(
+                f"actor has no method {name!r}; methods: "
+                f"{sorted(self._method_meta)}"
+            )
+        return ActorMethod(self, name, self._method_meta[name])
+
+    def _invoke(self, method_name: str, args, kwargs, num_returns: int = 1):
+        from raytpu.runtime import api
+
+        worker, backend = api._worker_and_backend()
+        task_args, kw_keys, keepalive = serialize_args(worker, args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=worker.job_id,
+            name=f"{self._actor_id.hex()[:8]}.{method_name}",
+            method_name=method_name,
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=num_returns,
+            actor_id=self._actor_id,
+            owner_address=worker.worker_id.binary(),
+        )
+        refs = backend.submit_actor_task(spec)
+        del keepalive
+        return refs[0] if num_returns == 1 else refs
+
+    def __del__(self):
+        if getattr(self, "_registered", False):
+            try:  # tolerate interpreter teardown
+                from raytpu.runtime import api
+
+                backend = api._backend_or_none()
+                if backend is not None:
+                    backend.actor_handle_removed(self._actor_id)
+            except BaseException:
+                pass
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id, self._method_meta))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:16]})"
+
+
+def _rebuild_handle(actor_id: ActorID, method_meta: Dict[str, int]) -> ActorHandle:
+    return ActorHandle(actor_id, method_meta)
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._name = cls.__name__
+        self._options = dict(options or {})
+        validate_options(self._options)
+        self._pickled: Optional[bytes] = None
+
+    def _blob(self) -> bytes:
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._cls)
+        return self._pickled
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"actor class {self._name} cannot be instantiated directly; use "
+            f"{self._name}.remote()"
+        )
+
+    def options(self, **options) -> "ActorClass":
+        merged = {**self._options, **options}
+        ac = ActorClass(self._cls, merged)
+        ac._pickled = self._pickled
+        return ac
+
+    def _method_meta(self) -> Dict[str, int]:
+        meta = {}
+        for name, member in inspect.getmembers(self._cls):
+            if name.startswith("__") or not callable(member):
+                continue
+            meta[name] = getattr(member, "_num_returns", 1)
+        return meta
+
+    def _is_async(self) -> bool:
+        return any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(self._cls, inspect.isfunction)
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from raytpu.runtime import api
+
+        worker, backend = api._worker_and_backend()
+        opts = self._options
+        actor_id = ActorID.from_random()
+        task_args, kw_keys, keepalive = serialize_args(worker, args, kwargs)
+        lifetime = opts.get("lifetime")
+        max_conc = opts.get("max_concurrency") or (1000 if self._is_async() else 1)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            job_id=worker.job_id,
+            name=opts.get("name") or f"{self._name}.__init__",
+            function_blob=self._blob(),
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=1,
+            resources=build_resources(opts, default_cpus=0.0),
+            max_retries=0,
+            scheduling=build_scheduling(opts),
+            runtime_env=opts.get("runtime_env"),
+            actor_creation=ActorCreationSpec(
+                actor_id=actor_id,
+                max_restarts=opts.get("max_restarts", cfg.actor_max_restarts),
+                max_concurrency=max_conc,
+                name=opts.get("name"),
+                namespace=opts.get("namespace", "default"),
+                lifetime_detached=(lifetime == "detached"),
+                is_async=self._is_async(),
+            ),
+            owner_address=worker.worker_id.binary(),
+        )
+        backend.create_actor(spec)
+        del keepalive
+        return ActorHandle(actor_id, self._method_meta())
+
+    def bind(self, *args, **kwargs):
+        from raytpu.dag.node import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
+
+def method(*, num_returns: int = 1):
+    """Decorator to override per-method defaults (reference:
+    ``@ray.method(num_returns=...)``)."""
+
+    def wrap(fn):
+        fn._num_returns = num_returns
+        return fn
+
+    return wrap

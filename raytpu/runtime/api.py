@@ -1,0 +1,334 @@
+"""Top-level API: init/shutdown, remote, get/put/wait, actors, introspection.
+
+Reference analogue: ``python/ray/_private/worker.py`` — ``init`` (``:1217``),
+``get`` (``:2554``), ``put`` (``:2686``), ``wait``, plus ``ray.remote``
+dispatch to function/class paths. ``get`` inside a task releases the task's
+resources while blocked (reference raylet blocked-worker protocol) so
+nested tasks can't deadlock a fully-packed node.
+"""
+
+from __future__ import annotations
+
+import atexit
+import concurrent.futures
+import inspect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from raytpu.core.errors import GetTimeoutError, RayTpuError, TaskError
+from raytpu.core.ids import JobID
+from raytpu.runtime import context as ctx_mod
+from raytpu.runtime.actor import ActorClass, ActorHandle
+from raytpu.runtime.actor import method as method  # re-export
+from raytpu.runtime.object_ref import ObjectRef
+from raytpu.runtime.remote_function import RemoteFunction
+from raytpu.runtime.serialization import deserialize
+
+_lock = threading.RLock()
+_backend = None
+_worker = None
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default", ignore_reinit_error: bool = False,
+         object_store_memory: Optional[int] = None,
+         runtime_env: Optional[dict] = None, **kwargs):
+    """Start (or connect to) the runtime.
+
+    ``address=None`` starts an in-process fabric (the reference starts a
+    local cluster; our single-process backend has the same semantics).
+    ``address="tcp://host:port"`` connects to a running cluster head
+    (cluster mode, :mod:`raytpu.cluster`).
+    """
+    global _backend, _worker
+    with _lock:
+        if _backend is not None:
+            if ignore_reinit_error:
+                return _backend
+            raise RuntimeError("raytpu.init() called twice (pass "
+                               "ignore_reinit_error=True to ignore)")
+        job_id = JobID.from_random()
+        if address is None or address == "local":
+            from raytpu.runtime.local_backend import LocalBackend
+
+            shm = None
+            if object_store_memory:
+                try:
+                    from raytpu.runtime.shm_store import SharedMemoryStore
+
+                    shm = SharedMemoryStore(capacity=object_store_memory)
+                except Exception:
+                    shm = None
+            _backend = LocalBackend(
+                job_id, num_cpus=num_cpus, num_tpus=num_tpus,
+                resources=resources, object_store=shm,
+            )
+            _worker = _backend.worker
+        else:
+            from raytpu.cluster.client import ClusterBackend
+
+            _backend = ClusterBackend(address, job_id)
+            _worker = _backend.worker
+        atexit.register(_shutdown_quiet)
+        return _backend
+
+
+def _shutdown_quiet():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    global _backend, _worker
+    with _lock:
+        if _backend is None:
+            return
+        try:
+            _backend.shutdown()
+        finally:
+            _backend = None
+            _worker = None
+
+
+def is_initialized() -> bool:
+    return _backend is not None
+
+
+def _ensure_init():
+    if _backend is None:
+        init()
+    return _backend
+
+
+def _worker_and_backend():
+    b = _ensure_init()
+    return _worker, b
+
+
+def _backend_or_none():
+    return _backend
+
+
+def _global_worker_or_none():
+    return _worker
+
+
+# -- remote -------------------------------------------------------------------
+
+
+def remote(*args, **options):
+    """``@raytpu.remote`` / ``@raytpu.remote(num_cpus=..., ...)`` on a
+    function or class."""
+    if len(args) == 1 and not options and (inspect.isfunction(args[0])
+                                           or inspect.isclass(args[0])):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("remote() takes keyword options only, e.g. "
+                        "@raytpu.remote(num_cpus=2)")
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    return wrap
+
+
+# -- data plane ---------------------------------------------------------------
+
+
+def put(value: Any) -> ObjectRef:
+    worker, _ = _worker_and_backend()
+    return worker.put_object(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    worker, backend = _worker_and_backend()
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+
+    blocked_tid = None
+    ctx = ctx_mod.current()
+    if ctx.task_id is not None and hasattr(backend, "task_blocked"):
+        # Release our resources while blocked (nested-task deadlock
+        # avoidance; reference: raylet NotifyWorkerBlocked).
+        missing = [r for r in ref_list if not backend.store.contains(r.id)] \
+            if hasattr(backend, "store") else ref_list
+        if missing:
+            blocked_tid = ctx.task_id
+            backend.task_blocked(blocked_tid)
+    try:
+        values = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for r in ref_list:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            sv = backend.get_object(r, timeout=remaining) if hasattr(
+                backend, "get_object") else backend.store.get(r.id, timeout=remaining)
+            value = deserialize(sv)
+            if isinstance(value, RayTpuError):
+                raise value
+            if isinstance(value, ObjectRef):
+                # A task returned a ref — transparently resolve one level
+                # (reference: ray.get flattens returned refs once).
+                value = get(value, timeout=None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+            values.append(value)
+    finally:
+        if blocked_tid is not None:
+            backend.task_unblocked(blocked_tid)
+    return values[0] if single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None,
+         fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    """Reference: ``ray.wait`` — first `num_returns` ready refs, preserving
+    argument order among the ready set."""
+    _, backend = _worker_and_backend()
+    refs = list(refs)
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    seen = set()
+    for r in refs:
+        if r.id in seen:
+            raise ValueError("wait() got duplicate refs")
+        seen.add(r.id)
+    deadline = None if timeout is None else time.monotonic() + timeout
+    contains = (backend.object_ready if hasattr(backend, "object_ready")
+                else (lambda rr: backend.store.contains(rr.id)))
+    while True:
+        ready = [r for r in refs if contains(r)]
+        if len(ready) >= num_returns:
+            ready = ready[:num_returns]
+            ready_ids = {r.id for r in ready}
+            return ready, [r for r in refs if r.id not in ready_ids]
+        if deadline is not None and time.monotonic() >= deadline:
+            ready_ids = {r.id for r in ready}
+            return ready, [r for r in refs if r.id not in ready_ids]
+        time.sleep(0.002)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = True) -> None:
+    from raytpu.core.ids import TaskID
+
+    _, backend = _worker_and_backend()
+    # Return ids are derived from the task id; the backend indexes both.
+    backend.cancel_object(ref.id) if hasattr(backend, "cancel_object") else \
+        _cancel_by_scan(backend, ref)
+
+
+def _cancel_by_scan(backend, ref: ObjectRef):
+    with backend._lock:
+        for tid, rec in backend._tasks.items():
+            if ref.id in {o for o in rec.spec.return_ids()}:
+                backend_task = tid
+                break
+        else:
+            return
+    backend.cancel_task(backend_task)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _, backend = _worker_and_backend()
+    backend.kill_actor(actor._id, no_restart=no_restart)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    _, backend = _worker_and_backend()
+    actor_id, creation_spec = backend.get_actor_handle_info(name, namespace)
+    import cloudpickle
+
+    cls = cloudpickle.loads(creation_spec.function_blob)
+    meta = {}
+    for mname in dir(cls):
+        if not mname.startswith("_") and callable(getattr(cls, mname, None)):
+            meta[mname] = getattr(getattr(cls, mname), "_num_returns", 1)
+    return ActorHandle(actor_id, meta)
+
+
+# -- introspection ------------------------------------------------------------
+
+
+def get_runtime_context():
+    ctx = ctx_mod.current()
+    if ctx.job_id is None and _worker is not None:
+        ctx.job_id = _worker.job_id
+        ctx.node_id = _worker.node_id
+    return ctx
+
+
+def available_resources() -> Dict[str, float]:
+    _, backend = _worker_and_backend()
+    return backend.available_resources()
+
+
+def cluster_resources() -> Dict[str, float]:
+    _, backend = _worker_and_backend()
+    return backend.cluster_resources()
+
+
+def nodes() -> List[dict]:
+    _, backend = _worker_and_backend()
+    return backend.nodes()
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace task timeline (reference: ``ray.timeline``,
+    ``python/ray/_private/state.py:917``)."""
+    _, backend = _worker_and_backend()
+    events = backend.task_events()
+    trace = []
+    starts: Dict[str, dict] = {}
+    for ev in events:
+        if ev["state"] == "running":
+            starts[ev["task_id"]] = ev
+        elif ev["state"] in ("finished", "failed") and ev["task_id"] in starts:
+            s = starts.pop(ev["task_id"])
+            trace.append({
+                "name": ev["name"], "cat": "task", "ph": "X",
+                "ts": s["ts"] * 1e6, "dur": (ev["ts"] - s["ts"]) * 1e6,
+                "pid": 0, "tid": 0,
+                "args": {"task_id": ev["task_id"]},
+            })
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+# -- async helpers ------------------------------------------------------------
+
+
+async def _async_get(ref: ObjectRef):
+    import asyncio
+
+    loop = asyncio.get_event_loop()
+    return await loop.run_in_executor(None, lambda: get(ref))
+
+
+def _as_future(ref: ObjectRef) -> concurrent.futures.Future:
+    fut: concurrent.futures.Future = concurrent.futures.Future()
+
+    def run():
+        try:
+            fut.set_result(get(ref))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True).start()
+    return fut

@@ -1,0 +1,57 @@
+"""Per-task execution context (reference: ``ray.get_runtime_context()``,
+``python/ray/runtime_context.py``)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from raytpu.core.ids import ActorID, JobID, NodeID, TaskID
+
+
+@dataclass
+class RuntimeContext:
+    job_id: Optional[JobID] = None
+    node_id: Optional[NodeID] = None
+    task_id: Optional[TaskID] = None
+    actor_id: Optional[ActorID] = None
+    placement_group_id: Optional[bytes] = None
+    attempt: int = 0
+    extras: dict = field(default_factory=dict)
+
+    def get_job_id(self):
+        return self.job_id
+
+    def get_node_id(self):
+        return self.node_id
+
+    def get_task_id(self):
+        return self.task_id
+
+    def get_actor_id(self):
+        return self.actor_id
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return self.attempt > 0
+
+
+_tls = threading.local()
+
+
+def current() -> RuntimeContext:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        ctx = RuntimeContext()
+        _tls.ctx = ctx
+    return ctx
+
+
+def set_current(ctx: Optional[RuntimeContext]):
+    _tls.ctx = ctx
+
+
+def in_task() -> bool:
+    ctx = getattr(_tls, "ctx", None)
+    return ctx is not None and ctx.task_id is not None

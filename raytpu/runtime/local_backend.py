@@ -1,0 +1,714 @@
+"""Single-process backend: the whole fabric in one process.
+
+This is the analogue of the reference's local-mode plus its single-node
+data path, with real semantics: resource-gated scheduling (hybrid policy is
+trivial with one node), dependency-triggered dispatch (reference:
+``dependency_manager.cc``), per-actor ordered execution queues (reference:
+``transport/actor_scheduling_queue.cc``), placement-group bundle
+reservation with ICI-aware chip assignment, retries, and blocked-worker
+resource release (a worker blocked in ``get`` returns its CPU — reference
+raylet behavior for blocked workers).
+
+Cluster mode (``raytpu.cluster``) runs the same Worker execution core in
+separate processes; this backend is both the dev/test fabric and each
+cluster worker's in-process engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from raytpu.core.config import cfg
+from raytpu.core.errors import (
+    ActorDiedError,
+    PlacementGroupError,
+    TaskCancelledError,
+    TaskError,
+)
+from raytpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+from raytpu.core.resources import CPU, TPU, NodeResources, ResourceSet
+from raytpu.core.topology import TpuTopology
+from raytpu.runtime.object_ref import ObjectRef
+from raytpu.runtime.object_store import MemoryStore
+from raytpu.runtime.serialization import deserialize, serialize
+from raytpu.runtime.task_spec import ArgKind, SchedulingKind, TaskSpec
+from raytpu.runtime.worker import Worker
+
+
+@dataclass
+class _TaskRecord:
+    spec: TaskSpec
+    required: ResourceSet
+    missing_deps: set
+    state: str = "waiting"  # waiting -> ready -> running -> done
+    released_while_blocked: int = 0
+
+
+@dataclass
+class _Bundle:
+    index: int
+    resources: ResourceSet
+    node: NodeResources = None  # per-bundle reservation ledger
+    chip_coords: List[Tuple[int, ...]] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.node is None:
+            self.node = NodeResources(self.resources)
+
+
+@dataclass
+class _PlacementGroup:
+    pg_id: PlacementGroupID
+    bundles: List[_Bundle]
+    strategy: str
+    name: str = ""
+    state: str = "created"  # created | removed
+
+
+class _ActorRuntime:
+    """One live actor: a dedicated thread draining an ordered queue.
+
+    Sync actors with max_concurrency>1 execute on an internal pool (dispatch
+    order preserved, completion unordered — reference threaded actors).
+    Async actors run an event loop; methods execute as asyncio tasks bounded
+    by a semaphore (reference: async actors, ``max_concurrency``).
+    """
+
+    def __init__(self, backend: "LocalBackend", spec: TaskSpec):
+        self.backend = backend
+        self.creation_spec = spec
+        self.actor_id = spec.actor_creation.actor_id
+        self.max_concurrency = spec.actor_creation.max_concurrency
+        self.is_async = spec.actor_creation.is_async
+        self.name = spec.actor_creation.name
+        self.namespace = spec.actor_creation.namespace
+        self.detached = spec.actor_creation.lifetime_detached
+        self.queue: "queue.Queue" = queue.Queue()
+        self.dead = False
+        self.death_reason = ""
+        self.instance = None
+        self.ready_event = threading.Event()
+        self.creation_error: Optional[BaseException] = None
+        self.num_handles = 0
+        self.resources = ResourceSet(spec.resources)
+        self.alloc_target: Optional[NodeResources] = None  # where resources came from
+        self.thread = threading.Thread(
+            target=self._run, name=f"actor-{self.actor_id.hex()[:8]}", daemon=True
+        )
+
+    def start(self):
+        self.thread.start()
+
+    def submit(self, spec: TaskSpec):
+        if self.dead:
+            err = ActorDiedError(self.actor_id.hex(), self.death_reason)
+            self.backend.worker._store_error(spec.return_ids(), spec, err)
+            return
+        self.queue.put(spec)
+
+    def kill(self, reason: str = "killed via raytpu.kill"):
+        if self.dead:
+            return
+        self.queue.put(("__kill__", reason))
+
+    # -- internals -----------------------------------------------------------
+
+    def _run(self):
+        w = self.backend.worker
+        try:
+            self.instance = w.create_actor_instance(
+                self.creation_spec, self.backend._get_serialized
+            )
+            # The creation task's return slot signals readiness (reference:
+            # actor creation dummy object).
+            w.put_serialized(
+                self.creation_spec.return_ids()[0],
+                serialize(None),
+                creating_task=self.creation_spec.task_id,
+            )
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError.from_exception(
+                self.creation_spec.name, e
+            )
+            self.creation_error = err
+            w._store_error(self.creation_spec.return_ids(), self.creation_spec, err)
+            self._die(f"creation failed: {e}")
+            self.ready_event.set()
+            return
+        self.ready_event.set()
+
+        if self.is_async:
+            self._run_async_loop()
+        elif self.max_concurrency > 1:
+            self._run_threaded()
+        else:
+            self._run_sync()
+
+    def _run_sync(self):
+        while True:
+            item = self.queue.get()
+            if isinstance(item, tuple) and item[0] == "__kill__":
+                self._die(item[1])
+                return
+            self._execute(item)
+
+    def _run_threaded(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(max_workers=self.max_concurrency)
+        while True:
+            item = self.queue.get()
+            if isinstance(item, tuple) and item[0] == "__kill__":
+                pool.shutdown(wait=False)
+                self._die(item[1])
+                return
+            pool.submit(self._execute, item)
+
+    def _run_async_loop(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        sem = asyncio.Semaphore(self.max_concurrency)
+        stop = loop.create_future()
+
+        async def handle(spec: TaskSpec):
+            async with sem:
+                await self._execute_async(spec)
+
+        async def pump():
+            while True:
+                item = await loop.run_in_executor(None, self.queue.get)
+                if isinstance(item, tuple) and item[0] == "__kill__":
+                    stop.set_result(item[1])
+                    return
+                asyncio.ensure_future(handle(item))
+
+        loop.create_task(pump())
+        reason = loop.run_until_complete(stop)
+        loop.close()
+        self._die(reason)
+
+    def _execute(self, spec: TaskSpec):
+        self.backend.worker.execute_task(
+            spec, self.backend._get_serialized, actor_instance=self.instance
+        )
+        self.backend._task_finished(spec)
+
+    async def _execute_async(self, spec: TaskSpec):
+        w = self.backend.worker
+        from raytpu.runtime import context as ctx_mod
+
+        try:
+            args, kwargs = w.resolve_args(spec, self.backend._get_serialized)
+            method = getattr(self.instance, spec.method_name)
+            ctx_mod.set_current(
+                ctx_mod.RuntimeContext(
+                    job_id=w.job_id, node_id=w.node_id,
+                    task_id=spec.task_id, actor_id=self.actor_id,
+                )
+            )
+            result = method(*args, **kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError.from_exception(
+                spec.name, e
+            )
+            w._store_error(spec.return_ids(), spec, err)
+            self.backend._task_finished(spec)
+            return
+        rids = spec.return_ids()
+        if spec.num_returns == 1:
+            w.put_serialized(rids[0], serialize(result), creating_task=spec.task_id)
+        else:
+            for oid, v in zip(rids, list(result or [])):
+                w.put_serialized(oid, serialize(v), creating_task=spec.task_id)
+        self.backend._task_finished(spec)
+
+    def _die(self, reason: str):
+        self.dead = True
+        self.death_reason = reason
+        # Fail everything still queued.
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(item, TaskSpec):
+                self.backend.worker._store_error(
+                    item.return_ids(), item,
+                    ActorDiedError(self.actor_id.hex(), reason),
+                )
+        self.backend._actor_died(self)
+
+
+class LocalBackend:
+    def __init__(self, job_id: JobID, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store=None):
+        import os
+
+        self.job_id = job_id
+        self.node_id = NodeID.from_random()
+        if num_cpus is None:
+            num_cpus = os.cpu_count() or 1
+        total = {CPU: num_cpus}
+        if num_tpus is None:
+            from raytpu.core.topology import detect_local_tpu
+
+            num_tpus = detect_local_tpu()["chips"]
+        if num_tpus:
+            total[TPU] = num_tpus
+        total.update(resources or {})
+        self.node = NodeResources(ResourceSet(total))
+        self.topology = TpuTopology(shape=(max(1, int(num_tpus)),)) if num_tpus else None
+        self.store = MemoryStore(shm=object_store)
+        self.store.on_put = self._on_object_available
+        self.worker = Worker(job_id, self.node_id, self.store)
+
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._tasks: Dict[TaskID, _TaskRecord] = {}
+        self._waiting_on: Dict[ObjectID, set] = {}  # oid -> task_ids
+        self._ready: List[TaskID] = []
+        self._running: Dict[TaskID, _TaskRecord] = {}
+        self._actors: Dict[ActorID, _ActorRuntime] = {}
+        self._named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
+        self._shutdown = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="raytpu-dispatcher", daemon=True
+        )
+        self._dispatcher.start()
+        self._task_events: List[dict] = []  # timeline feed
+
+    # -- public backend interface --------------------------------------------
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [
+            ObjectRef(oid, owner=self.worker.worker_id.binary())
+            for oid in spec.return_ids()
+        ]
+        required = self._required_resources(spec)
+        missing = set()
+        with self._lock:
+            for arg in spec.args:
+                if arg.kind == ArgKind.REF:
+                    ref = ObjectRef.from_binary(arg.data)
+                    self.worker.reference_counter.add_submitted_task_ref(ref.id)
+                    if not self.store.contains(ref.id):
+                        missing.add(ref.id)
+                        self._waiting_on.setdefault(ref.id, set()).add(spec.task_id)
+            rec = _TaskRecord(spec=spec, required=required, missing_deps=missing)
+            self._tasks[spec.task_id] = rec
+            if not missing:
+                rec.state = "ready"
+                self._ready.append(spec.task_id)
+                self._cv.notify_all()
+        self._record_event(spec, "submitted")
+        return refs
+
+    def create_actor(self, spec: TaskSpec) -> None:
+        """Actor creation flows through the scheduler like a task (resources
+        are held for the actor's lifetime); reference: GcsActorScheduler.
+
+        The actor runtime is registered eagerly so method calls submitted
+        before creation completes simply queue (the reference buffers these
+        in the actor submit queue the same way)."""
+        runtime = _ActorRuntime(self, spec)
+        name = spec.actor_creation.name
+        with self._lock:
+            if name:
+                key = (spec.actor_creation.namespace, name)
+                if key in self._named_actors:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._named_actors[key] = spec.actor_creation.actor_id
+            self._actors[spec.actor_creation.actor_id] = runtime
+        self.submit_task(spec)
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [
+            ObjectRef(oid, owner=self.worker.worker_id.binary())
+            for oid in spec.return_ids()
+        ]
+        for arg in spec.args:
+            if arg.kind == ArgKind.REF:
+                ref = ObjectRef.from_binary(arg.data)
+                self.worker.reference_counter.add_submitted_task_ref(ref.id)
+        with self._lock:
+            actor = self._actors.get(spec.actor_id)
+        if actor is None:
+            err = ActorDiedError(spec.actor_id.hex(), "actor not found or dead")
+            self.worker._store_error(spec.return_ids(), spec, err)
+            return refs
+        # Wait for creation to finish off-thread; ordering is preserved by
+        # the actor queue itself (reference: sequence numbers in
+        # direct_actor_task_submitter.cc).
+        actor.submit(spec)
+        self._record_event(spec, "submitted")
+        return refs
+
+    def get_actor_handle_info(self, name: str, namespace: str):
+        with self._lock:
+            actor_id = self._named_actors.get((namespace, name))
+            if actor_id is None:
+                raise ValueError(f"no actor named {name!r} in {namespace!r}")
+            runtime = self._actors.get(actor_id)
+            creation = runtime.creation_spec if runtime else None
+        if runtime is None:
+            # Not yet scheduled or already dead; look in pending tasks.
+            with self._lock:
+                for rec in self._tasks.values():
+                    ac = rec.spec.actor_creation
+                    if ac is not None and ac.actor_id == actor_id:
+                        creation = rec.spec
+                        break
+        if creation is None:
+            raise ValueError(f"actor {name!r} is dead")
+        return actor_id, creation
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        with self._lock:
+            actor = self._actors.get(actor_id)
+        if actor is not None:
+            actor.kill()
+
+    def actor_handle_added(self, actor_id: ActorID):
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is not None:
+                a.num_handles += 1
+
+    def actor_handle_removed(self, actor_id: ActorID):
+        with self._lock:
+            a = self._actors.get(actor_id)
+        if a is not None:
+            a.num_handles -= 1
+            if a.num_handles <= 0 and not a.detached and not a.dead:
+                a.kill("all handles out of scope")
+
+    def cancel_task(self, task_id: TaskID) -> None:
+        self.worker.cancel(task_id)
+        with self._lock:
+            rec = self._tasks.get(task_id)
+            if rec is not None and rec.state in ("waiting", "ready"):
+                rec.state = "done"
+                if task_id in self._ready:
+                    self._ready.remove(task_id)
+                err = TaskCancelledError(f"task {rec.spec.name} cancelled")
+                self.worker._store_error(rec.spec.return_ids(), rec.spec, err)
+
+    # -- placement groups -----------------------------------------------------
+
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str, name: str = "") -> PlacementGroupID:
+        pg_id = PlacementGroupID.from_random()
+        bs = [_Bundle(i, ResourceSet(b)) for i, b in enumerate(bundles)]
+        total = ResourceSet({})
+        for b in bs:
+            total = total + b.resources
+        with self._lock:
+            if strategy == "STRICT_SPREAD" and len(bs) > 1:
+                raise PlacementGroupError(
+                    "STRICT_SPREAD with >1 bundle cannot be satisfied on a "
+                    "single node"
+                )
+            if not total.is_subset_of(self.node.available):
+                raise PlacementGroupError(
+                    f"placement group infeasible: needs {total.to_dict()}, "
+                    f"available {self.node.available.to_dict()}"
+                )
+            self.node.allocate(total)
+            # ICI-aware chip assignment: STRICT_PACK gets contiguous sub-boxes.
+            if self.topology is not None:
+                for b in bs:
+                    chips = int(b.resources.get(TPU))
+                    if chips:
+                        coords = (
+                            self.topology.allocate_subcube(chips)
+                            if strategy in ("PACK", "STRICT_PACK")
+                            else self.topology.allocate_any(chips)
+                        )
+                        if coords is None:
+                            coords = self.topology.allocate_any(chips) or []
+                        b.chip_coords = coords
+            self._pgs[pg_id] = _PlacementGroup(pg_id, bs, strategy, name)
+        return pg_id
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            pg.state = "removed"
+            total = ResourceSet({})
+            for b in pg.bundles:
+                total = total + b.resources
+                if self.topology is not None and b.chip_coords:
+                    self.topology.release(b.chip_coords)
+            self.node.release(total)
+
+    def placement_group_info(self, pg_id: PlacementGroupID) -> Optional[dict]:
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return None
+            return {
+                "id": pg_id.hex(),
+                "state": pg.state,
+                "strategy": pg.strategy,
+                "bundles": [b.resources.to_dict() for b in pg.bundles],
+                "chip_coords": [b.chip_coords for b in pg.bundles],
+            }
+
+    # -- blocked-worker resource release --------------------------------------
+
+    def task_blocked(self, task_id: TaskID) -> None:
+        with self._lock:
+            rec = self._running.get(task_id)
+            if rec is not None and rec.released_while_blocked == 0:
+                self._release_resources(rec)
+                rec.released_while_blocked += 1
+                self._cv.notify_all()
+
+    def task_unblocked(self, task_id: TaskID) -> None:
+        with self._lock:
+            rec = self._running.get(task_id)
+            if rec is not None and rec.released_while_blocked > 0:
+                rec.released_while_blocked -= 1
+                self._allocate_resources(rec, force=True)
+
+    # -- info -----------------------------------------------------------------
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            return self.node.available.to_dict()
+
+    def cluster_resources(self) -> Dict[str, float]:
+        with self._lock:
+            return self.node.total.to_dict()
+
+    def nodes(self) -> List[dict]:
+        with self._lock:
+            return [{
+                "node_id": self.node_id.hex(),
+                "alive": True,
+                "resources": self.node.total.to_dict(),
+                "available": self.node.available.to_dict(),
+            }]
+
+    def task_events(self) -> List[dict]:
+        with self._lock:
+            return list(self._task_events)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._cv.notify_all()
+            actors = list(self._actors.values())
+        for a in actors:
+            a.kill("shutdown")
+
+    # -- internals ------------------------------------------------------------
+
+    def _get_serialized(self, oid: ObjectID):
+        return self.store.get(oid)
+
+    def _required_resources(self, spec: TaskSpec) -> ResourceSet:
+        return ResourceSet(spec.resources)
+
+    def _on_object_available(self, oid: ObjectID) -> None:
+        with self._lock:
+            waiters = self._waiting_on.pop(oid, None)
+            if not waiters:
+                return
+            for tid in waiters:
+                rec = self._tasks.get(tid)
+                if rec is None or rec.state != "waiting":
+                    continue
+                rec.missing_deps.discard(oid)
+                if not rec.missing_deps:
+                    rec.state = "ready"
+                    self._ready.append(tid)
+            self._cv.notify_all()
+
+    def _bundle_for(self, spec: TaskSpec) -> Optional[_Bundle]:
+        sched = spec.scheduling
+        if sched.kind != SchedulingKind.PLACEMENT_GROUP or sched.pg_id is None:
+            return None
+        pg = self._pgs.get(sched.pg_id)
+        if pg is None:
+            raise PlacementGroupError(f"placement group {sched.pg_id.hex()} gone")
+        if sched.bundle_index >= 0:
+            return pg.bundles[sched.bundle_index]
+        for b in pg.bundles:
+            if b.node.can_fit(ResourceSet(spec.resources)):
+                return b
+        return pg.bundles[0] if pg.bundles else None
+
+    def _try_allocate(self, rec: _TaskRecord) -> bool:
+        bundle = self._bundle_for(rec.spec)
+        if bundle is not None:
+            if bundle.node.can_fit(rec.required):
+                bundle.node.allocate(rec.required)
+                return True
+            return False
+        if self.node.can_fit(rec.required):
+            self.node.allocate(rec.required)
+            return True
+        if not rec.required.is_subset_of(self.node.total):
+            # Infeasible forever — fail fast instead of hanging (the
+            # reference raises after a warning period).
+            err = TaskError.from_exception(
+                rec.spec.name,
+                ValueError(
+                    f"task requires {rec.required.to_dict()} but node total is "
+                    f"{self.node.total.to_dict()}"
+                ),
+            )
+            self.worker._store_error(rec.spec.return_ids(), rec.spec, err)
+            rec.state = "done"
+            return False
+        return False
+
+    def _allocate_resources(self, rec: _TaskRecord, force: bool = False) -> None:
+        bundle = self._bundle_for(rec.spec)
+        target = bundle.node if bundle is not None else self.node
+        target.allocate(rec.required, force=force)
+
+    def _release_resources(self, rec: _TaskRecord) -> None:
+        bundle = self._bundle_for(rec.spec)
+        target = bundle.node if bundle is not None else self.node
+        target.release(rec.required)
+
+    def _dispatch_loop(self):
+        while True:
+            with self._lock:
+                while not self._shutdown and not self._ready:
+                    self._cv.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+                dispatched = []
+                for tid in list(self._ready):
+                    rec = self._tasks.get(tid)
+                    if rec is None or rec.state != "ready":
+                        self._ready.remove(tid)
+                        continue
+                    if self._try_allocate(rec):
+                        self._ready.remove(tid)
+                        rec.state = "running"
+                        self._running[tid] = rec
+                        dispatched.append(rec)
+                    elif rec.state == "done":  # infeasible
+                        self._ready.remove(tid)
+                if not dispatched:
+                    # Nothing fits right now; wait for a release.
+                    self._cv.wait(timeout=0.05)
+            for rec in dispatched:
+                threading.Thread(
+                    target=self._run_task, args=(rec,), daemon=True,
+                    name=f"task-{rec.spec.name[:24]}",
+                ).start()
+
+    def _run_task(self, rec: _TaskRecord):
+        spec = rec.spec
+        self._record_event(spec, "running")
+        if spec.is_actor_creation():
+            with self._lock:
+                runtime = self._actors.get(spec.actor_creation.actor_id)
+                if runtime is None:  # killed before scheduling
+                    self._release_resources(rec)
+                    self._running.pop(spec.task_id, None)
+                    rec.state = "done"
+                    return
+                bundle = self._bundle_for(spec)
+                runtime.alloc_target = bundle.node if bundle else self.node
+            runtime.start()
+            runtime.ready_event.wait()
+            # Resources stay allocated until the actor dies.
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+                rec.state = "done"
+                self._cv.notify_all()
+            self._record_event(spec, "finished")
+            self._after_task(spec)
+            return
+        err = self.worker.execute_task(spec, self._get_serialized,
+                                       store_errors=False)
+        retried = False
+        if err is not None and self._should_retry(rec, err):
+            retried = True
+        elif err is not None:
+            self.worker._store_error(spec.return_ids(), spec, err)
+        with self._lock:
+            self._running.pop(spec.task_id, None)
+            if rec.released_while_blocked == 0:
+                self._release_resources(rec)
+            rec.released_while_blocked = 0
+            if retried:
+                spec.attempt += 1
+                rec.state = "ready"
+                self._running.pop(spec.task_id, None)
+                self._ready.append(spec.task_id)
+            else:
+                rec.state = "done"
+            self._cv.notify_all()
+        self._record_event(spec, "finished" if err is None else "failed")
+        if not retried:
+            self._after_task(spec)
+
+    def _should_retry(self, rec: _TaskRecord, err: BaseException) -> bool:
+        spec = rec.spec
+        if spec.attempt >= spec.max_retries:
+            return False
+        if isinstance(err, TaskCancelledError):
+            return False
+        # User exceptions retry only when opted in (reference:
+        # ``retry_exceptions``); system failures always retry.
+        return bool(spec.retry_exceptions)
+
+    def _after_task(self, spec: TaskSpec):
+        rc = self.worker.reference_counter
+        for arg in spec.args:
+            if arg.kind == ArgKind.REF:
+                ref = ObjectRef.from_binary(arg.data)
+                rc.remove_submitted_task_ref(ref.id)
+        with self._lock:
+            self._tasks.pop(spec.task_id, None)
+
+    def _task_finished(self, spec: TaskSpec):
+        """Called by actor runtimes when an actor task completes."""
+        self._record_event(spec, "finished")
+        self._after_task(spec)
+
+    def _actor_died(self, runtime: _ActorRuntime):
+        with self._lock:
+            self._actors.pop(runtime.actor_id, None)
+            if runtime.name:
+                self._named_actors.pop((runtime.namespace, runtime.name), None)
+            if not runtime.resources.is_empty() and runtime.alloc_target is not None:
+                try:
+                    runtime.alloc_target.release(runtime.resources)
+                except ValueError:
+                    pass
+            self._cv.notify_all()
+
+    def _record_event(self, spec: TaskSpec, state: str):
+        if not cfg.enable_timeline:
+            return
+        with self._lock:
+            self._task_events.append({
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": state,
+                "ts": time.time(),
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            })
+            if len(self._task_events) > cfg.task_events_buffer_size:
+                del self._task_events[: len(self._task_events) // 2]

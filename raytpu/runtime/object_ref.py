@@ -1,0 +1,93 @@
+"""ObjectRef — a first-class future naming an owned object.
+
+Reference analogue: ``python/ray/_raylet.pyx`` ObjectRef + the ownership
+model of ``src/ray/core_worker/reference_count.h:61``: every object has
+exactly one owner (the worker that created it); refs carry the owner's
+address so any holder can resolve value/location through the owner.
+
+Refs participate in distributed reference counting: construction/destruction
+notify the current worker's ReferenceCounter; serializing a ref into a task
+arg or another object registers a borrow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raytpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "_skip_refcount", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: Optional[bytes] = None, *,
+                 _skip_refcount: bool = False):
+        self._id = object_id
+        self._owner = owner  # opaque owner address (worker id binary), None=local
+        self._skip_refcount = _skip_refcount
+        if not _skip_refcount:
+            w = _current_worker()
+            if w is not None:
+                w.reference_counter.add_local_ref(self._id)
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    @property
+    def owner_address(self) -> Optional[bytes]:
+        return self._owner
+
+    def binary(self) -> bytes:
+        return self._id.binary() + (self._owner or b"")
+
+    @classmethod
+    def from_binary(cls, b: bytes) -> "ObjectRef":
+        oid = ObjectID(b[: ObjectID.SIZE])
+        owner = b[ObjectID.SIZE :] or None
+        return cls(oid, owner)
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __del__(self):
+        if not self._skip_refcount:
+            try:  # tolerate interpreter teardown (module globals may be gone)
+                w = _current_worker()
+                if w is not None:
+                    w.reference_counter.remove_local_ref(self._id)
+            except BaseException:
+                pass
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        # Serializing a ref = borrowing it. The serializer records contained
+        # refs; reconstruction on the borrower side registers a local ref.
+        return (ObjectRef, (self._id, self._owner))
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        from raytpu.runtime import api
+
+        result = yield from api._async_get(self).__await__()
+        return result
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the value."""
+        from raytpu.runtime import api
+
+        return api._as_future(self)
+
+
+def _current_worker():
+    from raytpu.runtime import api
+
+    return api._global_worker_or_none()

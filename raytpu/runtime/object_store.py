@@ -1,0 +1,102 @@
+"""In-process object store: the memory-store half of the object plane.
+
+Reference analogue: ``src/ray/core_worker/store_provider/memory_store/`` —
+small objects live in the worker's memory store; large ones go to the
+shared-memory store (our C++ plasma-equivalent in ``src/store/``, bound via
+:mod:`raytpu.runtime.shm_store`). This class fronts both: values under the
+inline threshold stay here; larger values are created in shared memory and
+fetched zero-copy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from raytpu.core.config import cfg
+from raytpu.core.errors import GetTimeoutError
+from raytpu.core.ids import ObjectID
+from raytpu.runtime.serialization import SerializedValue
+
+
+class MemoryStore:
+    """Thread-safe oid → SerializedValue map with blocking gets."""
+
+    def __init__(self, shm=None):
+        self._objects: Dict[ObjectID, SerializedValue] = {}
+        self._cv = threading.Condition()
+        self._shm = shm  # optional SharedMemoryStore for large objects
+        # Called (outside the lock) after each put — the scheduler hooks this
+        # for dependency wakeups (reference: dependency_manager.cc).
+        self.on_put = None
+
+    def put(self, oid: ObjectID, value: SerializedValue) -> None:
+        use_shm = (
+            self._shm is not None
+            and value.total_bytes() > cfg.max_direct_call_object_size
+        )
+        stored = False
+        if use_shm:
+            try:
+                self._shm.put(oid, value)
+                with self._cv:
+                    self._cv.notify_all()
+                stored = True
+            except Exception:
+                pass  # fall back to heap
+        if not stored:
+            with self._cv:
+                self._objects[oid] = value
+                self._cv.notify_all()
+        if self.on_put is not None:
+            self.on_put(oid)
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._cv:
+            if oid in self._objects:
+                return True
+        return self._shm is not None and self._shm.contains(oid)
+
+    def get(self, oid: ObjectID, timeout: Optional[float] = None) -> SerializedValue:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                sv = self._objects.get(oid)
+                if sv is not None:
+                    return sv
+                if self._shm is not None and self._shm.contains(oid):
+                    break  # fetch outside the lock
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"object {oid.hex()} not ready")
+                self._cv.wait(timeout=remaining if remaining is None else min(remaining, 0.5))
+        return self._shm.get(oid)
+
+    def try_get(self, oid: ObjectID) -> Optional[SerializedValue]:
+        with self._cv:
+            sv = self._objects.get(oid)
+        if sv is not None:
+            return sv
+        if self._shm is not None and self._shm.contains(oid):
+            return self._shm.get(oid)
+        return None
+
+    def delete(self, oids: List[ObjectID]) -> None:
+        with self._cv:
+            for oid in oids:
+                self._objects.pop(oid, None)
+        if self._shm is not None:
+            for oid in oids:
+                try:
+                    self._shm.delete(oid)
+                except Exception:
+                    pass
+
+    def size(self) -> int:
+        with self._cv:
+            return len(self._objects)
+
+    def used_bytes(self) -> int:
+        with self._cv:
+            return sum(v.total_bytes() for v in self._objects.values())

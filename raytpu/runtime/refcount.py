@@ -1,0 +1,188 @@
+"""Distributed reference counting — the ownership ledger.
+
+Reference analogue: ``src/ray/core_worker/reference_count.h:61`` (impl 1663
+LoC). Each owned object tracks independent count components (reference
+fields at ``reference_count.h:607-767``):
+
+- ``local_ref_count``   — live Python handles in this process
+- ``submitted_task_ref_count`` — pending tasks using it as an argument
+- ``borrowers``         — remote workers holding a deserialized handle
+- ``stored_in_objects`` — refs serialized inside other owned objects
+- ``lineage_ref_count`` — tasks whose potential resubmission needs it
+
+An object is **out of scope** when the first four are zero; its value may
+then be freed everywhere. Lineage is released separately, enabling
+reconstruction-after-free (reference ``:688``). Out-of-scope callbacks feed
+the store eviction and the owner's pubsub to borrowers.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Set
+
+from raytpu.core.ids import ObjectID, TaskID
+
+
+@dataclass
+class Reference:
+    owner_is_local: bool = True
+    local_ref_count: int = 0
+    submitted_task_ref_count: int = 0
+    borrowers: Set[bytes] = field(default_factory=set)
+    stored_in_objects: Set[ObjectID] = field(default_factory=set)
+    lineage_ref_count: int = 0
+    # The task that created this object, for lineage reconstruction
+    # (reference: task_manager.h:264 resubmit path).
+    creating_task: Optional[TaskID] = None
+    pinned_size: int = 0
+
+    def in_scope(self) -> bool:
+        return (
+            self.local_ref_count > 0
+            or self.submitted_task_ref_count > 0
+            or bool(self.borrowers)
+            or bool(self.stored_in_objects)
+        )
+
+    def fully_released(self) -> bool:
+        return not self.in_scope() and self.lineage_ref_count == 0
+
+
+class ReferenceCounter:
+    """Per-worker ledger over owned + borrowed refs."""
+
+    def __init__(self, on_out_of_scope: Optional[Callable[[ObjectID], None]] = None,
+                 on_lineage_released: Optional[Callable[[ObjectID], None]] = None):
+        self._refs: Dict[ObjectID, Reference] = {}
+        self._lock = threading.RLock()
+        self._on_out_of_scope = on_out_of_scope
+        self._on_lineage_released = on_lineage_released
+
+    # -- registration ---------------------------------------------------------
+
+    def add_owned_object(self, oid: ObjectID, creating_task: Optional[TaskID] = None,
+                         size: int = 0) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(oid, Reference())
+            ref.owner_is_local = True
+            ref.creating_task = creating_task
+            ref.pinned_size = size
+
+    def add_borrowed_object(self, oid: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(oid, Reference())
+            ref.owner_is_local = False
+
+    # -- count components -----------------------------------------------------
+
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, Reference()).local_ref_count += 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        self._mutate(oid, "local_ref_count", -1)
+
+    def add_submitted_task_ref(self, oid: ObjectID) -> None:
+        self._mutate(oid, "submitted_task_ref_count", +1)
+
+    def remove_submitted_task_ref(self, oid: ObjectID) -> None:
+        self._mutate(oid, "submitted_task_ref_count", -1)
+
+    def add_borrower(self, oid: ObjectID, borrower: bytes) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, Reference()).borrowers.add(borrower)
+
+    def remove_borrower(self, oid: ObjectID, borrower: bytes) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            ref.borrowers.discard(borrower)
+            self._maybe_out_of_scope(oid, ref)
+
+    def add_stored_in(self, oid: ObjectID, outer: ObjectID) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, Reference()).stored_in_objects.add(outer)
+
+    def remove_stored_in(self, oid: ObjectID, outer: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            ref.stored_in_objects.discard(outer)
+            self._maybe_out_of_scope(oid, ref)
+
+    def add_lineage_ref(self, oid: ObjectID) -> None:
+        self._mutate(oid, "lineage_ref_count", +1, scope_check=False)
+
+    def remove_lineage_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            ref.lineage_ref_count = max(0, ref.lineage_ref_count - 1)
+            self._maybe_erase(oid, ref)
+
+    # -- queries --------------------------------------------------------------
+
+    def in_scope(self, oid: ObjectID) -> bool:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return ref is not None and ref.in_scope()
+
+    def get(self, oid: ObjectID) -> Optional[Reference]:
+        with self._lock:
+            return self._refs.get(oid)
+
+    def creating_task(self, oid: ObjectID) -> Optional[TaskID]:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return ref.creating_task if ref else None
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def summary(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "tracked": len(self._refs),
+                "in_scope": sum(1 for r in self._refs.values() if r.in_scope()),
+                "pinned_bytes": sum(r.pinned_size for r in self._refs.values()
+                                    if r.in_scope()),
+            }
+
+    # -- internals ------------------------------------------------------------
+
+    def _mutate(self, oid: ObjectID, field_name: str, delta: int,
+                scope_check: bool = True) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                if delta > 0:
+                    ref = self._refs.setdefault(oid, Reference())
+                else:
+                    return
+            setattr(ref, field_name, max(0, getattr(ref, field_name) + delta))
+            if scope_check:
+                self._maybe_out_of_scope(oid, ref)
+
+    def _maybe_out_of_scope(self, oid: ObjectID, ref: Reference) -> None:
+        if not ref.in_scope():
+            if self._on_out_of_scope is not None:
+                try:
+                    self._on_out_of_scope(oid)
+                except Exception:
+                    pass
+            self._maybe_erase(oid, ref)
+
+    def _maybe_erase(self, oid: ObjectID, ref: Reference) -> None:
+        if ref.fully_released():
+            self._refs.pop(oid, None)
+            if self._on_lineage_released is not None:
+                try:
+                    self._on_lineage_released(oid)
+                except Exception:
+                    pass

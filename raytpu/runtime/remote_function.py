@@ -1,0 +1,184 @@
+"""``@raytpu.remote`` machinery for plain functions.
+
+Reference analogue: ``python/ray/remote_function.py:40`` (RemoteFunction,
+``_remote`` at ``:266``) and option validation
+(``python/ray/_private/ray_option_utils.py``). Functions are pickled by
+value (cloudpickle) once and cached; args are serialized with the inline/
+ref split of ``task_spec.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from raytpu.core.config import cfg
+from raytpu.core.ids import TaskID
+from raytpu.core.resources import CPU, TPU
+from raytpu.runtime.object_ref import ObjectRef
+from raytpu.runtime.serialization import serialize
+from raytpu.runtime.task_spec import (
+    ArgKind,
+    SchedulingKind,
+    SchedulingStrategy,
+    TaskArg,
+    TaskSpec,
+)
+
+_VALID_OPTIONS = {
+    "num_cpus", "num_tpus", "num_gpus", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "name", "scheduling_strategy",
+    "placement_group", "placement_group_bundle_index",
+    "placement_group_capture_child_tasks", "runtime_env", "max_restarts",
+    "max_concurrency", "lifetime", "namespace", "max_task_retries",
+    "concurrency_groups", "memory",
+}
+
+
+def validate_options(options: Dict[str, Any]) -> None:
+    bad = set(options) - _VALID_OPTIONS
+    if bad:
+        raise ValueError(f"invalid remote options: {sorted(bad)}")
+
+
+def build_resources(options: Dict[str, Any], default_cpus: float) -> Dict[str, float]:
+    res = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    res[CPU] = default_cpus if num_cpus is None else float(num_cpus)
+    ntpu = options.get("num_tpus") or options.get("num_gpus")  # gpus alias for parity
+    if ntpu:
+        res[TPU] = float(ntpu)
+    if options.get("memory"):
+        res["memory"] = float(options["memory"])
+    return {k: v for k, v in res.items() if v}
+
+
+def build_scheduling(options: Dict[str, Any]) -> SchedulingStrategy:
+    strat = options.get("scheduling_strategy")
+    pg = options.get("placement_group")
+    if pg is not None:
+        from raytpu.runtime.placement_group import PlacementGroup
+
+        if isinstance(pg, PlacementGroup):
+            return SchedulingStrategy(
+                kind=SchedulingKind.PLACEMENT_GROUP,
+                pg_id=pg.id,
+                bundle_index=options.get("placement_group_bundle_index", -1),
+                capture_child_tasks=options.get(
+                    "placement_group_capture_child_tasks", False
+                ),
+            )
+    if strat is None or strat == "DEFAULT":
+        return SchedulingStrategy()
+    if strat == "SPREAD":
+        return SchedulingStrategy(kind=SchedulingKind.SPREAD)
+    if isinstance(strat, SchedulingStrategy):
+        return strat
+    from raytpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if isinstance(strat, PlacementGroupSchedulingStrategy):
+        return SchedulingStrategy(
+            kind=SchedulingKind.PLACEMENT_GROUP,
+            pg_id=strat.placement_group.id,
+            bundle_index=strat.placement_group_bundle_index,
+            capture_child_tasks=strat.placement_group_capture_child_tasks,
+        )
+    if isinstance(strat, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(
+            kind=SchedulingKind.NODE_AFFINITY,
+            node_id=bytes.fromhex(strat.node_id),
+            soft=strat.soft,
+        )
+    raise ValueError(f"unknown scheduling strategy: {strat!r}")
+
+
+def serialize_args(worker, args: tuple, kwargs: Dict[str, Any]):
+    """Top-level ObjectRefs pass as refs; big values are put to the store and
+    passed by ref (reference inline threshold: ray_config_def.h:206).
+
+    Returns (task_args, kwargs_keys, keepalive): `keepalive` holds the
+    ObjectRefs (both caller-supplied and freshly put) and MUST stay alive
+    until the backend has registered submitted-task refs — otherwise a
+    put arg can go out of scope (and be deleted) before submission.
+    """
+    out: List[TaskArg] = []
+    keepalive: List[ObjectRef] = []
+    kw_keys = list(kwargs.keys())
+    for value in list(args) + [kwargs[k] for k in kw_keys]:
+        if isinstance(value, ObjectRef):
+            out.append(TaskArg(ArgKind.REF, value.binary()))
+            keepalive.append(value)
+            continue
+        sv = serialize(value)
+        if sv.total_bytes() > cfg.max_direct_call_object_size:
+            ref = worker.put_object(value)
+            out.append(TaskArg(ArgKind.REF, ref.binary()))
+            keepalive.append(ref)
+        else:
+            out.append(TaskArg(ArgKind.INLINE, sv.to_bytes()))
+    return out, kw_keys, keepalive
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._function = fn
+        self._name = getattr(fn, "__qualname__", str(fn))
+        self._options = dict(options or {})
+        validate_options(self._options)
+        self._pickled: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def _blob(self) -> bytes:
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._function)
+        return self._pickled
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"remote function {self._name} cannot be called directly; use "
+            f"{self._name}.remote() (or .bind() in a DAG)"
+        )
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = {**self._options, **options}
+        rf = RemoteFunction(self._function, merged)
+        rf._pickled = self._pickled
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from raytpu.runtime import api
+
+        worker, backend = api._worker_and_backend()
+        opts = self._options
+        task_args, kw_keys, keepalive = serialize_args(worker, args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=worker.job_id,
+            name=opts.get("name") or self._name,
+            function_blob=self._blob(),
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=opts.get("num_returns", 1),
+            resources=build_resources(opts, default_cpus=1.0),
+            max_retries=opts.get("max_retries", cfg.task_max_retries),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            scheduling=build_scheduling(opts),
+            runtime_env=opts.get("runtime_env"),
+            owner_address=worker.worker_id.binary(),
+        )
+        refs = backend.submit_task(spec)
+        del keepalive  # submitted-task refs are registered now
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs
+
+    def bind(self, *args, **kwargs):
+        """DAG construction (reference: ``python/ray/dag/dag_node.py``)."""
+        from raytpu.dag.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)

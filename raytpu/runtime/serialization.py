@@ -1,0 +1,160 @@
+"""Two-layer serialization: msgpack envelope + cloudpickle payloads.
+
+Reference analogue: ``python/ray/_private/serialization.py`` — msgpack for
+the outer structure (cheap, language-portable), cloudpickle for arbitrary
+Python, with zero-copy out-of-band buffers for numpy/jax arrays (the
+reference uses pickle5 buffer callbacks; same mechanism here). ObjectRefs
+embedded in values are recorded so the owner can track borrowers
+(reference: ``SerializationContext`` ref-serialization hooks).
+
+Wire format: msgpack of
+  {"t": kind, "d": inline-data, "b": [buffer descriptors], "r": [refs]}
+followed by concatenated raw buffers. Numpy arrays (and jax arrays on host)
+ride as raw buffers — deserialization views them without copy.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+import msgpack
+import numpy as np
+
+_KIND_MSGPACK = 0  # plain msgpack-representable
+_KIND_PICKLE = 1  # cloudpickle with out-of-band buffers
+_KIND_NUMPY = 2  # a single ndarray, zero-copy
+_KIND_EXCEPTION = 3  # pickled exception
+
+
+class SerializedValue:
+    """A serialized object: a metadata header plus zero-copy buffers."""
+
+    __slots__ = ("header", "buffers")
+
+    def __init__(self, header: bytes, buffers: List[memoryview]):
+        self.header = header
+        self.buffers = buffers
+
+    def total_bytes(self) -> int:
+        return len(self.header) + sum(b.nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to one contiguous blob: [4-byte header len][header][buffers]."""
+        out = io.BytesIO()
+        out.write(len(self.header).to_bytes(4, "little"))
+        out.write(self.header)
+        for b in self.buffers:
+            out.write(b)
+        return out.getvalue()
+
+    @classmethod
+    def from_buffer(cls, buf) -> "SerializedValue":
+        mv = memoryview(buf)
+        hlen = int.from_bytes(bytes(mv[:4]), "little")
+        header = bytes(mv[4 : 4 + hlen])
+        return cls(header, [mv[4 + hlen :]])
+
+
+def _pack_ndarray(value: np.ndarray) -> Tuple[dict, List[memoryview]]:
+    if not value.flags.c_contiguous:
+        value = np.ascontiguousarray(value)
+    return (
+        {"dtype": value.dtype.str, "shape": list(value.shape)},
+        [memoryview(value).cast("B")],
+    )
+
+
+def serialize(value: Any) -> SerializedValue:
+    """Serialize, extracting contained ObjectRefs (returned inside header)."""
+    from raytpu.runtime.object_ref import ObjectRef
+
+    contained: List[bytes] = []
+
+    if isinstance(value, np.ndarray) and value.dtype != object:
+        meta, buffers = _pack_ndarray(value)
+        header = msgpack.packb({"t": _KIND_NUMPY, "d": meta, "r": []})
+        return SerializedValue(header, buffers)
+
+    # jax arrays → host numpy (single device copy), keep zero-copy onward.
+    if type(value).__module__.startswith("jaxlib") or type(value).__name__ == "ArrayImpl":
+        try:
+            arr = np.asarray(value)
+            meta, buffers = _pack_ndarray(arr)
+            header = msgpack.packb({"t": _KIND_NUMPY, "d": meta, "r": []})
+            return SerializedValue(header, buffers)
+        except Exception:
+            pass
+
+    try:
+        data = msgpack.packb({"t": _KIND_MSGPACK, "d": value, "r": []})
+        return SerializedValue(data, [])
+    except (TypeError, ValueError, OverflowError):
+        pass
+
+    buffers: List[pickle.PickleBuffer] = []
+
+    def _buffer_cb(pb: pickle.PickleBuffer) -> bool:
+        buffers.append(pb)
+        return False  # out-of-band
+
+    payload = cloudpickle.dumps(
+        value, protocol=5, buffer_callback=_buffer_cb
+    )
+    kind = _KIND_EXCEPTION if isinstance(value, BaseException) else _KIND_PICKLE
+    raw = [pb.raw() for pb in buffers]
+    header = msgpack.packb(
+        {
+            "t": kind,
+            "d": payload,
+            "bl": [b.nbytes for b in raw],
+            "r": [r.binary() for r in _find_refs(value, ObjectRef)],
+        }
+    )
+    return SerializedValue(header, [m if m.contiguous else memoryview(bytes(m)) for m in raw])
+
+
+def deserialize(sv: SerializedValue) -> Any:
+    meta = msgpack.unpackb(sv.header)
+    kind = meta["t"]
+    if kind == _KIND_MSGPACK:
+        return meta["d"]
+    if kind == _KIND_NUMPY:
+        d = meta["d"]
+        buf = sv.buffers[0]
+        n = int(np.prod(d["shape"])) * np.dtype(d["dtype"]).itemsize
+        return np.frombuffer(buf[:n], dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+    # pickle kinds: reconstruct out-of-band buffer list by slicing.
+    lens = meta.get("bl", [])
+    bufs: List[memoryview] = []
+    if len(sv.buffers) == len(lens):
+        bufs = list(sv.buffers)
+    elif sv.buffers:
+        mv, off = sv.buffers[0], 0
+        for ln in lens:
+            bufs.append(mv[off : off + ln])
+            off += ln
+    return pickle.loads(meta["d"], buffers=bufs)
+
+
+def contained_refs(sv: SerializedValue) -> List[bytes]:
+    """ObjectRef binaries embedded in this value (for borrower tracking)."""
+    return msgpack.unpackb(sv.header).get("r", [])
+
+
+def _find_refs(value: Any, ref_type, _depth: int = 0) -> list:
+    """Shallow scan for ObjectRefs in common containers (depth-limited)."""
+    if _depth > 3:
+        return []
+    if isinstance(value, ref_type):
+        return [value]
+    out = []
+    if isinstance(value, (list, tuple, set)):
+        for v in value:
+            out.extend(_find_refs(v, ref_type, _depth + 1))
+    elif isinstance(value, dict):
+        for v in value.values():
+            out.extend(_find_refs(v, ref_type, _depth + 1))
+    return out

@@ -1,0 +1,92 @@
+"""Task specifications — the unit of scheduling and execution.
+
+Reference analogue: ``src/ray/common/task/task_spec.h`` (TaskSpecification
+protobuf wrapper). A spec is fully serializable: function payload (pickled
+by value, reference: ``python/ray/_private/function_manager.py``), args
+(small values inline, large ones as refs — reference inline threshold
+``ray_config_def.h:206``), resource request, retry policy, and scheduling
+strategy (plain / placement-group bundle / node affinity).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from raytpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+
+class ArgKind(enum.IntEnum):
+    INLINE = 0  # serialized value carried in the spec
+    REF = 1  # ObjectID to resolve before execution
+
+
+@dataclass
+class TaskArg:
+    kind: ArgKind
+    data: bytes  # SerializedValue.to_bytes() or ObjectRef.binary()
+
+
+class SchedulingKind(enum.IntEnum):
+    DEFAULT = 0  # hybrid pack/spread
+    SPREAD = 1
+    NODE_AFFINITY = 2
+    PLACEMENT_GROUP = 3
+
+
+@dataclass
+class SchedulingStrategy:
+    kind: SchedulingKind = SchedulingKind.DEFAULT
+    node_id: Optional[bytes] = None
+    soft: bool = False
+    pg_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class ActorCreationSpec:
+    actor_id: ActorID
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    name: Optional[str] = None
+    namespace: str = "default"
+    lifetime_detached: bool = False
+    is_async: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    # Cloudpickled callable for plain tasks / actor-creation class; for actor
+    # method calls this is empty and `method_name` is set.
+    function_blob: bytes = b""
+    method_name: str = ""
+    args: List[TaskArg] = field(default_factory=list)
+    kwargs_keys: List[str] = field(default_factory=list)  # trailing args are kwargs
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: Optional[dict] = None
+    # Actor plumbing
+    actor_creation: Optional[ActorCreationSpec] = None
+    actor_id: Optional[ActorID] = None  # set for actor method calls
+    # Ownership
+    owner_address: bytes = b""
+    # Bookkeeping
+    attempt: int = 0
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i)
+                for i in range(self.num_returns)]
+
+    def is_actor_creation(self) -> bool:
+        return self.actor_creation is not None
+
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None

@@ -1,0 +1,219 @@
+"""Worker runtime — task execution and object ownership.
+
+Reference analogue: ``src/ray/core_worker/core_worker.h:291`` (CoreWorker)
+and the Cython execution callback (``python/ray/_raylet.pyx:1721``). The
+Worker owns: the reference counter, the memory/shm store front, arg
+resolution, task execution (deserialize args → call → store returns), and
+error wrapping (user exceptions become stored TaskError values so gets
+raise remotely-thrown errors; reference: RayTaskError plumbing).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from raytpu.core.errors import TaskCancelledError, TaskError
+from raytpu.core.ids import JobID, NodeID, ObjectID, WorkerID, _Counter
+from raytpu.runtime import context as ctx_mod
+from raytpu.runtime.object_ref import ObjectRef
+from raytpu.runtime.object_store import MemoryStore
+from raytpu.runtime.refcount import ReferenceCounter
+from raytpu.runtime.serialization import (
+    SerializedValue,
+    contained_refs,
+    deserialize,
+    serialize,
+)
+from raytpu.runtime.task_spec import ArgKind, TaskSpec
+
+
+class Worker:
+    """The per-process runtime object (one per worker/driver process)."""
+
+    def __init__(self, job_id: JobID, node_id: NodeID, store: MemoryStore):
+        self.worker_id = WorkerID.from_random()
+        self.job_id = job_id
+        self.node_id = node_id
+        self.store = store
+        self.reference_counter = ReferenceCounter(
+            on_out_of_scope=self._on_out_of_scope
+        )
+        self.put_counter = _Counter()
+        self._function_cache: Dict[bytes, Callable] = {}
+        self._cancelled: set = set()
+        self._cancel_lock = threading.Lock()
+
+    # -- ownership ------------------------------------------------------------
+
+    def _on_out_of_scope(self, oid: ObjectID) -> None:
+        self.store.delete([oid])
+
+    def put_object(self, value: Any, oid: Optional[ObjectID] = None,
+                   creating_task=None) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() on an ObjectRef is disallowed (same as reference)")
+        sv = serialize(value)
+        if oid is None:
+            oid = ObjectID.for_put(self.worker_id, self.put_counter.next())
+        self.reference_counter.add_owned_object(
+            oid, creating_task=creating_task, size=sv.total_bytes()
+        )
+        for rb in contained_refs(sv):
+            inner = ObjectRef.from_binary(rb)
+            self.reference_counter.add_stored_in(inner.id, oid)
+        self.store.put(oid, sv)
+        return ObjectRef(oid, owner=self.worker_id.binary())
+
+    def put_serialized(self, oid: ObjectID, sv: SerializedValue,
+                       creating_task=None) -> None:
+        self.reference_counter.add_owned_object(
+            oid, creating_task=creating_task, size=sv.total_bytes()
+        )
+        self.store.put(oid, sv)
+
+    # -- cancellation ---------------------------------------------------------
+
+    def cancel(self, task_id) -> None:
+        with self._cancel_lock:
+            self._cancelled.add(task_id)
+
+    def is_cancelled(self, task_id) -> bool:
+        with self._cancel_lock:
+            return task_id in self._cancelled
+
+    # -- execution ------------------------------------------------------------
+
+    def load_function(self, blob: bytes) -> Callable:
+        fn = self._function_cache.get(blob)
+        if fn is None:
+            fn = cloudpickle.loads(blob)
+            self._function_cache[blob] = fn
+        return fn
+
+    def resolve_args(self, spec: TaskSpec,
+                     get_fn: Callable[[ObjectID], SerializedValue]):
+        """Deserialize inline args; fetch + deserialize top-level refs.
+
+        Reference semantics: only *top-level* ObjectRef args are resolved to
+        values; refs nested inside structures pass through as refs.
+        """
+        values: List[Any] = []
+        for arg in spec.args:
+            if arg.kind == ArgKind.REF:
+                ref = ObjectRef.from_binary(arg.data)
+                sv = get_fn(ref.id)
+                val = deserialize(sv)
+                if isinstance(val, TaskError):
+                    raise val
+                values.append(val)
+            else:
+                values.append(deserialize(SerializedValue.from_buffer(arg.data)))
+        nkw = len(spec.kwargs_keys)
+        if nkw:
+            pos, kwvals = values[:-nkw], values[-nkw:]
+            kwargs = dict(zip(spec.kwargs_keys, kwvals))
+        else:
+            pos, kwargs = values, {}
+        return pos, kwargs
+
+    def execute_task(self, spec: TaskSpec,
+                     get_fn: Callable[[ObjectID], SerializedValue],
+                     actor_instance: Any = None,
+                     store_errors: bool = True) -> Optional[BaseException]:
+        """Run one task; store each return slot. Returns the error, if any.
+
+        All outcomes (including user exceptions) are *stored* into the return
+        objects so that any holder of the refs observes them — the reference
+        stores RayTaskError values the same way (``task_manager.cc``
+        ``MarkTaskReturnObjectsFailed``).
+        """
+        return_ids = spec.return_ids()
+        if self.is_cancelled(spec.task_id):
+            err = TaskCancelledError(f"task {spec.name} cancelled")
+            self._store_error(return_ids, spec, err)
+            return err
+        _maybe_store = (self._store_error if store_errors
+                        else (lambda *a, **k: None))
+
+        old_ctx = ctx_mod.current()
+        new_ctx = ctx_mod.RuntimeContext(
+            job_id=self.job_id,
+            node_id=self.node_id,
+            task_id=spec.task_id,
+            actor_id=spec.actor_id
+            or (spec.actor_creation.actor_id if spec.actor_creation else None),
+            placement_group_id=(spec.scheduling.pg_id.binary()
+                                if spec.scheduling.pg_id else None),
+            attempt=spec.attempt,
+        )
+        ctx_mod.set_current(new_ctx)
+        try:
+            args, kwargs = self.resolve_args(spec, get_fn)
+            if spec.is_actor_task():
+                method = getattr(actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+            else:
+                fn = self.load_function(spec.function_blob)
+                result = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — must capture everything
+            err = e if isinstance(e, TaskError) else TaskError.from_exception(
+                spec.name, e
+            )
+            _maybe_store(return_ids, spec, err)
+            return err
+        finally:
+            ctx_mod.set_current(old_ctx)
+
+        if spec.num_returns == 1:
+            results = [result]
+        elif spec.num_returns == 0:
+            results = []
+        else:
+            results = list(result) if result is not None else []
+            if len(results) != spec.num_returns:
+                err = TaskError.from_exception(
+                    spec.name,
+                    ValueError(
+                        f"expected {spec.num_returns} returns, got {len(results)}"
+                    ),
+                )
+                _maybe_store(return_ids, spec, err)
+                return err
+        for oid, value in zip(return_ids, results):
+            if isinstance(value, ObjectRef):
+                # Returning a ref forwards it; store a marker value.
+                self.put_serialized(oid, serialize(value), creating_task=spec.task_id)
+            else:
+                self.put_serialized(
+                    oid, serialize(value), creating_task=spec.task_id
+                )
+        return None
+
+    def _store_error(self, return_ids, spec: TaskSpec, err: BaseException) -> None:
+        sv = serialize(err)
+        for oid in return_ids:
+            self.put_serialized(oid, sv, creating_task=spec.task_id)
+
+    def create_actor_instance(self, spec: TaskSpec,
+                              get_fn) -> Any:
+        """Instantiate the actor class from an actor-creation spec (raises on
+        user error — caller stores the error)."""
+        cls = self.load_function(spec.function_blob)
+        args, kwargs = self.resolve_args(spec, get_fn)
+        old_ctx = ctx_mod.current()
+        ctx_mod.set_current(
+            ctx_mod.RuntimeContext(
+                job_id=self.job_id,
+                node_id=self.node_id,
+                task_id=spec.task_id,
+                actor_id=spec.actor_creation.actor_id,
+                attempt=spec.attempt,
+            )
+        )
+        try:
+            return cls(*args, **kwargs)
+        finally:
+            ctx_mod.set_current(old_ctx)

@@ -1,0 +1,13 @@
+from raytpu.util.actor_pool import ActorPool
+from raytpu.util.queue import Queue
+from raytpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "ActorPool",
+    "Queue",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

@@ -1,0 +1,113 @@
+"""Distributed queue backed by an actor (reference:
+``python/ray/util/queue.py``)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+def _make_queue_actor(maxsize: int):
+    import raytpu
+
+    @raytpu.remote(num_cpus=0)
+    class _QueueActor:
+        def __init__(self, maxsize: int):
+            import collections
+
+            self._maxsize = maxsize
+            self._q = collections.deque()
+
+        def put(self, item) -> bool:
+            if self._maxsize > 0 and len(self._q) >= self._maxsize:
+                return False
+            self._q.append(item)
+            return True
+
+        def get(self):
+            if not self._q:
+                return False, None
+            return True, self._q.popleft()
+
+        def qsize(self) -> int:
+            return len(self._q)
+
+        def empty(self) -> bool:
+            return not self._q
+
+        def full(self) -> bool:
+            return self._maxsize > 0 and len(self._q) >= self._maxsize
+
+    return _QueueActor.remote(maxsize)
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        self._actor = _make_queue_actor(maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        import raytpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok = raytpu.get(self._actor.put.remote(item))
+            if ok:
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full()
+            time.sleep(0.01)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        import raytpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = raytpu.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty()
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        import raytpu
+
+        return raytpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        import raytpu
+
+        return raytpu.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        import raytpu
+
+        return raytpu.get(self._actor.full.remote())
+
+    def put_batch(self, items: List[Any]) -> None:
+        for item in items:
+            self.put(item)
+
+    def shutdown(self) -> None:
+        import raytpu
+
+        raytpu.kill(self._actor)

@@ -1,0 +1,39 @@
+"""Test harness config.
+
+JAX tests run on a virtual 8-device CPU mesh (the analogue of the
+reference's fake-GPU / fake-multinode strategy, SURVEY.md §4): XLA is
+forced to expose 8 host devices so every sharding/collective path compiles
+and executes without TPU hardware. Must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def raytpu_local():
+    """A fresh single-process fabric per test (reference fixture analogue:
+    ``ray_start_regular``, ``python/ray/tests/conftest.py:412``)."""
+    import raytpu
+
+    raytpu.shutdown()
+    raytpu.init(num_cpus=4)
+    yield raytpu
+    raytpu.shutdown()
+
+
+@pytest.fixture
+def raytpu_local_tpu():
+    """Fabric with 8 fake TPU chips for topology-aware scheduling tests."""
+    import raytpu
+
+    raytpu.shutdown()
+    raytpu.init(num_cpus=4, num_tpus=8)
+    yield raytpu
+    raytpu.shutdown()

@@ -1,0 +1,210 @@
+"""Core substrate unit tests: ids, config, resources, topology, refcount,
+serialization (reference test analogues: ``src/ray/common/test/``,
+``reference_count_test.cc``)."""
+
+import numpy as np
+import pytest
+
+from raytpu.core.config import cfg
+from raytpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from raytpu.core.resources import CPU, TPU, NodeResources, ResourceSet
+from raytpu.core.topology import SliceType, TpuTopology
+from raytpu.runtime.refcount import ReferenceCounter
+from raytpu.runtime.serialization import SerializedValue, deserialize, serialize
+
+
+class TestIDs:
+    def test_roundtrip(self):
+        t = TaskID.from_random()
+        assert TaskID.from_hex(t.hex()) == t
+        assert t != TaskID.from_random()
+
+    def test_deterministic_return_ids(self):
+        t = TaskID.from_random()
+        assert ObjectID.for_task_return(t, 0) == ObjectID.for_task_return(t, 0)
+        assert ObjectID.for_task_return(t, 0) != ObjectID.for_task_return(t, 1)
+
+    def test_put_ids_unique(self):
+        w = WorkerID.from_random()
+        assert ObjectID.for_put(w, 1) != ObjectID.for_put(w, 2)
+
+    def test_nil(self):
+        assert ActorID.nil().is_nil()
+        assert not ActorID.from_random().is_nil()
+
+
+class TestConfig:
+    def test_defaults_and_set(self):
+        assert cfg.scheduler_spread_threshold == 0.5
+        assert cfg.max_direct_call_object_size == 100 * 1024
+        cfg.set("task_max_retries", 5)
+        assert cfg.task_max_retries == 5
+        cfg.set("task_max_retries", 3)
+
+    def test_snapshot_roundtrip(self):
+        blob = cfg.snapshot()
+        cfg.set("health_check_period_ms", 123)
+        cfg.load_snapshot(blob)
+        assert cfg.health_check_period_ms == 1000
+
+    def test_unknown_knob(self):
+        with pytest.raises(AttributeError):
+            cfg.nonexistent_knob
+
+
+class TestResources:
+    def test_fixed_point(self):
+        r = ResourceSet({CPU: 0.1})
+        total = ResourceSet({})
+        for _ in range(10):
+            total = total + r
+        assert total == ResourceSet({CPU: 1.0})
+
+    def test_subset_and_sub(self):
+        node = NodeResources(ResourceSet({CPU: 4, TPU: 8}))
+        req = ResourceSet({CPU: 2, TPU: 4})
+        assert node.can_fit(req)
+        node.allocate(req)
+        assert node.available.get(TPU) == 4
+        assert not node.can_fit(ResourceSet({TPU: 5}))
+        node.release(req)
+        assert node.available.get(CPU) == 4
+
+    def test_negative_raises(self):
+        with pytest.raises(ValueError):
+            ResourceSet({CPU: 2}) - ResourceSet({CPU: 3})
+
+    def test_force_allocate_oversubscribes(self):
+        node = NodeResources(ResourceSet({CPU: 1}))
+        node.allocate(ResourceSet({CPU: 1}))
+        node.allocate(ResourceSet({CPU: 1}), force=True)
+        assert node.available.get(CPU) == -1
+        node.release(ResourceSet({CPU: 1}))
+        node.release(ResourceSet({CPU: 1}))
+        assert node.available.get(CPU) == 1
+
+    def test_utilization(self):
+        node = NodeResources(ResourceSet({CPU: 4, TPU: 8}))
+        node.allocate(ResourceSet({TPU: 6}))
+        assert node.utilization() == pytest.approx(0.75)
+
+
+class TestTopology:
+    def test_slice_type_parse(self):
+        st = SliceType.parse("v4-32")
+        assert st.chips == 16 and st.hosts == 4
+        st = SliceType.parse("v5e-16")
+        assert st.chips == 16
+
+    def test_contiguous_subcube(self):
+        topo = TpuTopology(shape=(4, 4))
+        a = topo.allocate_subcube(4)
+        assert a is not None and len(a) == 4
+        # 2x2 box: max coordinate spread along each axis must be <=1
+        xs = {c[0] for c in a}
+        ys = {c[1] for c in a}
+        assert max(xs) - min(xs) <= 1 and max(ys) - min(ys) <= 1
+
+    def test_exhaustion_and_release(self):
+        topo = TpuTopology(shape=(2, 2))
+        a = topo.allocate_subcube(4)
+        assert a is not None
+        assert topo.allocate_subcube(1) is None
+        topo.release(a)
+        assert topo.allocate_subcube(2) is not None
+
+    def test_fragmented_falls_back(self):
+        topo = TpuTopology(shape=(2, 2))
+        got = topo.allocate_any(3)
+        assert got is not None and len(got) == 3
+        # no contiguous box of 2 exists in the remaining 1 chip
+        assert topo.allocate_subcube(2) is None
+        assert topo.allocate_any(1) is not None
+
+
+class TestRefCount:
+    def test_scope_lifecycle(self):
+        freed = []
+        rc = ReferenceCounter(on_out_of_scope=freed.append)
+        oid = ObjectID.from_random()
+        rc.add_owned_object(oid)
+        rc.add_local_ref(oid)
+        rc.add_submitted_task_ref(oid)
+        rc.remove_local_ref(oid)
+        assert rc.in_scope(oid)
+        rc.remove_submitted_task_ref(oid)
+        assert not rc.in_scope(oid)
+        assert freed == [oid]
+
+    def test_borrowers_keep_alive(self):
+        rc = ReferenceCounter()
+        oid = ObjectID.from_random()
+        rc.add_owned_object(oid)
+        rc.add_local_ref(oid)
+        rc.add_borrower(oid, b"worker-2")
+        rc.remove_local_ref(oid)
+        assert rc.in_scope(oid)
+        rc.remove_borrower(oid, b"worker-2")
+        assert not rc.in_scope(oid)
+
+    def test_stored_in_objects(self):
+        rc = ReferenceCounter()
+        inner, outer = ObjectID.from_random(), ObjectID.from_random()
+        rc.add_owned_object(inner)
+        rc.add_local_ref(inner)
+        rc.add_stored_in(inner, outer)
+        rc.remove_local_ref(inner)
+        assert rc.in_scope(inner)
+        rc.remove_stored_in(inner, outer)
+        assert not rc.in_scope(inner)
+
+    def test_lineage_outlives_scope(self):
+        released = []
+        rc = ReferenceCounter(on_lineage_released=released.append)
+        oid = ObjectID.from_random()
+        rc.add_owned_object(oid)
+        rc.add_local_ref(oid)
+        rc.add_lineage_ref(oid)
+        rc.remove_local_ref(oid)
+        assert not rc.in_scope(oid)
+        assert rc.get(oid) is not None  # still tracked for lineage
+        rc.remove_lineage_ref(oid)
+        assert rc.get(oid) is None
+        assert released == [oid]
+
+
+class TestSerialization:
+    def test_msgpack_fast_path(self):
+        for v in [1, "x", [1, 2, {"a": b"bytes"}], None, True]:
+            sv = serialize(v)
+            assert deserialize(sv) == v
+
+    def test_numpy_zero_copy(self):
+        x = np.arange(1024, dtype=np.float32).reshape(32, 32)
+        sv = serialize(x)
+        y = deserialize(SerializedValue.from_buffer(sv.to_bytes()))
+        np.testing.assert_array_equal(x, y)
+        assert y.dtype == np.float32
+
+    def test_arbitrary_object(self):
+        class Thing:
+            def __init__(self, v):
+                self.v = v
+
+        sv = serialize(Thing(42))
+        out = deserialize(SerializedValue.from_buffer(sv.to_bytes()))
+        assert out.v == 42
+
+    def test_exception_roundtrip(self):
+        from raytpu.core.errors import TaskError
+
+        err = TaskError("f", "trace")
+        out = deserialize(serialize(err))
+        assert isinstance(out, TaskError)
+
+    def test_large_pickle_buffers(self):
+        x = {"a": np.ones((100, 100)), "b": np.zeros(7)}
+        sv = serialize(x)
+        out = deserialize(SerializedValue.from_buffer(sv.to_bytes()))
+        np.testing.assert_array_equal(out["a"], x["a"])
+        np.testing.assert_array_equal(out["b"], x["b"])
