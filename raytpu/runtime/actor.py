@@ -25,6 +25,17 @@ from raytpu.runtime.remote_function import (
 from raytpu.runtime.task_spec import ActorCreationSpec, TaskSpec
 
 
+def method_meta_from_class(cls: type) -> Dict[str, int]:
+    """Public-method table shared by ActorClass.remote and get_actor (one
+    source of truth for which names a handle exposes)."""
+    meta = {}
+    for name, member in inspect.getmembers(cls):
+        if name.startswith("__") or not callable(member):
+            continue
+        meta[name] = getattr(member, "_num_returns", 1)
+    return meta
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
                  num_returns: int = 1):
@@ -85,7 +96,8 @@ class ActorHandle:
         from raytpu.runtime import api
 
         worker, backend = api._worker_and_backend()
-        task_args, kw_keys, keepalive = serialize_args(worker, args, kwargs)
+        task_args, kw_keys, keepalive, inline_refs = serialize_args(
+            worker, args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=worker.job_id,
@@ -93,6 +105,7 @@ class ActorHandle:
             method_name=method_name,
             args=task_args,
             kwargs_keys=kw_keys,
+            inline_refs=inline_refs,
             num_returns=num_returns,
             actor_id=self._actor_id,
             owner_address=worker.worker_id.binary(),
@@ -149,12 +162,7 @@ class ActorClass:
         return ac
 
     def _method_meta(self) -> Dict[str, int]:
-        meta = {}
-        for name, member in inspect.getmembers(self._cls):
-            if name.startswith("__") or not callable(member):
-                continue
-            meta[name] = getattr(member, "_num_returns", 1)
-        return meta
+        return method_meta_from_class(self._cls)
 
     def _is_async(self) -> bool:
         return any(
@@ -168,7 +176,8 @@ class ActorClass:
         worker, backend = api._worker_and_backend()
         opts = self._options
         actor_id = ActorID.from_random()
-        task_args, kw_keys, keepalive = serialize_args(worker, args, kwargs)
+        task_args, kw_keys, keepalive, inline_refs = serialize_args(
+            worker, args, kwargs)
         lifetime = opts.get("lifetime")
         max_conc = opts.get("max_concurrency") or (1000 if self._is_async() else 1)
         spec = TaskSpec(
@@ -178,6 +187,7 @@ class ActorClass:
             function_blob=self._blob(),
             args=task_args,
             kwargs_keys=kw_keys,
+            inline_refs=inline_refs,
             num_returns=1,
             resources=build_resources(opts, default_cpus=0.0),
             max_retries=0,

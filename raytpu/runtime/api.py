@@ -251,12 +251,10 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     actor_id, creation_spec = backend.get_actor_handle_info(name, namespace)
     import cloudpickle
 
+    from raytpu.runtime.actor import method_meta_from_class
+
     cls = cloudpickle.loads(creation_spec.function_blob)
-    meta = {}
-    for mname in dir(cls):
-        if not mname.startswith("_") and callable(getattr(cls, mname, None)):
-            meta[mname] = getattr(getattr(cls, mname), "_num_returns", 1)
-    return ActorHandle(actor_id, meta)
+    return ActorHandle(actor_id, method_meta_from_class(cls))
 
 
 # -- introspection ------------------------------------------------------------

@@ -90,6 +90,7 @@ class _ActorRuntime:
         self.namespace = spec.actor_creation.namespace
         self.detached = spec.actor_creation.lifetime_detached
         self.queue: "queue.Queue" = queue.Queue()
+        self.state_lock = threading.Lock()  # guards dead + queue transitions
         self.dead = False
         self.death_reason = ""
         self.instance = None
@@ -106,11 +107,14 @@ class _ActorRuntime:
         self.thread.start()
 
     def submit(self, spec: TaskSpec):
-        if self.dead:
-            err = ActorDiedError(self.actor_id.hex(), self.death_reason)
-            self.backend.worker._store_error(spec.return_ids(), spec, err)
-            return
-        self.queue.put(spec)
+        with self.state_lock:
+            if not self.dead:
+                self.queue.put(spec)
+                return
+            reason = self.death_reason
+        self.backend._fail_spec(
+            spec, ActorDiedError(self.actor_id.hex(), reason)
+        )
 
     def kill(self, reason: str = "killed via raytpu.kill"):
         if self.dead:
@@ -175,10 +179,14 @@ class _ActorRuntime:
         asyncio.set_event_loop(loop)
         sem = asyncio.Semaphore(self.max_concurrency)
         stop = loop.create_future()
+        inflight: dict = {}
 
         async def handle(spec: TaskSpec):
-            async with sem:
-                await self._execute_async(spec)
+            try:
+                async with sem:
+                    await self._execute_async(spec)
+            finally:
+                inflight.pop(spec.task_id, None)
 
         async def pump():
             while True:
@@ -186,10 +194,17 @@ class _ActorRuntime:
                 if isinstance(item, tuple) and item[0] == "__kill__":
                     stop.set_result(item[1])
                     return
+                inflight[item.task_id] = item
                 asyncio.ensure_future(handle(item))
 
         loop.create_task(pump())
         reason = loop.run_until_complete(stop)
+        # Fail anything still in flight before abandoning the loop — their
+        # return objects must observe the death (finding: async kill hang).
+        for spec in list(inflight.values()):
+            self.backend._fail_spec(
+                spec, ActorDiedError(self.actor_id.hex(), reason)
+            )
         loop.close()
         self._die(reason)
 
@@ -231,18 +246,19 @@ class _ActorRuntime:
         self.backend._task_finished(spec)
 
     def _die(self, reason: str):
-        self.dead = True
-        self.death_reason = reason
-        # Fail everything still queued.
-        while True:
-            try:
-                item = self.queue.get_nowait()
-            except queue.Empty:
-                break
+        with self.state_lock:
+            self.dead = True
+            self.death_reason = reason
+            drained = []
+            while True:
+                try:
+                    drained.append(self.queue.get_nowait())
+                except queue.Empty:
+                    break
+        for item in drained:
             if isinstance(item, TaskSpec):
-                self.backend.worker._store_error(
-                    item.return_ids(), item,
-                    ActorDiedError(self.actor_id.hex(), reason),
+                self.backend._fail_spec(
+                    item, ActorDiedError(self.actor_id.hex(), reason)
                 )
         self.backend._actor_died(self)
 
@@ -305,6 +321,9 @@ class LocalBackend:
                     if not self.store.contains(ref.id):
                         missing.add(ref.id)
                         self._waiting_on.setdefault(ref.id, set()).add(spec.task_id)
+            for rb in spec.inline_refs:
+                self.worker.reference_counter.add_submitted_task_ref(
+                    ObjectRef.from_binary(rb).id)
             rec = _TaskRecord(spec=spec, required=required, missing_deps=missing)
             self._tasks[spec.task_id] = rec
             if not missing:
@@ -341,11 +360,14 @@ class LocalBackend:
             if arg.kind == ArgKind.REF:
                 ref = ObjectRef.from_binary(arg.data)
                 self.worker.reference_counter.add_submitted_task_ref(ref.id)
+        for rb in spec.inline_refs:
+            self.worker.reference_counter.add_submitted_task_ref(
+                ObjectRef.from_binary(rb).id)
         with self._lock:
             actor = self._actors.get(spec.actor_id)
         if actor is None:
-            err = ActorDiedError(spec.actor_id.hex(), "actor not found or dead")
-            self.worker._store_error(spec.return_ids(), spec, err)
+            self._fail_spec(spec, ActorDiedError(
+                spec.actor_id.hex(), "actor not found or dead"))
             return refs
         # Wait for creation to finish off-thread; ordering is preserved by
         # the actor queue itself (reference: sequence numbers in
@@ -677,10 +699,18 @@ class LocalBackend:
         rc = self.worker.reference_counter
         for arg in spec.args:
             if arg.kind == ArgKind.REF:
-                ref = ObjectRef.from_binary(arg.data)
-                rc.remove_submitted_task_ref(ref.id)
+                rc.remove_submitted_task_ref(ObjectRef.from_binary(arg.data).id)
+        for rb in spec.inline_refs:
+            rc.remove_submitted_task_ref(ObjectRef.from_binary(rb).id)
         with self._lock:
             self._tasks.pop(spec.task_id, None)
+
+    def _fail_spec(self, spec: TaskSpec, err: BaseException):
+        """Store an error into a spec's return objects AND release its
+        submitted-arg refs (every failed-without-running path must end
+        here, or arg objects leak pinned forever)."""
+        self.worker._store_error(spec.return_ids(), spec, err)
+        self._after_task(spec)
 
     def _task_finished(self, spec: TaskSpec):
         """Called by actor runtimes when an actor task completes."""

@@ -69,8 +69,12 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
-        # Serializing a ref = borrowing it. The serializer records contained
-        # refs; reconstruction on the borrower side registers a local ref.
+        # Serializing a ref = borrowing it. An active serialize() call
+        # captures the containment exactly (any depth); reconstruction on
+        # the borrower side registers a local ref.
+        from raytpu.runtime.serialization import capture_ref
+
+        capture_ref(self.binary())
         return (ObjectRef, (self._id, self._owner))
 
     # Allow `await ref` inside async actors.

@@ -141,6 +141,20 @@ class ReferenceCounter:
             ref = self._refs.get(oid)
             return ref.creating_task if ref else None
 
+    def is_unreferenced(self, oid: ObjectID) -> bool:
+        """True when nothing (scope or lineage) tracks this object — the
+        stored value can be deleted. Erases a dangling zero-count entry.
+        Guards the fire-and-forget case: a return ref dropped before the
+        task completes must not pin the stored result forever."""
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return True
+            if ref.fully_released():
+                self._refs.pop(oid, None)
+                return True
+            return False
+
     def num_tracked(self) -> int:
         with self._lock:
             return len(self._refs)

@@ -18,7 +18,7 @@ from raytpu.core.config import cfg
 from raytpu.core.ids import TaskID
 from raytpu.core.resources import CPU, TPU
 from raytpu.runtime.object_ref import ObjectRef
-from raytpu.runtime.serialization import serialize
+from raytpu.runtime.serialization import contained_refs, serialize
 from raytpu.runtime.task_spec import (
     ArgKind,
     SchedulingKind,
@@ -108,6 +108,7 @@ def serialize_args(worker, args: tuple, kwargs: Dict[str, Any]):
     """
     out: List[TaskArg] = []
     keepalive: List[ObjectRef] = []
+    inline_refs: List[bytes] = []
     kw_keys = list(kwargs.keys())
     for value in list(args) + [kwargs[k] for k in kw_keys]:
         if isinstance(value, ObjectRef):
@@ -121,7 +122,10 @@ def serialize_args(worker, args: tuple, kwargs: Dict[str, Any]):
             keepalive.append(ref)
         else:
             out.append(TaskArg(ArgKind.INLINE, sv.to_bytes()))
-    return out, kw_keys, keepalive
+            for rb in contained_refs(sv):
+                inline_refs.append(rb)
+                keepalive.append(ObjectRef.from_binary(rb))
+    return out, kw_keys, keepalive, inline_refs
 
 
 class RemoteFunction:
@@ -155,7 +159,8 @@ class RemoteFunction:
 
         worker, backend = api._worker_and_backend()
         opts = self._options
-        task_args, kw_keys, keepalive = serialize_args(worker, args, kwargs)
+        task_args, kw_keys, keepalive, inline_refs = serialize_args(
+            worker, args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=worker.job_id,
@@ -163,6 +168,7 @@ class RemoteFunction:
             function_blob=self._blob(),
             args=task_args,
             kwargs_keys=kw_keys,
+            inline_refs=inline_refs,
             num_returns=opts.get("num_returns", 1),
             resources=build_resources(opts, default_cpus=1.0),
             max_retries=opts.get("max_retries", cfg.task_max_retries),

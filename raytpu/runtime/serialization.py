@@ -17,11 +17,24 @@ from __future__ import annotations
 
 import io
 import pickle
+import threading
 from typing import Any, List, Tuple
 
 import cloudpickle
 import msgpack
 import numpy as np
+
+# Active ref-capture context: while a serialize() call is pickling, every
+# ObjectRef.__reduce__ appends its binary here — exact containment tracking
+# at any nesting depth (the reference registers contained refs through its
+# serializer hooks the same way).
+_capture_tls = threading.local()
+
+
+def capture_ref(binary: bytes) -> None:
+    refs = getattr(_capture_tls, "refs", None)
+    if refs is not None:
+        refs.append(binary)
 
 _KIND_MSGPACK = 0  # plain msgpack-representable
 _KIND_PICKLE = 1  # cloudpickle with out-of-band buffers
@@ -100,9 +113,15 @@ def serialize(value: Any) -> SerializedValue:
         buffers.append(pb)
         return False  # out-of-band
 
-    payload = cloudpickle.dumps(
-        value, protocol=5, buffer_callback=_buffer_cb
-    )
+    prev = getattr(_capture_tls, "refs", None)
+    _capture_tls.refs = []
+    try:
+        payload = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=_buffer_cb
+        )
+        captured = _capture_tls.refs
+    finally:
+        _capture_tls.refs = prev
     kind = _KIND_EXCEPTION if isinstance(value, BaseException) else _KIND_PICKLE
     raw = [pb.raw() for pb in buffers]
     header = msgpack.packb(
@@ -110,7 +129,7 @@ def serialize(value: Any) -> SerializedValue:
             "t": kind,
             "d": payload,
             "bl": [b.nbytes for b in raw],
-            "r": [r.binary() for r in _find_refs(value, ObjectRef)],
+            "r": captured,
         }
     )
     return SerializedValue(header, [m if m.contiguous else memoryview(bytes(m)) for m in raw])
@@ -144,17 +163,3 @@ def contained_refs(sv: SerializedValue) -> List[bytes]:
     return msgpack.unpackb(sv.header).get("r", [])
 
 
-def _find_refs(value: Any, ref_type, _depth: int = 0) -> list:
-    """Shallow scan for ObjectRefs in common containers (depth-limited)."""
-    if _depth > 3:
-        return []
-    if isinstance(value, ref_type):
-        return [value]
-    out = []
-    if isinstance(value, (list, tuple, set)):
-        for v in value:
-            out.extend(_find_refs(v, ref_type, _depth + 1))
-    elif isinstance(value, dict):
-        for v in value.values():
-            out.extend(_find_refs(v, ref_type, _depth + 1))
-    return out

@@ -67,6 +67,10 @@ class TaskSpec:
     method_name: str = ""
     args: List[TaskArg] = field(default_factory=list)
     kwargs_keys: List[str] = field(default_factory=list)  # trailing args are kwargs
+    # ObjectRef binaries nested *inside* inline args — pinned via
+    # submitted-task refs for the task's duration (reference: contained refs
+    # in RayObject metadata).
+    inline_refs: List[bytes] = field(default_factory=list)
     num_returns: int = 1
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 0

@@ -72,7 +72,15 @@ class Worker:
         self.reference_counter.add_owned_object(
             oid, creating_task=creating_task, size=sv.total_bytes()
         )
+        for rb in contained_refs(sv):
+            inner = ObjectRef.from_binary(rb)
+            self.reference_counter.add_stored_in(inner.id, oid)
         self.store.put(oid, sv)
+        # Fire-and-forget: if every handle to this return object was dropped
+        # before the task finished, nothing will ever trigger deletion — free
+        # it now.
+        if self.reference_counter.is_unreferenced(oid):
+            self.store.delete([oid])
 
     # -- cancellation ---------------------------------------------------------
 
@@ -183,13 +191,9 @@ class Worker:
                 _maybe_store(return_ids, spec, err)
                 return err
         for oid, value in zip(return_ids, results):
-            if isinstance(value, ObjectRef):
-                # Returning a ref forwards it; store a marker value.
-                self.put_serialized(oid, serialize(value), creating_task=spec.task_id)
-            else:
-                self.put_serialized(
-                    oid, serialize(value), creating_task=spec.task_id
-                )
+            # A returned ObjectRef is stored as a value; get() resolves the
+            # indirection one level (api.get).
+            self.put_serialized(oid, serialize(value), creating_task=spec.task_id)
         return None
 
     def _store_error(self, return_ids, spec: TaskSpec, err: BaseException) -> None:

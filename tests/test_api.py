@@ -3,6 +3,7 @@
 ``test_actor*.py``, ``test_placement_group*.py``)."""
 
 import time
+import raytpu.runtime.api
 
 import numpy as np
 import pytest
@@ -488,3 +489,83 @@ class TestIntrospection:
         trace = raytpu.timeline()
         assert len(trace) >= 3
         assert all(ev["ph"] == "X" for ev in trace)
+
+
+class TestRefCounting:
+    """Regression tests for ownership-ledger bugs found in review."""
+
+    def test_nested_ref_in_inline_arg_pinned(self, raytpu_local):
+        raytpu = raytpu_local
+
+        @raytpu.remote
+        def use_list(lst):
+            import raytpu as r
+
+            return r.get(lst[0])
+
+        x = raytpu.put("payload")
+        ref = use_list.remote([x])
+        del x  # only the inline-arg containment keeps it alive
+        assert raytpu.get(ref, timeout=10) == "payload"
+
+    def test_deeply_nested_ref_in_put_pinned(self, raytpu_local):
+        raytpu = raytpu_local
+        inner = raytpu.put("deep")
+        outer = raytpu.put([[[[inner]]]])
+        del inner
+        got = raytpu.get(outer)
+        assert raytpu.get(got[0][0][0][0], timeout=10) == "deep"
+
+    def test_fire_and_forget_returns_freed(self, raytpu_local):
+        raytpu = raytpu_local
+
+        @raytpu.remote
+        def produce():
+            return "x" * 1000
+
+        for _ in range(10):
+            produce.remote()  # discard refs immediately
+        import time as _t
+
+        _t.sleep(1.0)
+        backend = raytpu.runtime.api._backend_or_none()
+        # All return objects must have been freed from the store.
+        assert backend.store.size() <= 2
+
+    def test_async_actor_kill_fails_inflight(self, raytpu_local):
+        raytpu = raytpu_local
+        import time as _t
+
+        @raytpu.remote
+        class Slow:
+            async def slow(self):
+                import asyncio
+
+                await asyncio.sleep(30)
+
+        a = Slow.remote()
+        ref = a.slow.remote()
+        _t.sleep(0.3)  # let it get in flight
+        raytpu.kill(a)
+        with pytest.raises(raytpu.ActorDiedError):
+            raytpu.get(ref, timeout=10)
+
+    def test_dead_actor_submit_releases_arg_refs(self, raytpu_local):
+        raytpu = raytpu_local
+        import time as _t
+
+        @raytpu.remote
+        class A:
+            def m(self, x):
+                return x
+
+        a = A.remote()
+        raytpu.get(a.m.remote(1))
+        raytpu.kill(a)
+        _t.sleep(0.3)
+        big = raytpu.put("pinned?")
+        with pytest.raises(raytpu.ActorDiedError):
+            raytpu.get(a.m.remote(big), timeout=10)
+        worker = raytpu.runtime.api._global_worker_or_none()
+        rec = worker.reference_counter.get(big.id)
+        assert rec is not None and rec.submitted_task_ref_count == 0
