@@ -423,8 +423,10 @@ class LocalBackend:
                 rec.state = "done"
                 if task_id in self._ready:
                     self._ready.remove(task_id)
-                err = TaskCancelledError(f"task {rec.spec.name} cancelled")
-                self.worker._store_error(rec.spec.return_ids(), rec.spec, err)
+                self._fail_spec(
+                    rec.spec,
+                    TaskCancelledError(f"task {rec.spec.name} cancelled"),
+                )
 
     # -- placement groups -----------------------------------------------------
 
@@ -587,14 +589,13 @@ class LocalBackend:
         if not rec.required.is_subset_of(self.node.total):
             # Infeasible forever — fail fast instead of hanging (the
             # reference raises after a warning period).
-            err = TaskError.from_exception(
+            self._fail_spec(rec.spec, TaskError.from_exception(
                 rec.spec.name,
                 ValueError(
                     f"task requires {rec.required.to_dict()} but node total is "
                     f"{self.node.total.to_dict()}"
                 ),
-            )
-            self.worker._store_error(rec.spec.return_ids(), rec.spec, err)
+            ))
             rec.state = "done"
             return False
         return False

@@ -117,7 +117,7 @@ def serialize_args(worker, args: tuple, kwargs: Dict[str, Any]):
             continue
         sv = serialize(value)
         if sv.total_bytes() > cfg.max_direct_call_object_size:
-            ref = worker.put_object(value)
+            ref = worker.put_object(value, sv=sv)  # no second pickle pass
             out.append(TaskArg(ArgKind.REF, ref.binary()))
             keepalive.append(ref)
         else:

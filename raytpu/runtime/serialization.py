@@ -45,7 +45,7 @@ _KIND_EXCEPTION = 3  # pickled exception
 class SerializedValue:
     """A serialized object: a metadata header plus zero-copy buffers."""
 
-    __slots__ = ("header", "buffers")
+    __slots__ = ("header", "buffers", "__weakref__")
 
     def __init__(self, header: bytes, buffers: List[memoryview]):
         self.header = header
@@ -82,9 +82,7 @@ def _pack_ndarray(value: np.ndarray) -> Tuple[dict, List[memoryview]]:
 
 def serialize(value: Any) -> SerializedValue:
     """Serialize, extracting contained ObjectRefs (returned inside header)."""
-    from raytpu.runtime.object_ref import ObjectRef
-
-    contained: List[bytes] = []
+    from raytpu.runtime.object_ref import ObjectRef  # noqa: F401 (capture hook)
 
     if isinstance(value, np.ndarray) and value.dtype != object:
         meta, buffers = _pack_ndarray(value)

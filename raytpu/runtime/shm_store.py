@@ -1,0 +1,192 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+Reference analogue: the plasma client (``src/ray/object_manager/plasma/
+client.cc``) — but our store is a passive shm arena (see
+``src/store/shm_store.cc`` header comment), so the "client" is just the
+mapping plus a handful of O(1) calls. Reads are zero-copy: ``get`` returns
+a SerializedValue whose buffer is a memoryview into the mapping, pinned by
+the store refcount until the view is garbage collected.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import weakref
+from typing import Optional
+
+from raytpu.core.errors import ObjectStoreFullError
+from raytpu.core.ids import ObjectID
+from raytpu.runtime.serialization import SerializedValue
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "_native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libshmstore.so")
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    "src", "store", "shm_store.cc",
+)
+
+
+def _ensure_built() -> str:
+    if os.path.exists(_LIB_PATH) and (
+        not os.path.exists(_SRC)
+        or os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
+    ):
+        return _LIB_PATH
+    os.makedirs(_NATIVE_DIR, exist_ok=True)
+    subprocess.run(
+        ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", _LIB_PATH,
+         _SRC, "-lpthread", "-lrt"],
+        check=True, capture_output=True,
+    )
+    return _LIB_PATH
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_ensure_built())
+        lib.shm_store_open.restype = ctypes.c_void_p
+        lib.shm_store_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int]
+        lib.shm_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shm_store_create.restype = ctypes.c_int64
+        lib.shm_store_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_delete.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.shm_store_used_bytes.restype = ctypes.c_uint64
+        lib.shm_store_used_bytes.argtypes = [ctypes.c_void_p]
+        lib.shm_store_capacity.restype = ctypes.c_uint64
+        lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_store_num_objects.restype = ctypes.c_uint64
+        lib.shm_store_num_objects.argtypes = [ctypes.c_void_p]
+        lib.shm_store_fd.restype = ctypes.c_int
+        lib.shm_store_fd.argtypes = [ctypes.c_void_p]
+        lib.shm_store_map_size.restype = ctypes.c_uint64
+        lib.shm_store_map_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class SharedMemoryStore:
+    """One node's shared-memory arena (create on the daemon, attach from
+    workers by name)."""
+
+    def __init__(self, capacity: int = 1 << 30, name: Optional[str] = None,
+                 create: bool = True, table_slots: int = 1 << 16):
+        lib = _load()
+        self.name = name or f"/raytpu-store-{os.getpid()}"
+        self._lib = lib
+        self._handle = lib.shm_store_open(
+            self.name.encode(), capacity, table_slots, 1 if create else 0
+        )
+        if not self._handle:
+            raise ObjectStoreFullError(
+                f"failed to open shm store {self.name} (capacity={capacity})"
+            )
+        self._owner = create
+        # A Python-side mmap view of the same segment for zero-copy reads.
+        fd = lib.shm_store_fd(self._handle)
+        self._map = mmap.mmap(fd, lib.shm_store_map_size(self._handle))
+        self._mv = memoryview(self._map)
+        self._closed = False
+
+    # -- object plane ---------------------------------------------------------
+
+    def put(self, oid: ObjectID, value: SerializedValue) -> None:
+        blob_len = 4 + len(value.header) + sum(b.nbytes for b in value.buffers)
+        off = self._lib.shm_store_create(self._handle, oid.binary(), blob_len)
+        if off < 0:
+            raise ObjectStoreFullError(
+                f"shm store cannot fit object of {blob_len} bytes "
+                f"(used {self.used_bytes()}/{self.capacity()})"
+            )
+        dst = self._mv[off : off + blob_len]
+        hl = len(value.header)
+        dst[:4] = hl.to_bytes(4, "little")
+        dst[4 : 4 + hl] = value.header
+        pos = 4 + hl
+        for b in value.buffers:
+            dst[pos : pos + b.nbytes] = b.cast("B") if b.format != "B" else b
+            pos += b.nbytes
+        if self._lib.shm_store_seal(self._handle, oid.binary()) != 0:
+            raise ObjectStoreFullError("seal failed")
+
+    def get(self, oid: ObjectID) -> SerializedValue:
+        off = ctypes.c_int64()
+        size = ctypes.c_uint64()
+        rc = self._lib.shm_store_get(
+            self._handle, oid.binary(), ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc != 0:
+            raise KeyError(f"object {oid.hex()} not in shm store")
+        view = self._mv[off.value : off.value + size.value]
+        sv = SerializedValue.from_buffer(view)
+        # Keep the object pinned while any deserialized view is alive.
+        lib, handle, key = self._lib, self._handle, oid.binary()
+        weakref.finalize(sv, _release, lib, handle, key)
+        return sv
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.shm_store_contains(self._handle, oid.binary()))
+
+    def delete(self, oid: ObjectID, force: bool = False) -> bool:
+        return self._lib.shm_store_delete(
+            self._handle, oid.binary(), 1 if force else 0) == 0
+
+    # -- stats ----------------------------------------------------------------
+
+    def used_bytes(self) -> int:
+        return self._lib.shm_store_used_bytes(self._handle)
+
+    def capacity(self) -> int:
+        return self._lib.shm_store_capacity(self._handle)
+
+    def num_objects(self) -> int:
+        return self._lib.shm_store_num_objects(self._handle)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mv.release()
+            self._map.close()
+        except (BufferError, ValueError):
+            pass  # live zero-copy views; the OS cleans the mapping on exit
+        self._lib.shm_store_close(
+            self._handle, 1 if (self._owner if unlink is None else unlink) else 0
+        )
+        self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except BaseException:
+            pass
+
+
+def _release(lib, handle, key: bytes) -> None:
+    try:
+        lib.shm_store_release(handle, key)
+    except BaseException:
+        pass
+
+
+def attach(name: str) -> SharedMemoryStore:
+    """Attach to an existing segment created by another process."""
+    return SharedMemoryStore(capacity=0, name=name, create=False)

@@ -49,13 +49,28 @@ class Worker:
     # -- ownership ------------------------------------------------------------
 
     def _on_out_of_scope(self, oid: ObjectID) -> None:
+        self._delete_object(oid)
+
+    def _delete_object(self, oid: ObjectID) -> None:
+        """Delete a stored value AND drop the stored_in edges it holds on
+        contained refs (the pairing for add_stored_in — without it, refs
+        inside deleted objects stay pinned forever)."""
+        sv = self.store.try_get(oid)
+        if sv is not None:
+            try:
+                for rb in contained_refs(sv):
+                    inner = ObjectRef.from_binary(rb)
+                    self.reference_counter.remove_stored_in(inner.id, oid)
+            except Exception:
+                pass
         self.store.delete([oid])
 
     def put_object(self, value: Any, oid: Optional[ObjectID] = None,
-                   creating_task=None) -> ObjectRef:
+                   creating_task=None, sv=None) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("put() on an ObjectRef is disallowed (same as reference)")
-        sv = serialize(value)
+        if sv is None:
+            sv = serialize(value)
         if oid is None:
             oid = ObjectID.for_put(self.worker_id, self.put_counter.next())
         self.reference_counter.add_owned_object(
@@ -78,9 +93,9 @@ class Worker:
         self.store.put(oid, sv)
         # Fire-and-forget: if every handle to this return object was dropped
         # before the task finished, nothing will ever trigger deletion — free
-        # it now.
+        # it now (including the stored_in edges just added).
         if self.reference_counter.is_unreferenced(oid):
-            self.store.delete([oid])
+            self._delete_object(oid)
 
     # -- cancellation ---------------------------------------------------------
 

@@ -1,0 +1,120 @@
+"""C++ shared-memory store tests (reference analogue:
+``src/ray/object_manager/plasma/test/``)."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from raytpu.core.errors import ObjectStoreFullError
+from raytpu.core.ids import ObjectID
+from raytpu.runtime.serialization import deserialize, serialize
+from raytpu.runtime.shm_store import SharedMemoryStore, attach
+
+
+@pytest.fixture
+def store():
+    s = SharedMemoryStore(capacity=16 * 1024 * 1024,
+                          name=f"/raytpu-test-{os.getpid()}")
+    yield s
+    s.close(unlink=True)
+
+
+class TestShmStore:
+    def test_put_get_roundtrip(self, store):
+        oid = ObjectID.from_random()
+        x = np.arange(10000, dtype=np.float64)
+        store.put(oid, serialize(x))
+        out = deserialize(store.get(oid))
+        np.testing.assert_array_equal(out, x)
+
+    def test_zero_copy_read(self, store):
+        oid = ObjectID.from_random()
+        x = np.ones(100000, dtype=np.float32)
+        store.put(oid, serialize(x))
+        out = deserialize(store.get(oid))
+        # The array data must point into the shared mapping, not a copy.
+        assert not out.flags.owndata
+
+    def test_contains_delete(self, store):
+        oid = ObjectID.from_random()
+        assert not store.contains(oid)
+        store.put(oid, serialize({"k": 1}))
+        assert store.contains(oid)
+        assert store.delete(oid)
+        assert not store.contains(oid)
+
+    def test_duplicate_put_fails(self, store):
+        oid = ObjectID.from_random()
+        store.put(oid, serialize(1))
+        with pytest.raises(ObjectStoreFullError):
+            store.put(oid, serialize(2))
+
+    def test_lru_eviction(self, store):
+        # Fill beyond capacity with unpinned objects: oldest must be evicted.
+        big = np.zeros(1024 * 1024, dtype=np.uint8)  # 1 MiB each
+        oids = []
+        for i in range(30):  # 30 MiB into a 16 MiB store
+            oid = ObjectID.from_random()
+            store.put(oid, serialize(big))
+            oids.append(oid)
+        assert store.contains(oids[-1])
+        assert not store.contains(oids[0])  # evicted
+        assert store.used_bytes() <= store.capacity()
+
+    def test_pinned_objects_survive_eviction(self, store):
+        oid = ObjectID.from_random()
+        data = np.arange(262144, dtype=np.uint8)
+        store.put(oid, serialize(data))
+        pin = store.get(oid)  # pinned by live SerializedValue
+        big = np.zeros(1024 * 1024, dtype=np.uint8)
+        for _ in range(30):
+            store.put(ObjectID.from_random(), serialize(big))
+        assert store.contains(oid)
+        np.testing.assert_array_equal(deserialize(pin), data)
+
+    def test_store_full_of_pinned_raises(self, store):
+        pins = []
+        big = np.zeros(4 * 1024 * 1024, dtype=np.uint8)
+        with pytest.raises(ObjectStoreFullError):
+            for _ in range(10):
+                oid = ObjectID.from_random()
+                store.put(oid, serialize(big))
+                pins.append(store.get(oid))
+
+    def test_free_list_coalescing(self, store):
+        # Alloc/free cycles must not leak (fragmentation bounded).
+        data = np.zeros(512 * 1024, dtype=np.uint8)
+        for _ in range(100):
+            oid = ObjectID.from_random()
+            store.put(oid, serialize(data))
+            assert store.delete(oid)
+        assert store.used_bytes() == 0
+
+
+def _child_writes(name, oid_bin, q):
+    s = attach(name)
+    x = np.full(1000, 7, dtype=np.int64)
+    s.put(ObjectID(oid_bin), serialize(x))
+    s.close(unlink=False)
+    q.put("done")
+
+
+class TestCrossProcess:
+    def test_child_writes_parent_reads(self):
+        name = f"/raytpu-xproc-{os.getpid()}"
+        store = SharedMemoryStore(capacity=8 * 1024 * 1024, name=name)
+        try:
+            oid = ObjectID.from_random()
+            ctx = mp.get_context("spawn")
+            q = ctx.Queue()
+            p = ctx.Process(target=_child_writes, args=(name, oid.binary(), q))
+            p.start()
+            assert q.get(timeout=60) == "done"
+            p.join(timeout=30)
+            assert store.contains(oid)
+            out = deserialize(store.get(oid))
+            assert out.sum() == 7000
+        finally:
+            store.close(unlink=True)
