@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops (guide: /opt/skills/guides/pallas_guide.md).
+
+The reference has no kernel layer (torch/CUDA own it); here the compute
+plane is ours, so the ops that dominate the profile get hand-tiled MXU/VMEM
+kernels with jnp fallbacks everywhere else.
+"""
+
+from raytpu.ops.flash_attention import flash_attention
+from raytpu.ops.fused import rmsnorm, swiglu
+
+__all__ = ["flash_attention", "rmsnorm", "swiglu"]

@@ -1,0 +1,241 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise-stable softmax with O(T) memory: Q blocks stream from HBM into
+VMEM via the grid; each program visits all K/V blocks of its row with a
+`fori_loop`, keeping running max / denominator / output accumulator in
+registers. Matmuls hit the MXU in fp32 accumulation
+(``preferred_element_type``); the causal upper triangle is skipped
+per-block (fully-masked blocks contribute nothing and early-out via
+`pl.when`-style predication).
+
+Backward uses recompute (flash-style): residuals are just (q, k, v, o,
+lse); gradients are computed with the reference einsum formulation — fused
+backward kernels are a later-round optimization. On non-TPU platforms the
+reference jnp path runs instead (tests compare the kernel in interpret
+mode against it).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# -- reference path (also the backward) --------------------------------------
+
+
+def _attn_fwd_reference(q, k, v, causal: bool, sm_scale: float):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        s = jnp.where(mask[None, None], s, -1e30)
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - lse)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+def _attn_bwd_reference(q, k, v, o, lse, g, causal: bool, sm_scale: float):
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    gf = g.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jnp.exp(s - lse)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# -- pallas kernel ------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
+                  sm_scale: float, block_k: int, t_kv: int):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [Bq, D]
+
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_start = iq * block_q
+    n_kb = t_kv // block_k
+
+    def body(jk, carry):
+        m, l, acc = carry
+        k_start = jk * block_k
+        kb = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    if causal:
+        # Only blocks with k_start <= q_end contribute.
+        n_visit = jnp.minimum((q_start + block_q + block_k - 1) // block_k,
+                              n_kb)
+    else:
+        n_visit = n_kb
+    m, l, acc = lax.fori_loop(0, n_visit, body, (m0, l0, o0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+
+
+def _flash_forward_pallas(q, k, v, causal: bool, sm_scale: float,
+                          block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, t_q, d)
+    k3 = k.reshape(bh, t_kv, d)
+    v3 = v.reshape(bh, t_kv, d)
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    if t_q % block_q or t_kv % block_k:
+        raise ValueError(
+            f"sequence lengths ({t_q}, {t_kv}) must be divisible by blocks "
+            f"({block_q}, {block_k})")
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, sm_scale=sm_scale,
+        block_k=block_k, t_kv=t_kv)
+
+    kwargs = {}
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+        vmem = pltpu.VMEM
+        any_space = getattr(pltpu, "ANY", None) or pl.ANY
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0),
+                         memory_space=any_space),
+            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0),
+                         memory_space=any_space),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((1, block_q, 1), lambda ib, iq: (ib, iq, 0),
+                         memory_space=vmem),
+        ]
+    else:
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0)),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda ib, iq: (ib, iq, 0)),
+        ]
+
+    o3, lse3 = pl.pallas_call(
+        kernel,
+        grid=(bh, t_q // block_q),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q3, k3, v3)
+    return (o3.reshape(b, h, t_q, d),
+            lse3.reshape(b, h, t_q, 1))
+
+
+# -- public op with custom vjp ------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, use_pallas):
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, use_pallas)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, use_pallas):
+    if use_pallas == "tpu":
+        o, lse = _flash_forward_pallas(q, k, v, causal, sm_scale,
+                                       DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                       interpret=False)
+    elif use_pallas == "interpret":
+        o, lse = _flash_forward_pallas(q, k, v, causal, sm_scale,
+                                       DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                       interpret=True)
+    else:
+        o, lse = _attn_fwd_reference(q, k, v, causal, sm_scale)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, use_pallas, res, g):
+    q, k, v, o, lse = res
+    return _attn_bwd_reference(q, k, v, o, lse, g, causal, sm_scale)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    force: Optional[str] = None):
+    """Flash attention on [B, H, T, D].
+
+    `force`: None (auto: pallas on TPU, reference elsewhere), "tpu",
+    "interpret" (pallas interpreter — tests), or "reference".
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if force is None:
+        mode = "tpu" if _on_tpu() else "reference"
+    else:
+        mode = {"tpu": "tpu", "interpret": "interpret",
+                "reference": "reference"}[force]
+    return _flash(q, k, v, causal, sm_scale, mode)

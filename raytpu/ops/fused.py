@@ -1,0 +1,70 @@
+"""Small fused elementwise kernels.
+
+XLA fuses most elementwise chains into adjacent matmuls on its own; these
+exist for the cases where the fusion boundary hurts (norm → matmul) and as
+the pattern template for later kernels. jnp fallback off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _rmsnorm_ref(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps) * scale).astype(
+        x.dtype)
+
+
+def _rmsnorm_kernel(x_ref, s_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(var + eps) * s_ref[:]).astype(o_ref.dtype)
+
+
+def rmsnorm(x, scale, *, eps: float = 1e-6, force: str = None):
+    """RMSNorm over the last dim. x: [..., D]; scale: [D]."""
+    mode = force or ("tpu" if _on_tpu() else "reference")
+    if mode == "reference":
+        return _rmsnorm_ref(x, scale, eps)
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    shape = x.shape
+    d = shape[-1]
+    rows = int(jnp.prod(jnp.array(shape[:-1]))) if len(shape) > 1 else 1
+    x2 = x.reshape(rows, d)
+    block = min(256, rows) or 1
+    pad = (-rows) % block
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    kwargs = {"interpret": mode == "interpret"}
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=(x2.shape[0] // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        **kwargs,
+    )(x2, scale)
+    if pad:
+        out = out[:rows]
+    return out.reshape(shape)
+
+
+def swiglu(x, w_gate, w_up):
+    """SwiGLU gate: silu(x @ w_gate) * (x @ w_up) — left to XLA fusion (it
+    fuses the elementwise tail into the two matmuls already)."""
+    return jax.nn.silu(x @ w_gate) * (x @ w_up)

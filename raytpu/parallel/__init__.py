@@ -1,0 +1,45 @@
+"""Parallelism strategies as mesh-axis annotations.
+
+Where the reference delegates model parallelism to torch.distributed (DDP
+wrap in ``python/ray/train/torch/train_loop_utils.py:158``, NCCL process
+groups in ``torch/config.py:65``), here every strategy is a sharding over a
+named `jax.sharding.Mesh` axis and the collectives are XLA programs riding
+ICI (SURVEY.md §2.5, §5):
+
+- **dp**    data parallel (batch sharding, psum gradients)
+- **fsdp**  fully-sharded data parallel (params sharded over dp ranks,
+            all-gathered per layer by XLA)
+- **tp**    tensor parallel (Megatron-style column/row kernel splits)
+- **pp**    pipeline parallel (stage loop with collective_permute)
+- **sp**    sequence/context parallel (ring attention / Ulysses all_to_all)
+- **ep**    expert parallel (MoE dispatch via all_to_all)
+"""
+
+from raytpu.parallel.mesh import MeshSpec, build_mesh, mesh_from_devices
+from raytpu.parallel.sharding import (
+    ShardingRules,
+    TRANSFORMER_RULES,
+    logical_sharding,
+    shard_params,
+    shard_batch,
+)
+from raytpu.parallel.ring_attention import ring_attention
+from raytpu.parallel.ulysses import ulysses_attention
+from raytpu.parallel.pipeline import pipeline_stage_loop
+from raytpu.parallel.moe import MoELayer, moe_dispatch
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "mesh_from_devices",
+    "ShardingRules",
+    "TRANSFORMER_RULES",
+    "logical_sharding",
+    "shard_params",
+    "shard_batch",
+    "ring_attention",
+    "ulysses_attention",
+    "pipeline_stage_loop",
+    "MoELayer",
+    "moe_dispatch",
+]

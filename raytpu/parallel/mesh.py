@@ -1,0 +1,95 @@
+"""Device-mesh construction over ICI topology.
+
+The mesh is the TPU-native replacement for the reference's collective
+groups (``python/ray/util/collective/collective.py:120`` group creation):
+instead of rendezvous + NCCL communicators, placement decides *which chips*
+and the mesh axes decide *which collectives ride which ICI dimension*.
+Placement-group bundles carry physical chip coordinates
+(:mod:`raytpu.core.topology`), so a PG bundle maps 1:1 onto a mesh whose
+ICI-adjacent axes get the bandwidth-hungry collectives (fsdp/tp) and whose
+outermost axis (dp, possibly spanning DCN) gets the cheap ones.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+STANDARD_AXES = ("dp", "fsdp", "pp", "sp", "tp", "ep")
+
+
+@dataclass
+class MeshSpec:
+    """Named axes → sizes. Size -1 means "absorb remaining devices"."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def resolved(self, n_devices: int) -> Dict[str, int]:
+        axes = {k: v for k, v in self.axes.items() if v != 1 or k in ("dp",)}
+        if not axes:
+            axes = {"dp": -1}
+        wild = [k for k, v in axes.items() if v == -1]
+        fixed = math.prod(v for v in axes.values() if v != -1)
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"mesh axes {axes} do not divide {n_devices} devices"
+            )
+        if len(wild) > 1:
+            raise ValueError("at most one axis may be -1")
+        if wild:
+            axes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes {axes} use {fixed} devices, have {n_devices}"
+            )
+        return axes
+
+    def build(self, devices: Optional[Sequence] = None):
+        return build_mesh(self.axes, devices)
+
+
+def build_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
+    """Create a `jax.sharding.Mesh` with named axes.
+
+    Axis order follows insertion order; put the slowest-varying (DCN-ish)
+    axis first — JAX assigns devices contiguously, and contiguous device
+    ranges on real TPU slices are ICI-adjacent, so the *innermost* axes get
+    the best ICI locality (where tp/fsdp collectives live).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    resolved = MeshSpec(dict(axes)).resolved(len(devices))
+    shape = tuple(resolved.values())
+    names = tuple(resolved.keys())
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def mesh_from_devices(devices: Optional[Sequence] = None, *,
+                      dp: int = -1, fsdp: int = 1, pp: int = 1, sp: int = 1,
+                      tp: int = 1, ep: int = 1):
+    """Convenience: standard axis order dp → fsdp → pp → sp → tp → ep."""
+    axes = {}
+    for name, size in (("dp", dp), ("fsdp", fsdp), ("pp", pp), ("sp", sp),
+                       ("tp", tp), ("ep", ep)):
+        if size != 1 or name == "dp":
+            axes[name] = size
+    return build_mesh(axes, devices)
+
+
+def mesh_from_chip_coords(coords: List[Tuple[int, ...]],
+                          axes: Dict[str, int], devices: Sequence):
+    """Build a mesh over the devices standing at the given physical chip
+    coordinates (from a placement-group bundle), ordered so that mesh-axis
+    neighbors are ICI neighbors (coordinates sorted lexicographically =
+    gray-code-ish walk along the box)."""
+    order = sorted(range(len(coords)), key=lambda i: coords[i])
+    ordered = [devices[i] for i in order]
+    return build_mesh(axes, ordered)

@@ -1,0 +1,117 @@
+"""Expert parallelism — dense-dispatch MoE over the ``ep`` axis.
+
+Absent from the reference (SURVEY.md §2.5: EP "Absent"). TPU-native
+design: top-1 gating with capacity, einsum dispatch (dense one-hot
+routing — the TPU-friendly formulation: MXU-shaped, static shapes, no
+scatter), `all_to_all` over ``ep`` so each device runs only its local
+experts, and the transposed einsum to combine. Everything is
+differentiable; gate gradients flow through the combine weights.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def moe_dispatch(x, gate_logits, expert_fn: Callable, *,
+                 num_experts: int, capacity_factor: float = 1.25,
+                 axis_name: str = "ep"):
+    """Inside shard_map. x: [T_local, D]; gate_logits: [T_local, E].
+
+    expert_fn(idx_local, xs) -> ys applies this device's expert
+    `idx_local` to xs [capacity_total, D].
+    """
+    n_dev = lax.psum(1, axis_name)
+    t_local, d = x.shape
+    e = num_experts
+    if e % n_dev != 0:
+        raise ValueError(f"num_experts {e} not divisible by ep size {n_dev}")
+    e_local = e // n_dev
+    capacity = max(1, int(capacity_factor * t_local / e))
+
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)  # [T]
+    gate_val = jnp.max(gates, axis=-1)  # [T]
+
+    # Position of each token within its expert's buffer.
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E], -1 elsewhere
+    pos_in_expert = jnp.sum(pos, axis=-1).astype(jnp.int32)  # [T]
+    keep = (pos_in_expert < capacity).astype(jnp.float32)
+
+    # Dense dispatch tensor [T, E, C].
+    pos_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :]
+    dispatch = dispatch * keep[:, None, None]
+    combine = dispatch * gate_val[:, None, None]
+
+    # Route: [T,E,C] x [T,D] -> [E,C,D]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # all_to_all over ep: device j gets every device's buffers for ITS
+    # local experts. [n, e_local, C, D] --a2a--> [e_local, n, C, D]
+    # (split axis 0 consumed; sources stacked at concat position).
+    expert_in = lax.all_to_all(
+        expert_in.reshape(n_dev, e_local, capacity, d),
+        axis_name, split_axis=0, concat_axis=1, tiled=False,
+    )
+    expert_in = expert_in.reshape(e_local, n_dev * capacity, d)
+
+    outs = []
+    for le in range(e_local):
+        outs.append(expert_fn(le, expert_in[le]))
+    expert_out = jnp.stack(outs)  # [e_local, n*C, D]
+
+    # Reverse route: send each source's chunk back home.
+    # [e_local, n, C, D] --a2a--> [n, e_local, C, D] -> [E, C, D]
+    expert_out = expert_out.reshape(e_local, n_dev, capacity, d)
+    expert_out = lax.all_to_all(
+        expert_out, axis_name, split_axis=1, concat_axis=0, tiled=False,
+    )
+    expert_out = expert_out.reshape(e, capacity, d)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.astype(x.dtype)
+
+
+class MoELayer:
+    """Functional MoE layer: params = {gate: [D,E], wi: [E,D,F], wo: [E,F,D]}.
+
+    Use inside shard_map with experts sharded over `ep` (each device holds
+    its e_local slices of wi/wo)."""
+
+    def __init__(self, num_experts: int, capacity_factor: float = 1.25,
+                 axis_name: str = "ep",
+                 activation: Callable = jax.nn.gelu):
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.axis_name = axis_name
+        self.activation = activation
+
+    def init(self, key, d_model: int, d_ff: int, e_local: int):
+        k1, k2, k3 = jax.random.split(key, 3)
+        scale = d_model ** -0.5
+        return {
+            "gate": jax.random.normal(k1, (d_model, self.num_experts)) * scale,
+            "wi": jax.random.normal(k2, (e_local, d_model, d_ff)) * scale,
+            "wo": jax.random.normal(k3, (e_local, d_ff, d_model)) * (d_ff ** -0.5),
+        }
+
+    def __call__(self, params, x):
+        """x: [T_local, D] inside shard_map."""
+        gate_logits = x.astype(jnp.float32) @ params["gate"].astype(jnp.float32)
+
+        def expert_fn(le, xs):
+            h = self.activation(xs @ params["wi"][le].astype(jnp.float32))
+            return h @ params["wo"][le].astype(jnp.float32)
+
+        return moe_dispatch(
+            x, gate_logits, expert_fn,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+            axis_name=self.axis_name,
+        )

@@ -1,0 +1,107 @@
+"""Pipeline parallelism as a compiled stage loop over the ``pp`` axis.
+
+The reference's answer to pipelines is host-side: compiled DAGs with
+pre-allocated channels between actors (``python/ray/dag/compiled_dag_node.py:174``,
+``python/ray/experimental/channel.py:51``) — microsecond-scale host hops.
+On TPU the pipeline belongs *inside* the XLA program: every stage is one
+device's shard of the layer stack, activations hop stages with
+`collective_permute` on ICI, and the whole schedule (GPipe fill/drain) is
+a `lax.fori_loop` the compiler can overlap. Differentiable, so training
+backprops through the pipeline transfer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from raytpu.parallel.ring_attention import _vary
+
+
+def pipeline_stage_loop(stage_fn: Callable, stage_params, microbatches,
+                        *, axis_name: str = "pp"):
+    """Run a GPipe-style pipeline inside shard_map.
+
+    stage_fn(params, x) -> y: ONE stage's computation (this device's shard).
+    stage_params: this device's stage parameters.
+    microbatches: [n_micro, ...] — the full input, present on stage 0
+      (other stages ignore their copy).
+
+    Returns [n_micro, ...] outputs, valid on the LAST stage (zeros
+    elsewhere) — psum or ppermute afterwards if other stages need them.
+    Schedule: n_micro + n_stages - 1 ticks (fill + steady + drain).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    x0 = jnp.zeros_like(microbatches[0])
+    y0 = stage_fn(stage_params, x0)
+    out_shape = y0.shape
+    outputs0 = jnp.zeros((n_micro,) + out_shape, y0.dtype)
+
+    def tick(t, carry):
+        state, outputs = carry
+        # Stage 0 injects microbatch t while t < n_micro, else zeros.
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
+        inject = jnp.where(t < n_micro, 1.0, 0.0).astype(mb.dtype)
+        x = jnp.where(idx == 0, mb * inject, state)
+        y = stage_fn(stage_params, x)
+        # Last stage emits output for microbatch t - (n - 1).
+        out_t = t - (n - 1)
+        valid = jnp.logical_and(idx == n - 1, out_t >= 0)
+        safe_t = jnp.clip(out_t, 0, n_micro - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, y, safe_t, 0)
+        outputs = jnp.where(valid, updated, outputs)
+        # Hand activations to the next stage (ring closes drain to fill).
+        state = lax.ppermute(
+            y, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        return state, outputs
+
+    state0 = _vary(jnp.zeros(out_shape, y0.dtype), axis_name)
+    outputs0 = _vary(outputs0, axis_name)
+    _, outputs = lax.fori_loop(
+        0, n_micro + n - 1, tick, (state0, outputs0))
+    return outputs
+
+
+def pipelined_apply(stage_fn: Callable, all_stage_params, batch, mesh: Mesh,
+                    *, n_micro: int, axis_name: str = "pp"):
+    """Driver-level pipeline: params' leading dim = stage, batch is global.
+
+    all_stage_params: pytree whose leaves have leading dim n_stages
+      (sharded over `axis_name`).
+    batch: [B, ...] — split into n_micro microbatches.
+    Returns outputs [B, ...] gathered from the last stage.
+    """
+    from jax import shard_map
+
+    n_stages = mesh.shape[axis_name]
+    b = batch.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    mb = batch.reshape((n_micro, b // n_micro) + batch.shape[1:])
+
+    param_spec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), all_stage_params)
+
+    def body(stage_params, microbatches):
+        stage_params = jax.tree_util.tree_map(
+            lambda x: jnp.squeeze(x, 0), stage_params)
+        out = pipeline_stage_loop(stage_fn, stage_params, microbatches,
+                                  axis_name=axis_name)
+        # Everyone needs the result: sum over stages (only last is nonzero).
+        return lax.psum(out, axis_name)
+
+    out = shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, P()), out_specs=P(),
+
+    )(all_stage_params, mb)
+    return out.reshape((b,) + out.shape[2:])

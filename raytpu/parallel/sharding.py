@@ -1,0 +1,162 @@
+"""Sharding-rule application — the TPU-native ``prepare_model``.
+
+Where the reference wraps the model object (DDP wrap at
+``python/ray/train/torch/train_loop_utils.py:158,369``), JAX models are
+pytrees of arrays: "preparing" a model is assigning a `PartitionSpec` to
+every leaf. Rules map *logical* dimension names (embed/hidden/heads/...)
+to mesh axes — Megatron-style TP splits and FSDP sharding fall out of the
+same table, and XLA inserts the all-gathers/reduce-scatters.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Axis = Union[str, Tuple[str, ...], None]
+
+
+@dataclass
+class ShardingRules:
+    """Ordered (param-path regex → PartitionSpec template) table.
+
+    The first matching rule wins; a template entry names mesh axes (or
+    None = replicated on that dim). Axes absent from the mesh are dropped
+    automatically, so one rule table serves dp-only, fsdp, fsdp+tp, ...
+    meshes unchanged.
+    """
+
+    rules: Sequence[Tuple[str, Tuple[Axis, ...]]] = field(default_factory=tuple)
+
+    def spec_for(self, path: str, ndim: int, mesh: Mesh) -> P:
+        for pattern, template in self.rules:
+            if re.search(pattern, path):
+                return _drop_missing(template, mesh, ndim)
+        return P()  # replicate by default
+
+    def sharding_for(self, path: str, ndim: int, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec_for(path, ndim, mesh))
+
+
+def _drop_missing(template: Tuple[Axis, ...], mesh: Mesh, ndim: int) -> P:
+    out = []
+    for entry in template[:ndim]:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names
+                         and mesh.shape[a] > 1)
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        else:
+            out.append(entry if entry in mesh.axis_names
+                       and mesh.shape[entry] > 1 else None)
+    while len(out) < ndim:
+        out.append(None)
+    return P(*out)
+
+
+# Megatron-style transformer table (see SURVEY.md §5 long-context entry):
+# column-parallel kernels shard the output dim on tp, row-parallel shard the
+# input dim; everything also FSDP-shards its largest non-tp dim.
+TRANSFORMER_RULES = ShardingRules(rules=(
+    # embeddings: [vocab, embed] — shard vocab on tp, embed on fsdp
+    (r"(wte|embed_tokens|embedding|token_embed)", ("tp", "fsdp")),
+    (r"(wpe|pos_embed)", (None, "fsdp")),
+    # attention qkv (column-parallel): [embed, heads*head_dim]
+    (r"(attn|attention).*(q_proj|k_proj|v_proj|qkv|c_attn).*kernel",
+     ("fsdp", "tp")),
+    # attention output (row-parallel): [heads*head_dim, embed]
+    (r"(attn|attention).*(o_proj|out_proj|c_proj).*kernel", ("tp", "fsdp")),
+    # mlp up (column): [embed, ff]
+    (r"(mlp|ffn).*(up_proj|gate_proj|c_fc|fc_in|wi).*kernel", ("fsdp", "tp")),
+    # mlp down (row): [ff, embed]
+    (r"(mlp|ffn).*(down_proj|c_proj|fc_out|wo).*kernel", ("tp", "fsdp")),
+    # biases on tp-split outputs
+    (r"(q_proj|k_proj|v_proj|qkv|c_attn|up_proj|gate_proj|c_fc|wi).*bias",
+     ("tp",)),
+    # norms / scalars replicated
+    (r"(ln|norm|scale)", (None,)),
+    # lm head: [embed, vocab]
+    (r"(lm_head|output_proj)", ("fsdp", "tp")),
+    # fallback: FSDP-shard the first dim of big matrices
+    (r"kernel$", ("fsdp", "tp")),
+))
+
+
+def _flatten_with_paths(tree) -> Dict[str, Any]:
+    import jax
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        flat["/".join(_path_str(p) for p in path)] = leaf
+    return flat
+
+
+def _path_str(entry) -> str:
+    import jax
+
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, jax.tree_util.GetAttrKey):
+        return entry.name
+    return str(entry)
+
+
+def tree_shardings(tree, mesh: Mesh,
+                   rules: Optional[ShardingRules] = None):
+    """A pytree of NamedShardings matching `tree`'s structure."""
+    import jax
+
+    rules = rules or TRANSFORMER_RULES
+
+    def spec(path, leaf):
+        pstr = "/".join(_path_str(p) for p in path)
+        ndim = getattr(leaf, "ndim", 0)
+        return rules.sharding_for(pstr, ndim, mesh)
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place a parameter pytree onto the mesh (the `prepare_model` moment).
+
+    Returns params with sharded device placement; under jit, use the
+    shardings from :func:`tree_shardings` as in/out shardings instead.
+    """
+    import jax
+
+    shardings = tree_shardings(params, mesh, rules)
+    return jax.device_put(params, shardings)
+
+
+def shard_batch(batch, mesh: Mesh, axes: Tuple[str, ...] = ("dp", "fsdp")):
+    """Shard the leading (batch) dim over the data axes, and — when an `sp`
+    axis exists — the second (sequence) dim over it (context parallelism)."""
+    import jax
+
+    present = tuple(a for a in axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    batch_axis: Axis = present if len(present) > 1 else (
+        present[0] if present else None)
+
+    def spec(leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0:
+            return NamedSharding(mesh, P())
+        entries = [batch_axis]
+        if ndim >= 2 and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+            entries.append("sp")
+        while len(entries) < ndim:
+            entries.append(None)
+        return NamedSharding(mesh, P(*entries))
+
+    shardings = jax.tree_util.tree_map(spec, batch)
+    return jax.device_put(batch, shardings)
+
+
+def logical_sharding(mesh: Mesh, *axes: Axis) -> NamedSharding:
+    return NamedSharding(mesh, _drop_missing(tuple(axes), mesh, len(axes)))

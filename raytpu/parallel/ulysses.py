@@ -1,0 +1,58 @@
+"""Ulysses-style sequence parallelism: all_to_all head/sequence reshard.
+
+Absent from the reference (SURVEY.md §2.5). The alternative to ring
+attention for moderate context: tokens arrive sequence-sharded over ``sp``;
+one `all_to_all` re-shards to head-sharded with the *full* sequence local,
+plain (flash) attention runs locally, and a second `all_to_all` restores
+sequence sharding. Two collectives per attention instead of n ring hops —
+wins when heads >= sp and context fits per-device HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _local(q, k, v, axis_name: str, causal: bool, attn_fn):
+    # [B, H, T/n, D] -> all_to_all -> [B, H/n, T, D]
+    def seq_to_head(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    out = attn_fn(qh, kh, vh, causal=causal)
+    return head_to_seq(out)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                      attn_fn: Optional[Callable] = None):
+    """Call inside shard_map; q,k,v [B, H, T_local, D]; H must be divisible
+    by the axis size."""
+    if attn_fn is None:
+        from raytpu.parallel.ring_attention import reference_attention
+
+        def attn_fn(q_, k_, v_, causal=True):
+            return reference_attention(q_, k_, v_, causal=causal)
+
+    return _local(q, k, v, axis_name, causal, attn_fn)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, *,
+                              axis_name: str = "sp", causal: bool = True,
+                              attn_fn: Optional[Callable] = None):
+    from jax import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal, attn_fn=attn_fn)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

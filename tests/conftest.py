@@ -13,6 +13,13 @@ os.environ.setdefault("XLA_FLAGS",
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# The axon TPU plugin registers itself at interpreter startup (before this
+# file runs), so the env var alone is too late — force the platform at the
+# config level or jax.devices() tries (and may block on) the TPU tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

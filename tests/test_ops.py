@@ -1,0 +1,64 @@
+"""Pallas kernel tests (interpret mode on CPU; real lowering happens on
+TPU at bench time)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raytpu.ops.flash_attention import flash_attention
+from raytpu.ops.fused import rmsnorm
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_interpret_matches_reference(self, causal):
+        b, h, t, d = 2, 3, 256, 64
+        key = jax.random.PRNGKey(0)
+        q, k, v = jax.random.normal(key, (3, b, h, t, d), jnp.float32)
+        ref = flash_attention(q, k, v, causal=causal, force="reference")
+        got = flash_attention(q, k, v, causal=causal, force="interpret")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match(self):
+        b, h, t, d = 1, 2, 128, 32
+        key = jax.random.PRNGKey(1)
+        q, k, v = jax.random.normal(key, (3, b, h, t, d), jnp.float32)
+
+        def loss(mode, q, k, v):
+            return flash_attention(q, k, v, force=mode).sum()
+
+        g_ref = jax.grad(lambda *a: loss("reference", *a),
+                         argnums=(0, 1, 2))(q, k, v)
+        g_int = jax.grad(lambda *a: loss("interpret", *a),
+                         argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_int, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_bf16(self):
+        b, h, t, d = 1, 2, 128, 64
+        key = jax.random.PRNGKey(2)
+        q, k, v = jax.random.normal(key, (3, b, h, t, d), jnp.bfloat16)
+        ref = flash_attention(q, k, v, force="reference")
+        got = flash_attention(q, k, v, force="interpret")
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)
+
+    def test_bad_block_divisibility(self):
+        q = jnp.ones((1, 1, 300, 64))
+        with pytest.raises(ValueError):
+            flash_attention(q, q, q, force="interpret")
+
+
+class TestRMSNorm:
+    def test_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (64, 128))
+        scale = jnp.ones(128) * 1.5
+        ref = rmsnorm(x, scale, force="reference")
+        got = rmsnorm(x, scale, force="interpret")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
