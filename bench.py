@@ -1,0 +1,146 @@
+"""Headline benchmark: GPT-2 pretraining throughput, tokens/sec/chip.
+
+Mirrors the reference's north-star config (BASELINE.json: "Train GPT-2
+tokens/sec/chip"): GPT-2 124M, seq 1024, bf16, AdamW, flash attention.
+Runs on whatever single accelerator is attached (the driver provides one
+real TPU chip); prints ONE JSON line.
+
+``vs_baseline`` is measured against the GPU-parity bar the task sets: an
+A100 running the same model at 40% MFU (the throughput class the
+reference's torch/DDP path achieves on its benchmark hardware):
+  baseline_tokens_per_sec = 0.40 * 312e12 / flops_per_token
+  flops_per_token         = 6 * n_params + 12 * n_layer * n_embd * seq
+So vs_baseline > 1.0 means this chip beats A100-40%-MFU GPU parity.
+
+Env knobs: RAYTPU_BENCH_SMOKE=1 (tiny model, CPU ok),
+RAYTPU_BENCH_BATCH, RAYTPU_BENCH_STEPS, RAYTPU_BENCH_SEQ.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    smoke = os.environ.get("RAYTPU_BENCH_SMOKE") == "1"
+    if smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+
+    if smoke:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from raytpu.models.gpt2 import GPT2, GPT2Config, init_params, make_train_step
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+
+    if smoke:
+        cfg = GPT2Config(vocab_size=512, block_size=128, n_layer=2,
+                         n_head=4, n_embd=128, dtype=jnp.float32,
+                         attn_impl="reference")
+        batch = int(os.environ.get("RAYTPU_BENCH_BATCH", 2))
+        steps = int(os.environ.get("RAYTPU_BENCH_STEPS", 3))
+    else:
+        seq = int(os.environ.get("RAYTPU_BENCH_SEQ", 1024))
+        cfg = GPT2Config(vocab_size=50304, block_size=seq, n_layer=12,
+                         n_head=12, n_embd=768, dtype=jnp.bfloat16)
+        batch = int(os.environ.get("RAYTPU_BENCH_BATCH", 8))
+        steps = int(os.environ.get("RAYTPU_BENCH_STEPS", 10))
+
+    # Pick the faster attention path: pallas kernel if it compiles on this
+    # backend, else the XLA-fused reference einsum formulation.
+    attn_impl = cfg.attn_impl
+    if attn_impl is None and on_accel:
+        attn_impl = _probe_pallas(jnp)
+        cfg = GPT2Config(**{**cfg.__dict__, "attn_impl": attn_impl})
+    model = GPT2(cfg)
+
+    params = init_params(model, cfg, batch=batch)
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(model, opt), donate_argnums=(0, 1))
+
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (batch, cfg.block_size), 0,
+                                cfg.vocab_size, jnp.int32)
+
+    # Warmup (compile).
+    params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * cfg.block_size
+    tokens_per_sec = tokens_per_step * steps / dt
+
+    n_params = cfg.n_params_approx
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * \
+        cfg.block_size
+    a100_parity = 0.40 * 312e12 / flops_per_token
+
+    print(json.dumps({
+        "metric": "gpt2_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec / a100_parity, 4),
+        "detail": {
+            "model": "gpt2-124M" if not smoke else "gpt2-smoke",
+            "batch": batch,
+            "seq": cfg.block_size,
+            "steps": steps,
+            "attn": attn_impl or "flash-auto",
+            "device": str(dev),
+            "loss": float(jax.device_get(loss)),
+            "mfu_vs_device_peak": _mfu(tokens_per_sec, flops_per_token, dev),
+        },
+    }))
+
+
+def _probe_pallas(jnp) -> str:
+    """Try compiling the pallas flash kernel on this backend once."""
+    try:
+        import jax
+
+        from raytpu.ops.flash_attention import flash_attention
+
+        q = jnp.ones((1, 1, 256, 64), jnp.bfloat16)
+        out = jax.jit(
+            lambda q: flash_attention(q, q, q, force="tpu"))(q)
+        jax.block_until_ready(out)
+        return "tpu"
+    except Exception as e:  # noqa: BLE001
+        print(f"# pallas probe failed ({type(e).__name__}); "
+              f"using XLA attention", file=sys.stderr)
+        return "reference"
+
+
+def _mfu(tokens_per_sec: float, flops_per_token: float, dev) -> float:
+    peaks = {"v4": 137e12, "v5": 197e12, "v5p": 459e12, "v6": 918e12}
+    kind = getattr(dev, "device_kind", "").lower()
+    peak = 197e12
+    for k, v in peaks.items():
+        if k in kind:
+            peak = v
+    return round(tokens_per_sec * flops_per_token / peak, 4)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Model zoo — Flax models designed for the sharding rule tables in
+:mod:`raytpu.parallel.sharding` (param names line up with the Megatron-
+style TP/FSDP rules) and for the pallas kernels in :mod:`raytpu.ops`."""
+
+from raytpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn, make_train_step
+from raytpu.models.mlp import MLPClassifier
+from raytpu.models.resnet import ResNet, ResNetConfig
+
+__all__ = [
+    "GPT2",
+    "GPT2Config",
+    "gpt2_loss_fn",
+    "make_train_step",
+    "MLPClassifier",
+    "ResNet",
+    "ResNetConfig",
+]

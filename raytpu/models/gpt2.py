@@ -1,0 +1,166 @@
+"""GPT-2 in Flax — the flagship training model.
+
+The reference's GPT-2 benchmark path is torch + DDP/DeepSpeed driven by
+Ray Train (``BASELINE.json`` north star; examples under
+``doc/source/train/examples/deepspeed/``). This is the TPU-first redesign:
+bf16 params/activations with fp32 loss/optimizer math, flash attention
+(:mod:`raytpu.ops.flash_attention`), `jax.checkpoint` rematerialization per
+block, `lax.scan` over layers (one compiled block body instead of n_layer
+unrolled copies → fast compiles, same XLA code), and parameter names chosen
+to match ``TRANSFORMer_RULES`` (c_attn/c_proj/c_fc → TP column/row splits).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # padded to a multiple of 128 for the MXU
+    block_size: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    scan_layers: bool = True
+    attn_impl: Optional[str] = None  # None=auto, "reference", "interpret", "tpu"
+
+    @classmethod
+    def small(cls) -> "GPT2Config":  # 124M
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "GPT2Config":
+        return cls(vocab_size=512, block_size=128, n_layer=2, n_head=2,
+                   n_embd=128)
+
+    @property
+    def n_params_approx(self) -> int:
+        c = self
+        per_block = 12 * c.n_embd * c.n_embd
+        return c.vocab_size * c.n_embd + c.block_size * c.n_embd + \
+            c.n_layer * per_block + 2 * c.n_embd
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        c = self.config
+        b, t, e = x.shape
+        h = c.n_head
+        qkv = nn.Dense(3 * e, dtype=c.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, e // h).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, h, e // h).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, h, e // h).transpose(0, 2, 1, 3)
+        from raytpu.ops.flash_attention import flash_attention
+
+        y = flash_attention(q, k, v, causal=True, force=c.attn_impl)
+        y = y.transpose(0, 2, 1, 3).reshape(b, t, e)
+        y = nn.Dense(e, dtype=c.dtype, name="c_proj")(y)
+        if c.dropout > 0:
+            y = nn.Dropout(c.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        c = self.config
+        x = nn.Dense(4 * c.n_embd, dtype=c.dtype, name="c_fc")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.Dense(c.n_embd, dtype=c.dtype, name="c_proj")(x)
+        if c.dropout > 0:
+            x = nn.Dropout(c.dropout)(x, deterministic=deterministic)
+        return x
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        c = self.config
+        x = x + CausalSelfAttention(c, name="attn")(
+            nn.LayerNorm(dtype=c.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(c, name="mlp")(
+            nn.LayerNorm(dtype=c.dtype, name="ln_2")(x), deterministic)
+        return x
+
+
+class GPT2(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        c = self.config
+        b, t = tokens.shape
+        pos = jnp.arange(t)[None]
+        x = nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype, name="wte")(tokens)
+        x = x + nn.Embed(c.block_size, c.n_embd, dtype=c.dtype,
+                         name="wpe")(pos)
+
+        block = Block
+        if c.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        if c.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, deterministic), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=c.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(c, name="h"), x, None)
+        else:
+            for i in range(c.n_layer):
+                x = block(c, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
+        # Weight-tied LM head: logits in fp32 for a stable softmax.
+        wte = self.variables["params"]["wte"]["embedding"]
+        logits = x.astype(jnp.float32) @ wte.astype(jnp.float32).T
+        return logits
+
+
+def gpt2_loss_fn(model: GPT2, params, tokens):
+    """Next-token cross-entropy; fp32 loss math."""
+    logits = model.apply({"params": params}, tokens)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return nll.mean()
+
+
+def make_train_step(model: GPT2, optimizer):
+    """(params, opt_state, tokens) -> (params, opt_state, loss); pure — jit
+    it with shardings from :func:`raytpu.parallel.sharding.tree_shardings`."""
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt2_loss_fn(model, p, tokens))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_params(model: GPT2, config: GPT2Config, seed: int = 0,
+                batch: int = 2):
+    tokens = jnp.zeros((batch, config.block_size), jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), tokens)["params"]

@@ -1,0 +1,32 @@
+"""Small MLP classifier (the FashionMNIST-parity model — reference
+benchmark: ``doc/source/train/benchmarks.rst:63-84`` torch DDP parity
+suite trains exactly this class of model)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLPClassifier(nn.Module):
+    hidden: Sequence[int] = (128, 128)
+    n_classes: int = 10
+    dtype: type = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(h, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.n_classes, dtype=self.dtype, name="head")(x)
+
+
+def xent_loss(model, params, batch):
+    logits = model.apply({"params": params}, batch["x"])
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, batch["y"][:, None], axis=-1)
+    return nll.mean()

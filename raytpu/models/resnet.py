@@ -1,0 +1,75 @@
+"""ResNet in Flax (reference benchmark family:
+``doc/source/train/benchmarks.rst:28-45`` ResNet image training). Convs
+are MXU-friendly (NHWC, channel-last) and bf16 by default."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)  # resnet-18
+    num_filters: int = 64
+    n_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def resnet18(cls, n_classes: int = 1000):
+        return cls((2, 2, 2, 2), 64, n_classes)
+
+    @classmethod
+    def resnet50(cls, n_classes: int = 1000):
+        return cls((3, 4, 6, 3), 64, n_classes)
+
+    @classmethod
+    def tiny(cls, n_classes: int = 10):
+        return cls((1, 1), 16, n_classes)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    use_bias=False, dtype=self.dtype)(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               (self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = self.config
+        x = x.astype(c.dtype)
+        x = nn.Conv(c.num_filters, (7, 7), (2, 2), use_bias=False,
+                    dtype=c.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=c.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, block_count in enumerate(c.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BasicBlock(c.num_filters * 2 ** i, strides, c.dtype)(
+                    x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(c.n_classes, dtype=jnp.float32, name="head")(x)

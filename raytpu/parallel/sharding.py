@@ -42,8 +42,12 @@ class ShardingRules:
 
 
 def _drop_missing(template: Tuple[Axis, ...], mesh: Mesh, ndim: int) -> P:
-    out = []
-    for entry in template[:ndim]:
+    """Right-align the template to the param's trailing dims: scanned layer
+    stacks (flax nn.scan) prepend a layer axis, and the rule still applies
+    to the per-layer trailing shape. Extra leading dims replicate."""
+    template = template[-ndim:] if len(template) > ndim else template
+    out: list = [None] * (ndim - len(template))
+    for entry in template:
         if entry is None:
             out.append(None)
         elif isinstance(entry, tuple):
@@ -53,8 +57,6 @@ def _drop_missing(template: Tuple[Axis, ...], mesh: Mesh, ndim: int) -> P:
         else:
             out.append(entry if entry in mesh.axis_names
                        and mesh.shape[entry] > 1 else None)
-    while len(out) < ndim:
-        out.append(None)
     return P(*out)
 
 

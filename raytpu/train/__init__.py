@@ -1,0 +1,41 @@
+"""raytpu.train — distributed training orchestration (reference:
+``python/ray/train/``)."""
+
+from raytpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    restore_pytree,
+    save_pytree,
+)
+from raytpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from raytpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from raytpu.train.trainer import BaseTrainer, JaxTrainer
+
+__all__ = [
+    "BaseTrainer",
+    "JaxTrainer",
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "Result",
+    "Checkpoint",
+    "CheckpointManager",
+    "save_pytree",
+    "restore_pytree",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "get_dataset_shard",
+]

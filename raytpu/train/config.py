@@ -1,0 +1,69 @@
+"""Train/Tune shared configs.
+
+Reference analogue: ``python/ray/air/config.py`` — ``ScalingConfig``
+(``:103``), ``FailureConfig`` (``:395``), ``CheckpointConfig`` (``:445``),
+``RunConfig`` (``:594``). TPU-first deltas: workers are sized in *chips*
+(``chips_per_worker``) and ScalingConfig emits STRICT_PACK placement-group
+bundles so each worker's chips form a contiguous ICI sub-box
+(reference translation: ``as_placement_group_factory``,
+``air/config.py:268-278`` — see SURVEY.md A6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "STRICT_PACK"  # chips must be ICI-contiguous
+
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        """One bundle per worker (reference: A6 — the zero-CPU trainer
+        bundle is merged into rank 0)."""
+        per = dict(self.resources_per_worker or {})
+        per.setdefault("CPU", 1)
+        if self.use_tpu and self.chips_per_worker:
+            per.setdefault("TPU", self.chips_per_worker)
+        return [dict(per) for _ in range(self.num_workers)]
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_workers * self.chips_per_worker if self.use_tpu else 0
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # retries of the whole worker group (gang restart)
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    verbose: int = 0
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    checkpoint: Optional[Any]
+    path: Optional[str]
+    error: Optional[BaseException] = None

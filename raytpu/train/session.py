@@ -1,0 +1,99 @@
+"""Per-worker training session.
+
+Reference analogue: ``python/ray/train/_internal/session.py`` —
+``_TrainSession`` (``:109``), ``report`` (``:661,401``). The user loop
+calls :func:`report` each step/epoch; metrics and an optional checkpoint
+flow back to the trainer, which persists checkpoints and feeds Tune.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class TrainContext:
+    rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    storage_path: Optional[str] = None
+    chip_coords: Optional[list] = None
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+
+class _Session:
+    def __init__(self, context: TrainContext, dataset_shards=None):
+        self.context = context
+        self.reports: List[Dict[str, Any]] = []
+        self.latest_checkpoint = None
+        self.lock = threading.Lock()
+        self.dataset_shards = dataset_shards or {}
+
+    def report(self, metrics: Dict[str, Any], checkpoint=None):
+        with self.lock:
+            self.reports.append(dict(metrics))
+            if checkpoint is not None:
+                self.latest_checkpoint = checkpoint
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self.lock:
+            out = self.reports
+            self.reports = []
+            return out
+
+
+_tls = threading.local()
+
+
+def _set_session(s: Optional[_Session]):
+    _tls.session = s
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_tls, "session", None)
+
+
+def report(metrics: Dict[str, Any], checkpoint=None) -> None:
+    """Report metrics (+ optional checkpoint) from inside the training loop
+    (reference: ``train.report``)."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("report() called outside a training session")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        return TrainContext()
+    return s.context
+
+
+def get_checkpoint():
+    """Checkpoint to resume from, if the trainer restored one."""
+    s = _get_session()
+    return s.latest_checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's streaming data shard (reference:
+    ``session.get_dataset_shard`` backed by ``streaming_split``,
+    ``python/ray/data/dataset.py:1141``)."""
+    s = _get_session()
+    if s is None or name not in s.dataset_shards:
+        raise KeyError(
+            f"no dataset shard {name!r}; pass datasets={{'{name}': ds}} to "
+            "the trainer")
+    return s.dataset_shards[name]

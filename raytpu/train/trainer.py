@@ -1,0 +1,263 @@
+"""JaxTrainer — the distributed-training orchestrator.
+
+Reference analogue (SURVEY.md §3.4 call stack): ``BaseTrainer.fit``
+(``python/ray/train/base_trainer.py:567``) → ``DataParallelTrainer``
+(``data_parallel_trainer.py:22``) → ``BackendExecutor`` (PG creation at
+``_internal/backend_executor.py:197``) → ``WorkerGroup``
+(``_internal/worker_group.py:102``) → per-worker ``_TrainSession``.
+
+TPU-first redesign: the worker group is a *gang* — one worker actor per
+host, each owning a contiguous-ICI bundle of chips; rendezvous runs
+``jax.distributed.initialize`` with the coordinator published through the
+control plane (reference pattern: NCCLUniqueIDStore named actor, SURVEY.md
+A5); the training loop itself is single-program SPMD over the global mesh,
+so there is no gradient-bucket machinery to orchestrate — XLA owns the
+collectives. Elastic recovery is gang-shaped too (FailureConfig →
+checkpoint + gang restart, not per-task retry).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Any, Callable, Dict, Optional
+
+import raytpu
+from raytpu.train import session as session_mod
+from raytpu.train.checkpoint import Checkpoint, CheckpointManager
+from raytpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@raytpu.remote(num_cpus=0)
+class TrainWorker:
+    """One gang member: hosts the user loop in a thread + a session."""
+
+    def __init__(self, rank: int, world_size: int, context_kwargs: dict):
+        self.rank = rank
+        self.world_size = world_size
+        self.context = session_mod.TrainContext(
+            rank=rank, world_size=world_size, local_rank=rank,
+            **context_kwargs)
+        self.session = None
+        self.thread = None
+        self.error = None
+        self.done = False
+
+    def setup_distributed(self, coordinator: Optional[str],
+                          num_processes: int, process_id: int):
+        """Multi-host rendezvous (reference analogue:
+        ``_setup_torch_process_group``, ``torch/config.py:65``)."""
+        if coordinator is None or num_processes <= 1:
+            return True
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+
+    def start(self, train_fn_blob: bytes, config: dict, dataset_shards=None,
+              resume_path=None):
+        import threading
+
+        import cloudpickle
+
+        train_fn = cloudpickle.loads(train_fn_blob)
+        self.session = session_mod._Session(self.context, dataset_shards)
+        if resume_path:
+            self.session.latest_checkpoint = Checkpoint(resume_path)
+
+        def run():
+            session_mod._set_session(self.session)
+            try:
+                train_fn(config)
+            except BaseException as e:  # noqa: BLE001
+                self.error = e
+            finally:
+                self.done = True
+                session_mod._set_session(None)
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+        return True
+
+    def poll(self):
+        """Drain buffered reports; returns (reports, done, error_repr,
+        checkpoint_path)."""
+        reports = self.session.drain() if self.session else []
+        ckpt = self.session.latest_checkpoint if self.session else None
+        ckpt_path = ckpt.path if ckpt is not None else None
+        if ckpt is not None:
+            self.session.latest_checkpoint = None
+        err = None
+        if self.error is not None:
+            import traceback
+
+            err = "".join(traceback.format_exception(
+                type(self.error), self.error, self.error.__traceback__))
+        return reports, self.done, err, ckpt_path
+
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+
+class JaxTrainer(BaseTrainer):
+    """Data-parallel (and beyond — the mesh decides) JAX trainer.
+
+    train_loop_per_worker(config) runs on every gang member; inside it use
+    ``raytpu.train.report`` / ``get_context`` / ``get_dataset_shard`` and
+    the mesh helpers in :mod:`raytpu.parallel`.
+    """
+
+    def __init__(self, train_loop_per_worker: Callable[[dict], None], *,
+                 train_loop_config: Optional[dict] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        import cloudpickle
+
+        sc = self.scaling_config
+        rc = self.run_config
+        name = rc.name or f"raytpu-train-{int(time.time())}"
+        storage = rc.storage_path or os.path.join(
+            tempfile.gettempdir(), "raytpu_results")
+        run_dir = os.path.join(storage, name)
+        os.makedirs(run_dir, exist_ok=True)
+        manager = CheckpointManager(
+            os.path.join(run_dir, "checkpoints"),
+            num_to_keep=rc.checkpoint_config.num_to_keep,
+            score_attribute=rc.checkpoint_config.checkpoint_score_attribute,
+            score_order=rc.checkpoint_config.checkpoint_score_order,
+        )
+
+        attempts = rc.failure_config.max_failures + 1
+        last_error = None
+        for attempt in range(attempts):
+            result = self._run_gang(sc, name, run_dir, manager,
+                                    cloudpickle.dumps(
+                                        self.train_loop_per_worker))
+            if result.error is None:
+                return result
+            last_error = result.error
+            # Gang restart from the latest checkpoint (SURVEY.md §7 hard
+            # part (d): elastic recovery = checkpoint + gang restart).
+            self.resume_from_checkpoint = manager.latest()
+        return Result(metrics={}, metrics_history=[], checkpoint=None,
+                      path=run_dir, error=last_error)
+
+    # -- internals ------------------------------------------------------------
+
+    def _run_gang(self, sc: ScalingConfig, name: str, run_dir: str,
+                  manager: CheckpointManager, fn_blob: bytes) -> Result:
+        pg = None
+        workers = []
+        try:
+            bundles = sc.bundle_specs()
+            pg = raytpu.placement_group(bundles,
+                                        strategy=sc.placement_strategy)
+            shards = _split_datasets(self.datasets, sc.num_workers)
+            for rank in range(sc.num_workers):
+                ctx_kwargs = {
+                    "experiment_name": name,
+                    "storage_path": run_dir,
+                    "chip_coords": pg.chip_coords(rank) if sc.use_tpu else None,
+                }
+                w = TrainWorker.options(
+                    placement_group=pg,
+                    placement_group_bundle_index=rank,
+                ).remote(rank, sc.num_workers, ctx_kwargs)
+                workers.append(w)
+            # Gang rendezvous (single-host: no-op; multi-host: rank-0
+            # coordinator address flows through the control plane).
+            raytpu.get([w.setup_distributed.remote(None, sc.num_workers, i)
+                        for i, w in enumerate(workers)])
+            resume = (self.resume_from_checkpoint.path
+                      if self.resume_from_checkpoint is not None else None)
+            raytpu.get([
+                w.start.remote(fn_blob, self.train_loop_config,
+                               shards[i], resume)
+                for i, w in enumerate(workers)])
+
+            history = []
+            last_ckpt = None
+            error = None
+            while True:
+                polls = raytpu.get([w.poll.remote() for w in workers])
+                rank0_reports, _, _, _ = polls[0]
+                for rep in rank0_reports:
+                    history.append(rep)
+                for rank, (_, _, _, ckpt_path) in enumerate(polls):
+                    if rank == 0 and ckpt_path:
+                        metrics = history[-1] if history else {}
+                        last_ckpt = manager.register(
+                            Checkpoint(ckpt_path), metrics)
+                errs = [p[2] for p in polls if p[2]]
+                if errs:
+                    from raytpu.core.errors import TaskError
+
+                    error = TaskError("train_loop_per_worker", errs[0])
+                    break
+                if all(p[1] for p in polls):
+                    break
+                time.sleep(0.05)
+            return Result(
+                metrics=history[-1] if history else {},
+                metrics_history=history,
+                checkpoint=last_ckpt or manager.latest(),
+                path=run_dir,
+                error=error,
+            )
+        finally:
+            for w in workers:
+                try:
+                    raytpu.kill(w)
+                except Exception:
+                    pass
+            if pg is not None:
+                try:
+                    raytpu.remove_placement_group(pg)
+                except Exception:
+                    pass
+
+
+def _split_datasets(datasets: Dict[str, Any], n: int):
+    """Per-worker dataset shards via streaming_split (reference:
+    ``DataConfig.configure_ingest``, SURVEY.md A8)."""
+    shards = [dict() for _ in range(n)]
+    for key, ds in datasets.items():
+        if hasattr(ds, "streaming_split"):
+            its = ds.streaming_split(n)
+            for i in range(n):
+                shards[i][key] = its[i]
+        else:
+            for i in range(n):
+                shards[i][key] = ds
+    return shards
