@@ -63,8 +63,10 @@ def main() -> None:
     # backend, else the XLA-fused reference einsum formulation.
     attn_impl = cfg.attn_impl
     if attn_impl is None and on_accel:
+        import dataclasses
+
         attn_impl = _probe_pallas(jnp)
-        cfg = GPT2Config(**{**cfg.__dict__, "attn_impl": attn_impl})
+        cfg = dataclasses.replace(cfg, attn_impl=attn_impl)
     model = GPT2(cfg)
 
     params = init_params(model, cfg, batch=batch)

@@ -75,6 +75,8 @@ def restore_pytree(path_or_ckpt, target=None, shardings=None):
         restore_args = jax.tree_util.tree_map(
             lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
         return ckptr.restore(path, restore_args=restore_args)
+    if target is not None:
+        return ckptr.restore(path, item=target)
     return ckptr.restore(path)
 
 
@@ -124,7 +126,10 @@ class CheckpointManager:
     def _score(self, metrics: Dict[str, Any]) -> float:
         if self.score_attribute and self.score_attribute in metrics:
             return float(metrics[self.score_attribute])
-        return float(self._counter)  # fall back to recency
+        # Recency fallback, sign-adjusted so "more recent ranks better"
+        # holds under BOTH score orders.
+        return float(self._counter if self.score_order == "max"
+                     else -self._counter)
 
     def _evict(self):
         if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:

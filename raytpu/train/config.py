@@ -22,6 +22,12 @@ class ScalingConfig:
     chips_per_worker: int = 0
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "STRICT_PACK"  # chips must be ICI-contiguous
+    # Multi-host gang rendezvous: when set, every worker runs
+    # jax.distributed.initialize(coordinator_address, num_workers, rank)
+    # (reference analogue: the TCP-store rendezvous of
+    # _setup_torch_process_group, torch/config.py:65). Cluster mode fills
+    # this from the head's address; leave None for single-host.
+    coordinator_address: Optional[str] = None
 
     def bundle_specs(self) -> List[Dict[str, float]]:
         """One bundle per worker (reference: A6 — the zero-CPU trainer

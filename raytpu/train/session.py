@@ -36,18 +36,19 @@ class TrainContext:
 class _Session:
     def __init__(self, context: TrainContext, dataset_shards=None):
         self.context = context
-        self.reports: List[Dict[str, Any]] = []
-        self.latest_checkpoint = None
+        # Each entry is (metrics, checkpoint-or-None): pairing is preserved
+        # so every checkpoint is registered with ITS metrics, and none are
+        # lost between polls.
+        self.reports: List[tuple] = []
+        self.latest_checkpoint = None  # resume-from slot (read at startup)
         self.lock = threading.Lock()
         self.dataset_shards = dataset_shards or {}
 
     def report(self, metrics: Dict[str, Any], checkpoint=None):
         with self.lock:
-            self.reports.append(dict(metrics))
-            if checkpoint is not None:
-                self.latest_checkpoint = checkpoint
+            self.reports.append((dict(metrics), checkpoint))
 
-    def drain(self) -> List[Dict[str, Any]]:
+    def drain(self) -> List[tuple]:
         with self.lock:
             out = self.reports
             self.reports = []

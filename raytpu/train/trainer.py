@@ -91,20 +91,21 @@ class TrainWorker:
         return True
 
     def poll(self):
-        """Drain buffered reports; returns (reports, done, error_repr,
-        checkpoint_path)."""
-        reports = self.session.drain() if self.session else []
-        ckpt = self.session.latest_checkpoint if self.session else None
-        ckpt_path = ckpt.path if ckpt is not None else None
-        if ckpt is not None:
-            self.session.latest_checkpoint = None
+        """Returns ([(metrics, ckpt_path_or_None), ...], done, error_repr).
+
+        `done` is read BEFORE draining: if the loop finishes between the
+        drain and the flag read, the final reports are still picked up on
+        the trainer's next (guaranteed, because done was False) poll."""
+        done = self.done
+        pairs = self.session.drain() if self.session else []
+        out = [(m, (c.path if c is not None else None)) for m, c in pairs]
         err = None
         if self.error is not None:
             import traceback
 
             err = "".join(traceback.format_exception(
                 type(self.error), self.error, self.error.__traceback__))
-        return reports, self.done, err, ckpt_path
+        return out, done, err
 
 
 
@@ -195,10 +196,13 @@ class JaxTrainer(BaseTrainer):
                     placement_group_bundle_index=rank,
                 ).remote(rank, sc.num_workers, ctx_kwargs)
                 workers.append(w)
-            # Gang rendezvous (single-host: no-op; multi-host: rank-0
-            # coordinator address flows through the control plane).
-            raytpu.get([w.setup_distributed.remote(None, sc.num_workers, i)
-                        for i, w in enumerate(workers)])
+            # Gang rendezvous: jax.distributed.initialize runs only when a
+            # coordinator address is configured (multi-host cluster mode);
+            # in-process workers share one JAX runtime and must skip it.
+            raytpu.get([
+                w.setup_distributed.remote(
+                    sc.coordinator_address, sc.num_workers, i)
+                for i, w in enumerate(workers)])
             resume = (self.resume_from_checkpoint.path
                       if self.resume_from_checkpoint is not None else None)
             raytpu.get([
@@ -211,12 +215,9 @@ class JaxTrainer(BaseTrainer):
             error = None
             while True:
                 polls = raytpu.get([w.poll.remote() for w in workers])
-                rank0_reports, _, _, _ = polls[0]
-                for rep in rank0_reports:
-                    history.append(rep)
-                for rank, (_, _, _, ckpt_path) in enumerate(polls):
-                    if rank == 0 and ckpt_path:
-                        metrics = history[-1] if history else {}
+                for metrics, ckpt_path in polls[0][0]:  # rank 0 drives
+                    history.append(metrics)
+                    if ckpt_path:
                         last_ckpt = manager.register(
                             Checkpoint(ckpt_path), metrics)
                 errs = [p[2] for p in polls if p[2]]
