@@ -1,0 +1,33 @@
+"""raytpu.data — lazy streaming datasets (reference: ``python/ray/data/``)."""
+
+from raytpu.data.block import Block, BlockAccessor
+from raytpu.data.dataset import DataIterator, Dataset
+from raytpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    range_tensor,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Dataset",
+    "DataIterator",
+    "Block",
+    "BlockAccessor",
+    "range",
+    "range_tensor",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "from_arrow",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_text",
+]

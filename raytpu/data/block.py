@@ -1,0 +1,141 @@
+"""Blocks — the unit of data movement.
+
+Reference analogue: Ray Data blocks (Arrow tables in plasma; accessor in
+``python/ray/data/_internal/block_accessor``-land). Here a block is a
+pyarrow Table (structured data) or a dict of numpy arrays (tensor data) —
+both zero-copy friendly through the shm object store (numpy buffers ride
+as raw buffers; arrow via its own buffer protocol).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Union
+
+import numpy as np
+
+Block = Union["pyarrow.Table", Dict[str, np.ndarray]]  # noqa: F821
+
+
+class BlockAccessor:
+    """Uniform view over the two block kinds."""
+
+    def __init__(self, block: Block):
+        self.block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        import pyarrow as pa
+
+        if isinstance(self.block, pa.Table):
+            return self.block.num_rows
+        if not self.block:
+            return 0
+        return len(next(iter(self.block.values())))
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        if isinstance(self.block, pa.Table):
+            return self.block
+        return pa.table({k: pa.array(np.asarray(v))
+                         for k, v in self.block.items()})
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        import pyarrow as pa
+
+        if isinstance(self.block, pa.Table):
+            return {name: col.to_numpy(zero_copy_only=False)
+                    for name, col in zip(self.block.column_names,
+                                         self.block.columns)}
+        return self.block
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def to_rows(self) -> List[dict]:
+        npd = self.to_numpy()
+        keys = list(npd.keys())
+        n = self.num_rows()
+        return [{k: npd[k][i] for k in keys} for i in range(n)]
+
+    def slice(self, start: int, end: int) -> Block:
+        import pyarrow as pa
+
+        if isinstance(self.block, pa.Table):
+            return self.block.slice(start, end - start)
+        return {k: v[start:end] for k, v in self.block.items()}
+
+    def size_bytes(self) -> int:
+        import pyarrow as pa
+
+        if isinstance(self.block, pa.Table):
+            return self.block.nbytes
+        return sum(np.asarray(v).nbytes for v in self.block.values())
+
+    def schema(self):
+        import pyarrow as pa
+
+        if isinstance(self.block, pa.Table):
+            return self.block.schema
+        return {k: np.asarray(v).dtype for k, v in self.block.items()}
+
+
+def block_from_rows(rows: List[Any]) -> Block:
+    """List of dicts (or scalars → {'item': ...}) to a block."""
+    import pyarrow as pa
+
+    if not rows:
+        return pa.table({})
+    if isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return pa.table({k: [r[k] for r in rows] for k in keys})
+    return pa.table({"item": list(rows)})
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    import pyarrow as pa
+
+    if not blocks:
+        return pa.table({})
+    if isinstance(blocks[0], dict):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([np.asarray(b[k]) for b in blocks])
+                for k in keys}
+    return pa.concat_tables([BlockAccessor(b).to_arrow() for b in blocks])
+
+
+def batch_format_view(block: Block, batch_format: str):
+    acc = BlockAccessor(block)
+    if batch_format in ("numpy", "default"):
+        return acc.to_numpy()
+    if batch_format == "pandas":
+        return acc.to_pandas()
+    if batch_format in ("pyarrow", "arrow"):
+        return acc.to_arrow()
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def normalize_batch_output(out: Any) -> Block:
+    """Accept what user map_batches fns return: dict of arrays, arrow
+    table, pandas frame, or list of rows."""
+    import pyarrow as pa
+
+    if isinstance(out, pa.Table):
+        return out
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(out, pd.DataFrame):
+            return pa.Table.from_pandas(out, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(out, list):
+        return block_from_rows(out)
+    raise TypeError(
+        f"map_batches function returned {type(out)}; expected dict of "
+        "arrays, pyarrow.Table, pandas.DataFrame, or list of rows")

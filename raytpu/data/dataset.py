@@ -1,0 +1,425 @@
+"""Dataset — lazy, streaming, distributed datasets.
+
+Reference analogue: ``python/ray/data/dataset.py:137`` (Dataset),
+``read_api.py``, logical plan + streaming execution (SURVEY.md §2.3, A8).
+A Dataset is a lazy plan: a block source plus a chain of operators;
+consumption streams blocks through remote tasks with bounded in-flight
+work (:mod:`raytpu.data.executor`). Blocks live in the object store; the
+driver holds refs only.
+
+Single-node simplifications (documented per method): global ops
+(sort/repartition/random_shuffle) materialize; everything else streams.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import raytpu
+from raytpu.data.block import (
+    BlockAccessor,
+    batch_format_view,
+    block_from_rows,
+    concat_blocks,
+    normalize_batch_output,
+)
+from raytpu.data.executor import OpSpec, run_pipeline
+
+
+class Dataset:
+    def __init__(self, source_fn: Callable[[], Iterator], ops: List[OpSpec],
+                 name: str = "dataset"):
+        self._source_fn = source_fn  # () -> iterator of block refs
+        self._ops = ops
+        self._name = name
+
+    # -- transforms (lazy) ----------------------------------------------------
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    num_cpus: float = 1.0, batch_size: Optional[int] = None,
+                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+        """Apply fn to whole blocks (reference: ``Dataset.map_batches``).
+        `batch_size=None` keeps source block boundaries (fastest)."""
+        kw = fn_kwargs or {}
+
+        def op(block):
+            view = batch_format_view(block, batch_format)
+            return normalize_batch_output(fn(view, **kw))
+
+        ds = self._with_op(OpSpec(getattr(fn, "__name__", "map_batches"),
+                                  op, num_cpus=num_cpus))
+        if batch_size is not None:
+            ds = ds._rechunk(batch_size)
+        return ds
+
+    def map(self, fn: Callable, *, num_cpus: float = 1.0) -> "Dataset":
+        def op(block):
+            rows = BlockAccessor(block).to_rows()
+            return block_from_rows([fn(r) for r in rows])
+
+        return self._with_op(OpSpec(getattr(fn, "__name__", "map"), op,
+                                    num_cpus=num_cpus))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def op(block):
+            rows = BlockAccessor(block).to_rows()
+            return block_from_rows([r for r in rows if fn(r)])
+
+        return self._with_op(OpSpec("filter", op))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def op(block):
+            rows = BlockAccessor(block).to_rows()
+            out = []
+            for r in rows:
+                out.extend(fn(r))
+            return block_from_rows(out)
+
+        return self._with_op(OpSpec("flat_map", op))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def op(batch):
+            batch = dict(batch)
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(op, batch_format="numpy")
+
+    def drop_columns(self, cols: Sequence[str]) -> "Dataset":
+        def op(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self.map_batches(op, batch_format="numpy")
+
+    def select_columns(self, cols: Sequence[str]) -> "Dataset":
+        def op(batch):
+            return {k: batch[k] for k in cols}
+
+        return self.map_batches(op, batch_format="numpy")
+
+    def limit(self, n: int) -> "Dataset":
+        parent = self
+
+        def source():
+            remaining = n
+            for ref in parent._iter_block_refs():
+                if remaining <= 0:
+                    break
+                block = raytpu.get(ref)
+                rows = BlockAccessor(block).num_rows()
+                if rows <= remaining:
+                    remaining -= rows
+                    yield ref
+                else:
+                    yield raytpu.put(
+                        BlockAccessor(block).slice(0, remaining))
+                    remaining = 0
+
+        return Dataset(source, [], name=f"{self._name}.limit({n})")
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        parents = [self, *others]
+
+        def source():
+            for p in parents:
+                yield from p._iter_block_refs()
+
+        return Dataset(source, [], name="union")
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Global op — materializes (all-to-all; reference repartition is a
+        shuffle too)."""
+        parent = self
+
+        def source():
+            blocks = [raytpu.get(r) for r in parent._iter_block_refs()]
+            if not blocks:
+                return
+            whole = concat_blocks(blocks)
+            total = BlockAccessor(whole).num_rows()
+            per = max(1, -(-total // num_blocks))
+            for i in range(num_blocks):
+                lo, hi = i * per, min((i + 1) * per, total)
+                if lo >= total:
+                    break
+                yield raytpu.put(BlockAccessor(whole).slice(lo, hi))
+
+        return Dataset(source, [], name=f"{self._name}.repartition")
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global op — materializes and row-permutes."""
+        parent = self
+
+        def source():
+            blocks = [raytpu.get(r) for r in parent._iter_block_refs()]
+            if not blocks:
+                return
+            whole = BlockAccessor(concat_blocks(blocks))
+            n = whole.num_rows()
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(n)
+            npd = whole.to_numpy()
+            shuffled = {k: np.asarray(v)[perm] for k, v in npd.items()}
+            nblocks = max(1, len(blocks))
+            per = -(-n // nblocks)
+            for i in range(nblocks):
+                lo, hi = i * per, min((i + 1) * per, n)
+                if lo >= n:
+                    break
+                yield raytpu.put({k: v[lo:hi] for k, v in shuffled.items()})
+
+        return Dataset(source, [], name=f"{self._name}.shuffle")
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Global op — materializes."""
+        parent = self
+
+        def source():
+            blocks = [raytpu.get(r) for r in parent._iter_block_refs()]
+            if not blocks:
+                return
+            whole = BlockAccessor(concat_blocks(blocks))
+            npd = whole.to_numpy()
+            order = np.argsort(npd[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            yield raytpu.put({k: np.asarray(v)[order]
+                              for k, v in npd.items()})
+
+        return Dataset(source, [], name=f"{self._name}.sort")
+
+    # -- consumption ----------------------------------------------------------
+
+    def _iter_block_refs(self) -> Iterator:
+        return run_pipeline(self._source_fn(), self._ops)
+
+    def iter_blocks(self) -> Iterator:
+        for ref in self._iter_block_refs():
+            yield raytpu.get(ref)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor(block).to_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator:
+        """Re-chunk the block stream into fixed-size batches."""
+        carry: List = []
+        carry_rows = 0
+        for block in self.iter_blocks():
+            carry.append(block)
+            carry_rows += BlockAccessor(block).num_rows()
+            while carry_rows >= batch_size:
+                whole = concat_blocks(carry)
+                acc = BlockAccessor(whole)
+                yield batch_format_view(acc.slice(0, batch_size),
+                                        batch_format)
+                rest = acc.slice(batch_size, acc.num_rows())
+                carry = [rest]
+                carry_rows = BlockAccessor(rest).num_rows()
+        if carry_rows and not drop_last:
+            whole = concat_blocks(carry)
+            yield batch_format_view(whole, batch_format)
+
+    def take(self, n: int = 20) -> List[dict]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[dict]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows() for b in self.iter_blocks())
+
+    def sum(self, col: str):
+        return sum(float(np.asarray(BlockAccessor(b).to_numpy()[col]).sum())
+                   for b in self.iter_blocks())
+
+    def mean(self, col: str):
+        total, n = 0.0, 0
+        for b in self.iter_blocks():
+            arr = np.asarray(BlockAccessor(b).to_numpy()[col])
+            total += float(arr.sum())
+            n += arr.size
+        return total / max(n, 1)
+
+    def min(self, col: str):
+        return min(float(np.asarray(BlockAccessor(b).to_numpy()[col]).min())
+                   for b in self.iter_blocks())
+
+    def max(self, col: str):
+        return max(float(np.asarray(BlockAccessor(b).to_numpy()[col]).max())
+                   for b in self.iter_blocks())
+
+    def schema(self):
+        for block in self.iter_blocks():
+            return BlockAccessor(block).schema()
+        return None
+
+    def to_pandas(self):
+        import pandas as pd
+
+        frames = [BlockAccessor(b).to_pandas() for b in self.iter_blocks()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def materialize(self) -> "Dataset":
+        refs = list(self._iter_block_refs())
+
+        def source():
+            yield from refs
+
+        return Dataset(source, [], name=f"{self._name}.materialized")
+
+    def stats(self) -> dict:
+        blocks = 0
+        rows = 0
+        nbytes = 0
+        for b in self.iter_blocks():
+            acc = BlockAccessor(b)
+            blocks += 1
+            rows += acc.num_rows()
+            nbytes += acc.size_bytes()
+        return {"blocks": blocks, "rows": rows, "bytes": nbytes}
+
+    # -- train ingest ---------------------------------------------------------
+
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        locality_hints=None) -> List["DataIterator"]:
+        """n coordinated iterators over one pass of the stream (reference:
+        ``Dataset.streaming_split``, ``dataset.py:1141`` — powered by a
+        coordinator actor + OutputSplitter)."""
+        coordinator = _SplitCoordinator.options(name=None).remote(
+            self, n)
+        return [DataIterator(coordinator, i) for i in range(n)]
+
+    # -- writes ---------------------------------------------------------------
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            pq.write_table(BlockAccessor(block).to_arrow(),
+                           f"{path}/part-{i:05d}.parquet")
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            BlockAccessor(block).to_pandas().to_csv(
+                f"{path}/part-{i:05d}.csv", index=False)
+
+    def write_json(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            BlockAccessor(block).to_pandas().to_json(
+                f"{path}/part-{i:05d}.json", orient="records", lines=True)
+
+    # -- internals ------------------------------------------------------------
+
+    def _with_op(self, op: OpSpec) -> "Dataset":
+        return Dataset(self._source_fn, [*self._ops, op], name=self._name)
+
+    def _rechunk(self, rows_per_block: int) -> "Dataset":
+        parent = self
+
+        def source():
+            for batch in parent.iter_batches(batch_size=rows_per_block):
+                yield raytpu.put(batch)
+
+        return Dataset(source, [], name=f"{self._name}.rechunk")
+
+    def __repr__(self):
+        ops = " -> ".join(op.name for op in self._ops) or "source"
+        return f"Dataset({self._name}: {ops})"
+
+
+@raytpu.remote(num_cpus=0)
+class _SplitCoordinator:
+    """Feeds n consumers from one pass (OutputSplitter analogue). Blocks
+    are handed out round-robin; `equal=True` semantics approximated by
+    per-consumer demand-driven pull."""
+
+    def __init__(self, dataset: Dataset, n: int):
+        self.iter = dataset._iter_block_refs()
+        self.n = n
+        self.buffers: List[List] = [[] for _ in range(n)]
+        self.exhausted = False
+        self.rr = 0
+
+    def next_ref(self, split: int):
+        """Next block ref for consumer `split`, or None at end of stream."""
+        while not self.buffers[split] and not self.exhausted:
+            try:
+                ref = next(self.iter)
+            except StopIteration:
+                self.exhausted = True
+                break
+            self.buffers[self.rr].append(ref)
+            self.rr = (self.rr + 1) % self.n
+        if self.buffers[split]:
+            return self.buffers[split].pop(0)
+        return None
+
+
+class DataIterator:
+    """Per-worker streaming iterator (reference: ``DataIterator`` from
+    ``streaming_split``; consumed in train loops via
+    ``session.get_dataset_shard``)."""
+
+    def __init__(self, coordinator, split: int):
+        self._coordinator = coordinator
+        self._split = split
+
+    def iter_blocks(self):
+        while True:
+            # get() resolves the returned block ref one level, so this
+            # yields the block value directly.
+            block = raytpu.get(
+                self._coordinator.next_ref.remote(self._split))
+            if block is None:
+                return
+            yield block
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False):
+        carry: List = []
+        carry_rows = 0
+        for block in self.iter_blocks():
+            carry.append(block)
+            carry_rows += BlockAccessor(block).num_rows()
+            while carry_rows >= batch_size:
+                whole = concat_blocks(carry)
+                acc = BlockAccessor(whole)
+                yield batch_format_view(acc.slice(0, batch_size),
+                                        batch_format)
+                rest = acc.slice(batch_size, acc.num_rows())
+                carry = [rest]
+                carry_rows = BlockAccessor(rest).num_rows()
+        if carry_rows and not drop_last:
+            yield batch_format_view(concat_blocks(carry), batch_format)
+
+    def iter_rows(self):
+        for block in self.iter_blocks():
+            yield from BlockAccessor(block).to_rows()

@@ -1,0 +1,82 @@
+"""Streaming executor — pull-based pipelined block processing.
+
+Reference analogue: ``python/ray/data/_internal/execution/
+streaming_executor.py:55`` + ``streaming_executor_state.py`` (SURVEY.md
+A8): operators process blocks as distributed tasks; the driver-side loop
+keeps at most ``max_in_flight`` tasks outstanding per operator
+(ConcurrencyCapBackpressurePolicy analogue,
+``backpressure_policy/concurrency_cap_backpressure_policy.py:18``) and
+yields output blocks as they complete, preserving block order (streaming:
+downstream consumption overlaps upstream production; memory is bounded by
+in-flight count, not dataset size).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional
+
+import raytpu
+from raytpu.runtime.object_ref import ObjectRef
+
+
+class OpSpec:
+    """One pipeline stage: a remote transform over blocks.
+
+    fn(block) -> block (or list of blocks for flat ops).
+    """
+
+    def __init__(self, name: str, fn: Callable, *, num_cpus: float = 1.0,
+                 flat: bool = False):
+        self.name = name
+        self.fn = fn
+        self.num_cpus = num_cpus
+        self.flat = flat
+
+
+def run_pipeline(source: Iterator, ops: List[OpSpec], *,
+                 max_in_flight: int = 8) -> Iterator[ObjectRef]:
+    """Stream block refs from `source` through `ops`.
+
+    `source` yields ObjectRefs of blocks. Returns an iterator of output
+    block refs in order. Each stage runs as remote tasks with a
+    concurrency cap; stages are chained per-block (pipeline, no barrier —
+    block i can be in stage 2 while block j is in stage 0).
+    """
+    if not ops:
+        yield from source
+        return
+
+    remotes = []
+    for op in ops:
+        @raytpu.remote(num_cpus=op.num_cpus, name=f"data::{op.name}")
+        def stage(block, _fn=op.fn):
+            return _fn(block)
+
+        remotes.append(stage)
+
+    def chain(ref: ObjectRef) -> ObjectRef:
+        for r in remotes:
+            ref = r.remote(ref)
+        return ref
+
+    pending: List[ObjectRef] = []  # ordered
+    source_iter = iter(source)
+    exhausted = False
+    while pending or not exhausted:
+        while not exhausted and len(pending) < max_in_flight:
+            try:
+                in_ref = next(source_iter)
+            except StopIteration:
+                exhausted = True
+                break
+            pending.append(chain(in_ref))
+        if pending:
+            # Ordered streaming: wait on the head (completion order within
+            # the window doesn't matter for memory; order does for output).
+            head = pending.pop(0)
+            raytpu.wait([head], num_returns=1)
+            yield head
+
+
+def materialize_refs(refs: Iterator[ObjectRef]) -> List[ObjectRef]:
+    return list(refs)

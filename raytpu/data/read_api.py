@@ -1,0 +1,187 @@
+"""Dataset sources (reference: ``python/ray/data/read_api.py`` + the 38
+datasource modules under ``python/ray/data/datasource/`` — the common
+file-based ones re-implemented; exotic connectors are later-round work)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import raytpu
+from raytpu.data.block import block_from_rows
+from raytpu.data.dataset import Dataset
+
+
+def range(n: int, *, blocks: int = 8) -> Dataset:  # noqa: A001
+    """Integers [0, n) as column 'id' (reference: ``ray.data.range``)."""
+    import builtins
+
+    blocks = max(1, min(blocks, n or 1))
+
+    def source():
+        per = -(-n // blocks)
+        for i in builtins.range(blocks):
+            lo, hi = i * per, min((i + 1) * per, n)
+            if lo >= n:
+                break
+            yield raytpu.put({"id": np.arange(lo, hi, dtype=np.int64)})
+
+    return Dataset(source, [], name=f"range({n})")
+
+
+def range_tensor(n: int, *, shape=(1,), blocks: int = 8) -> Dataset:
+    blocks = max(1, min(blocks, n or 1))
+
+    def source():
+        import builtins
+
+        per = -(-n // blocks)
+        for i in builtins.range(blocks):
+            lo, hi = i * per, min((i + 1) * per, n)
+            if lo >= n:
+                break
+            count = hi - lo
+            data = np.arange(lo, hi, dtype=np.float32).reshape(
+                (count,) + (1,) * len(shape)) * np.ones((1,) + tuple(shape),
+                                                        np.float32)
+            yield raytpu.put({"data": data})
+
+    return Dataset(source, [], name=f"range_tensor({n})")
+
+
+def from_items(items: List[Any], *, blocks: int = 8) -> Dataset:
+    items = list(items)
+    blocks = max(1, min(blocks, len(items) or 1))
+
+    def source():
+        import builtins
+
+        per = -(-len(items) // blocks)
+        for i in builtins.range(blocks):
+            chunk = items[i * per: (i + 1) * per]
+            if not chunk:
+                break
+            rows = [x if isinstance(x, dict) else {"item": x} for x in chunk]
+            yield raytpu.put(block_from_rows(rows))
+
+    return Dataset(source, [], name="from_items")
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, blocks: int = 1) -> Dataset:
+    def source():
+        import builtins
+
+        n = len(next(iter(arrays.values())))
+        per = -(-n // blocks)
+        for i in builtins.range(blocks):
+            lo, hi = i * per, min((i + 1) * per, n)
+            if lo >= n:
+                break
+            yield raytpu.put({k: np.asarray(v)[lo:hi]
+                              for k, v in arrays.items()})
+
+    return Dataset(source, [], name="from_numpy")
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    table = pa.Table.from_pandas(df, preserve_index=False)
+
+    def source():
+        yield raytpu.put(table)
+
+    return Dataset(source, [], name="from_pandas")
+
+
+def from_arrow(table) -> Dataset:
+    def source():
+        yield raytpu.put(table)
+
+    return Dataset(source, [], name="from_arrow")
+
+
+def _expand_paths(paths, suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+        elif any(ch in p for ch in "*?["):
+            files.extend(sorted(_glob.glob(p)))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no {suffix} files under {paths}")
+    return files
+
+
+def read_parquet(paths, *, columns: Optional[Sequence[str]] = None) -> Dataset:
+    """One remote read task per file — IO parallelism rides the task
+    fabric (reference: parquet datasource)."""
+    files = _expand_paths(paths, ".parquet")
+
+    @raytpu.remote(name="data::read_parquet")
+    def read_one(path):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=list(columns) if columns else None)
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_parquet")
+
+
+def read_csv(paths, **read_kwargs) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    @raytpu.remote(name="data::read_csv")
+    def read_one(path):
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path)
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_csv")
+
+
+def read_json(paths, **read_kwargs) -> Dataset:
+    files = _expand_paths(paths, ".json")
+
+    @raytpu.remote(name="data::read_json")
+    def read_one(path):
+        import pyarrow.json as pajson
+
+        return pajson.read_json(path)
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_json")
+
+
+def read_text(paths) -> Dataset:
+    files = _expand_paths(paths, "")
+
+    @raytpu.remote(name="data::read_text")
+    def read_one(path):
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return block_from_rows([{"text": ln} for ln in lines])
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_text")

@@ -1,0 +1,188 @@
+"""Dataset engine tests (reference analogues: ``python/ray/data/tests/``
+operator-level + e2e tests)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def data_env(raytpu_local):
+    import raytpu.data as rd
+
+    yield raytpu_local, rd
+
+
+class TestSources:
+    def test_range(self, data_env):
+        _, rd = data_env
+        ds = rd.range(100, blocks=4)
+        assert ds.count() == 100
+        assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+    def test_from_items(self, data_env):
+        _, rd = data_env
+        ds = rd.from_items([{"a": i} for i in range(10)])
+        assert ds.count() == 10
+
+    def test_from_numpy(self, data_env):
+        _, rd = data_env
+        ds = rd.from_numpy({"x": np.arange(20), "y": np.arange(20) * 2},
+                           blocks=4)
+        assert ds.count() == 20
+        assert ds.sum("y") == 380.0
+
+    def test_parquet_roundtrip(self, data_env, tmp_path):
+        _, rd = data_env
+        ds = rd.range(50, blocks=2)
+        ds.write_parquet(str(tmp_path / "pq"))
+        back = rd.read_parquet(str(tmp_path / "pq"))
+        assert back.count() == 50
+        assert back.sum("id") == sum(range(50))
+
+    def test_csv_roundtrip(self, data_env, tmp_path):
+        _, rd = data_env
+        rd.range(30, blocks=1).write_csv(str(tmp_path / "csv"))
+        back = rd.read_csv(str(tmp_path / "csv"))
+        assert back.count() == 30
+
+
+class TestTransforms:
+    def test_map_batches_numpy(self, data_env):
+        _, rd = data_env
+        ds = rd.range(100, blocks=4).map_batches(
+            lambda b: {"id": b["id"] * 2})
+        assert ds.sum("id") == 2 * sum(range(100))
+
+    def test_map_and_filter(self, data_env):
+        _, rd = data_env
+        ds = (rd.range(20, blocks=2)
+              .map(lambda r: {"v": int(r["id"]) + 1})
+              .filter(lambda r: r["v"] % 2 == 0))
+        assert sorted(r["v"] for r in ds.take_all()) == [2, 4, 6, 8, 10, 12,
+                                                         14, 16, 18, 20]
+
+    def test_flat_map(self, data_env):
+        _, rd = data_env
+        ds = rd.range(5, blocks=1).flat_map(
+            lambda r: [{"v": int(r["id"])}, {"v": int(r["id"])}])
+        assert ds.count() == 10
+
+    def test_chained_streaming(self, data_env):
+        _, rd = data_env
+        ds = (rd.range(1000, blocks=8)
+              .map_batches(lambda b: {"id": b["id"] + 1})
+              .map_batches(lambda b: {"id": b["id"] * 3}))
+        assert ds.min("id") == 3.0
+        assert ds.max("id") == 3000.0
+
+    def test_limit_stops_early(self, data_env):
+        _, rd = data_env
+        ds = rd.range(10_000, blocks=100).limit(15)
+        assert ds.count() == 15
+
+    def test_repartition(self, data_env):
+        _, rd = data_env
+        ds = rd.range(100, blocks=10).repartition(3)
+        assert ds.stats()["blocks"] == 3
+        assert ds.count() == 100
+
+    def test_random_shuffle_preserves_rows(self, data_env):
+        _, rd = data_env
+        ds = rd.range(50, blocks=5).random_shuffle(seed=7)
+        vals = sorted(int(r["id"]) for r in ds.take_all())
+        assert vals == list(range(50))
+
+    def test_sort(self, data_env):
+        _, rd = data_env
+        ds = rd.from_items([{"k": v} for v in [5, 3, 9, 1]]).sort("k")
+        assert [r["k"] for r in ds.take_all()] == [1, 3, 5, 9]
+
+    def test_union(self, data_env):
+        _, rd = data_env
+        assert rd.range(10).union(rd.range(5)).count() == 15
+
+    def test_select_drop_columns(self, data_env):
+        _, rd = data_env
+        ds = rd.from_numpy({"a": np.arange(5), "b": np.arange(5)})
+        assert set(ds.select_columns(["a"]).take(1)[0].keys()) == {"a"}
+        assert set(ds.drop_columns(["a"]).take(1)[0].keys()) == {"b"}
+
+
+class TestConsumption:
+    def test_iter_batches_sizes(self, data_env):
+        _, rd = data_env
+        ds = rd.range(103, blocks=7)
+        batches = list(ds.iter_batches(batch_size=25))
+        sizes = [len(b["id"]) for b in batches]
+        assert sum(sizes) == 103
+        assert all(s == 25 for s in sizes[:-1])
+
+    def test_iter_batches_pandas(self, data_env):
+        _, rd = data_env
+        ds = rd.range(10, blocks=2)
+        batch = next(ds.iter_batches(batch_size=10, batch_format="pandas"))
+        assert list(batch.columns) == ["id"]
+
+    def test_to_pandas(self, data_env):
+        _, rd = data_env
+        df = rd.range(10).to_pandas()
+        assert len(df) == 10
+
+    def test_materialize(self, data_env):
+        _, rd = data_env
+        calls = []
+
+        def spy(b):
+            calls.append(1)
+            return b
+
+        ds = rd.range(10, blocks=2).map_batches(spy).materialize()
+        assert ds.count() == 10
+        n = len(calls)
+        assert ds.count() == 10  # second pass reuses blocks
+        assert len(calls) == n
+
+
+class TestStreamingSplit:
+    def test_split_covers_all_rows(self, data_env):
+        _, rd = data_env
+        ds = rd.range(100, blocks=10)
+        its = ds.streaming_split(2)
+        rows0 = [int(r["id"]) for r in its[0].iter_rows()]
+        rows1 = [int(r["id"]) for r in its[1].iter_rows()]
+        assert sorted(rows0 + rows1) == list(range(100))
+        assert rows0 and rows1
+
+    def test_split_batches(self, data_env):
+        _, rd = data_env
+        ds = rd.range(64, blocks=8)
+        its = ds.streaming_split(2)
+        total = 0
+        for b in its[0].iter_batches(batch_size=8):
+            total += len(b["id"])
+        for b in its[1].iter_batches(batch_size=8):
+            total += len(b["id"])
+        assert total == 64
+
+
+class TestTrainIntegration:
+    def test_dataset_into_trainer(self, data_env, tmp_path):
+        raytpu, rd = data_env
+        from raytpu.train import JaxTrainer, RunConfig, ScalingConfig, report
+        from raytpu.train.session import get_dataset_shard
+
+        def loop(config):
+            it = get_dataset_shard("train")
+            seen = 0
+            for batch in it.iter_batches(batch_size=10):
+                seen += len(batch["id"])
+            report({"rows_seen": seen})
+
+        result = JaxTrainer(
+            loop,
+            datasets={"train": rd.range(100, blocks=10)},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=str(tmp_path)),
+        ).fit()
+        assert result.error is None
+        assert result.metrics["rows_seen"] > 0
