@@ -1,0 +1,40 @@
+"""raytpu.tune — experiment runner (reference: ``python/ray/tune/``)."""
+
+from raytpu.train.session import report  # same report API as Train
+from raytpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from raytpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    randint,
+    uniform,
+)
+from raytpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "ResultGrid",
+    "run",
+    "report",
+    "choice",
+    "uniform",
+    "loguniform",
+    "randint",
+    "qrandint",
+    "grid_search",
+    "Searcher",
+    "BasicVariantGenerator",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "ASHAScheduler",
+    "PopulationBasedTraining",
+]

@@ -1,0 +1,134 @@
+"""Trial schedulers — early stopping and population-based training.
+
+Reference analogue: ``python/ray/tune/schedulers/`` (ASHA/HyperBand/PBT).
+Decisions are made on every reported result: CONTINUE, STOP, or (PBT)
+EXPLOIT another trial's config+checkpoint.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def exploit_target(self, trial):
+        """PBT hook: trial to clone from (None = keep going)."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference:
+    ``tune/schedulers/async_hyperband.py``): rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung stops
+    unless in the top 1/reduction_factor of completed results there."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 100):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_results: Dict[int, List[float]] = defaultdict(list)
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        value = float(metric) if self.mode == "max" else -float(metric)
+        for rung in self.rungs:
+            if t == rung:
+                peers = self.rung_results[rung]
+                peers.append(value)
+                k = max(1, math.ceil(len(peers) / self.rf))
+                top_k = sorted(peers, reverse=True)[:k]
+                if value < top_k[-1]:
+                    return STOP
+        if t >= self.max_t:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: ``tune/schedulers/pbt.py``): every
+    ``perturbation_interval`` results, bottom-quantile trials exploit a
+    top-quantile trial (config + checkpoint) and explore by perturbing
+    hyperparams."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self.latest: Dict[str, float] = {}
+        self._trials: Dict[str, Any] = {}
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        metric = result.get(self.metric)
+        if metric is None:
+            return CONTINUE
+        sign = 1.0 if self.mode == "max" else -1.0
+        self.latest[trial.trial_id] = sign * float(metric)
+        self._trials[trial.trial_id] = trial
+        return CONTINUE
+
+    def exploit_target(self, trial):
+        t = trial.last_result.get(self.time_attr, 0)
+        if not t or t % self.interval != 0 or len(self.latest) < 2:
+            return None
+        ranked = sorted(self.latest.items(), key=lambda kv: kv[1],
+                        reverse=True)
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom_ids = {tid for tid, _ in ranked[-k:]}
+        if trial.trial_id not in bottom_ids:
+            return None
+        top_ids = [tid for tid, _ in ranked[:k] if tid != trial.trial_id]
+        if not top_ids:
+            return None
+        return self._trials[self.rng.choice(top_ids)]
+
+    def perturb(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from raytpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            elif isinstance(spec, Domain):
+                out[key] = spec.sample(self.rng)
+            elif callable(spec):
+                out[key] = spec()
+            elif key in out and isinstance(out[key], (int, float)):
+                out[key] = out[key] * self.rng.choice([0.8, 1.2])
+        return out

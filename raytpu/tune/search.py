@@ -1,0 +1,118 @@
+"""Search spaces and suggestion algorithms.
+
+Reference analogue: ``python/ray/tune/search/`` — the sample-space API
+(``tune.choice/uniform/loguniform/randint/grid_search``), the
+BasicVariantGenerator (grid x random expansion), and the Searcher plugin
+interface the Optuna/Ax/HEBO wrappers implement.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class Domain:
+    sampler: Callable[[random.Random], Any]
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.sampler(rng)
+
+
+def choice(options: List[Any]) -> Domain:
+    opts = list(options)
+    return Domain(lambda rng: rng.choice(opts))
+
+
+def uniform(low: float, high: float) -> Domain:
+    return Domain(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Domain:
+    lo, hi = math.log(low), math.log(high)
+    return Domain(lambda rng: math.exp(rng.uniform(lo, hi)))
+
+
+def randint(low: int, high: int) -> Domain:
+    return Domain(lambda rng: rng.randrange(low, high))
+
+
+def qrandint(low: int, high: int, q: int) -> Domain:
+    return Domain(lambda rng: rng.randrange(low, high, q))
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+class Searcher:
+    """Suggestion interface (reference: ``tune/search/searcher.py``)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid axes fully expanded x num_samples random draws of the rest
+    (reference semantics: grid_search multiplies num_samples)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = self._expand()
+        self._idx = 0
+
+    def _expand(self) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in self.param_space.items()
+                     if isinstance(v, GridSearch)]
+        grids = [self.param_space[k].values for k in grid_keys]
+        out = []
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grids) if grids else [()]:
+                cfg = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self.rng)
+                    else:
+                        cfg[k] = v
+                out.append(cfg)
+        return out
+
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg
+
+
+def sample_config(param_space: Dict[str, Any],
+                  rng: random.Random) -> Dict[str, Any]:
+    cfg = {}
+    for k, v in param_space.items():
+        if isinstance(v, Domain):
+            cfg[k] = v.sample(rng)
+        elif isinstance(v, GridSearch):
+            cfg[k] = rng.choice(v.values)
+        else:
+            cfg[k] = v
+    return cfg

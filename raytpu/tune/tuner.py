@@ -1,0 +1,266 @@
+"""Tuner + trial controller.
+
+Reference analogue: ``python/ray/tune/tuner.py:46`` (Tuner),
+``tune/execution/tune_controller.py:69`` (the central event loop driving
+trial actors), ``tune/trainable/``. Trials run as actors hosting the user
+function in a session thread (the same session machinery Train uses — in
+the reference Train itself runs *on* Tune, ``base_trainer.py:724``);
+the controller polls reports, feeds the scheduler, stops/exploits trials,
+and collects Results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import raytpu
+from raytpu.train.checkpoint import Checkpoint, CheckpointManager
+from raytpu.train.config import Result, RunConfig
+from raytpu.train.trainer import TrainWorker
+from raytpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from raytpu.tune.search import BasicVariantGenerator, Searcher
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    state: str = "PENDING"  # PENDING/RUNNING/TERMINATED/ERROR/STOPPED
+    last_result: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    actor: Any = None
+    error: Optional[str] = None
+    checkpoint: Optional[Checkpoint] = None
+    iterations: int = 0
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], trials: List[Trial],
+                 metric: Optional[str], mode: str):
+        self._results = results
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set in TuneConfig or here)")
+        best, best_v = None, None
+        for r in self._results:
+            if r.error is not None or metric not in r.metrics:
+                continue
+            v = float(r.metrics[metric])
+            if best_v is None or (v > best_v if mode == "max" else v < best_v):
+                best, best_v = r, v
+        if best is None:
+            raise RuntimeError("no successful trial reported the metric")
+        return best
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for t in self._trials:
+            row = dict(t.last_result)
+            row["trial_id"] = t.trial_id
+            row.update({f"config/{k}": v for k, v in t.config.items()
+                        if isinstance(v, (int, float, str, bool))})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable[[dict], None], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        if hasattr(trainable, "train_loop_per_worker"):
+            # A JaxTrainer instance: tune over its train_loop_config
+            # (reference: BaseTrainer.fit wraps itself as a trainable).
+            trainer = trainable
+            base_cfg = dict(trainer.train_loop_config)
+            loop = trainer.train_loop_per_worker
+
+            def trainable(config):  # noqa: F811
+                merged = {**base_cfg, **config}
+                loop(merged)
+
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        rc = self.run_config
+        name = rc.name or f"raytpu-tune-{int(time.time())}"
+        storage = rc.storage_path or os.path.join(
+            tempfile.gettempdir(), "raytpu_results")
+        run_dir = os.path.join(storage, name)
+        os.makedirs(run_dir, exist_ok=True)
+
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples)
+        scheduler = tc.scheduler or FIFOScheduler()
+        if isinstance(scheduler, PopulationBasedTraining) and tc.metric:
+            scheduler.metric = scheduler.metric or tc.metric
+
+        import cloudpickle
+
+        fn_blob = cloudpickle.dumps(self.trainable)
+        max_conc = tc.max_concurrent_trials or self._default_concurrency()
+
+        trials: List[Trial] = []
+        live: List[Trial] = []
+        done: List[Trial] = []
+
+        def launch(config: Dict[str, Any],
+                   resume: Optional[Checkpoint] = None) -> Trial:
+            tid = f"trial_{uuid.uuid4().hex[:8]}"
+            trial = Trial(tid, config)
+            ctx_kwargs = {"experiment_name": name, "storage_path": run_dir}
+            actor = TrainWorker.options(
+                resources=tc.resources_per_trial).remote(0, 1, ctx_kwargs)
+            raytpu.get(actor.start.remote(
+                fn_blob, config, None,
+                resume.path if resume else None))
+            trial.actor = actor
+            trial.state = "RUNNING"
+            trials.append(trial)
+            live.append(trial)
+            return trial
+
+        # Prime the first wave.
+        while len(live) < max_conc:
+            cfg = searcher.suggest(f"t{len(trials)}")
+            if cfg is None:
+                break
+            launch(cfg)
+
+        while live:
+            polls = raytpu.get([t.actor.poll.remote() for t in live])
+            next_live: List[Trial] = []
+            for trial, (pairs, finished, err) in zip(live, polls):
+                decision = CONTINUE
+                for metrics, ckpt_path in pairs:
+                    trial.iterations += 1
+                    metrics.setdefault("training_iteration",
+                                       trial.iterations)
+                    trial.last_result = metrics
+                    trial.history.append(metrics)
+                    if ckpt_path:
+                        trial.checkpoint = self._persist_ckpt(
+                            run_dir, trial, ckpt_path)
+                    d = scheduler.on_result(trial, metrics)
+                    if d == STOP:
+                        decision = STOP
+                if err:
+                    trial.state = "ERROR"
+                    trial.error = err
+                    done.append(trial)
+                    searcher.on_trial_complete(trial.trial_id,
+                                               trial.last_result)
+                    continue
+                if finished:
+                    trial.state = "TERMINATED"
+                    done.append(trial)
+                    searcher.on_trial_complete(trial.trial_id,
+                                               trial.last_result)
+                    continue
+                if decision == STOP:
+                    trial.state = "STOPPED"
+                    raytpu.kill(trial.actor)
+                    done.append(trial)
+                    searcher.on_trial_complete(trial.trial_id,
+                                               trial.last_result)
+                    continue
+                # PBT exploit/explore.
+                target = scheduler.exploit_target(trial)
+                if target is not None and target.checkpoint is not None:
+                    raytpu.kill(trial.actor)
+                    trial.state = "STOPPED"
+                    done.append(trial)
+                    new_cfg = scheduler.perturb(target.config)
+                    launch(new_cfg, resume=target.checkpoint)
+                    continue
+                next_live.append(trial)
+            # Backfill free slots.
+            live = [t for t in next_live if t.state == "RUNNING"]
+            while len(live) < max_conc:
+                cfg = searcher.suggest(f"t{len(trials)}")
+                if cfg is None:
+                    break
+                t = launch(cfg)
+                live = [x for x in trials if x.state == "RUNNING"]
+            if live:
+                time.sleep(0.05)
+
+        results = []
+        for t in trials:
+            err = None
+            if t.error:
+                from raytpu.core.errors import TaskError
+
+                err = TaskError(t.trial_id, t.error)
+            results.append(Result(
+                metrics=t.last_result, metrics_history=t.history,
+                checkpoint=t.checkpoint, path=run_dir, error=err))
+        return ResultGrid(results, trials, tc.metric, tc.mode)
+
+    def _persist_ckpt(self, run_dir: str, trial: Trial,
+                      ckpt_path: str) -> Checkpoint:
+        import shutil
+
+        dst = os.path.join(run_dir, trial.trial_id,
+                           f"checkpoint_{trial.iterations:06d}")
+        if os.path.abspath(ckpt_path) != dst:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(ckpt_path, dst)
+        return Checkpoint(dst)
+
+    def _default_concurrency(self) -> int:
+        res = raytpu.cluster_resources()
+        return max(1, int(res.get("CPU", 1)))
+
+
+def run(trainable, *, param_space=None, tune_config=None, run_config=None):
+    """Functional entry (reference: ``tune.run``, ``tune/tune.py:277``)."""
+    return Tuner(trainable, param_space=param_space, tune_config=tune_config,
+                 run_config=run_config).fit()

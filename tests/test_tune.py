@@ -1,0 +1,147 @@
+"""Tune tests (reference analogues: ``python/ray/tune/tests/``)."""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def tune_env(raytpu_local, tmp_path):
+    import raytpu.tune as tune
+
+    from raytpu.train.config import RunConfig
+
+    yield raytpu_local, tune, RunConfig(storage_path=str(tmp_path))
+
+
+class TestSearchSpace:
+    def test_grid_expansion(self, tune_env):
+        _, tune, _ = tune_env
+        gen = tune.BasicVariantGenerator(
+            {"a": tune.grid_search([1, 2, 3]), "b": 7}, num_samples=2)
+        cfgs = [gen.suggest(str(i)) for i in range(6)]
+        assert all(c is not None for c in cfgs)
+        assert gen.suggest("x") is None
+        assert sorted(c["a"] for c in cfgs) == [1, 1, 2, 2, 3, 3]
+        assert all(c["b"] == 7 for c in cfgs)
+
+    def test_samplers(self, tune_env):
+        _, tune, _ = tune_env
+        import random
+
+        rng = random.Random(0)
+        assert tune.choice([1, 2]).sample(rng) in (1, 2)
+        assert 0.0 <= tune.uniform(0, 1).sample(rng) <= 1.0
+        v = tune.loguniform(1e-4, 1e-1).sample(rng)
+        assert 1e-4 <= v <= 1e-1
+        assert 5 <= tune.randint(5, 9).sample(rng) < 9
+
+
+class TestTuner:
+    def test_grid_finds_best(self, tune_env):
+        raytpu, tune, run_config = tune_env
+
+        def objective(config):
+            score = -(config["x"] - 3) ** 2
+            tune.report({"score": score})
+
+        grid = tune.Tuner(
+            objective,
+            param_space={"x": tune.grid_search([0, 1, 2, 3, 4, 5])},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        max_concurrent_trials=3),
+            run_config=run_config,
+        ).fit()
+        best = grid.get_best_result()
+        assert best.metrics["score"] == 0
+
+    def test_num_samples_random(self, tune_env):
+        raytpu, tune, run_config = tune_env
+
+        def objective(config):
+            tune.report({"v": config["lr"]})
+
+        grid = tune.Tuner(
+            objective,
+            param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+            tune_config=tune.TuneConfig(metric="v", mode="max",
+                                        num_samples=5,
+                                        max_concurrent_trials=2),
+            run_config=run_config,
+        ).fit()
+        assert len(grid) == 5
+        assert not grid.errors
+
+    def test_trial_error_isolated(self, tune_env):
+        raytpu, tune, run_config = tune_env
+
+        def objective(config):
+            if config["x"] == 1:
+                raise RuntimeError("bad trial")
+            tune.report({"score": config["x"]})
+
+        grid = tune.Tuner(
+            objective,
+            param_space={"x": tune.grid_search([0, 1, 2])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=run_config,
+        ).fit()
+        assert len(grid.errors) == 1
+        assert grid.get_best_result().metrics["score"] == 2
+
+    def test_asha_stops_bad_trials(self, tune_env):
+        raytpu, tune, run_config = tune_env
+
+        def objective(config):
+            for step in range(1, 20):
+                tune.report({"acc": config["q"] * step,
+                             "training_iteration": step})
+                # Weak trials arrive at rungs later, so the rung already
+                # has strong peers (async ASHA stops late weak arrivals).
+                time.sleep(0.005 if config["q"] >= 1.0 else 0.05)
+
+        grid = tune.Tuner(
+            objective,
+            param_space={"q": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+            tune_config=tune.TuneConfig(
+                metric="acc", mode="max", max_concurrent_trials=4,
+                scheduler=tune.ASHAScheduler(
+                    metric="acc", grace_period=2, reduction_factor=2,
+                    max_t=19)),
+            run_config=run_config,
+        ).fit()
+        stopped = [t for t in grid._trials if t.state == "STOPPED"]
+        assert stopped, "ASHA should stop at least one weak trial"
+        assert grid.get_best_result().metrics["acc"] > 1.0
+
+    def test_dataframe(self, tune_env):
+        raytpu, tune, run_config = tune_env
+
+        def objective(config):
+            tune.report({"score": config["x"]})
+
+        grid = tune.Tuner(
+            objective, param_space={"x": tune.grid_search([1, 2])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=run_config,
+        ).fit()
+        df = grid.get_dataframe()
+        assert len(df) == 2
+        assert "config/x" in df.columns
+
+    def test_tune_over_jax_trainer(self, tune_env):
+        raytpu, tune, run_config = tune_env
+        from raytpu.train import JaxTrainer, ScalingConfig
+
+        def loop(config):
+            tune.report({"loss": abs(config["lr"] - 0.01)})
+
+        trainer = JaxTrainer(loop, train_loop_config={"lr": 0.1},
+                             scaling_config=ScalingConfig(num_workers=1))
+        grid = tune.Tuner(
+            trainer,
+            param_space={"lr": tune.grid_search([0.1, 0.01, 0.001])},
+            tune_config=tune.TuneConfig(metric="loss", mode="min"),
+            run_config=run_config,
+        ).fit()
+        assert grid.get_best_result().metrics["loss"] == 0.0
