@@ -1,0 +1,30 @@
+"""raytpu.collective — collectives on two planes.
+
+Host plane (orchestration-scale, numpy over the actor fabric; reference:
+``ray.util.collective`` gloo backend) and device plane (compiled XLA
+collectives over mesh axes; replaces the reference's NCCL backend).
+"""
+
+from raytpu.collective.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reducescatter,
+    send,
+)
+from raytpu.collective import mesh_ops
+
+__all__ = [
+    "ReduceOp", "init_collective_group", "destroy_collective_group",
+    "is_group_initialized", "get_rank", "get_collective_group_size",
+    "allreduce", "allgather", "reducescatter", "broadcast", "barrier",
+    "send", "recv", "mesh_ops",
+]

@@ -1,0 +1,180 @@
+"""Collective library tests (reference analogue:
+``python/ray/util/collective/tests/``)."""
+
+import numpy as np
+import pytest
+
+import raytpu
+
+
+def _spawn_ranks(raytpu_mod, world, fn):
+    """Run fn(rank, world) in `world` parallel tasks, return results."""
+    remote_fn = raytpu_mod.remote(fn)
+    refs = [remote_fn.remote(r, world) for r in range(world)]
+    return raytpu_mod.get(refs)
+
+
+class TestHostCollectives:
+    def test_allreduce_sum(self, raytpu_local):
+        def work(rank, world):
+            from raytpu import collective as col
+
+            col.init_collective_group(world, rank, group_name="ar")
+            out = col.allreduce(np.full((4,), float(rank + 1)),
+                                group_name="ar")
+            return out
+
+        results = _spawn_ranks(raytpu_local, 4, work)
+        expected = np.full((4,), 1.0 + 2 + 3 + 4)
+        for r in results:
+            np.testing.assert_allclose(r, expected)
+
+    def test_allgather_and_broadcast(self, raytpu_local):
+        def work(rank, world):
+            from raytpu import collective as col
+
+            col.init_collective_group(world, rank, group_name="ag")
+            gathered = col.allgather(np.array([rank]), group_name="ag")
+            bcast = col.broadcast(np.array([rank * 10.0]), src_rank=2,
+                                  group_name="ag")
+            return [g.item() for g in gathered], bcast.item()
+
+        results = _spawn_ranks(raytpu_local, 3, work)
+        for gathered, bcast in results:
+            assert gathered == [0, 1, 2]
+            assert bcast == 20.0
+
+    def test_reducescatter(self, raytpu_local):
+        def work(rank, world):
+            from raytpu import collective as col
+
+            col.init_collective_group(world, rank, group_name="rs")
+            # Each rank contributes ones(4); sum = world, rank r gets rows
+            # [2r, 2r+2).
+            return col.reducescatter(np.ones((4, 2)), group_name="rs")
+
+        results = _spawn_ranks(raytpu_local, 2, work)
+        for r in results:
+            np.testing.assert_allclose(r, np.full((2, 2), 2.0))
+
+    def test_send_recv_and_barrier(self, raytpu_local):
+        def work(rank, world):
+            from raytpu import collective as col
+
+            col.init_collective_group(world, rank, group_name="p2p")
+            col.barrier(group_name="p2p", timeout=30)
+            if rank == 0:
+                col.send(np.array([42.0]), dst_rank=1, group_name="p2p")
+                return None
+            return col.recv(0, group_name="p2p", timeout=30).item()
+
+        results = _spawn_ranks(raytpu_local, 2, work)
+        assert results[1] == 42.0
+
+    def test_rank_and_size_queries(self, raytpu_local):
+        def work(rank, world):
+            from raytpu import collective as col
+
+            assert col.get_rank("q") == -1
+            col.init_collective_group(world, rank, group_name="q")
+            assert col.is_group_initialized("q")
+            r, s = col.get_rank("q"), col.get_collective_group_size("q")
+            col.destroy_collective_group("q")
+            assert not col.is_group_initialized("q")
+            return r, s
+
+        results = _spawn_ranks(raytpu_local, 2, work)
+        assert sorted(r for r, _ in results) == [0, 1]
+        assert all(s == 2 for _, s in results)
+
+    def test_op_order_mismatch_raises(self, raytpu_local):
+        def work(rank, world):
+            from raytpu import collective as col
+            from raytpu.core.errors import TaskError
+
+            col.init_collective_group(world, rank, group_name="mm")
+            try:
+                if rank == 0:
+                    col.allreduce(np.ones(2), group_name="mm")
+                else:
+                    col.allgather(np.ones(2), group_name="mm")
+            except Exception as e:  # noqa: BLE001
+                return type(e).__name__
+            return "ok"
+
+        results = _spawn_ranks(raytpu_local, 2, work)
+        # At least one rank must observe the mismatch error.
+        assert any(r != "ok" for r in results)
+
+
+class TestMeshOps:
+    def test_allreduce_allgather_in_mesh(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from raytpu.collective import mesh_ops
+
+        devs = np.array(jax.devices()[:8]).reshape(8)
+        mesh = Mesh(devs, ("x",))
+
+        def f(x):
+            s = mesh_ops.allreduce(x, "x")
+            g = mesh_ops.allgather(x, "x")
+            rs = mesh_ops.reducescatter(g, "x")
+            return s, g, rs
+
+        x = jnp.arange(8.0).reshape(8, 1)
+        s, g, rs = shard_map(f, mesh=mesh, in_specs=P("x"),
+                             out_specs=(P("x"), P("x"), P("x")),
+                             check_rep=False)(x)
+        np.testing.assert_allclose(np.asarray(s),
+                                   np.full((8, 1), 28.0))
+        # all_gather tiled: every shard holds all 8 rows -> global (64, 1)
+        assert g.shape == (64, 1)
+        # reduce_scatter of the gathered copy sums 8 copies then scatters:
+        np.testing.assert_allclose(np.asarray(rs).ravel(),
+                                   np.arange(8.0) * 8)
+
+    def test_broadcast_and_ring(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from raytpu.collective import mesh_ops
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+
+        def f(x):
+            b = mesh_ops.broadcast(x, "x", src_rank=1)
+            nxt = mesh_ops.send_next(x, "x", 4)
+            return b, nxt
+
+        x = jnp.arange(4.0).reshape(4, 1)
+        b, nxt = shard_map(f, mesh=mesh, in_specs=P("x"),
+                           out_specs=(P("x"), P("x")), check_rep=False)(x)
+        np.testing.assert_allclose(np.asarray(b).ravel(), np.ones(4))
+        np.testing.assert_allclose(np.asarray(nxt).ravel(),
+                                   np.array([3.0, 0.0, 1.0, 2.0]))
+
+    def test_all_to_all_ulysses_reshard(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from raytpu.collective import mesh_ops
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+
+        def f(x):  # x local: (seq/4, heads)
+            return mesh_ops.all_to_all(x, "sp", split_axis=1, concat_axis=0)
+
+        x = jnp.arange(32.0).reshape(8, 4)  # global seq=8 sharded -> local 2
+        out = shard_map(f, mesh=mesh, in_specs=P("sp", None),
+                        out_specs=P(None, "sp"), check_rep=False)(x)
+        # Resharded: seq now full per shard, heads sharded.
+        assert out.shape == (8, 4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
