@@ -100,10 +100,16 @@ class CheckpointManager:
         """Move the checkpoint into managed storage; evict beyond top-K."""
         self._counter += 1
         dst = os.path.join(self.root, f"checkpoint_{self._counter:06d}")
-        if os.path.abspath(checkpoint.path) != dst:
+        src = os.path.abspath(checkpoint.path)
+        if src != dst:
             if os.path.exists(dst):
                 shutil.rmtree(dst)
-            shutil.copytree(checkpoint.path, dst)
+            if f"{os.sep}.staged_ckpts{os.sep}" in src:
+                # Session-staged snapshot: single-owner, safe to move
+                # (avoids a second copy and cleans the staging area).
+                shutil.move(src, dst)
+            else:
+                shutil.copytree(src, dst)
         with open(os.path.join(dst, "_metrics.json"), "w") as f:
             json.dump(_jsonable(metrics), f)
         score = self._score(metrics)

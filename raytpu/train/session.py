@@ -8,6 +8,7 @@ flow back to the trainer, which persists checkpoints and feeds Tune.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -45,6 +46,21 @@ class _Session:
         self.dataset_shards = dataset_shards or {}
 
     def report(self, metrics: Dict[str, Any], checkpoint=None):
+        # Snapshot the checkpoint dir SYNCHRONOUSLY before returning:
+        # the reference's report() blocks until the checkpoint is
+        # persisted, which is what makes the canonical
+        # ``with TemporaryDirectory() as d: report(..., Checkpoint(d))``
+        # idiom safe. Draining happens later, possibly after `d` is gone.
+        if checkpoint is not None and getattr(checkpoint, "path", None):
+            import shutil
+            import tempfile
+            import uuid
+
+            base = self.context.storage_path or tempfile.gettempdir()
+            staged = os.path.join(base, ".staged_ckpts", uuid.uuid4().hex)
+            os.makedirs(os.path.dirname(staged), exist_ok=True)
+            shutil.copytree(checkpoint.path, staged)
+            checkpoint = type(checkpoint)(staged)
         with self.lock:
             self.reports.append((dict(metrics), checkpoint))
 

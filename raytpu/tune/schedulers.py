@@ -20,6 +20,9 @@ class TrialScheduler:
     def on_result(self, trial, result: Dict[str, Any]) -> str:
         return CONTINUE
 
+    def on_trial_remove(self, trial) -> None:
+        """Trial left the live set (terminated/errored/stopped/exploited)."""
+
     def exploit_target(self, trial):
         """PBT hook: trial to clone from (None = keep going)."""
         return None
@@ -51,6 +54,11 @@ class ASHAScheduler(TrialScheduler):
             self.rungs.append(t)
             t *= reduction_factor
         self.rung_results: Dict[int, List[float]] = defaultdict(list)
+        # trial_id -> rungs already evaluated (a trial whose time_attr
+        # skips past a rung value is still judged at that rung — exact
+        # equality would silently degrade ASHA to FIFO for trials that
+        # report every k iterations).
+        self._completed: Dict[str, set] = defaultdict(set)
 
     def on_result(self, trial, result: Dict[str, Any]) -> str:
         t = result.get(self.time_attr)
@@ -58,8 +66,10 @@ class ASHAScheduler(TrialScheduler):
         if t is None or metric is None:
             return CONTINUE
         value = float(metric) if self.mode == "max" else -float(metric)
+        seen = self._completed[trial.trial_id]
         for rung in self.rungs:
-            if t == rung:
+            if t >= rung and rung not in seen:
+                seen.add(rung)
                 peers = self.rung_results[rung]
                 peers.append(value)
                 k = max(1, math.ceil(len(peers) / self.rf))
@@ -69,6 +79,9 @@ class ASHAScheduler(TrialScheduler):
         if t >= self.max_t:
             return STOP
         return CONTINUE
+
+    def on_trial_remove(self, trial) -> None:
+        self._completed.pop(trial.trial_id, None)
 
 
 class PopulationBasedTraining(TrialScheduler):
@@ -101,6 +114,13 @@ class PopulationBasedTraining(TrialScheduler):
         self.latest[trial.trial_id] = sign * float(metric)
         self._trials[trial.trial_id] = trial
         return CONTINUE
+
+    def on_trial_remove(self, trial) -> None:
+        # Quantiles must rank LIVE trials only — a dead trial left in
+        # `latest` would occupy a bottom slot and shield a struggling live
+        # trial from exploitation.
+        self.latest.pop(trial.trial_id, None)
+        self._trials.pop(trial.trial_id, None)
 
     def exploit_target(self, trial):
         t = trial.last_result.get(self.time_attr, 0)

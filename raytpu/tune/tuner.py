@@ -54,6 +54,9 @@ class Trial:
     error: Optional[str] = None
     checkpoint: Optional[Checkpoint] = None
     iterations: int = 0
+    # True when the config came from the searcher (PBT clones don't —
+    # the searcher must only see completions for ids it issued).
+    from_searcher: bool = False
 
 
 class ResultGrid:
@@ -111,14 +114,38 @@ class Tuner:
                  run_config: Optional[RunConfig] = None):
         if hasattr(trainable, "train_loop_per_worker"):
             # A JaxTrainer instance: tune over its train_loop_config
-            # (reference: BaseTrainer.fit wraps itself as a trainable).
+            # (reference: BaseTrainer.fit wraps itself as a trainable,
+            # ``base_trainer.py:724`` — the trial runs the FULL trainer,
+            # gang + datasets included, not just the bare loop).
             trainer = trainable
             base_cfg = dict(trainer.train_loop_config)
-            loop = trainer.train_loop_per_worker
 
             def trainable(config):  # noqa: F811
+                from raytpu.train import session as session_mod
+
                 merged = {**base_cfg, **config}
-                loop(merged)
+                single = (trainer.scaling_config.num_workers <= 1
+                          and not trainer.datasets)
+                if single:
+                    # Fast path: run the loop inline so per-iteration
+                    # reports stream to the trial session (ASHA/PBT see
+                    # every result).
+                    trainer.train_loop_per_worker(merged)
+                    return
+                nested = type(trainer)(
+                    trainer.train_loop_per_worker,
+                    train_loop_config=merged,
+                    datasets=trainer.datasets,
+                    scaling_config=trainer.scaling_config,
+                    run_config=trainer.run_config,
+                    resume_from_checkpoint=(session_mod.get_checkpoint()
+                                            or trainer.resume_from_checkpoint),
+                )
+                result = nested.fit()
+                if result.error is not None:
+                    raise result.error
+                session_mod.report(result.metrics,
+                                   checkpoint=result.checkpoint)
 
         self.trainable = trainable
         self.param_space = param_space or {}
@@ -146,12 +173,10 @@ class Tuner:
         max_conc = tc.max_concurrent_trials or self._default_concurrency()
 
         trials: List[Trial] = []
-        live: List[Trial] = []
-        done: List[Trial] = []
+        ckpt_managers: Dict[str, CheckpointManager] = {}
 
-        def launch(config: Dict[str, Any],
+        def launch(tid: str, config: Dict[str, Any],
                    resume: Optional[Checkpoint] = None) -> Trial:
-            tid = f"trial_{uuid.uuid4().hex[:8]}"
             trial = Trial(tid, config)
             ctx_kwargs = {"experiment_name": name, "storage_path": run_dir}
             actor = TrainWorker.options(
@@ -162,19 +187,40 @@ class Tuner:
             trial.actor = actor
             trial.state = "RUNNING"
             trials.append(trial)
-            live.append(trial)
             return trial
 
-        # Prime the first wave.
-        while len(live) < max_conc:
-            cfg = searcher.suggest(f"t{len(trials)}")
+        def suggest_and_launch() -> Optional[Trial]:
+            tid = f"trial_{uuid.uuid4().hex[:8]}"
+            cfg = searcher.suggest(tid)
             if cfg is None:
-                break
-            launch(cfg)
+                return None
+            t = launch(tid, cfg)
+            t.from_searcher = True
+            return t
 
+        def finish(trial: Trial, state: str, error: Optional[str] = None):
+            """Completion paths share one exit: state, actor kill (frees
+            resources_per_trial), searcher + scheduler notification."""
+            trial.state = state
+            trial.error = error
+            if trial.actor is not None:
+                try:
+                    raytpu.kill(trial.actor)
+                except Exception:
+                    pass
+                trial.actor = None
+            if getattr(trial, "from_searcher", False):
+                searcher.on_trial_complete(trial.trial_id, trial.last_result)
+            scheduler.on_trial_remove(trial)
+
+        # Prime the first wave.
+        while sum(t.state == "RUNNING" for t in trials) < max_conc:
+            if suggest_and_launch() is None:
+                break
+
+        live = [t for t in trials if t.state == "RUNNING"]
         while live:
             polls = raytpu.get([t.actor.poll.remote() for t in live])
-            next_live: List[Trial] = []
             for trial, (pairs, finished, err) in zip(live, polls):
                 decision = CONTINUE
                 for metrics, ckpt_path in pairs:
@@ -185,48 +231,37 @@ class Tuner:
                     trial.history.append(metrics)
                     if ckpt_path:
                         trial.checkpoint = self._persist_ckpt(
-                            run_dir, trial, ckpt_path)
+                            ckpt_managers, run_dir, trial, ckpt_path,
+                            metrics)
                     d = scheduler.on_result(trial, metrics)
                     if d == STOP:
+                        # Later buffered results from a to-be-stopped trial
+                        # must not enter rung statistics.
                         decision = STOP
+                        break
                 if err:
-                    trial.state = "ERROR"
-                    trial.error = err
-                    done.append(trial)
-                    searcher.on_trial_complete(trial.trial_id,
-                                               trial.last_result)
+                    finish(trial, "ERROR", error=err)
                     continue
                 if finished:
-                    trial.state = "TERMINATED"
-                    done.append(trial)
-                    searcher.on_trial_complete(trial.trial_id,
-                                               trial.last_result)
+                    finish(trial, "TERMINATED")
                     continue
                 if decision == STOP:
-                    trial.state = "STOPPED"
-                    raytpu.kill(trial.actor)
-                    done.append(trial)
-                    searcher.on_trial_complete(trial.trial_id,
-                                               trial.last_result)
+                    finish(trial, "STOPPED")
                     continue
                 # PBT exploit/explore.
                 target = scheduler.exploit_target(trial)
                 if target is not None and target.checkpoint is not None:
-                    raytpu.kill(trial.actor)
-                    trial.state = "STOPPED"
-                    done.append(trial)
+                    finish(trial, "STOPPED")
                     new_cfg = scheduler.perturb(target.config)
-                    launch(new_cfg, resume=target.checkpoint)
-                    continue
-                next_live.append(trial)
-            # Backfill free slots.
-            live = [t for t in next_live if t.state == "RUNNING"]
+                    launch(f"trial_{uuid.uuid4().hex[:8]}", new_cfg,
+                           resume=target.checkpoint)
+            # Rebuild from `trials` (not the poll set) so PBT clones
+            # launched mid-poll stay tracked; then backfill free slots.
+            live = [t for t in trials if t.state == "RUNNING"]
             while len(live) < max_conc:
-                cfg = searcher.suggest(f"t{len(trials)}")
-                if cfg is None:
+                if suggest_and_launch() is None:
                     break
-                t = launch(cfg)
-                live = [x for x in trials if x.state == "RUNNING"]
+                live = [t for t in trials if t.state == "RUNNING"]
             if live:
                 time.sleep(0.05)
 
@@ -242,18 +277,22 @@ class Tuner:
                 checkpoint=t.checkpoint, path=run_dir, error=err))
         return ResultGrid(results, trials, tc.metric, tc.mode)
 
-    def _persist_ckpt(self, run_dir: str, trial: Trial,
-                      ckpt_path: str) -> Checkpoint:
-        import shutil
-
-        dst = os.path.join(run_dir, trial.trial_id,
-                           f"checkpoint_{trial.iterations:06d}")
-        if os.path.abspath(ckpt_path) != dst:
-            os.makedirs(os.path.dirname(dst), exist_ok=True)
-            if os.path.exists(dst):
-                shutil.rmtree(dst)
-            shutil.copytree(ckpt_path, dst)
-        return Checkpoint(dst)
+    def _persist_ckpt(self, managers: Dict[str, CheckpointManager],
+                      run_dir: str, trial: Trial, ckpt_path: str,
+                      metrics: Dict[str, Any]) -> Checkpoint:
+        """Per-trial CheckpointManager so RunConfig.checkpoint_config
+        (num_to_keep / score retention) is honored for tune runs the same
+        way JaxTrainer.fit honors it."""
+        cc = self.run_config.checkpoint_config
+        mgr = managers.get(trial.trial_id)
+        if mgr is None:
+            mgr = managers[trial.trial_id] = CheckpointManager(
+                os.path.join(run_dir, trial.trial_id),
+                num_to_keep=cc.num_to_keep,
+                score_attribute=cc.checkpoint_score_attribute,
+                score_order=cc.checkpoint_score_order,
+            )
+        return mgr.register(Checkpoint(ckpt_path), metrics)
 
     def _default_concurrency(self) -> int:
         res = raytpu.cluster_resources()

@@ -145,3 +145,180 @@ class TestTuner:
             run_config=run_config,
         ).fit()
         assert grid.get_best_result().metrics["loss"] == 0.0
+
+
+class TestSchedulerRegressions:
+    def test_asha_rung_geq_not_equality(self, tune_env):
+        """A trial reporting every 2 iterations must still hit odd rungs
+        (rungs are t >= rung, not t == rung)."""
+        _, tune, _ = tune_env
+        sched = tune.ASHAScheduler(metric="m", grace_period=1,
+                                   reduction_factor=3, max_t=100)
+
+        class T:
+            def __init__(self, tid):
+                self.trial_id = tid
+
+        strong, weak = T("strong"), T("weak")
+        # Strong trial seeds rungs 1, 3, 9 with high scores (reports at
+        # even iterations only).
+        from raytpu.tune.schedulers import CONTINUE, STOP
+        for it in (2, 4, 10):
+            assert sched.on_result(strong, {"m": 100.0,
+                                            "training_iteration": it}) \
+                == CONTINUE
+        # Weak trial reporting at iteration 2 crosses rung 1 and must be
+        # stopped (bottom 1/3 there).
+        d = None
+        for it in (2,):
+            d = sched.on_result(weak, {"m": 0.1,
+                                       "training_iteration": it})
+        assert d == STOP
+
+    def test_pbt_ranks_live_trials_only(self, tune_env):
+        _, tune, _ = tune_env
+        from raytpu.tune.schedulers import PopulationBasedTraining
+
+        sched = PopulationBasedTraining(metric="m", perturbation_interval=1,
+                                        quantile_fraction=0.5, seed=0)
+
+        class T:
+            def __init__(self, tid, ckpt="c"):
+                self.trial_id = tid
+                self.config = {"lr": 1.0}
+                self.last_result = {}
+                self.checkpoint = ckpt
+
+        dead1, dead2 = T("dead1"), T("dead2")
+        top, low = T("top"), T("low")
+        for t, v in ((dead1, -10.0), (dead2, -9.0), (top, 5.0), (low, 1.0)):
+            t.last_result = {"m": v, "training_iteration": 1}
+            sched.on_result(t, t.last_result)
+        # Without removal, dead trials hold the bottom quantile and `low`
+        # never exploits.
+        sched.on_trial_remove(dead1)
+        sched.on_trial_remove(dead2)
+        target = sched.exploit_target(low)
+        assert target is top
+
+    def test_completed_trials_release_resources(self, tune_env):
+        """Trial actors are killed on completion so backfilled trials can
+        schedule under resources_per_trial (regression: leaked actors held
+        reservations forever and fit() hung)."""
+        raytpu, tune, run_config = tune_env
+
+        def objective(config):
+            tune.report({"v": config["x"]})
+
+        grid = tune.Tuner(
+            objective, param_space={"x": tune.grid_search(list(range(6)))},
+            tune_config=tune.TuneConfig(
+                metric="v", mode="max", max_concurrent_trials=2,
+                resources_per_trial={"CPU": 2}),
+            run_config=run_config,
+        ).fit()
+        assert len(grid) == 6
+        assert grid.get_best_result().metrics["v"] == 5
+        # All reservations returned.
+        assert raytpu.available_resources().get("CPU") == 4
+
+    def test_searcher_sees_consistent_ids(self, tune_env):
+        raytpu, tune, run_config = tune_env
+        from raytpu.tune.search import Searcher
+
+        class RecordingSearcher(Searcher):
+            def __init__(self):
+                self.suggested = []
+                self.completed = []
+                self._n = 0
+
+            def suggest(self, trial_id):
+                if self._n >= 3:
+                    return None
+                self._n += 1
+                self.suggested.append(trial_id)
+                return {"x": self._n}
+
+            def on_trial_complete(self, trial_id, result):
+                self.completed.append(trial_id)
+
+        searcher = RecordingSearcher()
+
+        def objective(config):
+            tune.report({"v": config["x"]})
+
+        tune.Tuner(
+            objective,
+            tune_config=tune.TuneConfig(metric="v", mode="max",
+                                        search_alg=searcher),
+            run_config=run_config,
+        ).fit()
+        assert sorted(searcher.completed) == sorted(searcher.suggested)
+
+    def test_checkpoint_num_to_keep_honored(self, tune_env, tmp_path):
+        import os
+
+        raytpu, tune, _ = tune_env
+        from raytpu.train.config import CheckpointConfig, RunConfig
+
+        def objective(config):
+            import tempfile
+
+            for step in range(5):
+                with tempfile.TemporaryDirectory() as d:
+                    with open(os.path.join(d, "w.txt"), "w") as f:
+                        f.write(str(step))
+                    from raytpu.train import Checkpoint
+
+                    tune.report({"v": step,
+                                 "training_iteration": step + 1},
+                                checkpoint=Checkpoint(d))
+
+        run_config = RunConfig(
+            storage_path=str(tmp_path / "keep"),
+            checkpoint_config=CheckpointConfig(num_to_keep=2))
+        grid = tune.Tuner(
+            objective, param_space={"x": tune.grid_search([1])},
+            tune_config=tune.TuneConfig(metric="v", mode="max"),
+            run_config=run_config,
+        ).fit()
+        trial = grid._trials[0]
+        trial_dir = None
+        for root, dirs, _ in os.walk(str(tmp_path / "keep")):
+            if trial.trial_id in dirs:
+                trial_dir = os.path.join(root, trial.trial_id)
+        assert trial_dir is not None
+        kept = [d for d in os.listdir(trial_dir)
+                if d.startswith("checkpoint")]
+        assert len(kept) == 2, kept
+
+    def test_tuner_runs_trainer_gang_and_datasets(self, tune_env):
+        """Tuning over a JaxTrainer keeps scaling_config + datasets
+        (regression: they were silently dropped)."""
+        raytpu, tune, run_config = tune_env
+        import raytpu.data as rdata
+        from raytpu.train import JaxTrainer, ScalingConfig
+
+        def loop(config):
+            from raytpu.train import get_context, get_dataset_shard, report
+
+            ctx = get_context()
+            n = 0
+            for batch in get_dataset_shard("train").iter_batches(
+                    batch_size=4):
+                n += len(next(iter(batch.values())))
+            report({"rows": n, "world": ctx.get_world_size(),
+                    "lr": config["lr"]})
+
+        ds = rdata.range(32)
+        trainer = JaxTrainer(loop, train_loop_config={"lr": 0.0},
+                             datasets={"train": ds},
+                             scaling_config=ScalingConfig(num_workers=2))
+        grid = tune.Tuner(
+            trainer, param_space={"lr": tune.grid_search([0.1, 0.2])},
+            tune_config=tune.TuneConfig(metric="rows", mode="max"),
+            run_config=run_config,
+        ).fit()
+        assert len(grid) == 2
+        best = grid.get_best_result()
+        assert best.metrics["world"] == 2
