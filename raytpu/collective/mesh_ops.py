@@ -75,18 +75,18 @@ def barrier(axis_name: str):
 
 
 def group_call(mesh: Mesh, fn: Callable, *args,
-               in_specs=None, out_specs=None, check_rep: bool = False):
+               in_specs=None, out_specs=None, check_vma: bool = False):
     """Run ``fn`` SPMD over ``mesh`` with the wrappers above bound to the
     mesh's axis names — the moral equivalent of the reference's
     "declare a collective group over these actors, then call collectives"
     flow (``collective.py:151``), collapsed into one compiled program.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     if in_specs is None:
         in_specs = P(*mesh.axis_names)
     if out_specs is None:
         out_specs = P(*mesh.axis_names)
     wrapped = shard_map(fn, mesh=mesh, in_specs=in_specs,
-                        out_specs=out_specs, check_rep=check_rep)
+                        out_specs=out_specs, check_vma=check_vma)
     return wrapped(*args)

@@ -1,0 +1,31 @@
+"""raytpu.rllib — RL training on the TPU-native fabric.
+
+Reference analogue: ``rllib/`` new stack (``rllib/core/rl_module``,
+``rllib/core/learner``, ``rllib/env/env_runner.py``,
+``rllib/algorithms/``). Compute-plane redesign: losses/updates are jitted
+XLA programs; multi-learner sync is an in-program ``pmean`` over a
+``learner`` mesh axis instead of torch-DDP actors.
+"""
+
+from raytpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from raytpu.rllib.algorithms.dqn import DQN, DQNConfig
+from raytpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from raytpu.rllib.algorithms.ppo import PPO, PPOConfig
+from raytpu.rllib.core.learner import Learner, compute_gae, vtrace
+from raytpu.rllib.core.rl_module import (
+    DiscretePolicyModule,
+    QModule,
+    RLModule,
+    RLModuleSpec,
+)
+from raytpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from raytpu.rllib.env.envs import CartPoleEnv, make_env, register_env
+from raytpu.rllib.utils.replay_buffer import ReplayBuffer
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+    "IMPALAConfig", "DQN", "DQNConfig", "Learner", "compute_gae", "vtrace",
+    "RLModule", "RLModuleSpec", "DiscretePolicyModule", "QModule",
+    "EnvRunnerGroup", "SingleAgentEnvRunner", "register_env", "make_env",
+    "CartPoleEnv", "ReplayBuffer",
+]

@@ -1,0 +1,247 @@
+"""Algorithm + AlgorithmConfig — the training driver.
+
+Reference analogue: ``rllib/algorithms/algorithm.py`` (``Algorithm.step``
+``:789``, ``training_step`` ``:1490``), ``algorithm_config.py`` (fluent
+config: ``.environment().env_runners().training().learners()``).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from raytpu.rllib.core.rl_module import RLModuleSpec
+from raytpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from raytpu.rllib.env.envs import make_env
+
+
+class AlgorithmConfig:
+    """Fluent builder (reference: ``AlgorithmConfig``; SURVEY.md A9 lists
+    the knobs that matter for parity: num_env_runners / num_learners)."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env = None
+        self.env_config: Dict[str, Any] = {}
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 64
+        # training
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 512
+        self.grad_clip = 40.0
+        self.model: Dict[str, Any] = {}
+        # learners
+        self.num_learners = 1
+        # debugging
+        self.seed: Optional[int] = None
+        # evaluation
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_num_episodes = 5
+
+    # -- fluent sections ------------------------------------------------------
+
+    def environment(self, env=None, *, env_config: Optional[dict] = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_num_episodes: Optional[int] = None):
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_episodes is not None:
+            self.evaluation_num_episodes = evaluation_num_episodes
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items()
+                if k != "algo_class" and not k.startswith("_")}
+
+    # -- build ----------------------------------------------------------------
+
+    def spaces(self):
+        env = make_env(self.env, self.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(env.action_space.n)
+        return obs_dim, act_dim
+
+    def rl_module_spec(self) -> RLModuleSpec:
+        obs_dim, act_dim = self.spaces()
+        return RLModuleSpec(observation_dim=obs_dim, action_dim=act_dim,
+                            model_config=dict(self.model))
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use PPOConfig() etc.")
+        return self.algo_class(self)
+
+
+class Algorithm:
+    """Drives training_step() and aggregates results.
+
+    Subclasses set ``learner_class`` and implement ``training_step()``
+    returning a metrics dict.
+    """
+
+    learner_class = None
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_returns: list = []
+        self.setup(config)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def setup(self, config: AlgorithmConfig):
+        spec = config.rl_module_spec()
+        runner_config = {
+            "env": config.env,
+            "env_config": config.env_config,
+            "module_spec": spec,
+            "rollout_fragment_length": config.rollout_fragment_length,
+            "num_envs_per_env_runner": config.num_envs_per_env_runner,
+            "seed": config.seed,
+            "gamma": config.gamma,
+        }
+        self.env_runner_group = EnvRunnerGroup(
+            runner_config, config.num_env_runners)
+        self.module = spec.build()
+        learner_cfg = {
+            "lr": config.lr, "grad_clip": config.grad_clip,
+            "num_learners": config.num_learners,
+            "seed": config.seed or 0,
+        }
+        learner_cfg.update(self._learner_config())
+        self.learner = self.learner_class(self.module, learner_cfg)
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+
+    def _learner_config(self) -> Dict[str, Any]:
+        return {}
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- public ---------------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration (reference: ``Algorithm.step``, ``:789``)."""
+        t0 = time.monotonic()
+        metrics = self.training_step()
+        self.iteration += 1
+        took = time.monotonic() - t0
+
+        recent = self._episode_returns[-100:]
+        result = {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "time_this_iter_s": took,
+            "env_steps_per_s": metrics.pop("_env_steps", 0) / max(took, 1e-9),
+            "episode_return_mean": (float(np.mean(recent))
+                                    if recent else float("nan")),
+            "episode_return_max": (float(np.max(recent))
+                                   if recent else float("nan")),
+            "num_episodes": len(self._episode_returns),
+            **metrics,
+        }
+        ci = self.config.evaluation_interval
+        if ci and self.iteration % ci == 0:
+            result["evaluation"] = self.evaluate()
+        return result
+
+    def evaluate(self) -> Dict[str, float]:
+        return self.env_runner_group.evaluate(
+            self.config.evaluation_num_episodes)
+
+    def stop(self):
+        self.env_runner_group.stop()
+
+    # -- checkpointing (reference: Checkpointable save/restore) ---------------
+
+    def save(self, path: str) -> str:
+        import cloudpickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "learner_state.pkl"), "wb") as f:
+            cloudpickle.dump(self.learner.get_state(), f)
+        with open(os.path.join(path, "algorithm_state.json"), "w") as f:
+            json.dump({"iteration": self.iteration,
+                       "timesteps_total": self._timesteps_total}, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        import cloudpickle
+
+        with open(os.path.join(path, "learner_state.pkl"), "rb") as f:
+            self.learner.set_state(cloudpickle.load(f))
+        with open(os.path.join(path, "algorithm_state.json")) as f:
+            st = json.load(f)
+        self.iteration = st["iteration"]
+        self._timesteps_total = st["timesteps_total"]
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+
+    # -- helpers for subclasses -----------------------------------------------
+
+    def _absorb_episodes(self, samples) -> int:
+        steps = 0
+        for s in samples:
+            for ep in s.pop("episodes", []):
+                self._episode_returns.append(ep["episode_return"])
+            steps += s.get("env_steps", 0)
+        self._timesteps_total += steps
+        return steps
+
+    @staticmethod
+    def _concat_time_major(samples) -> Dict[str, np.ndarray]:
+        """Concatenate runner fragments on the env (batch) axis."""
+        out = {}
+        for key in ("obs", "actions", "rewards", "terminateds",
+                    "action_logp", "vf_preds"):
+            out[key] = np.concatenate([s[key] for s in samples], axis=1)
+        out["bootstrap_obs"] = np.concatenate(
+            [s["bootstrap_obs"] for s in samples], axis=0)
+        return out

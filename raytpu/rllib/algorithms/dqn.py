@@ -1,0 +1,128 @@
+"""DQN — replay + target network + double-Q.
+
+Reference analogue: ``rllib/algorithms/dqn/dqn.py`` (training_step:
+sample → store → replay-sample → update → target sync) and
+``dqn_rainbow_torch_learner.py`` (double-Q loss).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raytpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from raytpu.rllib.core.learner import Learner
+from raytpu.rllib.core.rl_module import QModule, RLModuleSpec
+from raytpu.rllib.utils.replay_buffer import ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.lr = 5e-4
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500  # env steps
+        self.train_batch_size = 32
+        self.updates_per_step = 4
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 10_000
+        self.double_q = True
+
+    def rl_module_spec(self) -> RLModuleSpec:
+        obs_dim, act_dim = self.spaces()
+        return RLModuleSpec(module_class=QModule, observation_dim=obs_dim,
+                            action_dim=act_dim,
+                            model_config=dict(self.model))
+
+
+class DQNLearner(Learner):
+    def __init__(self, module, config):
+        super().__init__(module, config)
+        self.target_params = self.params
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        gamma = cfg["gamma"]
+        q = self.module.q_values(params, batch["obs"])
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+        q_next_target = self.module.q_values(
+            batch["target_params"], batch["next_obs"])
+        if cfg.get("double_q", True):
+            q_next_online = self.module.q_values(params, batch["next_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+        else:
+            best = jnp.argmax(q_next_target, axis=-1)
+        q_next = jnp.take_along_axis(
+            q_next_target, best[:, None], axis=-1)[:, 0]
+        nonterminal = 1.0 - batch["terminateds"].astype(jnp.float32)
+        target = batch["rewards"] + gamma * nonterminal * \
+            jax.lax.stop_gradient(q_next)
+        # Huber loss (reference default).
+        err = q_taken - target
+        loss = jnp.mean(jnp.where(jnp.abs(err) < 1.0, 0.5 * err ** 2,
+                                  jnp.abs(err) - 0.5))
+        return loss, {"qf_loss": loss, "q_mean": jnp.mean(q_taken)}
+
+    def update(self, batch):
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        return super().update(batch)
+
+    def sync_target(self):
+        self.target_params = self.params
+
+
+class DQN(Algorithm):
+    learner_class = DQNLearner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        c = self.config
+        return {"gamma": c.gamma, "double_q": c.double_q}
+
+    def setup(self, config):
+        super().setup(config)
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+        self._since_target_sync = 0
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._timesteps_total / max(1, c.epsilon_timesteps))
+        return c.epsilon_initial + frac * (c.epsilon_final
+                                           - c.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        samples = self.env_runner_group.sample(epsilon=self._epsilon())
+        steps = self._absorb_episodes(samples)
+        # Flatten fragments into (s, a, r, s', done) transitions.
+        for s in samples:
+            T, B = s["rewards"].shape
+            next_obs = np.concatenate(
+                [s["obs"][1:], s["bootstrap_obs"][None]], axis=0)
+            self.buffer.add({
+                "obs": s["obs"].reshape(T * B, -1),
+                "actions": s["actions"].reshape(T * B),
+                "rewards": s["rewards"].reshape(T * B),
+                "terminateds": s["terminateds"].reshape(T * B),
+                "next_obs": next_obs.reshape(T * B, -1),
+            })
+        metrics: Dict[str, Any] = {"epsilon": self._epsilon(),
+                                   "replay_size": len(self.buffer)}
+        if len(self.buffer) >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.updates_per_step):
+                metrics.update(self.learner.update(
+                    self.buffer.sample(c.train_batch_size)))
+            self._since_target_sync += steps
+            if self._since_target_sync >= c.target_network_update_freq:
+                self.learner.sync_target()
+                self._since_target_sync = 0
+            self.env_runner_group.sync_weights(self.learner.get_weights())
+        metrics["_env_steps"] = steps
+        return metrics

@@ -1,0 +1,121 @@
+"""IMPALA — async sampling + V-trace off-policy correction.
+
+Reference analogue: ``rllib/algorithms/impala/impala.py:667``
+(training_step: async sample queues feeding learner) and
+``vtrace_torch.py``. The actor-plane asynchrony is the point: env runners
+keep one sample task in flight each; the learner consumes whichever
+fragment lands first and corrects for policy lag with v-trace
+(:func:`raytpu.rllib.core.learner.vtrace` — a ``lax.scan`` inside the
+jitted update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import raytpu
+from raytpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from raytpu.rllib.core.learner import Learner, vtrace
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.lr = 5e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho_threshold = 1.0
+        self.clip_c_threshold = 1.0
+        self.num_fragments_per_step = 4
+
+
+class IMPALALearner(Learner):
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        T, B = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape(T * B, -1)
+        logp_flat, entropy_flat, vf_flat = self.module.logp_entropy(
+            params, obs_flat, batch["actions"].reshape(T * B))
+        target_logp = logp_flat.reshape(T, B)
+        values = vf_flat.reshape(T, B)
+        entropy = entropy_flat.reshape(T, B)
+        bootstrap_v = self.module.forward_train(
+            params, batch["bootstrap_obs"])[1]
+        vs, pg_adv = vtrace(
+            batch["action_logp"], target_logp, batch["rewards"], values,
+            batch["terminateds"], bootstrap_v, cfg["gamma"],
+            cfg["clip_rho_threshold"], cfg["clip_c_threshold"])
+        # vs/pg_adv are targets: no gradient flows through them.
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        policy_loss = -jnp.mean(pg_adv * target_logp)
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        ent = jnp.mean(entropy)
+        total = (policy_loss + cfg["vf_loss_coeff"] * vf_loss
+                 - cfg["entropy_coeff"] * ent)
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": ent}
+
+
+class IMPALA(Algorithm):
+    learner_class = IMPALALearner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        c = self.config
+        return {
+            "gamma": c.gamma, "vf_loss_coeff": c.vf_loss_coeff,
+            "entropy_coeff": c.entropy_coeff,
+            "clip_rho_threshold": c.clip_rho_threshold,
+            "clip_c_threshold": c.clip_c_threshold,
+        }
+
+    def setup(self, config):
+        super().setup(config)
+        self._inflight: Dict[Any, Any] = {}  # ref -> runner
+
+    def _launch(self, runner):
+        ref = runner.sample.remote()
+        self._inflight[ref] = runner
+        return ref
+
+    def training_step(self) -> Dict[str, Any]:
+        group = self.env_runner_group
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        if group.local_runner is not None:
+            # Degenerate sync path (num_env_runners=0).
+            for _ in range(self.config.num_fragments_per_step):
+                sample = group.local_runner.sample()
+                steps += self._absorb_episodes([sample])
+                batch = self._concat_time_major([sample])
+                metrics = self.learner.update(batch)
+                group.local_runner.set_weights(self.learner.get_weights())
+        else:
+            # Keep one fragment in flight per runner; consume in arrival
+            # order (reference: IMPALA's sample queue).
+            for r in group.remote_runners:
+                if r not in self._inflight.values():
+                    self._launch(r)
+            consumed = 0
+            while consumed < self.config.num_fragments_per_step:
+                ready, _ = raytpu.wait(list(self._inflight), num_returns=1)
+                ref = ready[0]
+                runner = self._inflight.pop(ref)
+                sample = raytpu.get(ref)
+                # Relaunch immediately — sampling overlaps the update.
+                self._launch(runner)
+                steps += self._absorb_episodes([sample])
+                batch = self._concat_time_major([sample])
+                metrics = self.learner.update(batch)
+                consumed += 1
+            # Broadcast fresh weights once per step (policy lag is what
+            # v-trace corrects for).
+            ref = raytpu.put(self.learner.get_weights())
+            raytpu.get([r.set_weights.remote(ref)
+                        for r in group.remote_runners])
+        metrics["_env_steps"] = steps
+        return metrics

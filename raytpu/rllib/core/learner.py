@@ -1,0 +1,178 @@
+"""Learner + LearnerGroup — the update plane.
+
+Reference analogue: ``rllib/core/learner/learner.py:107`` (Learner),
+``learner_group.py:60`` (LearnerGroup of N actors with torch-DDP gradient
+sync, ``torch_learner.py:384-395``). TPU redesign (SURVEY.md A9): there is
+no DDP wrapper at all — a LearnerGroup with N>1 shards is ONE compiled
+XLA program ``shard_map``-ped over a ``learner`` mesh axis: the batch is
+sharded on its leading dim, gradients are ``pmean``-ed on ICI inside the
+program, and the optimizer step runs replicated. Scaling the learner
+plane = growing the mesh axis, not adding actors.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+class Learner:
+    """Owns params + optimizer state; subclasses define the loss.
+
+    ``compute_loss(params, batch, rng) -> (loss, metrics_dict)`` must be
+    pure/jittable. ``update`` is compiled once and reused.
+    """
+
+    def __init__(self, module, config: Optional[Dict[str, Any]] = None):
+        self.module = module
+        self.config = dict(config or {})
+        self.num_shards = int(self.config.get("num_learners", 1)) or 1
+        seed = int(self.config.get("seed", 0))
+        self._rng = jax.random.PRNGKey(seed)
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        self.optimizer = self._build_optimizer()
+        self.opt_state = self.optimizer.init(self.params)
+        self._update_fn = None
+        self._mesh = None
+
+    def _build_optimizer(self):
+        lr = self.config.get("lr", 3e-4)
+        clip = self.config.get("grad_clip", 40.0)
+        chain = []
+        if clip:
+            chain.append(optax.clip_by_global_norm(clip))
+        chain.append(optax.adam(lr))
+        return optax.chain(*chain)
+
+    # -- the loss (override per algorithm) ------------------------------------
+
+    def compute_loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        raise NotImplementedError
+
+    # -- update ---------------------------------------------------------------
+
+    def _grad_step(self, params, opt_state, batch, rng, axis_name=None):
+        (loss, metrics), grads = jax.value_and_grad(
+            self.compute_loss, has_aux=True)(params, batch, rng)
+        if axis_name is not None:
+            grads = lax.pmean(grads, axis_name)
+            loss = lax.pmean(loss, axis_name)
+            metrics = jax.tree_util.tree_map(
+                lambda m: lax.pmean(m, axis_name), metrics)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics)
+        metrics["total_loss"] = loss
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    def _build_update(self):
+        if self.num_shards <= 1:
+            self._update_fn = jax.jit(
+                lambda p, o, b, r: self._grad_step(p, o, b, r))
+            return
+        devices = jax.devices()
+        if len(devices) < self.num_shards:
+            raise ValueError(
+                f"num_learners={self.num_shards} exceeds {len(devices)} "
+                "devices")
+        self._mesh = Mesh(np.array(devices[: self.num_shards]), ("learner",))
+        from jax import shard_map
+
+        step = partial(self._grad_step, axis_name="learner")
+        sharded = shard_map(
+            step, mesh=self._mesh,
+            in_specs=(P(), P(), P("learner"), P()),
+            out_specs=(P(), P(), P()),
+
+        )
+        self._update_fn = jax.jit(sharded)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One SGD step over the (already minibatched) batch."""
+        if self._update_fn is None:
+            self._build_update()
+        self._rng, key = jax.random.split(self._rng)
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.opt_state, batch, key)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # -- weights io -----------------------------------------------------------
+
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_state(self) -> dict:
+        return {
+            "params": self.get_weights(),
+            "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
+        }
+
+    def set_state(self, state: dict):
+        self.set_weights(state["params"])
+        self.opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["opt_state"])
+
+
+def compute_gae(rewards, values, terminateds, bootstrap_value,
+                gamma: float, lam: float):
+    """Generalized advantage estimation, time-major (T, B), under scan.
+
+    Reference analogue: ``rllib/evaluation/postprocessing.py``
+    ``compute_advantages``. Returns (advantages, value_targets).
+    """
+    nonterminal = 1.0 - terminateds.astype(jnp.float32)
+    next_values = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = rewards + gamma * nonterminal * next_values - values
+
+    def scan_fn(carry, inp):
+        delta_t, nonterm_t = inp
+        adv = delta_t + gamma * lam * nonterm_t * carry
+        return adv, adv
+
+    _, advs = lax.scan(scan_fn, jnp.zeros_like(bootstrap_value),
+                       (deltas, nonterminal), reverse=True)
+    return advs, advs + values
+
+
+def vtrace(behaviour_logp, target_logp, rewards, values, terminateds,
+           bootstrap_value, gamma: float, clip_rho: float = 1.0,
+           clip_c: float = 1.0):
+    """V-trace off-policy correction (IMPALA, Espeholt et al. 2018);
+    reference analogue: ``rllib/algorithms/impala/vtrace*``.
+
+    All inputs time-major (T, B). Returns (vs, pg_advantages).
+    """
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+    nonterminal = 1.0 - terminateds.astype(jnp.float32)
+    next_values = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + gamma * nonterminal * next_values - values)
+
+    def scan_fn(acc, inp):
+        delta_t, c_t, nonterm_t = inp
+        acc = delta_t + gamma * nonterm_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value),
+        (deltas, cs, nonterminal), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (
+        rewards + gamma * nonterminal * next_vs - values)
+    return vs, pg_adv

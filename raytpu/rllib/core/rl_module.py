@@ -1,0 +1,156 @@
+"""RLModule — the neural-network container.
+
+Reference analogue: ``rllib/core/rl_module/rl_module.py:236``. The
+reference RLModule wraps a torch.nn.Module with three forward passes
+(exploration / inference / train). TPU redesign: an RLModule owns a flax
+module + an explicit params pytree and every forward is a *pure function*
+``(params, batch, rng) -> outputs`` so the whole train step jits and the
+params shard over mesh axes without wrapper classes (no DDP analogue
+needed — see :mod:`raytpu.rllib.core.learner`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Columns = type("Columns", (), {
+    "OBS": "obs", "ACTIONS": "actions", "REWARDS": "rewards",
+    "TERMINATEDS": "terminateds", "TRUNCATEDS": "truncateds",
+    "ACTION_LOGP": "action_logp", "VF_PREDS": "vf_preds",
+    "ADVANTAGES": "advantages", "VALUE_TARGETS": "value_targets",
+    "NEXT_OBS": "next_obs",
+})
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Builds an RLModule (reference: ``SingleAgentRLModuleSpec``)."""
+
+    module_class: Optional[type] = None
+    observation_dim: int = 0
+    action_dim: int = 0
+    model_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        cls = self.module_class or DiscretePolicyModule
+        return cls(self.observation_dim, self.action_dim, self.model_config)
+
+
+class _PolicyValueNet(nn.Module):
+    """Shared-nothing policy + value torso (reference default model:
+    ``rllib/models/catalog.py`` fcnet)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+    dual_head: bool = True  # emit value head
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"pi_{i}")(x))
+        logits = nn.Dense(self.action_dim, name="pi_out",
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        if not self.dual_head:
+            return logits, None
+        v = obs
+        for i, h in enumerate(self.hidden):
+            v = nn.tanh(nn.Dense(h, name=f"vf_{i}")(v))
+        value = nn.Dense(1, name="vf_out")(v)
+        return logits, value[..., 0]
+
+
+class RLModule:
+    """Base: categorical-policy module over a flax net.
+
+    Pure-function API (everything jittable):
+      - ``forward_exploration(params, obs, rng)`` → actions, logp, vf
+      - ``forward_inference(params, obs)`` → greedy actions
+      - ``forward_train(params, batch)`` → logits, vf (used by losses)
+    """
+
+    def __init__(self, observation_dim: int, action_dim: int,
+                 model_config: Optional[Dict[str, Any]] = None):
+        self.observation_dim = observation_dim
+        self.action_dim = action_dim
+        self.model_config = model_config or {}
+        self.net = self._build_net()
+
+    def _build_net(self) -> nn.Module:
+        return _PolicyValueNet(
+            action_dim=self.action_dim,
+            hidden=tuple(self.model_config.get("fcnet_hiddens", (256, 256))),
+            dual_head=self.model_config.get("dual_head", True),
+        )
+
+    def init_params(self, rng) -> Any:
+        obs = jnp.zeros((1, self.observation_dim), jnp.float32)
+        return self.net.init(rng, obs)["params"]
+
+    # -- pure forwards --------------------------------------------------------
+
+    def forward_train(self, params, obs):
+        return self.net.apply({"params": params}, obs)
+
+    def forward_exploration(self, params, obs, rng):
+        logits, vf = self.forward_train(params, obs)
+        actions = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)
+        action_logp = jnp.take_along_axis(
+            logp, actions[..., None], axis=-1)[..., 0]
+        return actions, action_logp, vf
+
+    def forward_inference(self, params, obs):
+        logits, _ = self.forward_train(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    def logp_entropy(self, params, obs, actions):
+        logits, vf = self.forward_train(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, actions[..., None],
+                                   axis=-1)[..., 0]
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.sum(probs * logp_all, axis=-1)
+        return logp, entropy, vf
+
+    def num_params(self, params) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+
+class DiscretePolicyModule(RLModule):
+    """Default module (policy + value heads)."""
+
+
+class QModule(RLModule):
+    """Q-network module for DQN-family algorithms: the "policy head" emits
+    Q-values; no value head."""
+
+    def _build_net(self) -> nn.Module:
+        return _PolicyValueNet(
+            action_dim=self.action_dim,
+            hidden=tuple(self.model_config.get("fcnet_hiddens", (256, 256))),
+            dual_head=False,
+        )
+
+    def q_values(self, params, obs):
+        q, _ = self.forward_train(params, obs)
+        return q
+
+    def forward_exploration(self, params, obs, rng, epsilon: float = 0.1):
+        q, _ = self.forward_train(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        random_a = jax.random.randint(rng, greedy.shape, 0, self.action_dim)
+        explore = jax.random.uniform(rng, greedy.shape) < epsilon
+        actions = jnp.where(explore, random_a, greedy)
+        return actions, jnp.zeros_like(actions, jnp.float32), None
+
+    def forward_inference(self, params, obs):
+        q, _ = self.forward_train(params, obs)
+        return jnp.argmax(q, axis=-1)

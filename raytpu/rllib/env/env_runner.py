@@ -1,0 +1,230 @@
+"""EnvRunner — the sampling plane.
+
+Reference analogue: ``rllib/env/env_runner.py:15`` (EnvRunner ABC),
+``single_agent_env_runner.py:30``. Env stepping is host-side numpy in
+actor processes; only the policy forward is a compiled function. Batches
+come back time-major (T, B, ...) so GAE/v-trace scan directly over them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import raytpu
+from raytpu.rllib.env.envs import make_env
+
+
+class SingleAgentEnvRunner:
+    """Steps ``num_envs`` copies of one env with the current policy.
+
+    Config keys (subset of the reference's AlgorithmConfig surface):
+    ``env``, ``env_config``, ``module_spec``, ``rollout_fragment_length``,
+    ``num_envs_per_env_runner``, ``seed``, ``worker_index``.
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self.worker_index = int(config.get("worker_index", 0))
+        seed = config.get("seed")
+        self._seed = (None if seed is None
+                      else int(seed) + 1000 * self.worker_index)
+        self.num_envs = int(config.get("num_envs_per_env_runner", 1))
+        self.fragment_len = int(config.get("rollout_fragment_length", 64))
+        env_config = dict(config.get("env_config") or {})
+        if self._seed is not None:
+            env_config.setdefault("seed", self._seed)
+        self.envs = [make_env(config["env"], env_config)
+                     for _ in range(self.num_envs)]
+        self.module = config["module_spec"].build()
+        self.params = self.module.init_params(
+            jax.random.PRNGKey(self._seed or 0))
+        self._rng = jax.random.PRNGKey((self._seed or 0) + 1)
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._infer_fn = jax.jit(self.module.forward_inference)
+        self._value_fn = jax.jit(
+            lambda p, o: self.module.forward_train(p, o)[1])
+        # Persistent episode state across sample() calls.
+        self._obs = np.stack([e.reset()[0] for e in self.envs])
+        self._ep_return = np.zeros(self.num_envs)
+        self._ep_len = np.zeros(self.num_envs, dtype=np.int64)
+        self._completed: List[dict] = []
+        self._total_steps = 0
+
+    # -- weight sync (reference: EnvRunnerGroup.sync_weights) -----------------
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    # -- sampling -------------------------------------------------------------
+
+    def sample(self, num_steps: Optional[int] = None,
+               explore: bool = True, **explore_kwargs) -> Dict[str, Any]:
+        """Collect a time-major fragment: arrays shaped (T, B, ...).
+
+        Truncated (not terminated) episodes get their value bootstrap
+        folded into the reward at the truncation step, so downstream
+        GAE/v-trace can treat every done as terminal without leaking
+        across episode boundaries.
+        """
+        T = num_steps or self.fragment_len
+        B = self.num_envs
+        obs_buf = np.zeros((T, B) + self._obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, B), np.int32)
+        rew_buf = np.zeros((T, B), np.float32)
+        term_buf = np.zeros((T, B), np.bool_)
+        logp_buf = np.zeros((T, B), np.float32)
+        vf_buf = np.zeros((T, B), np.float32)
+
+        for t in range(T):
+            obs = self._obs.astype(np.float32)
+            obs_buf[t] = obs
+            if explore:
+                self._rng, key = jax.random.split(self._rng)
+                actions, logp, vf = self._explore_fn(
+                    self.params, jnp.asarray(obs), key, **explore_kwargs)
+            else:
+                actions = self._infer_fn(self.params, jnp.asarray(obs))
+                logp = jnp.zeros((B,), jnp.float32)
+                vf = None
+            actions = np.asarray(actions)
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            if vf is not None:
+                vf_buf[t] = np.asarray(vf)
+
+            truncated_next_obs = {}
+            for i, env in enumerate(self.envs):
+                nobs, r, terminated, truncated, _ = env.step(
+                    int(actions[i]))
+                self._ep_return[i] += r
+                self._ep_len[i] += 1
+                rew_buf[t, i] = r
+                done = terminated or truncated
+                term_buf[t, i] = done
+                if truncated and not terminated:
+                    truncated_next_obs[i] = nobs
+                if done:
+                    self._completed.append({
+                        "episode_return": float(self._ep_return[i]),
+                        "episode_len": int(self._ep_len[i]),
+                    })
+                    self._ep_return[i] = 0.0
+                    self._ep_len[i] = 0
+                    nobs = env.reset()[0]
+                self._obs[i] = nobs
+            if truncated_next_obs:
+                idx = sorted(truncated_next_obs)
+                vals = np.asarray(self._value_fn(
+                    self.params,
+                    jnp.asarray(np.stack([truncated_next_obs[i]
+                                          for i in idx]))))
+                gamma = float(self.config.get("gamma", 0.99))
+                for j, i in enumerate(idx):
+                    rew_buf[t, i] += gamma * float(vals[j])
+        self._total_steps += T * B
+
+        episodes, self._completed = self._completed, []
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "terminateds": term_buf, "action_logp": logp_buf,
+            "vf_preds": vf_buf,
+            "bootstrap_obs": self._obs.astype(np.float32).copy(),
+            "episodes": episodes,
+            "env_steps": T * B,
+        }
+
+    def evaluate(self, num_episodes: int = 5,
+                 max_steps: int = 1000) -> Dict[str, float]:
+        """Greedy episodes on a fresh env (reference: evaluation workers)."""
+        env = make_env(self.config["env"],
+                       dict(self.config.get("env_config") or {}))
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=None if self._seed is None
+                               else self._seed + 7919 * (ep + 1))
+            total = 0.0
+            for _ in range(max_steps):
+                a = int(np.asarray(self._infer_fn(
+                    self.params, jnp.asarray(obs[None].astype(np.float32))))[0])
+                obs, r, terminated, truncated, _ = env.step(a)
+                total += r
+                if terminated or truncated:
+                    break
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}
+
+    def total_steps(self) -> int:
+        return self._total_steps
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    """Fan-out over remote env-runner actors (+ an optional local runner).
+
+    Reference analogue: ``rllib/evaluation/worker_set.py:82`` /
+    ``EnvRunnerGroup``. ``num_env_runners=0`` samples in-process.
+    """
+
+    def __init__(self, config: Dict[str, Any], num_env_runners: int,
+                 resources_per_runner: Optional[Dict[str, float]] = None):
+        self.num_env_runners = num_env_runners
+        self.local_runner: Optional[SingleAgentEnvRunner] = None
+        self.remote_runners = []
+        if num_env_runners <= 0:
+            self.local_runner = SingleAgentEnvRunner(
+                {**config, "worker_index": 0})
+        else:
+            actor_cls = raytpu.remote(SingleAgentEnvRunner)
+            opts = {"num_cpus": 1}
+            if resources_per_runner:
+                opts = {"resources": resources_per_runner}
+            for i in range(num_env_runners):
+                self.remote_runners.append(actor_cls.options(**opts).remote(
+                    {**config, "worker_index": i + 1}))
+
+    def sample(self, **kwargs) -> List[Dict[str, Any]]:
+        if self.local_runner is not None:
+            return [self.local_runner.sample(**kwargs)]
+        return raytpu.get([r.sample.remote(**kwargs)
+                           for r in self.remote_runners])
+
+    def sample_refs(self, **kwargs):
+        """Async sampling (IMPALA): one in-flight ref per runner."""
+        if self.local_runner is not None:
+            return [raytpu.put(self.local_runner.sample(**kwargs))]
+        return [r.sample.remote(**kwargs) for r in self.remote_runners]
+
+    def sync_weights(self, weights) -> None:
+        if self.local_runner is not None:
+            self.local_runner.set_weights(weights)
+            return
+        ref = raytpu.put(weights)
+        raytpu.get([r.set_weights.remote(ref) for r in self.remote_runners])
+
+    def evaluate(self, num_episodes: int) -> Dict[str, float]:
+        if self.local_runner is not None:
+            return self.local_runner.evaluate(num_episodes)
+        per = max(1, num_episodes // len(self.remote_runners))
+        outs = raytpu.get([r.evaluate.remote(per)
+                           for r in self.remote_runners])
+        return {"episode_return_mean": float(np.mean(
+            [o["episode_return_mean"] for o in outs])),
+            "num_episodes": per * len(self.remote_runners)}
+
+    def stop(self) -> None:
+        for r in self.remote_runners:
+            try:
+                raytpu.kill(r)
+            except Exception:
+                pass
+        self.remote_runners = []

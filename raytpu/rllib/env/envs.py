@@ -1,0 +1,112 @@
+"""Built-in environments + registry.
+
+The reference uses Farama gymnasium throughout (``rllib/env/``); this
+image has no gym, so we ship a numpy CartPole with the gymnasium API shape
+(``reset() -> (obs, info)``, ``step(a) -> (obs, r, terminated, truncated,
+info)``) and accept any user class with that interface. Reference
+analogue for the registry: ``ray.tune.registry.register_env``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+_ENV_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register_env(name: str, creator: Callable[..., Any]) -> None:
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(spec, env_config: Optional[dict] = None):
+    env_config = env_config or {}
+    if isinstance(spec, str):
+        if spec in _ENV_REGISTRY:
+            return _ENV_REGISTRY[spec](env_config)
+        raise ValueError(f"unknown env {spec!r}; register_env() it first "
+                         f"(built-ins: {sorted(_ENV_REGISTRY)})")
+    if callable(spec):
+        try:
+            return spec(env_config)
+        except TypeError:
+            return spec()
+    raise TypeError(f"env spec must be a name or callable, got {type(spec)}")
+
+
+class Space:
+    """Minimal space descriptor (gymnasium-API compatible subset)."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype, n: Optional[int] = None,
+                 low=None, high=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.n = n  # discrete size, None for continuous
+        self.low = low
+        self.high = high
+
+    @classmethod
+    def discrete(cls, n: int) -> "Space":
+        return cls((), np.int32, n=n)
+
+    @classmethod
+    def box(cls, low, high, shape) -> "Space":
+        return cls(tuple(shape), np.float32, low=low, high=high)
+
+
+class CartPoleEnv:
+    """Classic cart-pole balancing (dynamics per Barto-Sutton-Anderson,
+    matching gymnasium's CartPole-v1 constants)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.max_steps = int(config.get("max_episode_steps", 500))
+        self.observation_space = Space.box(-np.inf, np.inf, (4,))
+        self.action_space = Space.discrete(2)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._state = None
+        self._steps = 0
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._steps = 0
+        return self._state.astype(np.float32), {}
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._steps += 1
+        terminated = bool(
+            abs(x) > self.x_threshold or abs(theta) > self.theta_threshold)
+        truncated = self._steps >= self.max_steps
+        return (self._state.astype(np.float32), 1.0, terminated, truncated,
+                {})
+
+
+register_env("CartPole-v1", CartPoleEnv)
+register_env("CartPole-v0",
+             lambda cfg: CartPoleEnv({**(cfg or {}),
+                                      "max_episode_steps": 200}))

@@ -46,6 +46,11 @@ class _Session:
         self.dataset_shards = dataset_shards or {}
 
     def report(self, metrics: Dict[str, Any], checkpoint=None):
+        # Only rank 0's checkpoint is persisted by the trainer (single-
+        # controller design) — dropping the others here avoids staging a
+        # full copy per worker per report that nobody ever drains.
+        if checkpoint is not None and self.context.rank != 0:
+            checkpoint = None
         # Snapshot the checkpoint dir SYNCHRONOUSLY before returning:
         # the reference's report() blocks until the checkpoint is
         # persisted, which is what makes the canonical

@@ -161,18 +161,27 @@ class JaxTrainer(BaseTrainer):
 
         attempts = rc.failure_config.max_failures + 1
         last_error = None
-        for attempt in range(attempts):
-            result = self._run_gang(sc, name, run_dir, manager,
-                                    cloudpickle.dumps(
-                                        self.train_loop_per_worker))
-            if result.error is None:
-                return result
-            last_error = result.error
-            # Gang restart from the latest checkpoint (SURVEY.md §7 hard
-            # part (d): elastic recovery = checkpoint + gang restart).
-            self.resume_from_checkpoint = manager.latest()
-        return Result(metrics={}, metrics_history=[], checkpoint=None,
-                      path=run_dir, error=last_error)
+        try:
+            for attempt in range(attempts):
+                result = self._run_gang(sc, name, run_dir, manager,
+                                        cloudpickle.dumps(
+                                            self.train_loop_per_worker))
+                if result.error is None:
+                    return result
+                last_error = result.error
+                # Gang restart from the latest checkpoint (SURVEY.md §7
+                # hard part (d): elastic recovery = checkpoint + gang
+                # restart).
+                self.resume_from_checkpoint = manager.latest()
+            return Result(metrics={}, metrics_history=[], checkpoint=None,
+                          path=run_dir, error=last_error)
+        finally:
+            # Staged snapshots that were never registered (failed gangs,
+            # undrained reports) are garbage once fit() returns.
+            import shutil
+
+            shutil.rmtree(os.path.join(run_dir, ".staged_ckpts"),
+                          ignore_errors=True)
 
     # -- internals ------------------------------------------------------------
 

@@ -121,6 +121,10 @@ class Tuner:
             base_cfg = dict(trainer.train_loop_config)
 
             def trainable(config):  # noqa: F811
+                import shutil as _sh
+                import tempfile as _tf
+                import uuid as _uuid
+
                 from raytpu.train import session as session_mod
 
                 merged = {**base_cfg, **config}
@@ -129,23 +133,44 @@ class Tuner:
                 if single:
                     # Fast path: run the loop inline so per-iteration
                     # reports stream to the trial session (ASHA/PBT see
-                    # every result).
+                    # every result). Honor a user-supplied resume
+                    # checkpoint when the trial isn't resuming (PBT).
+                    if (trainer.resume_from_checkpoint is not None
+                            and session_mod.get_checkpoint() is None):
+                        s = session_mod._get_session()
+                        if s is not None:
+                            s.latest_checkpoint = \
+                                trainer.resume_from_checkpoint
                     trainer.train_loop_per_worker(merged)
                     return
+                # Unique nested run name: concurrent trials must not share
+                # a run_dir (their CheckpointManagers would evict each
+                # other's checkpoint_NNNNNN dirs).
+                rc = trainer.run_config
+                nested_name = (f"{rc.name or 'nested'}-"
+                               f"{_uuid.uuid4().hex[:8]}")
+                nested_rc = dataclasses.replace(rc, name=nested_name)
                 nested = type(trainer)(
                     trainer.train_loop_per_worker,
                     train_loop_config=merged,
                     datasets=trainer.datasets,
                     scaling_config=trainer.scaling_config,
-                    run_config=trainer.run_config,
+                    run_config=nested_rc,
                     resume_from_checkpoint=(session_mod.get_checkpoint()
                                             or trainer.resume_from_checkpoint),
                 )
                 result = nested.fit()
                 if result.error is not None:
                     raise result.error
+                # report() stages a synchronous copy, so the nested run
+                # dir (manager-owned checkpoints included) can be removed
+                # — otherwise every trial orphans a full run tree.
                 session_mod.report(result.metrics,
                                    checkpoint=result.checkpoint)
+                storage = rc.storage_path or os.path.join(
+                    _tf.gettempdir(), "raytpu_results")
+                _sh.rmtree(os.path.join(storage, nested_name),
+                           ignore_errors=True)
 
         self.trainable = trainable
         self.param_space = param_space or {}
@@ -253,8 +278,12 @@ class Tuner:
                 if target is not None and target.checkpoint is not None:
                     finish(trial, "STOPPED")
                     new_cfg = scheduler.perturb(target.config)
+                    # Pin a private copy: the target's CheckpointManager
+                    # may evict (rmtree) the exploited dir before the
+                    # clone's lazy restore reads it.
+                    pinned = self._pin_ckpt(run_dir, target.checkpoint)
                     launch(f"trial_{uuid.uuid4().hex[:8]}", new_cfg,
-                           resume=target.checkpoint)
+                           resume=pinned)
             # Rebuild from `trials` (not the poll set) so PBT clones
             # launched mid-poll stay tracked; then backfill free slots.
             live = [t for t in trials if t.state == "RUNNING"]
@@ -264,6 +293,13 @@ class Tuner:
                 live = [t for t in trials if t.state == "RUNNING"]
             if live:
                 time.sleep(0.05)
+
+        # Staged-but-unregistered checkpoint snapshots (killed trials,
+        # post-STOP reports) are garbage once the run ends.
+        import shutil
+
+        shutil.rmtree(os.path.join(run_dir, ".staged_ckpts"),
+                      ignore_errors=True)
 
         results = []
         for t in trials:
@@ -276,6 +312,14 @@ class Tuner:
                 metrics=t.last_result, metrics_history=t.history,
                 checkpoint=t.checkpoint, path=run_dir, error=err))
         return ResultGrid(results, trials, tc.metric, tc.mode)
+
+    def _pin_ckpt(self, run_dir: str, ckpt: Checkpoint) -> Checkpoint:
+        import shutil
+
+        dst = os.path.join(run_dir, ".staged_ckpts", uuid.uuid4().hex)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copytree(ckpt.path, dst)
+        return Checkpoint(dst)
 
     def _persist_ckpt(self, managers: Dict[str, CheckpointManager],
                       run_dir: str, trial: Trial, ckpt_path: str,

@@ -112,7 +112,7 @@ class TestMeshOps:
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         from raytpu.collective import mesh_ops
 
@@ -128,7 +128,7 @@ class TestMeshOps:
         x = jnp.arange(8.0).reshape(8, 1)
         s, g, rs = shard_map(f, mesh=mesh, in_specs=P("x"),
                              out_specs=(P("x"), P("x"), P("x")),
-                             check_rep=False)(x)
+)(x)
         np.testing.assert_allclose(np.asarray(s),
                                    np.full((8, 1), 28.0))
         # all_gather tiled: every shard holds all 8 rows -> global (64, 1)
@@ -141,7 +141,7 @@ class TestMeshOps:
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         from raytpu.collective import mesh_ops
 
@@ -154,7 +154,7 @@ class TestMeshOps:
 
         x = jnp.arange(4.0).reshape(4, 1)
         b, nxt = shard_map(f, mesh=mesh, in_specs=P("x"),
-                           out_specs=(P("x"), P("x")), check_rep=False)(x)
+                           out_specs=(P("x"), P("x")))(x)
         np.testing.assert_allclose(np.asarray(b).ravel(), np.ones(4))
         np.testing.assert_allclose(np.asarray(nxt).ravel(),
                                    np.array([3.0, 0.0, 1.0, 2.0]))
@@ -163,7 +163,7 @@ class TestMeshOps:
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         from raytpu.collective import mesh_ops
 
@@ -174,7 +174,7 @@ class TestMeshOps:
 
         x = jnp.arange(32.0).reshape(8, 4)  # global seq=8 sharded -> local 2
         out = shard_map(f, mesh=mesh, in_specs=P("sp", None),
-                        out_specs=P(None, "sp"), check_rep=False)(x)
+                        out_specs=P(None, "sp"))(x)
         # Resharded: seq now full per shard, heads sharded.
         assert out.shape == (8, 4)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x))
