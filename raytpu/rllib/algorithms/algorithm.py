@@ -70,9 +70,12 @@ class AlgorithmConfig:
     def training(self, **kwargs):
         for k, v in kwargs.items():
             if not hasattr(self, k):
-                setattr(self, k, v)
-            else:
-                setattr(self, k, v)
+                raise ValueError(
+                    f"unknown config key {k!r} for "
+                    f"{type(self).__name__}; known: "
+                    f"{sorted(x for x in vars(self) if not x.startswith('_'))}"
+                )
+            setattr(self, k, v)
         return self
 
     def learners(self, *, num_learners: Optional[int] = None):

@@ -69,6 +69,15 @@ class DQNLearner(Learner):
                                   jnp.abs(err) - 0.5))
         return loss, {"qf_loss": loss, "q_mean": jnp.mean(q_taken)}
 
+    def _batch_leaf_spec(self, key, value):
+        # The target network rides in the batch dict: replicate it on every
+        # learner shard (it's parameters, not data).
+        from jax.sharding import PartitionSpec as P
+
+        if key == "target_params":
+            return P()
+        return P("learner")
+
     def update(self, batch):
         batch = dict(batch)
         batch["target_params"] = self.target_params

@@ -34,6 +34,16 @@ class IMPALAConfig(AlgorithmConfig):
 
 
 class IMPALALearner(Learner):
+    def _batch_leaf_spec(self, key, value):
+        # Batches are time-major (T, B, ...) except bootstrap_obs (B, d):
+        # shard the BATCH axis across learners, never time (v-trace scans
+        # over the full trajectory on every shard).
+        from jax.sharding import PartitionSpec as P
+
+        if key == "bootstrap_obs":
+            return P("learner")
+        return P(None, "learner")
+
     def compute_loss(self, params, batch, rng):
         cfg = self.config
         T, B = batch["rewards"].shape

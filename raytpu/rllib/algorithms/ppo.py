@@ -114,7 +114,7 @@ class PPOLearner(Learner):
         metrics = jax.tree_util.tree_map(lambda m: m[-1, -1], metrics)
         return params, opt_state, metrics
 
-    def _build_update(self):
+    def _build_update(self, batch=None):
         if self.num_shards <= 1:
             self._update_fn = jax.jit(
                 lambda p, o, b, r: self._rollout_update(p, o, b, r))

@@ -73,7 +73,22 @@ class Learner:
         metrics["grad_norm"] = optax.global_norm(grads)
         return params, opt_state, metrics
 
-    def _build_update(self):
+    def _batch_leaf_spec(self, key: str, value) -> P:
+        """Sharding spec for one batch entry on the learner mesh axis.
+
+        Default: shard the leading (batch) dim. Subclasses override for
+        time-major entries or replicated auxiliaries (e.g. DQN target
+        params). The per-key table replaces torch-DDP's implicit "grads are
+        the only cross-learner traffic" contract — here data layout IS the
+        parallelism (reference contrast:
+        ``rllib/core/learner/torch/torch_learner.py:384-395``).
+        """
+        return P("learner")
+
+    def _batch_spec(self, batch) -> Dict[str, Any]:
+        return {k: self._batch_leaf_spec(k, v) for k, v in batch.items()}
+
+    def _build_update(self, batch):
         if self.num_shards <= 1:
             self._update_fn = jax.jit(
                 lambda p, o, b, r: self._grad_step(p, o, b, r))
@@ -89,7 +104,7 @@ class Learner:
         step = partial(self._grad_step, axis_name="learner")
         sharded = shard_map(
             step, mesh=self._mesh,
-            in_specs=(P(), P(), P("learner"), P()),
+            in_specs=(P(), P(), self._batch_spec(batch), P()),
             out_specs=(P(), P(), P()),
 
         )
@@ -98,7 +113,7 @@ class Learner:
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One SGD step over the (already minibatched) batch."""
         if self._update_fn is None:
-            self._build_update()
+            self._build_update(batch)
         self._rng, key = jax.random.split(self._rng)
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, batch, key)

@@ -146,8 +146,9 @@ class QModule(RLModule):
     def forward_exploration(self, params, obs, rng, epsilon: float = 0.1):
         q, _ = self.forward_train(params, obs)
         greedy = jnp.argmax(q, axis=-1)
-        random_a = jax.random.randint(rng, greedy.shape, 0, self.action_dim)
-        explore = jax.random.uniform(rng, greedy.shape) < epsilon
+        rng_a, rng_e = jax.random.split(rng)
+        random_a = jax.random.randint(rng_a, greedy.shape, 0, self.action_dim)
+        explore = jax.random.uniform(rng_e, greedy.shape) < epsilon
         actions = jnp.where(explore, random_a, greedy)
         return actions, jnp.zeros_like(actions, jnp.float32), None
 

@@ -100,7 +100,12 @@ def serialize(value: Any) -> SerializedValue:
             pass
 
     try:
-        data = msgpack.packb({"t": _KIND_MSGPACK, "d": value, "r": []})
+        # strict_types: tuples (and dict/list subclasses) are NOT coerced to
+        # their msgpack look-alikes — they fall through to pickle so the
+        # round-trip preserves exact Python types (the reference preserves
+        # types by always cloudpickling the payload layer).
+        data = msgpack.packb({"t": _KIND_MSGPACK, "d": value, "r": []},
+                             strict_types=True)
         return SerializedValue(data, [])
     except (TypeError, ValueError, OverflowError):
         pass

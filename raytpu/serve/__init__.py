@@ -1,0 +1,31 @@
+"""raytpu.serve — model serving on the TPU-native fabric.
+
+Reference analogue: ``python/ray/serve/`` (69.4k LoC). Controller actor
+reconciles declarative app state; replicas are long-lived actors holding
+jit-compiled models pinned to their chips; routing is client-side
+power-of-two-choices; HTTP ingress is an aiohttp proxy actor.
+"""
+
+from raytpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from raytpu.serve.batching import batch
+from raytpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from raytpu.serve.deployment import Application, Deployment, deployment
+from raytpu.serve.handle import DeploymentHandle, DeploymentResponse
+from raytpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from raytpu.serve._private.proxy import Request
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "Request",
+    "batch", "delete", "deployment", "get_app_handle",
+    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
+    "run", "shutdown", "start", "status",
+]

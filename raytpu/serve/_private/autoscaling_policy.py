@@ -1,0 +1,61 @@
+"""Replica-count autoscaling decisions from queue metrics.
+
+Reference analogue: ``python/ray/serve/_private/autoscaling_policy.py`` —
+``AutoscalingPolicyManager.get_decision_num_replicas`` (``:12,30``): target
+replicas = total (queued + ongoing) requests / target_ongoing_requests,
+smoothed, bounded by [min, max], with upscale/downscale hysteresis windows
+so transient spikes don't thrash replica churn (each churn on TPU costs a
+re-jit warm-up, so the downscale delay defaults higher than the upscale).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+from raytpu.serve.config import AutoscalingConfig
+
+
+class AutoscalingPolicyManager:
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    def desired(self, total_requests: float, current: int) -> int:
+        c = self.config
+        raw = total_requests / c.target_ongoing_requests
+        if raw > current:
+            smoothed = current + (raw - current) * c.upscale_smoothing_factor
+            target = math.ceil(smoothed)
+        else:
+            smoothed = current - (current - raw) * c.downscale_smoothing_factor
+            target = math.ceil(smoothed)
+        return max(c.min_replicas, min(c.max_replicas, target))
+
+    def get_decision_num_replicas(
+        self, total_requests: float, current: int, now: Optional[float] = None
+    ) -> Optional[int]:
+        """Return a new target or None (no change yet)."""
+        now = time.monotonic() if now is None else now
+        target = self.desired(total_requests, current)
+        if target > current:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= self.config.upscale_delay_s:
+                self._upscale_since = None
+                return target
+            return None
+        if target < current:
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if now - self._downscale_since >= self.config.downscale_delay_s:
+                self._downscale_since = None
+                return target
+            return None
+        self._upscale_since = None
+        self._downscale_since = None
+        return None

@@ -1,0 +1,356 @@
+"""Serve controller: declarative app state reconciled onto replica actors.
+
+Reference analogue: ``python/ray/serve/_private/controller.py`` —
+``ServeController`` (``:84``, ``deploy_application`` ``:699``) and
+``python/ray/serve/_private/deployment_state.py`` — ``DeploymentState``
+(``:1202``), ``DeploymentStateManager`` (``:2392``). The controller is a
+detached async actor. Each reconcile tick: diff target vs running replicas,
+start/stop replica actors, run health checks, feed queue metrics to the
+autoscaler, and publish routing tables through the long-poll host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from raytpu.serve._private.autoscaling_policy import AutoscalingPolicyManager
+from raytpu.serve._private.long_poll import LongPollHost
+from raytpu.serve.config import DeploymentConfig, ReplicaConfig
+
+logger = logging.getLogger("raytpu.serve")
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+RECONCILE_PERIOD_S = 0.1
+
+
+class ReplicaWrapper:
+    """Controller-side record of one replica actor (reference:
+    ``ActorReplicaWrapper``, deployment_state.py:219)."""
+
+    def __init__(self, replica_id: str, handle, config: ReplicaConfig):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.config = config
+        self.healthy = True
+        self.last_health_check = time.monotonic()
+        self.draining = False
+
+
+class DeploymentState:
+    """Target state + running replicas for one deployment."""
+
+    def __init__(self, app_name: str, name: str, replica_config: ReplicaConfig):
+        self.app_name = app_name
+        self.name = name
+        self.replica_config = replica_config
+        self.target_num_replicas = self._initial_target()
+        self.replicas: Dict[str, ReplicaWrapper] = {}
+        self._counter = 0
+        cfg = replica_config.deployment_config.autoscaling_config
+        self.autoscaler = AutoscalingPolicyManager(cfg) if cfg else None
+
+    def _initial_target(self) -> int:
+        dc = self.replica_config.deployment_config
+        if dc.autoscaling_config:
+            ac = dc.autoscaling_config
+            return ac.initial_replicas if ac.initial_replicas is not None \
+                else ac.min_replicas
+        return dc.num_replicas
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.app_name}#{self.name}"
+
+    def next_replica_id(self) -> str:
+        self._counter += 1
+        return f"{self.full_name}#{self._counter}"
+
+
+class ServeController(LongPollHost):
+    """Async detached actor. All methods run on its event loop."""
+
+    def __init__(self):
+        LongPollHost.__init__(self)
+        # app_name -> {deployment_name -> DeploymentState}
+        self._apps: Dict[str, Dict[str, DeploymentState]] = {}
+        self._app_meta: Dict[str, dict] = {}  # route_prefix, ingress name
+        self._loop_task: Optional[asyncio.Task] = None
+        self._shutdown = False
+        # full_name -> requests reported waiting by handles with no replicas
+        # to route to (the scale-from-zero signal; reference: handles report
+        # queued metrics to the controller for autoscaling).
+        self._pending_demand: Dict[str, float] = {}
+
+    def _ensure_loop(self):
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._reconcile_loop())
+
+    # -- API used by serve.run / handles ----------------------------------
+
+    async def deploy_application(
+        self,
+        app_name: str,
+        route_prefix: Optional[str],
+        ingress_deployment: str,
+        deployments_blob: bytes,
+    ) -> None:
+        """deployments_blob: cloudpickle'd list[ReplicaConfig]."""
+        self._ensure_loop()
+        configs: List[ReplicaConfig] = cloudpickle.loads(deployments_blob)
+        states = self._apps.setdefault(app_name, {})
+        new_names = set()
+        for rc in configs:
+            new_names.add(rc.deployment_name)
+            existing = states.get(rc.deployment_name)
+            if existing is None:
+                states[rc.deployment_name] = DeploymentState(
+                    app_name, rc.deployment_name, rc
+                )
+            else:
+                await self._update_deployment(existing, rc)
+        # Deployments removed from the app: scale to 0 then drop.
+        for name in list(states):
+            if name not in new_names:
+                states[name].target_num_replicas = 0
+                states[name].replica_config.deployment_config.num_replicas = 0
+        self._app_meta[app_name] = {
+            "route_prefix": route_prefix,
+            "ingress": ingress_deployment,
+        }
+        self.notify_changed("route_table", self._route_table())
+        await self._reconcile_once()
+
+    async def _update_deployment(self, state: DeploymentState, rc: ReplicaConfig):
+        old_dc = state.replica_config.deployment_config
+        new_dc = rc.deployment_config
+        code_changed = (
+            rc.serialized_callable != state.replica_config.serialized_callable
+            or rc.init_args != state.replica_config.init_args
+            or rc.init_kwargs != state.replica_config.init_kwargs
+        )
+        state.replica_config = rc
+        if new_dc.autoscaling_config and state.autoscaler is None:
+            state.autoscaler = AutoscalingPolicyManager(new_dc.autoscaling_config)
+        elif not new_dc.autoscaling_config:
+            state.autoscaler = None
+        if state.autoscaler is None:
+            state.target_num_replicas = new_dc.num_replicas
+        if code_changed:
+            # Rolling replace: stop everything, reconcile restarts fresh.
+            for rep in list(state.replicas.values()):
+                await self._stop_replica(state, rep)
+        elif new_dc.user_config != old_dc.user_config and \
+                new_dc.user_config is not None:
+            for rep in state.replicas.values():
+                try:
+                    await rep.handle.reconfigure.remote(new_dc.user_config)
+                except Exception:
+                    rep.healthy = False
+
+    async def delete_application(self, app_name: str) -> None:
+        states = self._apps.get(app_name)
+        if states is None:
+            return
+        for state in states.values():
+            for rep in list(state.replicas.values()):
+                await self._stop_replica(state, rep)
+        del self._apps[app_name]
+        self._app_meta.pop(app_name, None)
+        self.notify_changed("route_table", self._route_table())
+        for state in states.values():
+            self.notify_changed(f"replicas::{state.full_name}", [])
+
+    async def get_deployment_targets(self, app_name: str) -> List[str]:
+        return sorted(self._apps.get(app_name, {}))
+
+    async def status(self) -> Dict[str, Any]:
+        out = {}
+        for app, states in self._apps.items():
+            deps = {}
+            for name, st in states.items():
+                healthy = sum(1 for r in st.replicas.values() if r.healthy)
+                if healthy >= st.target_num_replicas:
+                    status = "RUNNING"
+                elif st.replicas:
+                    status = "UPDATING"
+                else:
+                    status = "DEPLOYING" if st.target_num_replicas else "RUNNING"
+                deps[name] = {
+                    "status": status,
+                    "target_replicas": st.target_num_replicas,
+                    "running_replicas": len(st.replicas),
+                    "healthy_replicas": healthy,
+                }
+            out[app] = {
+                "route_prefix": self._app_meta.get(app, {}).get("route_prefix"),
+                "ingress": self._app_meta.get(app, {}).get("ingress"),
+                "deployments": deps,
+            }
+        return out
+
+    async def graceful_shutdown(self) -> None:
+        self._shutdown = True
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            self._loop_task = None
+        for app in list(self._apps):
+            await self.delete_application(app)
+
+    # -- reconcile loop ----------------------------------------------------
+
+    async def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+            except Exception:
+                logger.exception("serve controller reconcile failed")
+            await asyncio.sleep(RECONCILE_PERIOD_S)
+
+    async def _reconcile_once(self):
+        for states in list(self._apps.values()):
+            for state in list(states.values()):
+                await self._autoscale(state)
+                await self._reconcile_deployment(state)
+                await self._health_check(state)
+
+    async def _reconcile_deployment(self, state: DeploymentState):
+        # Remove dead/unhealthy replicas first so they get replaced.
+        for rep in [r for r in state.replicas.values() if not r.healthy]:
+            await self._stop_replica(state, rep)
+        delta = state.target_num_replicas - len(state.replicas)
+        if delta > 0:
+            for _ in range(delta):
+                self._start_replica(state)
+            self._publish_replicas(state)
+        elif delta < 0:
+            doomed = list(state.replicas.values())[delta:]
+            for rep in doomed:
+                await self._stop_replica(state, rep)
+
+    def _start_replica(self, state: DeploymentState):
+        import raytpu
+        from raytpu.serve._private.replica import Replica
+
+        rid = state.next_replica_id()
+        opts = dict(state.replica_config.deployment_config.ray_actor_options)
+        opts.setdefault("max_concurrency", 10_000)
+        handle = raytpu.remote(Replica).options(**opts).remote(
+            rid, cloudpickle.dumps(state.replica_config)
+        )
+        state.replicas[rid] = ReplicaWrapper(rid, handle, state.replica_config)
+
+    async def _stop_replica(self, state: DeploymentState, rep: ReplicaWrapper):
+        import raytpu
+
+        state.replicas.pop(rep.replica_id, None)
+        self._publish_replicas(state)
+        dc = rep.config.deployment_config
+        try:
+            await asyncio.wait_for(
+                _await_ref(rep.handle.prepare_for_shutdown.remote(
+                    dc.graceful_shutdown_wait_loop_s,
+                    dc.graceful_shutdown_timeout_s,
+                )),
+                timeout=dc.graceful_shutdown_timeout_s + 1.0,
+            )
+        except Exception:
+            pass
+        try:
+            raytpu.kill(rep.handle)
+        except Exception:
+            pass
+
+    async def _health_check(self, state: DeploymentState):
+        now = time.monotonic()
+        period = state.replica_config.deployment_config.health_check_period_s
+        for rep in list(state.replicas.values()):
+            if now - rep.last_health_check < period:
+                continue
+            rep.last_health_check = now
+            try:
+                await asyncio.wait_for(
+                    _await_ref(rep.handle.check_health.remote()),
+                    timeout=state.replica_config.deployment_config
+                    .health_check_timeout_s,
+                )
+            except Exception:
+                rep.healthy = False
+
+    async def record_handle_demand(self, full_name: str, n: float = 1.0):
+        self._pending_demand[full_name] = \
+            self._pending_demand.get(full_name, 0.0) + n
+
+    async def _autoscale(self, state: DeploymentState):
+        if state.autoscaler is None:
+            return
+        total = self._pending_demand.pop(state.full_name, 0.0)
+        for rep in list(state.replicas.values()):
+            try:
+                m = await asyncio.wait_for(
+                    _await_ref(rep.handle.get_metrics.remote()), timeout=2.0
+                )
+                total += m["avg_ongoing"]
+            except Exception:
+                pass
+        decision = state.autoscaler.get_decision_num_replicas(
+            total, state.target_num_replicas
+        )
+        if decision is not None and decision != state.target_num_replicas:
+            logger.info(
+                "autoscaling %s: %d -> %d (load=%.1f)",
+                state.full_name, state.target_num_replicas, decision, total,
+            )
+            state.target_num_replicas = decision
+
+    # -- routing state published to handles/proxies ------------------------
+
+    def _publish_replicas(self, state: DeploymentState):
+        snapshot = [
+            (r.replica_id, r.handle) for r in state.replicas.values() if r.healthy
+        ]
+        self.notify_changed(f"replicas::{state.full_name}", snapshot)
+
+    def _route_table(self) -> Dict[str, tuple]:
+        table = {}
+        for app, meta in self._app_meta.items():
+            if meta.get("route_prefix"):
+                table[meta["route_prefix"]] = (app, meta["ingress"])
+        return table
+
+    async def get_route_table(self) -> Dict[str, tuple]:
+        return self._route_table()
+
+    async def get_running_replicas(self, full_name: str) -> list:
+        for states in self._apps.values():
+            for state in states.values():
+                if state.full_name == full_name:
+                    return [
+                        (r.replica_id, r.handle)
+                        for r in state.replicas.values()
+                        if r.healthy
+                    ]
+        return []
+
+
+async def _await_ref(ref):
+    from raytpu.runtime.api import _async_get
+
+    return await _async_get(ref)
+
+
+def get_or_create_controller():
+    """Find the named controller actor or start it (detached)."""
+    import raytpu
+
+    try:
+        return raytpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    return raytpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, lifetime="detached", max_concurrency=10_000
+    ).remote()

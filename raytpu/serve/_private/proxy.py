@@ -1,0 +1,160 @@
+"""HTTP proxy: aiohttp front door routing to deployment replicas.
+
+Reference analogue: ``python/ray/serve/_private/proxy.py`` — ``HTTPProxy``
+(``:747``) / ``ProxyActor`` (``:1111``). Ours is an async actor hosting an
+aiohttp server (the reference embeds uvicorn). Routing: longest-prefix
+match of the path against the app route table (long-polled from the
+controller), then power-of-two-choices replica selection via the handle.
+
+Request → handler contract: the ingress callable receives a ``Request``
+namedtuple (method, path, query, headers, body-bytes, json()). Returning
+bytes/str → raw body; dict/list → JSON; (status, body) tuple respected.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import raytpu
+from raytpu.serve._private.controller import CONTROLLER_NAME
+from raytpu.serve.handle import DeploymentHandle
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: Dict[str, str]
+    headers: Dict[str, str]
+    body: bytes = b""
+    route_prefix: str = "/"
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def json(self) -> Any:
+        return _json.loads(self.body or b"null")
+
+    @property
+    def text(self) -> str:
+        return self.body.decode()
+
+
+def _encode_response(result: Any) -> Tuple[int, bytes, str]:
+    status = 200
+    if isinstance(result, tuple) and len(result) == 2 and \
+            isinstance(result[0], int):
+        status, result = result
+    if isinstance(result, bytes):
+        return status, result, "application/octet-stream"
+    if isinstance(result, str):
+        return status, result.encode(), "text/plain; charset=utf-8"
+    return status, _json.dumps(result).encode(), "application/json"
+
+
+class ProxyActor:
+    """Async actor: runs the aiohttp site on its own event loop."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._controller = raytpu.get_actor(CONTROLLER_NAME)
+        self._route_table: Dict[str, tuple] = {}
+        self._route_version = -1
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._runner = None
+        self._ready = False
+
+    async def ready(self) -> bool:
+        if not self._ready:
+            await self._start()
+        return True
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle_http)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        self._poll_task = asyncio.ensure_future(self._poll_routes())
+        self._ready = True
+
+    async def _poll_routes(self):
+        from raytpu.runtime.api import _async_get
+
+        while True:
+            try:
+                updates = await _async_get(
+                    self._controller.listen_for_change.remote(
+                        {"route_table": self._route_version}
+                    )
+                )
+            except Exception:
+                await asyncio.sleep(0.2)
+                continue
+            if "route_table" in updates:
+                upd = updates["route_table"]
+                self._route_table = dict(upd.object_snapshot)
+                self._route_version = upd.snapshot_id
+
+    def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
+        best = None
+        for prefix, (app_name, ingress) in self._route_table.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(norm + "/") or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, app_name, ingress)
+        return best
+
+    async def _handle_http(self, request):
+        from aiohttp import web
+
+        if request.path == "/-/healthz":
+            return web.Response(text="ok")
+        if request.path == "/-/routes":
+            return web.json_response(
+                {p: list(v) for p, v in self._route_table.items()}
+            )
+        match = self._match_route(request.path)
+        if match is None:
+            return web.Response(status=404, text="no deployment at this path")
+        prefix, app_name, ingress = match
+        key = f"{app_name}#{ingress}"
+        handle = self._handles.get(key)
+        if handle is None:
+            handle = self._handles[key] = DeploymentHandle(ingress, app_name)
+        body = await request.read()
+        req = Request(
+            method=request.method,
+            path=request.path,
+            query=dict(request.query),
+            headers=dict(request.headers),
+            body=body,
+            route_prefix=prefix,
+        )
+        model_id = request.headers.get("serve_multiplexed_model_id")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
+        try:
+            result = await handle.remote_async(req)
+        except TimeoutError:
+            return web.Response(status=503, text="deployment unavailable")
+        except Exception as e:
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        status, payload, ctype = _encode_response(result)
+        return web.Response(status=status, body=payload, content_type=ctype.split(";")[0])
+
+    async def shutdown(self):
+        task = getattr(self, "_poll_task", None)
+        if task is not None:
+            task.cancel()
+            self._poll_task = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+            self._ready = False

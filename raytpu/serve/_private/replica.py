@@ -1,0 +1,195 @@
+"""Replica actor: hosts one copy of the user callable.
+
+Reference analogue: ``python/ray/serve/_private/replica.py`` — the replica
+wraps the user class/function, tracks queued+ongoing request counts (the
+autoscaler's input), enforces ``max_ongoing_requests``, exposes health
+checks and ``reconfigure``. On TPU the replica is where a jit-compiled
+model lives pinned to its chips, so replicas are long-lived and the
+constructor is the natural place for warm-up compilation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+# Ambient per-request context (reference: serve.context._serve_request_context)
+_request_context: contextvars.ContextVar[Dict[str, Any]] = contextvars.ContextVar(
+    "raytpu_serve_request_context", default={}
+)
+
+
+def get_request_context() -> Dict[str, Any]:
+    return _request_context.get()
+
+
+class TooManyQueuedRequests(Exception):
+    pass
+
+
+class Replica:
+    """Generic replica actor body. Instantiated via ``@raytpu.remote`` with
+    ``max_concurrency`` high; concurrency is governed by the deployment's
+    ``max_ongoing_requests`` instead (reference replica does the same)."""
+
+    def __init__(self, replica_id: str, replica_config_blob: bytes):
+        from raytpu.serve.config import ReplicaConfig
+
+        self._replica_id = replica_id
+        self._config: ReplicaConfig = cloudpickle.loads(replica_config_blob)
+        dep_cfg = self._config.deployment_config
+        target = cloudpickle.loads(self._config.serialized_callable)
+        if inspect.isclass(target):
+            self._callable = target(
+                *self._config.init_args, **self._config.init_kwargs
+            )
+        else:
+            self._callable = target
+        self._num_ongoing = 0
+        self._num_queued = 0
+        self._total_handled = 0
+        self._max_ongoing = dep_cfg.max_ongoing_requests
+        self._max_queued = dep_cfg.max_queued_requests
+        self._sem = asyncio.Semaphore(self._max_ongoing)
+        import concurrent.futures
+
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, min(self._max_ongoing, 64)),
+            thread_name_prefix=f"replica-{replica_id}",
+        )
+        self._shutting_down = False
+        # Window of (timestamp, ongoing) samples for autoscaling metrics.
+        self._metric_samples: list = []
+        if dep_cfg.user_config is not None:
+            self._apply_user_config(dep_cfg.user_config)
+
+    # -- control plane ----------------------------------------------------
+
+    def _apply_user_config(self, user_config: Any) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is None:
+            raise AttributeError(
+                "deployment got user_config but the class has no "
+                "reconfigure(user_config) method"
+            )
+        fn(user_config)
+
+    async def reconfigure(self, user_config: Any) -> None:
+        self._apply_user_config(user_config)
+
+    async def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            out = fn()
+            if inspect.isawaitable(out):
+                await out
+        return True
+
+    async def prepare_for_shutdown(self, wait_loop_s: float, timeout_s: float) -> None:
+        """Drain: refuse new work, wait for ongoing requests to finish."""
+        self._shutting_down = True
+        deadline = time.monotonic() + timeout_s
+        while self._num_ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(wait_loop_s)
+        fn = getattr(self._callable, "__del__", None)
+        del fn  # user teardown runs when the actor process exits
+
+    # -- data plane --------------------------------------------------------
+
+    def get_queue_len(self) -> int:
+        """Probe used by the power-of-two-choices router."""
+        return self._num_ongoing + self._num_queued
+
+    def get_metrics(self) -> Dict[str, float]:
+        now = time.monotonic()
+        self._metric_samples = [
+            (t, v) for (t, v) in self._metric_samples if now - t < 10.0
+        ]
+        if self._metric_samples:
+            avg = sum(v for _, v in self._metric_samples) / len(self._metric_samples)
+        else:
+            avg = float(self._num_ongoing + self._num_queued)
+        return {
+            "replica_id": self._replica_id,
+            "ongoing": float(self._num_ongoing),
+            "queued": float(self._num_queued),
+            "avg_ongoing": avg,
+            "total_handled": float(self._total_handled),
+        }
+
+    async def handle_request(
+        self,
+        method_name: str,
+        request_args: tuple,
+        request_kwargs: dict,
+        request_meta: Optional[dict] = None,
+    ) -> Any:
+        if self._shutting_down:
+            raise RuntimeError(f"replica {self._replica_id} is draining")
+        if self._max_queued >= 0 and self._num_queued >= self._max_queued:
+            raise TooManyQueuedRequests(
+                f"replica {self._replica_id}: {self._num_queued} queued >= "
+                f"max_queued_requests={self._max_queued}"
+            )
+        self._num_queued += 1
+        dequeued = False
+        try:
+            async with self._sem:
+                self._num_queued -= 1
+                dequeued = True
+                self._num_ongoing += 1
+                self._metric_samples.append(
+                    (time.monotonic(), self._num_ongoing + self._num_queued)
+                )
+                try:
+                    token = _request_context.set(dict(request_meta or {}))
+                    try:
+                        return await self._invoke(
+                            method_name, request_args, request_kwargs
+                        )
+                    finally:
+                        _request_context.reset(token)
+                finally:
+                    self._num_ongoing -= 1
+                    self._total_handled += 1
+        finally:
+            if not dequeued:
+                # The semaphore acquire itself failed/cancelled: undo enqueue.
+                self._num_queued -= 1
+
+    async def _invoke(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        if method_name == "__call__":
+            target = self._callable
+            if not callable(target):
+                raise AttributeError(
+                    f"deployment {self._config.deployment_name} is not callable"
+                )
+        else:
+            target = getattr(self._callable, method_name, None)
+            if target is None:
+                raise AttributeError(
+                    f"deployment {self._config.deployment_name} has no method "
+                    f"{method_name!r}"
+                )
+        if inspect.iscoroutinefunction(target) or (
+            not inspect.isfunction(target) and not inspect.ismethod(target)
+            and inspect.iscoroutinefunction(
+                getattr(target, "__call__", None))
+        ):
+            return await target(*args, **kwargs)
+        # Sync callables run in a thread pool so they can't block the
+        # replica's event loop (reference: sync methods execute on the
+        # replica's executor; keeps queue-length metrics & health checks
+        # live while user code computes).
+        loop = asyncio.get_event_loop()
+        out = await loop.run_in_executor(
+            self._executor, lambda: target(*args, **kwargs)
+        )
+        if inspect.isawaitable(out):
+            out = await out
+        return out

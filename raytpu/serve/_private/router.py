@@ -1,0 +1,153 @@
+"""Client-side request router with power-of-two-choices replica selection.
+
+Reference analogue: ``python/ray/serve/_private/router.py`` and
+``python/ray/serve/_private/replica_scheduler/pow_2_scheduler.py`` —
+``PowerOfTwoChoicesReplicaScheduler.choose_replica_for_request``
+(``:50,717``): sample two replicas, probe their queue lengths, send to the
+shorter queue; if both are at ``max_ongoing_requests``, back off and
+re-sample. The replica set is kept fresh by long-polling the controller
+(O(changes), not O(requests)).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import raytpu
+from raytpu.serve._private.controller import CONTROLLER_NAME
+
+BACKOFF_S = 0.02
+MAX_BACKOFF_S = 0.5
+
+
+class ReplicaSet:
+    """Thread-safe view of one deployment's healthy replicas, refreshed by a
+    background long-poll thread."""
+
+    def __init__(self, controller, full_name: str, max_ongoing: int):
+        self._controller = controller
+        self._full_name = full_name
+        self._max_ongoing = max_ongoing
+        self._lock = threading.Lock()
+        self._replicas: List[Tuple[str, object]] = []
+        self._version = -1
+        self._stopped = False
+        self._have_replicas = threading.Event()
+        self._thread = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"serve-longpoll-{full_name}",
+        )
+        self._thread.start()
+
+    def _poll_loop(self):
+        key = f"replicas::{self._full_name}"
+        while not self._stopped:
+            try:
+                updates = raytpu.get(
+                    self._controller.listen_for_change.remote({key: self._version})
+                )
+            except Exception:
+                if self._stopped:
+                    return
+                time.sleep(0.1)
+                continue
+            if key in updates:
+                upd = updates[key]
+                with self._lock:
+                    self._replicas = list(upd.object_snapshot)
+                    self._version = upd.snapshot_id
+                if self._replicas:
+                    self._have_replicas.set()
+                else:
+                    self._have_replicas.clear()
+
+    def stop(self):
+        self._stopped = True
+
+    def snapshot(self) -> List[Tuple[str, object]]:
+        with self._lock:
+            return list(self._replicas)
+
+    def choose(self, timeout_s: float = 30.0) -> object:
+        """Power-of-two-choices with queue-length probes."""
+        deadline = time.monotonic() + timeout_s
+        backoff = BACKOFF_S
+        while True:
+            replicas = self.snapshot()
+            if not replicas:
+                # Scale-from-zero signal: tell the controller a request is
+                # waiting so the autoscaler can leave min_replicas=0.
+                try:
+                    self._controller.record_handle_demand.remote(
+                        self._full_name, 1.0)
+                except Exception:
+                    pass
+                if not self._have_replicas.wait(timeout=min(
+                    1.0, max(0.0, deadline - time.monotonic())
+                )) and time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"no running replicas for {self._full_name} after "
+                        f"{timeout_s}s"
+                    )
+                continue
+            if len(replicas) == 1:
+                candidates = replicas
+            else:
+                candidates = random.sample(replicas, 2)
+            probed = []
+            for rid, handle in candidates:
+                try:
+                    qlen = raytpu.get(handle.get_queue_len.remote(), timeout=2.0)
+                    probed.append((qlen, rid, handle))
+                except Exception:
+                    continue  # dead replica; long-poll will remove it
+            probed.sort(key=lambda t: t[0])
+            if probed and probed[0][0] < self._max_ongoing:
+                return probed[0][2]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"all replicas of {self._full_name} saturated for {timeout_s}s"
+                )
+            time.sleep(backoff)
+            backoff = min(backoff * 2, MAX_BACKOFF_S)
+
+
+class Router:
+    """One per DeploymentHandle; owns the replica set and assigns requests."""
+
+    _sets: Dict[str, ReplicaSet] = {}
+    _sets_lock = threading.Lock()
+
+    def __init__(self, full_name: str, max_ongoing: int = 100):
+        self._full_name = full_name
+        self._controller = raytpu.get_actor(CONTROLLER_NAME)
+        with Router._sets_lock:
+            rs = Router._sets.get(full_name)
+            if rs is None or rs._stopped:
+                rs = ReplicaSet(self._controller, full_name, max_ongoing)
+                Router._sets[full_name] = rs
+        self._replica_set = rs
+
+    def assign_request(
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        request_meta: Optional[dict] = None,
+        timeout_s: float = 30.0,
+    ):
+        """Returns an ObjectRef for the replica's response."""
+        replica = self._replica_set.choose(timeout_s=timeout_s)
+        return replica.handle_request.remote(
+            method_name, args, kwargs, request_meta or {}
+        )
+
+    @classmethod
+    def reset_all(cls):
+        with cls._sets_lock:
+            for rs in cls._sets.values():
+                rs.stop()
+            cls._sets.clear()

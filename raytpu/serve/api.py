@@ -1,0 +1,135 @@
+"""Serve public API.
+
+Reference analogue: ``python/ray/serve/api.py`` — ``serve.run`` (``:537``),
+``serve.start``, ``serve.shutdown``, ``serve.status``,
+``serve.get_deployment_handle``, ``serve.get_app_handle``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import raytpu
+from raytpu.serve._private.controller import (
+    CONTROLLER_NAME,
+    get_or_create_controller,
+)
+from raytpu.serve.config import HTTPOptions
+from raytpu.serve.deployment import Application, build_app
+from raytpu.serve.handle import DeploymentHandle
+
+PROXY_NAME = "SERVE_PROXY"
+
+_http_options: Optional[HTTPOptions] = None
+
+
+def start(http_options: Optional[HTTPOptions] = None, **kwargs) -> None:
+    """Start the Serve instance (controller + HTTP proxy)."""
+    global _http_options
+    if not raytpu.is_initialized():
+        raytpu.init()
+    get_or_create_controller()
+    opts = http_options or HTTPOptions(**kwargs) if (http_options or kwargs) \
+        else HTTPOptions()
+    _http_options = opts
+    try:
+        proxy = raytpu.get_actor(PROXY_NAME)
+    except Exception:
+        from raytpu.serve._private.proxy import ProxyActor
+
+        proxy = raytpu.remote(ProxyActor).options(
+            name=PROXY_NAME, lifetime="detached", max_concurrency=10_000
+        ).remote(opts.host, opts.port)
+    raytpu.get(proxy.ready.remote())
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+    blocking: bool = False,
+    _start_http: bool = False,
+    wait_for_ready_timeout_s: float = 60.0,
+) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress.
+
+    HTTP ingress is opt-in (``_start_http=True`` or a prior
+    ``serve.start()``); handle-only apps skip the proxy entirely.
+    """
+    if not raytpu.is_initialized():
+        raytpu.init()
+    controller = get_or_create_controller()
+    if _start_http or _http_options is not None:
+        start(_http_options)
+    ingress, blob, dep_configs = build_app(app, name)
+    raytpu.get(
+        controller.deploy_application.remote(name, route_prefix, ingress, blob)
+    )
+    _wait_healthy(controller, name, wait_for_ready_timeout_s)
+    handle = DeploymentHandle(
+        ingress, name, max_ongoing=dep_configs[ingress].max_ongoing_requests
+    )
+    if blocking:  # pragma: no cover - interactive use
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = raytpu.get(controller.status.remote())
+        deps = st.get(app_name, {}).get("deployments", {})
+        if deps and all(d["status"] == "RUNNING" for d in deps.values()):
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"application {app_name!r} not healthy after {timeout_s}s")
+
+
+def status() -> Dict[str, Any]:
+    controller = raytpu.get_actor(CONTROLLER_NAME)
+    return raytpu.get(controller.status.remote())
+
+
+def get_deployment_handle(
+    deployment_name: str, app_name: str = "default"
+) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = raytpu.get_actor(CONTROLLER_NAME)
+    st = raytpu.get(controller.status.remote())
+    if name not in st:
+        raise KeyError(f"no application named {name!r}")
+    return DeploymentHandle(st[name]["ingress"], name)
+
+
+def delete(name: str) -> None:
+    controller = raytpu.get_actor(CONTROLLER_NAME)
+    raytpu.get(controller.delete_application.remote(name))
+
+
+def shutdown() -> None:
+    global _http_options
+    from raytpu.serve._private.router import Router
+
+    Router.reset_all()
+    try:
+        proxy = raytpu.get_actor(PROXY_NAME)
+        raytpu.get(proxy.shutdown.remote(), timeout=5.0)
+        raytpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        controller = raytpu.get_actor(CONTROLLER_NAME)
+        raytpu.get(controller.graceful_shutdown.remote(), timeout=30.0)
+        raytpu.kill(controller)
+    except Exception:
+        pass
+    _http_options = None

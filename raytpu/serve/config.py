@@ -1,0 +1,97 @@
+"""Serve configuration schemas.
+
+Reference analogue: ``python/ray/serve/config.py`` (``DeploymentConfig``,
+``AutoscalingConfig``, ``HTTPOptions``) and ``python/ray/serve/schema.py``.
+Ours are plain dataclasses validated at construction; TPU-specific knobs
+(``tpu_chips`` per replica, static-shape batching) are first-class because a
+replica on a TPU slice holds a jit-compiled model whose batch shape should
+stay fixed across requests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-metric driven autoscaling (reference:
+    ``python/ray/serve/_private/autoscaling_policy.py:12,30`` and
+    ``AutoscalingConfig`` in ``python/ray/serve/config.py``)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_ongoing_requests: float = 2.0
+    # Look-back window over which request metrics are averaged.
+    metrics_interval_s: float = 0.5
+    look_back_period_s: float = 5.0
+    # Hysteresis: how long a scale decision must persist before acting.
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    upscale_smoothing_factor: float = 1.0
+    downscale_smoothing_factor: float = 1.0
+    initial_replicas: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_replicas < 0:
+            raise ValueError("min_replicas must be >= 0")
+        if self.max_replicas < max(self.min_replicas, 1):
+            raise ValueError("max_replicas must be >= max(min_replicas, 1)")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be > 0")
+
+
+@dataclass
+class DeploymentConfig:
+    """Per-deployment behavior (reference: ``DeploymentConfig`` proto mirror
+    in ``python/ray/serve/config.py``)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    user_config: Optional[Any] = None
+    graceful_shutdown_timeout_s: float = 20.0
+    graceful_shutdown_wait_loop_s: float = 0.1
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 30.0
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    # Resources per replica. TPU chips are the first-class accelerator here.
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    max_queued_requests: int = -1  # -1 == unbounded
+
+    def __post_init__(self):
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_ongoing_requests <= 0:
+            raise ValueError("max_ongoing_requests must be > 0")
+        if isinstance(self.autoscaling_config, dict):
+            self.autoscaling_config = AutoscalingConfig(**self.autoscaling_config)
+
+
+@dataclass
+class HTTPOptions:
+    """Proxy options (reference: ``HTTPOptions`` in serve/config.py)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+    root_path: str = ""
+
+    def __post_init__(self):
+        if not (0 <= self.port < 65536):
+            raise ValueError("port out of range")
+
+
+@dataclass
+class ReplicaConfig:
+    """Everything a replica actor needs to construct the user callable."""
+
+    deployment_name: str
+    app_name: str
+    serialized_callable: bytes  # cloudpickle'd class or function
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+    deployment_config: DeploymentConfig = field(default_factory=DeploymentConfig)
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.app_name}#{self.deployment_name}"

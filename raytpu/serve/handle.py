@@ -1,0 +1,106 @@
+"""DeploymentHandle: the Python-native way to call a deployment.
+
+Reference analogue: ``python/ray/serve/handle.py`` — ``DeploymentHandle``
+returning ``DeploymentResponse`` futures. ``handle.remote(...)`` routes
+through the power-of-two-choices router; the response wraps an ObjectRef
+and supports ``.result()``, ``await``, and being passed as an argument to
+another deployment call (composition without materializing on the caller).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import raytpu
+from raytpu.runtime.object_ref import ObjectRef
+
+
+class DeploymentResponse:
+    def __init__(self, ref: ObjectRef):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return raytpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self) -> ObjectRef:
+        return self._ref
+
+    def __await__(self):
+        from raytpu.runtime.api import _async_get
+
+        return _async_get(self._ref).__await__()
+
+
+class DeploymentHandle:
+    def __init__(
+        self,
+        deployment_name: str,
+        app_name: str = "default",
+        method_name: str = "__call__",
+        max_ongoing: int = 100,
+        _meta: Optional[Dict[str, Any]] = None,
+    ):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._max_ongoing = max_ongoing
+        self._meta = dict(_meta or {})
+        self._router = None
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.app_name}#{self.deployment_name}"
+
+    def _get_router(self):
+        if self._router is None:
+            from raytpu.serve._private.router import Router
+
+            self._router = Router(self.full_name, self._max_ongoing)
+        return self._router
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None,
+                **_ignored) -> "DeploymentHandle":
+        meta = dict(self._meta)
+        if multiplexed_model_id is not None:
+            meta["multiplexed_model_id"] = multiplexed_model_id
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self._method_name, self._max_ongoing, meta,
+        )
+        h._router = self._router
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        # Resolve nested DeploymentResponses into their refs so the replica
+        # fetches results directly (composition without a round-trip here).
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args
+        )
+        kwargs = {
+            k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        ref = self._get_router().assign_request(
+            self._method_name, args, kwargs, request_meta=self._meta
+        )
+        return DeploymentResponse(ref)
+
+    async def remote_async(self, *args, **kwargs) -> Any:
+        loop = asyncio.get_event_loop()
+        resp = await loop.run_in_executor(None, lambda: self.remote(*args, **kwargs))
+        return await resp
+
+    def __reduce__(self):
+        return (
+            DeploymentHandle,
+            (self.deployment_name, self.app_name, self._method_name,
+             self._max_ongoing, self._meta),
+        )

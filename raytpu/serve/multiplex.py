@@ -1,0 +1,81 @@
+"""Model multiplexing: many models share one replica pool.
+
+Reference analogue: ``python/ray/serve/multiplex.py`` —
+``@serve.multiplexed(max_num_models_per_replica)`` decorating an async
+``load_model(model_id)``; the wrapper LRU-caches loaded models per replica
+and ``serve.get_multiplexed_model_id()`` reads the id the caller attached
+via ``handle.options(multiplexed_model_id=...)``. On TPU this is how many
+LoRA/fine-tune variants share one set of chips: the base jit program stays
+resident, per-model weights swap in HBM.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from raytpu.serve._private.replica import get_request_context
+
+
+def get_multiplexed_model_id() -> str:
+    """Model id attached to the current request (empty string if none)."""
+    return get_request_context().get("multiplexed_model_id", "")
+
+
+class _ModelCache:
+    def __init__(self, loader: Callable, capacity: int):
+        self.loader = loader
+        self.capacity = capacity
+        self.cache: OrderedDict = OrderedDict()
+        self.locks = {}
+
+    async def get(self, *args) -> object:
+        model_id = args[-1] if args else get_multiplexed_model_id()
+        if model_id in self.cache:
+            self.cache.move_to_end(model_id)
+            return self.cache[model_id]
+        lock = self.locks.setdefault(model_id, asyncio.Lock())
+        async with lock:
+            if model_id in self.cache:  # loaded while we waited
+                self.cache.move_to_end(model_id)
+                return self.cache[model_id]
+            while len(self.cache) >= self.capacity:
+                _, evicted = self.cache.popitem(last=False)
+                unload = getattr(evicted, "__del__", None)
+                del unload, evicted
+            model = self.loader(*args)
+            if inspect.isawaitable(model):
+                model = await model
+            self.cache[model_id] = model
+            return model
+
+
+def multiplexed(
+    _fn: Optional[Callable] = None, *, max_num_models_per_replica: int = 3
+):
+    def wrap(fn: Callable):
+        caches = {}  # per bound instance
+
+        is_method = "self" in inspect.signature(fn).parameters
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            key = id(args[0]) if is_method else None
+            cache = caches.get(key)
+            if cache is None:
+                bound = functools.partial(fn, args[0]) if is_method else fn
+                cache = caches[key] = _ModelCache(
+                    bound, max_num_models_per_replica
+                )
+            call_args = args[1:] if is_method else args
+            return await cache.get(*call_args)
+
+        wrapper._is_serve_multiplexed = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

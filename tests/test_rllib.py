@@ -206,7 +206,46 @@ class TestIMPALA:
         algo.stop()
 
 
+    def test_impala_multi_learner_shards(self, raytpu_local):
+        """Regression: time-major batches shard on the BATCH axis, not the
+        leading time axis; bootstrap_obs shards on its own batch axis."""
+        from raytpu.rllib import IMPALAConfig
+
+        config = (IMPALAConfig().environment("CartPole-v1")
+                  .env_runners(num_env_runners=0,
+                               num_envs_per_env_runner=4,
+                               rollout_fragment_length=16)
+                  .training(lr=5e-4, num_fragments_per_step=2)
+                  .learners(num_learners=2)
+                  .debugging(seed=0))
+        algo = config.build()
+        r = algo.train()
+        assert np.isfinite(r["total_loss"])
+        algo.stop()
+
+
 class TestDQN:
+    def test_dqn_multi_learner_shards(self, raytpu_local):
+        """Regression: target_params in the batch dict must be replicated
+        across learner shards, not leading-dim sharded."""
+        from raytpu.rllib import DQNConfig
+
+        config = (DQNConfig().environment("CartPole-v1")
+                  .env_runners(num_env_runners=0,
+                               num_envs_per_env_runner=2,
+                               rollout_fragment_length=16)
+                  .training(lr=1e-3, train_batch_size=64,
+                            updates_per_step=2,
+                            num_steps_sampled_before_learning_starts=64,
+                            epsilon_timesteps=500)
+                  .learners(num_learners=2)
+                  .debugging(seed=0))
+        algo = config.build()
+        for _ in range(4):
+            r = algo.train()
+        assert r["replay_size"] > 0
+        algo.stop()
+
     def test_dqn_learns_cartpole(self, raytpu_local):
         from raytpu.rllib import DQNConfig
 
