@@ -1,3 +1,7 @@
 from raytpu.dag.node import DAGNode, FunctionNode, ActorMethodNode, ClassNode, InputNode
+from raytpu.dag.compiled import CompiledDAG, CompiledDAGRef, MultiOutputNode
 
-__all__ = ["DAGNode", "FunctionNode", "ActorMethodNode", "ClassNode", "InputNode"]
+__all__ = [
+    "ActorMethodNode", "ClassNode", "CompiledDAG", "CompiledDAGRef",
+    "DAGNode", "FunctionNode", "InputNode", "MultiOutputNode",
+]

@@ -1,0 +1,315 @@
+"""Compiled DAGs: pre-allocated channel pipelines across actors.
+
+Reference analogue: ``python/ray/dag/compiled_dag_node.py`` —
+``CompiledDAG`` (``:174``) and the per-actor exec loop
+(``do_exec_compiled_task``, ``:90-110``): compile once, then every
+``execute()`` writes the input into a channel and each actor runs
+read-inputs → invoke-method → write-output with NO per-step task
+submission. This is the microsecond-pipeline path; on TPU it is how
+multi-actor pipelines (e.g. host data prep → trainer step → metrics sink)
+avoid submission overhead between steps.
+
+Supported topology: one ``InputNode``, any DAG of ``ActorMethodNode``s over
+``ClassNode``/``ActorHandle`` targets, optionally a ``MultiOutputNode``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from raytpu.dag.node import (
+    ActorMethodNode,
+    ClassNode,
+    DAGNode,
+    InputNode,
+)
+from raytpu.runtime.channel import Channel, ChannelClosed
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several leaf nodes into one execute() result tuple."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+        self.outputs = list(outputs)
+
+    def execute(self, input_value: Any = None):
+        return [o.execute(input_value) for o in self.outputs]
+
+
+class _Teardown:
+    """Sentinel flushed through the pipeline to stop exec loops."""
+
+    def __reduce__(self):
+        return (_teardown_singleton, ())
+
+
+_TEARDOWN = _Teardown()
+
+
+def _teardown_singleton():
+    return _TEARDOWN
+
+
+class _ExecError:
+    """An exception captured in some upstream node, propagated downstream
+    so the driver re-raises it from get() (reference: compiled DAGs forward
+    errors through channels the same way)."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _exec_compiled_loop(self_callable, method_name: str,
+                        in_channels: List[Channel],
+                        in_reader_ids: List[int],
+                        const_args: tuple, const_kwargs: dict,
+                        arg_slots: List,
+                        out_channel: Channel) -> str:
+    """Parked inside the actor as one long-running task. ``arg_slots[i]``
+    says where in_channels[i]'s value goes: an int is a positional slot,
+    a str a keyword name; ``const_args``/``const_kwargs`` fill the rest
+    (None placeholders at channel slots)."""
+    method = getattr(self_callable, method_name)
+    while True:
+        vals = []
+        err: Optional[_ExecError] = None
+        stop = False
+        for ch, rid in zip(in_channels, in_reader_ids):
+            try:
+                v = ch.read(rid)
+            except ChannelClosed:
+                stop = True
+                break
+            if isinstance(v, _Teardown):
+                stop = True
+                break
+            if isinstance(v, _ExecError) and err is None:
+                err = v
+            vals.append(v)
+        if stop:
+            try:
+                out_channel.write(_TEARDOWN)
+            except ChannelClosed:
+                pass
+            return "stopped"
+        if err is not None:
+            out_channel.write(err)
+            continue
+        args = list(const_args)
+        kwargs = dict(const_kwargs)
+        for slot, v in zip(arg_slots, vals):
+            if isinstance(slot, str):
+                kwargs[slot] = v
+            else:
+                args[slot] = v
+        try:
+            result = method(*args, **kwargs)
+        except BaseException as e:  # propagate, keep looping
+            result = _ExecError(e)
+        try:
+            out_channel.write(result)
+        except ChannelClosed:
+            return "stopped"
+
+
+class CompiledDAGRef:
+    """Future for one execute(); reads the output channel in order."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value: Any = None
+        self._done = False
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        self._dag._drain_until(self._seq, timeout)
+        value = self._dag._results.pop(self._seq)
+        if isinstance(value, _ExecError):
+            raise value.exc
+        if isinstance(value, list):
+            for v in value:
+                if isinstance(v, _ExecError):
+                    raise v.exc
+        return value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size: int = 16):
+        self._root = root
+        self._buffer_size = buffer_size
+        self._input_channel: Optional[Channel] = None
+        self._output_channels: List[Channel] = []
+        self._output_reader_ids: List[int] = []
+        self._loop_refs: list = []
+        # _meta_lock guards counters/flags only (never held while blocking
+        # on a channel); _drain_lock serializes output readers, so a parked
+        # get() can't deadlock execute()/teardown().
+        self._meta_lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+        self._exec_lock = threading.Lock()  # keeps seq == input-write order
+        self._seq = 0            # next execute() sequence number
+        self._read_seq = 0       # next sequence to read from outputs
+        self._results: Dict[int, Any] = {}
+        self._multi_output = isinstance(root, MultiOutputNode)
+        self._torn_down = False
+        self._compile()
+
+    # -- compilation -------------------------------------------------------
+
+    def _compile(self) -> None:
+        leaves = self._root.outputs if self._multi_output else [self._root]
+        # node -> its output channel; count consumers first.
+        consumers: Dict[int, int] = {}
+        nodes: List[ActorMethodNode] = []
+        seen: Dict[int, ActorMethodNode] = {}
+        input_consumers = 0
+
+        def walk(node: DAGNode):
+            nonlocal input_consumers
+            if not isinstance(node, ActorMethodNode):
+                raise TypeError(
+                    "compiled DAGs support actor-method nodes (got "
+                    f"{type(node).__name__}); tasks have no persistent "
+                    "process to park the exec loop in"
+                )
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for a in list(node._bound_args) + list(node._bound_kwargs.values()):
+                if isinstance(a, InputNode):
+                    input_consumers += 1
+                elif isinstance(a, ActorMethodNode):
+                    consumers[id(a)] = consumers.get(id(a), 0) + 1
+                    walk(a)
+                elif isinstance(a, DAGNode):
+                    raise TypeError(
+                        f"unsupported node type in compiled DAG: "
+                        f"{type(a).__name__}"
+                    )
+            nodes.append(node)
+
+        for leaf in leaves:
+            walk(leaf)
+            consumers[id(leaf)] = consumers.get(id(leaf), 0) + 1  # driver
+
+        self._input_channel = Channel(
+            num_readers=max(1, input_consumers),
+            capacity=self._buffer_size)
+        channels: Dict[int, Channel] = {
+            nid: Channel(num_readers=n, capacity=self._buffer_size)
+            for nid, n in consumers.items()
+        }
+
+        # Launch one exec loop per node (topological order from walk()).
+        for node in nodes:
+            target = node._target
+            if isinstance(target, ClassNode):
+                handle = target.execute()
+            else:
+                handle = target
+            in_channels, in_rids, slots = [], [], []
+            const_args: List[Any] = []
+            const_kwargs: Dict[str, Any] = {}
+
+            def wire(a, slot):
+                if isinstance(a, InputNode):
+                    in_channels.append(self._input_channel)
+                    in_rids.append(self._input_channel.reader_id())
+                    slots.append(slot)
+                    return None, True
+                if isinstance(a, ActorMethodNode):
+                    ch = channels[id(a)]
+                    in_channels.append(ch)
+                    in_rids.append(ch.reader_id())
+                    slots.append(slot)
+                    return None, True
+                return a, False
+
+            for i, a in enumerate(node._bound_args):
+                v, _ = wire(a, i)
+                const_args.append(v)
+            for k, a in node._bound_kwargs.items():
+                v, wired = wire(a, k)
+                if not wired:
+                    const_kwargs[k] = v
+            ref = _submit_loop(handle, node, in_channels, in_rids,
+                               tuple(const_args), const_kwargs,
+                               slots, channels[id(node)])
+            self._loop_refs.append(ref)
+
+        for leaf in leaves:
+            ch = channels[id(leaf)]
+            self._output_channels.append(ch)
+            self._output_reader_ids.append(ch.reader_id())
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, input_value: Any = None,
+                timeout: Optional[float] = None) -> CompiledDAGRef:
+        with self._exec_lock:
+            with self._meta_lock:
+                if self._torn_down:
+                    raise RuntimeError("compiled DAG was torn down")
+                seq = self._seq
+                self._seq += 1
+            # Channel capacity provides backpressure; a parked get() holds
+            # only _drain_lock, so it can never block this write.
+            self._input_channel.write(input_value, timeout=timeout)
+        return CompiledDAGRef(self, seq)
+
+    def _drain_until(self, seq: int, timeout: Optional[float]) -> None:
+        with self._drain_lock:
+            while self._read_seq <= seq:
+                outs = [
+                    ch.read(rid, timeout=timeout)
+                    for ch, rid in zip(self._output_channels,
+                                       self._output_reader_ids)
+                ]
+                with self._meta_lock:
+                    self._results[self._read_seq] = (
+                        outs if self._multi_output else outs[0]
+                    )
+                    self._read_seq += 1
+
+    def teardown(self) -> None:
+        with self._meta_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+        try:
+            self._input_channel.write(_TEARDOWN, timeout=5.0)
+        except Exception:
+            self._input_channel.close()
+        import raytpu
+
+        for ref in self._loop_refs:
+            try:
+                raytpu.get(ref, timeout=5.0)
+            except Exception:
+                pass
+        for ch in [self._input_channel] + self._output_channels:
+            ch.close()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def _submit_loop(handle, node, in_channels, in_rids, const_args,
+                 const_kwargs, slots, out_channel):
+    """Park _exec_compiled_loop inside the actor. Every actor dispatches
+    the reserved ``__raytpu_exec_compiled__`` method name to the loop
+    (runtime/worker.py execute path)."""
+    from raytpu.runtime.actor import ActorMethod
+
+    return ActorMethod(handle, "__raytpu_exec_compiled__", 1).remote(
+        node._method_name, in_channels, in_rids, const_args, const_kwargs,
+        slots, out_channel)
+
+
+def experimental_compile(dag: DAGNode, buffer_size: int = 16) -> CompiledDAG:
+    return CompiledDAG(dag, buffer_size=buffer_size)

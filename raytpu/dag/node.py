@@ -35,6 +35,13 @@ class DAGNode:
     def execute(self, input_value: Any = None):
         raise NotImplementedError
 
+    def experimental_compile(self, buffer_size: int = 16):
+        """Compile into a pre-allocated channel pipeline (reference:
+        ``DAGNode.experimental_compile``, ``python/ray/dag/dag_node.py:108``)."""
+        from raytpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, buffer_size=buffer_size)
+
 
 class InputNode(DAGNode):
     """Placeholder for the value passed to ``dag.execute(x)``."""

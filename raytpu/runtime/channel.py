@@ -1,0 +1,145 @@
+"""Single-writer multi-reader versioned channels.
+
+Reference analogue: mutable plasma objects
+(``src/ray/core_worker/experimental_mutable_object_manager.h:59-108``) and
+the Python ``Channel`` (``python/ray/experimental/channel.py:51``): a
+pre-allocated buffer with ``WriteAcquire``/``WriteRelease`` and blocking
+``ReadAcquire``/``ReadRelease`` — zero per-message allocation, natural
+backpressure (the writer blocks when ``capacity`` versions are unconsumed
+by the slowest reader).
+
+Our local fabric runs actors as threads in one process, so the buffer is
+in-process memory guarded by a condition variable; pickling a channel into
+an actor resolves to the SAME underlying buffer through a process-global
+registry (the reference gets this via shared memory; cluster mode maps the
+same protocol onto the shm store).
+
+TPU relevance: this is the host-side feeding primitive — e.g. a data-loader
+actor writes per-step input shards into a channel the training actor reads,
+overlapping host prep with device compute without per-step task submission.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+_registry: Dict[int, "Channel"] = {}
+_registry_lock = threading.Lock()
+_next_id = itertools.count(1)
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """Versioned ring of ``capacity`` slots. ``num_readers`` fixed at
+    creation; every reader sees every version exactly once (broadcast)."""
+
+    def __init__(self, num_readers: int = 1, capacity: int = 1):
+        if num_readers < 1:
+            raise ValueError("num_readers must be >= 1")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._chan_id = next(_next_id)
+        self._num_readers = num_readers
+        self._capacity = capacity
+        self._cond = threading.Condition()
+        # deque of (version, value); versions are contiguous.
+        self._buffer: deque = deque()
+        self._version = 0  # version of the newest write
+        self._cursors: Dict[int, int] = {}  # reader_id -> last version read
+        self._next_reader = itertools.count()
+        self._closed = False
+        with _registry_lock:
+            _registry[self._chan_id] = self
+
+    # -- reader registration ----------------------------------------------
+
+    def reader_id(self) -> int:
+        """Claim one of the num_readers read cursors."""
+        with self._cond:
+            rid = next(self._next_reader)
+            if rid >= self._num_readers:
+                raise ValueError(
+                    f"channel has {self._num_readers} readers; all claimed"
+                )
+            self._cursors[rid] = self._version  # sees only future writes
+            return rid
+
+    def _slowest(self) -> int:
+        return min(self._cursors.values()) if self._cursors else self._version
+
+    def _trim(self) -> None:
+        slowest = self._slowest()
+        while self._buffer and self._buffer[0][0] <= slowest:
+            self._buffer.popleft()
+
+    # -- data plane --------------------------------------------------------
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        """Block while ``capacity`` versions are pending for some reader."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while (self._version - self._slowest() >= self._capacity
+                   and not self._closed):
+                self._wait(deadline, "write")
+            if self._closed:
+                raise ChannelClosed()
+            self._version += 1
+            self._buffer.append((self._version, value))
+            self._cond.notify_all()
+
+    def read(self, reader_id: int, timeout: Optional[float] = None) -> Any:
+        """Block until a version newer than this reader's cursor appears."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            if reader_id not in self._cursors:
+                raise ValueError(f"unknown reader {reader_id}")
+            while self._cursors[reader_id] >= self._version:
+                if self._closed:
+                    raise ChannelClosed()
+                self._wait(deadline, "read")
+            want = self._cursors[reader_id] + 1
+            first = self._buffer[0][0]
+            value = self._buffer[want - first][1]
+            self._cursors[reader_id] = want
+            self._trim()
+            self._cond.notify_all()  # wake a parked writer
+            return value
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        with _registry_lock:
+            _registry.pop(self._chan_id, None)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _wait(self, deadline: Optional[float], what: str) -> None:
+        if deadline is None:
+            self._cond.wait()
+            return
+        remaining = deadline - time.monotonic()
+        if remaining <= 0 or not self._cond.wait(timeout=remaining):
+            raise TimeoutError(f"channel {what} timed out")
+
+    # -- serialization: same process → same buffer -------------------------
+
+    def __reduce__(self):
+        return (_resolve_channel, (self._chan_id,))
+
+
+def _resolve_channel(chan_id: int) -> Channel:
+    with _registry_lock:
+        ch = _registry.get(chan_id)
+    if ch is None:
+        raise ChannelClosed(f"channel {chan_id} no longer exists")
+    return ch

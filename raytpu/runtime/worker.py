@@ -176,8 +176,16 @@ class Worker:
         try:
             args, kwargs = self.resolve_args(spec, get_fn)
             if spec.is_actor_task():
-                method = getattr(actor_instance, spec.method_name)
-                result = method(*args, **kwargs)
+                if spec.method_name == "__raytpu_exec_compiled__":
+                    # Compiled-DAG exec loop parked inside this actor
+                    # (reference: do_exec_compiled_task,
+                    # python/ray/dag/compiled_dag_node.py:90-110).
+                    from raytpu.dag.compiled import _exec_compiled_loop
+
+                    result = _exec_compiled_loop(actor_instance, *args)
+                else:
+                    method = getattr(actor_instance, spec.method_name)
+                    result = method(*args, **kwargs)
             else:
                 fn = self.load_function(spec.function_blob)
                 result = fn(*args, **kwargs)

@@ -51,6 +51,7 @@ class DeploymentState:
         self.target_num_replicas = self._initial_target()
         self.replicas: Dict[str, ReplicaWrapper] = {}
         self._counter = 0
+        self.deleting = False
         cfg = replica_config.deployment_config.autoscaling_config
         self.autoscaler = AutoscalingPolicyManager(cfg) if cfg else None
 
@@ -81,10 +82,13 @@ class ServeController(LongPollHost):
         self._app_meta: Dict[str, dict] = {}  # route_prefix, ingress name
         self._loop_task: Optional[asyncio.Task] = None
         self._shutdown = False
-        # full_name -> requests reported waiting by handles with no replicas
-        # to route to (the scale-from-zero signal; reference: handles report
-        # queued metrics to the controller for autoscaling).
-        self._pending_demand: Dict[str, float] = {}
+        # full_name -> [(ts, n)] requests reported waiting by handles with
+        # no replicas to route to (the scale-from-zero signal; reference:
+        # handles report queued metrics to the controller for autoscaling).
+        self._pending_demand: Dict[str, list] = {}
+        # In-flight replica stop tasks (concurrent drains; the reconcile
+        # loop must not stall behind graceful_shutdown_timeout_s).
+        self._stop_tasks: set = set()
 
     def _ensure_loop(self):
         if self._loop_task is None or self._loop_task.done():
@@ -113,11 +117,12 @@ class ServeController(LongPollHost):
                 )
             else:
                 await self._update_deployment(existing, rc)
-        # Deployments removed from the app: scale to 0 then drop.
+        # Deployments removed from the app: drain to 0, reconcile drops the
+        # state once the last replica is gone (``deleting`` flag).
         for name in list(states):
             if name not in new_names:
+                states[name].deleting = True
                 states[name].target_num_replicas = 0
-                states[name].replica_config.deployment_config.num_replicas = 0
         self._app_meta[app_name] = {
             "route_prefix": route_prefix,
             "ingress": ingress_deployment,
@@ -143,7 +148,7 @@ class ServeController(LongPollHost):
         if code_changed:
             # Rolling replace: stop everything, reconcile restarts fresh.
             for rep in list(state.replicas.values()):
-                await self._stop_replica(state, rep)
+                self._stop_replica_background(state, rep)
         elif new_dc.user_config != old_dc.user_config and \
                 new_dc.user_config is not None:
             for rep in state.replicas.values():
@@ -156,9 +161,13 @@ class ServeController(LongPollHost):
         states = self._apps.get(app_name)
         if states is None:
             return
-        for state in states.values():
-            for rep in list(state.replicas.values()):
-                await self._stop_replica(state, rep)
+        stops = [
+            self._stop_replica_background(state, rep)
+            for state in states.values()
+            for rep in list(state.replicas.values())
+        ]
+        if stops:
+            await asyncio.gather(*stops, return_exceptions=True)
         del self._apps[app_name]
         self._app_meta.pop(app_name, None)
         self.notify_changed("route_table", self._route_table())
@@ -212,16 +221,19 @@ class ServeController(LongPollHost):
             await asyncio.sleep(RECONCILE_PERIOD_S)
 
     async def _reconcile_once(self):
-        for states in list(self._apps.values()):
-            for state in list(states.values()):
-                await self._autoscale(state)
+        for app_name, states in list(self._apps.items()):
+            for name, state in list(states.items()):
+                if not state.deleting:
+                    await self._autoscale(state)
                 await self._reconcile_deployment(state)
                 await self._health_check(state)
+                if state.deleting and not state.replicas:
+                    states.pop(name, None)
 
     async def _reconcile_deployment(self, state: DeploymentState):
         # Remove dead/unhealthy replicas first so they get replaced.
         for rep in [r for r in state.replicas.values() if not r.healthy]:
-            await self._stop_replica(state, rep)
+            self._stop_replica_background(state, rep)
         delta = state.target_num_replicas - len(state.replicas)
         if delta > 0:
             for _ in range(delta):
@@ -230,7 +242,19 @@ class ServeController(LongPollHost):
         elif delta < 0:
             doomed = list(state.replicas.values())[delta:]
             for rep in doomed:
-                await self._stop_replica(state, rep)
+                self._stop_replica_background(state, rep)
+
+    def _stop_replica_background(self, state: DeploymentState,
+                                 rep: ReplicaWrapper) -> asyncio.Task:
+        """Unpublish immediately; drain+kill concurrently so one slow drain
+        (up to graceful_shutdown_timeout_s) can't freeze the reconcile loop
+        for every other deployment."""
+        state.replicas.pop(rep.replica_id, None)
+        self._publish_replicas(state)
+        task = asyncio.ensure_future(self._drain_and_kill(rep))
+        self._stop_tasks.add(task)
+        task.add_done_callback(self._stop_tasks.discard)
+        return task
 
     def _start_replica(self, state: DeploymentState):
         import raytpu
@@ -244,11 +268,9 @@ class ServeController(LongPollHost):
         )
         state.replicas[rid] = ReplicaWrapper(rid, handle, state.replica_config)
 
-    async def _stop_replica(self, state: DeploymentState, rep: ReplicaWrapper):
+    async def _drain_and_kill(self, rep: ReplicaWrapper):
         import raytpu
 
-        state.replicas.pop(rep.replica_id, None)
-        self._publish_replicas(state)
         dc = rep.config.deployment_config
         try:
             await asyncio.wait_for(
@@ -282,13 +304,31 @@ class ServeController(LongPollHost):
                 rep.healthy = False
 
     async def record_handle_demand(self, full_name: str, n: float = 1.0):
-        self._pending_demand[full_name] = \
-            self._pending_demand.get(full_name, 0.0) + n
+        self._pending_demand.setdefault(full_name, []).append(
+            (time.monotonic(), n))
+
+    def _demand_level(self, full_name: str) -> float:
+        """Requests reported waiting by handles within the last 2s. A level
+        (not a counter): each waiting request re-reports ~1/s, so summing a
+        2s window survives reconcile ticks that land between reports —
+        required for upscale hysteresis to ever elapse at zero replicas."""
+        entries = self._pending_demand.get(full_name)
+        if not entries:
+            return 0.0
+        cutoff = time.monotonic() - 2.0
+        fresh = [(t, n) for (t, n) in entries if t >= cutoff]
+        if fresh:
+            self._pending_demand[full_name] = fresh
+        else:
+            self._pending_demand.pop(full_name, None)
+        # Each waiting request contributes ~2 reports per window; halve,
+        # but any fresh report counts as at least one waiting request.
+        return max(sum(n for _, n in fresh) / 2.0, 1.0)
 
     async def _autoscale(self, state: DeploymentState):
         if state.autoscaler is None:
             return
-        total = self._pending_demand.pop(state.full_name, 0.0)
+        total = self._demand_level(state.full_name)
         for rep in list(state.replicas.values()):
             try:
                 m = await asyncio.wait_for(
@@ -310,9 +350,16 @@ class ServeController(LongPollHost):
     # -- routing state published to handles/proxies ------------------------
 
     def _publish_replicas(self, state: DeploymentState):
-        snapshot = [
-            (r.replica_id, r.handle) for r in state.replicas.values() if r.healthy
-        ]
+        snapshot = {
+            "replicas": [
+                (r.replica_id, r.handle)
+                for r in state.replicas.values() if r.healthy
+            ],
+            # Routers size their saturation threshold from the deployment's
+            # actual config, not the handle-constructor default.
+            "max_ongoing": state.replica_config.deployment_config
+            .max_ongoing_requests,
+        }
         self.notify_changed(f"replicas::{state.full_name}", snapshot)
 
     def _route_table(self) -> Dict[str, tuple]:

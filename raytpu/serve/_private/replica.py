@@ -96,8 +96,6 @@ class Replica:
         deadline = time.monotonic() + timeout_s
         while self._num_ongoing > 0 and time.monotonic() < deadline:
             await asyncio.sleep(wait_loop_s)
-        fn = getattr(self._callable, "__del__", None)
-        del fn  # user teardown runs when the actor process exits
 
     # -- data plane --------------------------------------------------------
 

@@ -56,8 +56,17 @@ class ReplicaSet:
                 continue
             if key in updates:
                 upd = updates[key]
+                snap = upd.object_snapshot
+                if isinstance(snap, dict):
+                    reps = list(snap["replicas"])
+                    max_ongoing = int(snap.get("max_ongoing",
+                                               self._max_ongoing))
+                else:  # pre-dict snapshots (e.g. the delete-path empty list)
+                    reps = list(snap)
+                    max_ongoing = self._max_ongoing
                 with self._lock:
-                    self._replicas = list(upd.object_snapshot)
+                    self._replicas = reps
+                    self._max_ongoing = max_ongoing
                     self._version = upd.snapshot_id
                 if self._replicas:
                     self._have_replicas.set()

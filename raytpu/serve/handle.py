@@ -104,3 +104,18 @@ class DeploymentHandle:
             (self.deployment_name, self.app_name, self._method_name,
              self._max_ongoing, self._meta),
         )
+
+    def __eq__(self, other):
+        # Structural equality so redeploys of composed apps (whose init
+        # args are freshly built handles) don't read as code changes.
+        if not isinstance(other, DeploymentHandle):
+            return NotImplemented
+        return (
+            self.deployment_name == other.deployment_name
+            and self.app_name == other.app_name
+            and self._method_name == other._method_name
+            and self._meta == other._meta
+        )
+
+    def __hash__(self):
+        return hash((self.deployment_name, self.app_name, self._method_name))

@@ -44,8 +44,11 @@ class _ModelCache:
                 return self.cache[model_id]
             while len(self.cache) >= self.capacity:
                 _, evicted = self.cache.popitem(last=False)
-                unload = getattr(evicted, "__del__", None)
-                del unload, evicted
+                unload = getattr(evicted, "unload", None)
+                if callable(unload):
+                    out = unload()
+                    if inspect.isawaitable(out):
+                        await out
             model = self.loader(*args)
             if inspect.isawaitable(model):
                 model = await model

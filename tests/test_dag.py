@@ -1,0 +1,219 @@
+"""DAG + compiled DAG tests (reference: python/ray/dag/tests/)."""
+
+import threading
+import time
+
+import pytest
+
+import raytpu
+from raytpu.dag import InputNode, MultiOutputNode
+from raytpu.runtime.channel import Channel, ChannelClosed
+
+
+class TestChannel:
+    def test_write_read_roundtrip(self):
+        ch = Channel(num_readers=1)
+        rid = ch.reader_id()
+        ch.write({"a": 1})
+        assert ch.read(rid) == {"a": 1}
+
+    def test_backpressure_blocks_writer(self):
+        ch = Channel(num_readers=1)
+        rid = ch.reader_id()
+        ch.write(1)
+        with pytest.raises(TimeoutError):
+            ch.write(2, timeout=0.1)  # reader hasn't consumed v1
+        assert ch.read(rid) == 1
+        ch.write(2, timeout=1.0)
+        assert ch.read(rid) == 2
+
+    def test_broadcast_to_all_readers(self):
+        ch = Channel(num_readers=3)
+        rids = [ch.reader_id() for _ in range(3)]
+        ch.write("x")
+        assert [ch.read(r) for r in rids] == ["x", "x", "x"]
+        ch.write("y", timeout=1.0)  # unblocked only after all 3 read
+        assert [ch.read(r) for r in rids] == ["y", "y", "y"]
+
+    def test_read_blocks_until_write(self):
+        ch = Channel(num_readers=1)
+        rid = ch.reader_id()
+        got = []
+
+        def reader():
+            got.append(ch.read(rid, timeout=5.0))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.05)
+        ch.write(42)
+        t.join(timeout=5)
+        assert got == [42]
+
+    def test_close_wakes_blocked(self):
+        ch = Channel(num_readers=1)
+        rid = ch.reader_id()
+        errs = []
+
+        def reader():
+            try:
+                ch.read(rid, timeout=5.0)
+            except ChannelClosed:
+                errs.append("closed")
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.05)
+        ch.close()
+        t.join(timeout=5)
+        assert errs == ["closed"]
+
+    def test_pickle_resolves_same_buffer(self):
+        import cloudpickle
+
+        ch = Channel(num_readers=1)
+        ch2 = cloudpickle.loads(cloudpickle.dumps(ch))
+        assert ch2 is ch
+
+
+@raytpu.remote
+class Stage:
+    def __init__(self, mult):
+        self.mult = mult
+        self.calls = 0
+
+    def apply(self, x):
+        self.calls += 1
+        return x * self.mult
+
+    def add(self, x, y):
+        return x + y
+
+    def call_count(self):
+        return self.calls
+
+
+class TestClassicDAG:
+    def test_execute_chain(self, raytpu_local):
+        a = Stage.remote(2)
+        with InputNode() as inp:
+            dag = a.apply.bind(inp)
+        assert raytpu.get(dag.execute(21)) == 42
+
+
+class TestCompiledDAG:
+    def test_linear_pipeline(self, raytpu_local):
+        a = Stage.remote(2)
+        b = Stage.remote(10)
+        with InputNode() as inp:
+            dag = b.apply.bind(a.apply.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(3).get(timeout=10) == 60
+            assert compiled.execute(5).get(timeout=10) == 100
+        finally:
+            compiled.teardown()
+
+    def test_pipelined_executes(self, raytpu_local):
+        a = Stage.remote(3)
+        with InputNode() as inp:
+            dag = a.apply.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            refs = [compiled.execute(i) for i in range(5)]
+            assert [r.get(timeout=10) for r in refs] == [0, 3, 6, 9, 12]
+        finally:
+            compiled.teardown()
+
+    def test_fan_out_multi_output(self, raytpu_local):
+        a = Stage.remote(2)
+        b = Stage.remote(5)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.apply.bind(inp), b.apply.bind(inp)])
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(4).get(timeout=10) == [8, 20]
+        finally:
+            compiled.teardown()
+
+    def test_fan_in_two_args(self, raytpu_local):
+        a = Stage.remote(2)
+        b = Stage.remote(3)
+        c = Stage.remote(1)
+        with InputNode() as inp:
+            dag = c.add.bind(a.apply.bind(inp), b.apply.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(10).get(timeout=10) == 50  # 20 + 30
+        finally:
+            compiled.teardown()
+
+    def test_const_args_mixed_with_channels(self, raytpu_local):
+        a = Stage.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp, 100)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(7).get(timeout=10) == 107
+        finally:
+            compiled.teardown()
+
+    def test_error_propagates_and_pipeline_survives(self, raytpu_local):
+        @raytpu.remote
+        class Picky:
+            def check(self, x):
+                if x < 0:
+                    raise ValueError("negative!")
+                return x
+
+        p = Picky.remote()
+        with InputNode() as inp:
+            dag = p.check.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(1).get(timeout=10) == 1
+            with pytest.raises(ValueError, match="negative"):
+                compiled.execute(-1).get(timeout=10)
+            # Loop keeps running after a user error.
+            assert compiled.execute(2).get(timeout=10) == 2
+        finally:
+            compiled.teardown()
+
+    def test_teardown_frees_actor(self, raytpu_local):
+        a = Stage.remote(2)
+        with InputNode() as inp:
+            dag = a.apply.bind(inp)
+        compiled = dag.experimental_compile()
+        assert compiled.execute(1).get(timeout=10) == 2
+        compiled.teardown()
+        # Actor usable for normal calls again after teardown.
+        assert raytpu.get(a.call_count.remote(), timeout=10) == 1
+
+    def test_kwarg_bound_input(self, raytpu_local):
+        """Regression: DAG nodes bound as KEYWORD args must be wired
+        through channels, not passed as raw node objects."""
+        @raytpu.remote
+        class KwStage:
+            def apply(self, *, x, offset=0):
+                return x * 2 + offset
+
+        a = KwStage.remote()
+        b = KwStage.remote()
+        with InputNode() as inp:
+            dag = b.apply.bind(x=a.apply.bind(x=inp, offset=1), offset=100)
+        compiled = dag.experimental_compile()
+        try:
+            # a: 5*2+1=11; b: 11*2+100=122
+            assert compiled.execute(5).get(timeout=10) == 122
+        finally:
+            compiled.teardown()
+
+    def test_task_nodes_rejected(self, raytpu_local):
+        @raytpu.remote
+        def f(x):
+            return x
+
+        with InputNode() as inp:
+            dag = f.bind(inp)
+        with pytest.raises(TypeError, match="actor-method"):
+            dag.experimental_compile()

@@ -179,12 +179,57 @@ class TestAutoscalingPolicy:
         assert len(results) == 12
 
 
+class TestRedeploy:
+    def test_removed_deployment_is_dropped(self, serve_instance):
+        app = Adder.bind(1)
+        serve.run(app, name="rm", route_prefix=None)
+        # Redeploy the app with a different deployment set.
+        serve.run(Doubler.bind(), name="rm", route_prefix=None)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            deps = serve.status()["rm"]["deployments"]
+            if "Adder" not in deps:
+                break
+            time.sleep(0.1)
+        assert "Adder" not in serve.status()["rm"]["deployments"]
+
+    def test_user_config_only_redeploy_keeps_replicas(self, serve_instance):
+        @serve.deployment(user_config={"v": 1})
+        class Stateful:
+            def __init__(self):
+                self.v = None
+                self.created = time.monotonic()
+
+            def reconfigure(self, cfg):
+                self.v = cfg["v"]
+
+            def __call__(self, _):
+                return (self.v, self.created)
+
+        handle = serve.run(Stateful.bind(), name="ucfg", route_prefix=None)
+        v1, created1 = handle.remote(0).result()
+        assert v1 == 1
+        serve.run(Stateful.options(user_config={"v": 2}).bind(),
+                  name="ucfg", route_prefix=None)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            v, created = handle.remote(0).result()
+            if v == 2:
+                break
+            time.sleep(0.1)
+        assert v == 2
+        # Same replica instance (no restart): warm jit state preserved.
+        assert created == created1
+
+
 class TestScaleFromZero:
     def test_scale_from_zero(self, serve_instance):
         @serve.deployment(autoscaling_config=AutoscalingConfig(
             min_replicas=0, max_replicas=2, target_ongoing_requests=1.0,
             initial_replicas=0,
-            upscale_delay_s=0.0, downscale_delay_s=60.0))
+            # Nonzero delay: the demand signal must survive reconcile ticks
+            # between the handle's ~1/s reports for hysteresis to elapse.
+            upscale_delay_s=0.3, downscale_delay_s=60.0))
         class ColdStart:
             def __call__(self, x):
                 return x + 1
