@@ -1,0 +1,29 @@
+"""raytpu.cluster — multi-process / multi-host cluster mode.
+
+Reference analogue: the GCS + raylet process topology (SURVEY.md §1).
+``HeadServer`` is the control plane (GCS), ``NodeServer`` the per-host
+daemon (raylet + workers), ``ClusterBackend`` the driver's client, and
+``Cluster`` the single-host multi-process test harness.
+
+Submodules are lazy so ``python -m raytpu.cluster.head`` doesn't trip
+runpy's found-in-sys.modules warning.
+"""
+
+
+def __getattr__(name):
+    if name in ("Cluster", "ClusterNodeHandle"):
+        from raytpu.cluster import cluster_utils
+
+        return getattr(cluster_utils, name)
+    if name == "HeadServer":
+        from raytpu.cluster.head import HeadServer
+
+        return HeadServer
+    if name == "NodeServer":
+        from raytpu.cluster.node import NodeServer
+
+        return NodeServer
+    raise AttributeError(name)
+
+
+__all__ = ["Cluster", "ClusterNodeHandle", "HeadServer", "NodeServer"]

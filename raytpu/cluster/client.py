@@ -1,0 +1,594 @@
+"""Driver-side cluster backend: routes the core API onto head + nodes.
+
+Reference analogue: the driver's CoreWorker talking to GCS + raylets
+(``src/ray/core_worker/core_worker.cc`` submit paths). The driver is also a
+data-plane peer: it embeds a serve-only :class:`NodeServer` so objects it
+``put``s are fetchable by executing nodes and results it ``get``s are
+pulled straight from the node that produced them.
+
+Failure semantics (reference: owner-side ``TaskManager`` retries +
+lineage): the driver tracks in-flight tasks per node; on a node-death
+publish, unfinished tasks are resubmitted elsewhere if retries remain,
+else their return refs resolve to ``WorkerCrashedError``. Results that
+died with the node and have no other copy are re-executed (cheap lineage
+reconstruction: the spec IS the lineage).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from raytpu.cluster.node import NodeServer
+from raytpu.cluster.protocol import ConnectionLost, RpcClient
+from raytpu.core.errors import (
+    ActorDiedError,
+    GetTimeoutError,
+    PlacementGroupError,
+    WorkerCrashedError,
+)
+from raytpu.core.ids import (
+    ActorID,
+    JobID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+)
+from raytpu.runtime.object_ref import ObjectRef
+from raytpu.runtime.serialization import SerializedValue, serialize
+from raytpu.runtime.task_spec import ArgKind, SchedulingKind, TaskSpec
+
+
+class _InFlight:
+    __slots__ = ("spec", "node_id", "attempts")
+
+    def __init__(self, spec: TaskSpec, node_id: str, attempts: int = 0):
+        self.spec = spec
+        self.node_id = node_id
+        self.attempts = attempts
+
+
+class ClusterBackend:
+    def __init__(self, address: str, job_id: JobID):
+        if address.startswith("tcp://"):
+            address = address[len("tcp://"):]
+        self.job_id = job_id
+        # Data-plane endpoint: the driver is a serve-only node.
+        self._node = NodeServer(address, serve_only=True)
+        self._node.start()
+        self.node_id = self._node.node_id
+        self.store = self._node.backend.store
+        self.worker = self._node.backend.worker
+        self.worker.job_id = job_id
+        self._head = RpcClient(address)
+        self._head.subscribe("nodes", self._on_node_event)
+        self._head.subscribe("actors", self._on_actor_event)
+        self._head.call("subscribe", "nodes")
+        self._head.call("subscribe", "actors")
+        self._peers: Dict[str, RpcClient] = {}
+        self._peers_lock = threading.Lock()
+        self._lock = threading.RLock()
+        self._inflight: Dict[TaskID, _InFlight] = {}
+        self._actor_nodes: Dict[ActorID, str] = {}      # actor -> node_id
+        self._actor_inflight: Dict[ActorID, List[TaskSpec]] = {}
+        self._dead_actors: Dict[ActorID, str] = {}      # actor -> reason
+        self._pending: List[TaskSpec] = []              # no feasible node yet
+        self._pgs: Dict[PlacementGroupID, dict] = {}
+        self._shutdown_flag = False
+        self._retry_thread = threading.Thread(
+            target=self._pending_loop, name="cluster-pending", daemon=True
+        )
+        self._retry_thread.start()
+        # Owner-directed distributed free: when the driver's refcount drops
+        # an object, release every cluster copy (nodes pin results until
+        # this arrives — reference: owner-based lifetime, A1).
+        import queue as _q
+
+        self._free_queue: "_q.Queue" = _q.Queue()
+        prev_oos = self.worker.reference_counter._on_out_of_scope
+
+        def _oos(oid):
+            if prev_oos is not None:
+                prev_oos(oid)
+            self._free_queue.put(oid)
+
+        self.worker.reference_counter._on_out_of_scope = _oos
+        self._free_thread = threading.Thread(
+            target=self._free_loop, name="cluster-free", daemon=True
+        )
+        self._free_thread.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _peer(self, address: str) -> RpcClient:
+        with self._peers_lock:
+            c = self._peers.get(address)
+            if c is None or c.closed:
+                c = self._peers[address] = RpcClient(address)
+            return c
+
+    def _node_addr(self, node_id: str) -> Optional[str]:
+        for n in self._head.call("list_nodes"):
+            if n["node_id"] == node_id and n["alive"]:
+                return n["address"]
+        return None
+
+    def _required_resources(self, spec: TaskSpec) -> Dict[str, float]:
+        return dict(spec.resources or {})
+
+    # -- task submission ---------------------------------------------------
+
+    def _arg_ref_ids(self, spec: TaskSpec) -> List[ObjectID]:
+        ids = [ObjectRef.from_binary(a.data).id for a in spec.args
+               if a.kind == ArgKind.REF]
+        ids.extend(ObjectRef.from_binary(rb).id for rb in spec.inline_refs)
+        return ids
+
+    def _pin_args(self, spec: TaskSpec) -> None:
+        """Hold submitted-task refs on the driver so argument objects can't
+        be freed while a remote task still needs them (reference:
+        submitted_task_ref_count, reference_count.h:607)."""
+        for oid in self._arg_ref_ids(spec):
+            self.worker.reference_counter.add_submitted_task_ref(oid)
+
+    def _unpin_args(self, spec: TaskSpec) -> None:
+        for oid in self._arg_ref_ids(spec):
+            try:
+                self.worker.reference_counter.remove_submitted_task_ref(oid)
+            except Exception:
+                pass
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
+                for oid in spec.return_ids()]
+        self._pin_args(spec)
+        self._route_task(spec)
+        return refs
+
+    def _route_task(self, spec: TaskSpec) -> None:
+        node_id = self._pick_node(spec)
+        if node_id is None:
+            with self._lock:
+                self._pending.append(spec)
+            return
+        self._send_to_node(spec, node_id, "submit_task")
+
+    def _pick_node(self, spec: TaskSpec) -> Optional[str]:
+        sched = spec.scheduling
+        if sched.kind == SchedulingKind.PLACEMENT_GROUP and sched.pg_id:
+            pg = self._pgs.get(sched.pg_id) or \
+                self._head.call("pg_info", sched.pg_id.hex())
+            if pg is None:
+                raise PlacementGroupError(
+                    f"placement group {sched.pg_id.hex()} gone")
+            idx = sched.bundle_index if sched.bundle_index >= 0 else 0
+            node_id = pg["nodes"][idx]
+            return node_id
+        return self._head.call(
+            "schedule", self._required_resources(spec))
+
+    def _send_to_node(self, spec: TaskSpec, node_id: str,
+                      method: str) -> None:
+        addr = self._node_addr(node_id)
+        if addr is None:
+            with self._lock:
+                self._pending.append(spec)
+            return
+        with self._lock:
+            self._inflight[spec.task_id] = _InFlight(
+                spec, node_id, attempts=spec.attempt)
+        try:
+            self._peer(addr).call(method, cloudpickle.dumps(spec))
+        except Exception:
+            with self._lock:
+                self._inflight.pop(spec.task_id, None)
+                self._pending.append(spec)
+
+    def _free_loop(self) -> None:
+        while not self._shutdown_flag:
+            oid = self._free_queue.get()
+            if oid is None or self._shutdown_flag:
+                return
+            try:
+                locs = self._head.call("locate_object", oid.hex(),
+                                       timeout=5.0)
+                for loc in locs or ():
+                    if loc["address"] != self._node.address:
+                        self._peer(loc["address"]).notify(
+                            "free_object", oid.hex())
+            except Exception:
+                pass
+
+    def _pending_loop(self) -> None:
+        while not self._shutdown_flag:
+            time.sleep(0.2)
+            with self._lock:
+                pending, self._pending = self._pending, []
+            for spec in pending:
+                if self._shutdown_flag:
+                    return
+                try:
+                    self._route_task(spec)
+                except Exception as e:
+                    self._fail_refs(spec, e)
+            self._sweep_completed()
+
+    def _sweep_completed(self) -> None:
+        """Detect finished tasks (all return objects exist somewhere) and
+        release their submitted-arg pins + inflight records."""
+        with self._lock:
+            candidates = list(self._inflight.values())
+        for rec in candidates:
+            oids = rec.spec.return_ids()
+            try:
+                done = all(self.store.contains(oid) or
+                           bool(self._head.call("locate_object", oid.hex(),
+                                                timeout=5.0))
+                           for oid in oids)
+            except Exception:
+                continue
+            if done:
+                with self._lock:
+                    self._inflight.pop(rec.spec.task_id, None)
+                    if rec.spec.actor_id is not None:
+                        lst = self._actor_inflight.get(rec.spec.actor_id)
+                        if lst and rec.spec in lst:
+                            lst.remove(rec.spec)
+                self._unpin_args(rec.spec)
+
+    # -- actors ------------------------------------------------------------
+
+    def create_actor(self, spec: TaskSpec) -> None:
+        ac = spec.actor_creation
+        node_id = self._head.call(
+            "schedule", self._required_resources(spec))
+        if node_id is None:
+            raise ValueError(
+                f"no feasible node for actor {ac.name or ac.actor_id.hex()} "
+                f"requiring {spec.resources}")
+        addr = self._node_addr(node_id)
+        if addr is None:
+            raise ValueError("scheduled node vanished; retry")
+        with self._lock:
+            self._actor_nodes[ac.actor_id] = node_id
+        self._peer(addr).call("create_actor", cloudpickle.dumps(spec))
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
+                for oid in spec.return_ids()]
+        with self._lock:
+            dead = self._dead_actors.get(spec.actor_id)
+        if dead is not None:
+            self._fail_refs(spec, ActorDiedError(spec.actor_id.hex(), dead))
+            return refs
+        node_id = None
+        with self._lock:
+            node_id = self._actor_nodes.get(spec.actor_id)
+        if node_id is None:
+            info = self._head.call("resolve_actor", spec.actor_id.hex())
+            if info is None:
+                self._fail_refs(spec, ActorDiedError(
+                    spec.actor_id.hex(), "actor not found"))
+                return refs
+            node_id = info["node_id"]
+            with self._lock:
+                self._actor_nodes[spec.actor_id] = node_id
+        addr = self._node_addr(node_id)
+        if addr is None:
+            self._fail_refs(spec, ActorDiedError(
+                spec.actor_id.hex(), "actor node is gone"))
+            return refs
+        self._pin_args(spec)
+        with self._lock:
+            self._actor_inflight.setdefault(spec.actor_id, []).append(spec)
+            self._inflight[spec.task_id] = _InFlight(spec, node_id)
+        try:
+            self._peer(addr).call("submit_actor_task",
+                                  cloudpickle.dumps(spec))
+        except Exception as e:
+            self._fail_refs(spec, ActorDiedError(spec.actor_id.hex(), str(e)))
+        return refs
+
+    def get_actor_handle_info(self, name: str, namespace: str):
+        info = self._head.call("resolve_named_actor", name, namespace)
+        if info is None:
+            raise ValueError(f"no actor named {name!r} in {namespace!r}")
+        blob = self._head.call(
+            "kv_get", f"__actor_spec__::{info['actor_id']}")
+        if blob is None:
+            raise ValueError(f"actor {name!r} spec not found")
+        spec: TaskSpec = cloudpickle.loads(blob)
+        actor_id = ActorID.from_hex(info["actor_id"])
+        with self._lock:
+            self._actor_nodes[actor_id] = info["node_id"]
+        return actor_id, spec
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        with self._lock:
+            node_id = self._actor_nodes.get(actor_id)
+        if node_id is None:
+            info = self._head.call("resolve_actor", actor_id.hex())
+            if info is None:
+                return
+            node_id = info["node_id"]
+        addr = self._node_addr(node_id)
+        if addr is not None:
+            try:
+                self._peer(addr).call("kill_actor", actor_id.hex(),
+                                      no_restart)
+            except Exception:
+                pass
+
+    def actor_handle_added(self, actor_id: ActorID) -> None:
+        pass  # cluster actors live until killed or their node dies
+
+    def actor_handle_removed(self, actor_id: ActorID) -> None:
+        pass
+
+    def cancel_task(self, task_id: TaskID) -> None:
+        with self._lock:
+            rec = self._inflight.get(task_id)
+        if rec is None:
+            return
+        addr = self._node_addr(rec.node_id)
+        if addr is not None:
+            try:
+                self._peer(addr).call("cancel_task", task_id.binary())
+            except Exception:
+                pass
+
+    # -- objects -----------------------------------------------------------
+
+    def get_object(self, ref: ObjectRef,
+                   timeout: Optional[float] = None) -> SerializedValue:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.005
+        while True:
+            sv = self.store.try_get(ref.id)
+            if sv is not None:
+                return sv
+            try:
+                locs = self._head.call("locate_object", ref.id.hex())
+            except ConnectionLost:
+                raise WorkerCrashedError("lost connection to cluster head")
+            for loc in locs or ():
+                if loc["address"] == self._node.address:
+                    continue
+                try:
+                    blob = self._peer(loc["address"]).call(
+                        "fetch_object", ref.id.hex(), timeout=60.0)
+                except Exception:
+                    continue
+                if blob is not None:
+                    sv = SerializedValue.from_buffer(blob)
+                    self.store.put(ref.id, sv)
+                    return sv
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(
+                    f"object {ref.id.hex()} not ready within {timeout}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    def object_ready(self, ref: ObjectRef) -> bool:
+        if self.store.contains(ref.id):
+            return True
+        try:
+            return bool(self._head.call("locate_object", ref.id.hex()))
+        except Exception:
+            return False
+
+    # -- failure handling --------------------------------------------------
+
+    def _fail_refs(self, spec: TaskSpec, err: BaseException) -> None:
+        sv = serialize(err)
+        for oid in spec.return_ids():
+            self.store.put(oid, sv)
+
+    def _on_node_event(self, data: dict) -> None:
+        if data.get("event") != "removed":
+            return
+        node_id = data["node_id"]
+        with self._lock:
+            doomed = [rec for rec in self._inflight.values()
+                      if rec.node_id == node_id]
+            for rec in doomed:
+                self._inflight.pop(rec.spec.task_id, None)
+            dead_actor_ids = [aid for aid, nid in self._actor_nodes.items()
+                              if nid == node_id]
+        for rec in doomed:
+            spec = rec.spec
+            done = all(
+                self.store.contains(oid) or
+                self._safe_located(oid)
+                for oid in spec.return_ids()
+            )
+            if done:
+                continue
+            if spec.attempt < spec.max_retries:
+                spec.attempt += 1
+                try:
+                    self._route_task(spec)
+                except Exception as e:
+                    self._fail_refs(spec, e)
+            else:
+                self._fail_refs(spec, WorkerCrashedError(
+                    f"node {node_id[:12]} died running task "
+                    f"{spec.name} (attempt {spec.attempt})"))
+        for aid in dead_actor_ids:
+            self._mark_actor_dead(aid, f"node {node_id[:12]} died")
+
+    def _safe_located(self, oid: ObjectID) -> bool:
+        try:
+            return bool(self._head.call("locate_object", oid.hex(),
+                                        timeout=5.0))
+        except Exception:
+            return False
+
+    def _on_actor_event(self, data: dict) -> None:
+        if data.get("event") != "dead":
+            return
+        self._mark_actor_dead(ActorID.from_hex(data["actor_id"]),
+                              data.get("reason", "actor died"))
+
+    def _mark_actor_dead(self, actor_id: ActorID, reason: str) -> None:
+        with self._lock:
+            self._dead_actors[actor_id] = reason
+            self._actor_nodes.pop(actor_id, None)
+            pending = self._actor_inflight.pop(actor_id, [])
+        err = ActorDiedError(actor_id.hex(), reason)
+        for spec in pending:
+            if not all(self.store.contains(oid)
+                       for oid in spec.return_ids()):
+                # The executing node may have stored results before dying;
+                # only fail refs that will never materialize.
+                if not any(self._safe_located(oid)
+                           for oid in spec.return_ids()):
+                    self._fail_refs(spec, err)
+
+    # -- placement groups --------------------------------------------------
+
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str,
+                               name: str = "") -> PlacementGroupID:
+        pg_id = PlacementGroupID.from_random()
+        # The head's availability view lags heartbeats (and is optimistically
+        # debited by recent schedules), so transient infeasibility is normal;
+        # PGs are pending-until-placeable (reference: GCS PG state machine).
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                result = self._head.call("create_pg", pg_id.hex(), bundles,
+                                         strategy)
+                break
+            except ValueError as e:
+                if "infeasible" not in str(e) or \
+                        time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
+        placement: List[str] = result["nodes"]
+        # Tell each node to reserve its shard under this pg id.
+        by_node: Dict[str, List[Tuple[int, Dict[str, float]]]] = {}
+        for idx, node_id in enumerate(placement):
+            by_node.setdefault(node_id, []).append((idx, bundles[idx]))
+        try:
+            for node_id, indexed in by_node.items():
+                addr = self._node_addr(node_id)
+                if addr is None:
+                    raise PlacementGroupError(
+                        f"node {node_id[:12]} vanished during pg creation")
+                self._peer(addr).call(
+                    "create_pg_shard", pg_id.binary(), indexed, strategy,
+                    len(bundles))
+        except Exception:
+            self._head.call("remove_pg", pg_id.hex())
+            raise
+        with self._lock:
+            self._pgs[pg_id] = {"nodes": placement, "bundles": bundles,
+                                "strategy": strategy, "state": "created"}
+        return pg_id
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+        info = pg or self._head.call("pg_info", pg_id.hex())
+        if info is None:
+            return
+        for node_id in set(info["nodes"]):
+            if node_id is None:
+                continue
+            addr = self._node_addr(node_id)
+            if addr is not None:
+                try:
+                    self._peer(addr).call("remove_pg_shard", pg_id.binary())
+                except Exception:
+                    pass
+        self._head.call("remove_pg", pg_id.hex())
+
+    def placement_group_info(self, pg_id: PlacementGroupID) -> Optional[dict]:
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+        if pg is None:
+            info = self._head.call("pg_info", pg_id.hex())
+            if info is None:
+                return None
+            pg = info | {"state": "created"}
+        return {
+            "id": pg_id.hex(),
+            "state": pg["state"],
+            "strategy": pg["strategy"],
+            "bundles": list(pg["bundles"]),
+            "nodes": list(pg["nodes"]),
+            "chip_coords": [[] for _ in pg["bundles"]],
+        }
+
+    # -- blocked workers (driver never executes tasks) ---------------------
+
+    def task_blocked(self, task_id: TaskID) -> None:
+        pass
+
+    def task_unblocked(self, task_id: TaskID) -> None:
+        pass
+
+    # -- introspection -----------------------------------------------------
+
+    def available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self._head.call("list_nodes"):
+            if n["alive"] and n["labels"].get("role") != "driver":
+                for k, v in n["available"].items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+    def cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self._head.call("list_nodes"):
+            if n["alive"] and n["labels"].get("role") != "driver":
+                for k, v in n["resources"].items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+    def nodes(self) -> List[dict]:
+        return [
+            {
+                "NodeID": n["node_id"],
+                "Alive": n["alive"],
+                "Resources": n["resources"],
+                "Available": n["available"],
+                "Address": n["address"],
+                "Labels": n["labels"],
+            }
+            for n in self._head.call("list_nodes")
+        ]
+
+    def task_events(self) -> List[dict]:
+        return list(self._node.backend.task_events())
+
+    # -- kv (used by job submission / function shipping) -------------------
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self._head.call("kv_put", key, value, overwrite)
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self._head.call("kv_get", key)
+
+    def kv_del(self, key: str) -> bool:
+        return self._head.call("kv_del", key)
+
+    def shutdown(self) -> None:
+        self._shutdown_flag = True
+        self._free_queue.put(None)
+        try:
+            self._node.stop()
+        except Exception:
+            pass
+        try:
+            self._head.close()
+        except Exception:
+            pass
+        with self._peers_lock:
+            for c in self._peers.values():
+                c.close()
+            self._peers.clear()

@@ -1,0 +1,142 @@
+"""Single-host multi-process cluster harness for tests and local use.
+
+Reference analogue: ``Cluster`` (``python/ray/cluster_utils.py:135``) — the
+reference's primary multi-node-without-a-cluster mechanism (SURVEY.md §4
+item 2): real head + node processes on one machine. ``kill_node`` is the
+chaos hook (reference: ``NodeKillerActor``,
+``python/ray/_private/test_utils.py:1497``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from raytpu.cluster.protocol import RpcClient
+
+
+def _await_banner(proc: subprocess.Popen, marker: str, what: str,
+                  max_lines: int = 50) -> str:
+    """Read lines until the startup banner appears, skipping interpreter
+    noise (warnings etc.); raise with everything seen if the process dies
+    or never prints it."""
+    seen = []
+    for _ in range(max_lines):
+        line = proc.stdout.readline()
+        if not line:
+            break
+        seen.append(line)
+        if marker in line:
+            return line
+    raise RuntimeError(
+        f"{what} failed to start (rc={proc.poll()}):\n{''.join(seen)}")
+
+
+class ClusterNodeHandle:
+    def __init__(self, proc: subprocess.Popen, node_id: Optional[str] = None):
+        self.proc = proc
+        self.node_id = node_id
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class Cluster:
+    """Launches a head process + node processes; drivers connect with
+    ``raytpu.init(address=cluster.address)``."""
+
+    def __init__(self, num_nodes: int = 0,
+                 node_resources: Optional[Dict] = None,
+                 host: str = "127.0.0.1"):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # Child processes must import raytpu from the same tree as us even
+        # when it isn't pip-installed.
+        import raytpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(raytpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._env = env
+        self._host = host
+        self.head_proc = subprocess.Popen(
+            [sys.executable, "-m", "raytpu.cluster.head",
+             "--host", host, "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        line = _await_banner(self.head_proc, "listening on", "head")
+        self.address = line.strip().rsplit(" ", 1)[-1]
+        self.nodes: List[ClusterNodeHandle] = []
+        for _ in range(num_nodes):
+            self.add_node(**(node_resources or {"num_cpus": 2}))
+
+    def add_node(self, num_cpus: float = 2, num_tpus: int = 0,
+                 resources: Optional[Dict[str, float]] = None
+                 ) -> ClusterNodeHandle:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "raytpu.cluster.node",
+             "--head", self.address,
+             "--num-cpus", str(num_cpus),
+             "--num-tpus", str(num_tpus),
+             "--resources", json.dumps(resources or {}),
+             "--host", self._host],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=self._env,
+        )
+        line = _await_banner(proc, "raytpu node", "node")
+        node_id = line.split()[2]
+        handle = ClusterNodeHandle(proc, node_id)
+        self.nodes.append(handle)
+        return handle
+
+    def wait_for_nodes(self, count: Optional[int] = None,
+                       timeout: float = 15.0) -> None:
+        want = count if count is not None else len(self.nodes)
+        client = RpcClient(self.address)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                alive = [
+                    n for n in client.call("list_nodes")
+                    if n["alive"] and n["labels"].get("role") != "driver"
+                ]
+                if len(alive) >= want:
+                    return
+                time.sleep(0.1)
+            raise TimeoutError(
+                f"only {len(alive)} of {want} nodes registered")
+        finally:
+            client.close()
+
+    def kill_node(self, handle: ClusterNodeHandle,
+                  graceful: bool = False) -> None:
+        """Chaos hook: SIGKILL (default) simulates a host loss; the head
+        detects it via heartbeat timeout (reference: GcsHealthCheckManager)."""
+        if graceful:
+            handle.proc.send_signal(signal.SIGTERM)
+        else:
+            handle.proc.kill()
+        handle.proc.wait(timeout=10)
+
+    def shutdown(self) -> None:
+        for n in self.nodes:
+            if n.alive:
+                n.proc.send_signal(signal.SIGTERM)
+        for n in self.nodes:
+            try:
+                n.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                n.proc.kill()
+        if self.head_proc.poll() is None:
+            self.head_proc.send_signal(signal.SIGTERM)
+            try:
+                self.head_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.head_proc.kill()

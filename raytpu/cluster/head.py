@@ -1,0 +1,482 @@
+"""Cluster head: the control plane (GCS equivalent).
+
+Reference analogue: ``src/ray/gcs/gcs_server/`` — ``GcsNodeManager`` (node
+table + death broadcast), ``GcsActorManager`` (actor directory, named
+actors), ``GcsKvManager`` (KV), ``GcsHealthCheckManager`` (heartbeat
+timeout), ``GcsPlacementGroupManager``, plus the cluster-level half of the
+two-level scheduler (``ClusterTaskManager``/hybrid policy,
+``src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:50``).
+
+One process per cluster. Tables are in-memory dicts (the reference's
+default ``InMemoryStoreClient``); everything is reconstructible from node
+re-registration, matching the reference's GCS-restart story.
+
+TPU-first twist: a node registers with its slice topology; the scheduler
+packs TPU bundles onto whole hosts of one slice (contiguous ICI) before
+spreading — the topology is a scheduling dimension, not an env var.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from raytpu.cluster.protocol import Peer, RpcServer
+
+HEARTBEAT_TIMEOUT_S = 5.0
+CHECK_PERIOD_S = 1.0
+
+
+class NodeEntry:
+    def __init__(self, node_id: str, address: str, resources: Dict[str, float],
+                 labels: Dict[str, str]):
+        self.node_id = node_id
+        self.address = address          # node RPC endpoint
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels)
+        self.last_heartbeat = time.monotonic()
+        self.alive = True
+        self.peer: Optional[Peer] = None
+
+    def snapshot(self) -> dict:
+        return {
+            "node_id": self.node_id, "address": self.address,
+            "resources": dict(self.total), "available": dict(self.available),
+            "labels": dict(self.labels), "alive": self.alive,
+        }
+
+
+class HeadServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._rpc = RpcServer(host, port)
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, NodeEntry] = {}
+        self._kv: Dict[str, bytes] = {}
+        # actor_id(hex) -> {"node_id", "name", "namespace", "creation_blob"}
+        self._actors: Dict[str, dict] = {}
+        self._named: Dict[Tuple[str, str], str] = {}
+        # object_id(hex) -> set of node_ids that hold it
+        self._objects: Dict[str, Set[str]] = {}
+        self._object_waiters: Dict[str, List[Peer]] = {}
+        # placement groups: pg_id -> {"bundles": [...], "nodes": [node_id per bundle]}
+        self._pgs: Dict[str, dict] = {}
+        self._subscribers: Dict[str, List[Peer]] = {}  # topic -> peers
+        self._job_counter = 0
+        self._stop = threading.Event()
+        h = self._rpc.register
+        h("register_node", self._register_node)
+        h("heartbeat", self._heartbeat)
+        h("drain_node", self._drain_node)
+        h("list_nodes", self._list_nodes)
+        h("kv_put", self._kv_put)
+        h("kv_get", self._kv_get)
+        h("kv_del", self._kv_del)
+        h("kv_keys", self._kv_keys)
+        h("schedule", self._schedule)
+        h("register_actor", self._register_actor)
+        h("resolve_actor", self._resolve_actor)
+        h("resolve_named_actor", self._resolve_named_actor)
+        h("actor_dead", self._actor_dead)
+        h("report_object", self._report_object)
+        h("forget_object", self._forget_object)
+        h("locate_object", self._locate_object)
+        h("create_pg", self._create_pg)
+        h("remove_pg", self._remove_pg)
+        h("pg_info", self._pg_info)
+        h("subscribe", self._subscribe)
+        h("next_job_id", self._next_job_id)
+        h("ping", lambda peer: "pong")
+        self._rpc.on_disconnect(self._peer_gone)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> str:
+        addr = self._rpc.start()
+        self._checker = threading.Thread(
+            target=self._health_loop, name="head-health", daemon=True
+        )
+        self._checker.start()
+        return addr
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._rpc.stop()
+
+    @property
+    def address(self) -> str:
+        return self._rpc.address
+
+    # -- node table --------------------------------------------------------
+
+    def _register_node(self, peer: Peer, node_id: str, address: str,
+                       resources: Dict[str, float],
+                       labels: Dict[str, str]) -> dict:
+        with self._lock:
+            entry = NodeEntry(node_id, address, resources, labels)
+            entry.peer = peer
+            peer.meta["node_id"] = node_id
+            self._nodes[node_id] = entry
+            snap = [n.snapshot() for n in self._nodes.values() if n.alive]
+        self._publish("nodes", {"event": "added", "node": entry.snapshot()})
+        return {"nodes": snap}
+
+    def _heartbeat(self, peer: Peer, node_id: str,
+                   available: Dict[str, float]) -> None:
+        with self._lock:
+            entry = self._nodes.get(node_id)
+            if entry is not None:
+                entry.last_heartbeat = time.monotonic()
+                entry.available = dict(available)
+
+    def _drain_node(self, peer: Peer, node_id: str) -> None:
+        self._mark_dead(node_id, reason="drained")
+
+    def _list_nodes(self, peer: Peer) -> List[dict]:
+        with self._lock:
+            return [n.snapshot() for n in self._nodes.values()]
+
+    def _peer_gone(self, peer: Peer) -> None:
+        node_id = peer.meta.get("node_id")
+        if node_id:
+            self._mark_dead(node_id, reason="connection lost")
+        with self._lock:
+            for peers in self._subscribers.values():
+                if peer in peers:
+                    peers.remove(peer)
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(CHECK_PERIOD_S):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for entry in self._nodes.values():
+                    if entry.alive and \
+                            now - entry.last_heartbeat > HEARTBEAT_TIMEOUT_S:
+                        dead.append(entry.node_id)
+            for node_id in dead:
+                self._mark_dead(node_id, reason="heartbeat timeout")
+
+    def _mark_dead(self, node_id: str, reason: str) -> None:
+        with self._lock:
+            entry = self._nodes.get(node_id)
+            if entry is None or not entry.alive:
+                return
+            entry.alive = False
+            dead_actors = [
+                aid for aid, info in self._actors.items()
+                if info["node_id"] == node_id
+            ]
+            for aid in dead_actors:
+                info = self._actors.pop(aid)
+                if info.get("name"):
+                    self._named.pop((info["namespace"], info["name"]), None)
+            for oid in list(self._objects):
+                self._objects[oid].discard(node_id)
+                if not self._objects[oid]:
+                    del self._objects[oid]
+            # Free PG bundles placed on the dead node.
+            for pg in self._pgs.values():
+                pg["nodes"] = [
+                    (None if n == node_id else n) for n in pg["nodes"]
+                ]
+        self._publish("nodes", {"event": "removed", "node_id": node_id,
+                                "reason": reason})
+        for aid in dead_actors:
+            self._publish("actors", {"event": "dead", "actor_id": aid,
+                                     "reason": f"node {node_id} {reason}"})
+
+    # -- kv ----------------------------------------------------------------
+
+    def _kv_put(self, peer: Peer, key: str, value: bytes,
+                overwrite: bool = True) -> bool:
+        with self._lock:
+            if not overwrite and key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+    def _kv_get(self, peer: Peer, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def _kv_del(self, peer: Peer, key: str) -> bool:
+        with self._lock:
+            return self._kv.pop(key, None) is not None
+
+    def _kv_keys(self, peer: Peer, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # -- scheduling --------------------------------------------------------
+
+    def _schedule(self, peer: Peer, resources: Dict[str, float],
+                  node_hint: Optional[str] = None,
+                  spread_threshold: float = 0.5) -> Optional[str]:
+        """Pick a node for a task/actor of this shape. Hybrid policy
+        (reference: hybrid_scheduling_policy.h:50): prefer the hinted /
+        most-utilized feasible node until utilization crosses the spread
+        threshold, then pick the least-utilized feasible node."""
+        with self._lock:
+            feasible = []
+            for entry in self._nodes.values():
+                if not entry.alive or entry.labels.get("role") == "driver":
+                    continue
+                if all(entry.available.get(k, 0.0) >= v - 1e-9
+                       for k, v in resources.items()):
+                    feasible.append(entry)
+            if not feasible:
+                return None
+            if node_hint:
+                for entry in feasible:
+                    if entry.node_id == node_hint:
+                        return entry.node_id
+
+            def utilization(e: NodeEntry) -> float:
+                fracs = [
+                    1.0 - e.available.get(k, 0.0) / t
+                    for k, t in e.total.items() if t > 0
+                ]
+                return max(fracs) if fracs else 0.0
+
+            packed = sorted(feasible, key=lambda e: (-utilization(e),
+                                                     e.node_id))
+            best = packed[0]
+            if utilization(best) >= spread_threshold:
+                best = min(packed, key=lambda e: (utilization(e),
+                                                  e.node_id))
+            # Optimistic debit: bursts of schedule() calls between 1s
+            # heartbeats must see each other's placements or they all pack
+            # onto the same node (heartbeats overwrite with ground truth).
+            for k, v in resources.items():
+                best.available[k] = best.available.get(k, 0.0) - v
+            return best.node_id
+
+    # -- actor directory ---------------------------------------------------
+
+    def _register_actor(self, peer: Peer, actor_id: str, node_id: str,
+                        name: Optional[str], namespace: str) -> None:
+        with self._lock:
+            if name:
+                key = (namespace, name)
+                if key in self._named and self._named[key] != actor_id:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._named[key] = actor_id
+            self._actors[actor_id] = {
+                "node_id": node_id, "name": name, "namespace": namespace,
+            }
+        self._publish("actors", {"event": "registered",
+                                 "actor_id": actor_id, "node_id": node_id})
+
+    def _resolve_actor(self, peer: Peer, actor_id: str) -> Optional[dict]:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return None
+            node = self._nodes.get(info["node_id"])
+            if node is None or not node.alive:
+                return None
+            return {"node_id": info["node_id"], "address": node.address}
+
+    def _resolve_named_actor(self, peer: Peer, name: str,
+                             namespace: str) -> Optional[dict]:
+        with self._lock:
+            actor_id = self._named.get((namespace, name))
+        if actor_id is None:
+            return None
+        info = self._resolve_actor(peer, actor_id)
+        if info is None:
+            return None
+        info["actor_id"] = actor_id
+        return info
+
+    def _actor_dead(self, peer: Peer, actor_id: str, reason: str) -> None:
+        with self._lock:
+            info = self._actors.pop(actor_id, None)
+            if info and info.get("name"):
+                self._named.pop((info["namespace"], info["name"]), None)
+        self._publish("actors", {"event": "dead", "actor_id": actor_id,
+                                 "reason": reason})
+
+    # -- object directory --------------------------------------------------
+
+    def _report_object(self, peer: Peer, object_id: str,
+                       node_id: str) -> None:
+        with self._lock:
+            self._objects.setdefault(object_id, set()).add(node_id)
+            waiters = self._object_waiters.pop(object_id, [])
+            entry = self._nodes.get(node_id)
+            address = entry.address if entry else None
+        for w in waiters:
+            w.push(f"object::{object_id}",
+                   {"node_id": node_id, "address": address})
+
+    def _forget_object(self, peer: Peer, object_id: str,
+                       node_id: str) -> None:
+        with self._lock:
+            locs = self._objects.get(object_id)
+            if locs is not None:
+                locs.discard(node_id)
+                if not locs:
+                    del self._objects[object_id]
+
+    def _locate_object(self, peer: Peer, object_id: str,
+                       wait: bool = False) -> List[dict]:
+        """Current locations; with wait=True and none yet, the caller gets
+        a push on topic ``object::<id>`` when the first copy is reported."""
+        with self._lock:
+            locs = [
+                {"node_id": nid, "address": self._nodes[nid].address}
+                for nid in self._objects.get(object_id, ())
+                if nid in self._nodes and self._nodes[nid].alive
+            ]
+            if not locs and wait:
+                self._object_waiters.setdefault(object_id, []).append(peer)
+        return locs
+
+    # -- placement groups --------------------------------------------------
+
+    def _create_pg(self, peer: Peer, pg_id: str,
+                   bundles: List[Dict[str, float]],
+                   strategy: str) -> dict:
+        """Reserve bundles on nodes. STRICT_PACK: all on one node;
+        PACK: prefer one node, spill; SPREAD/STRICT_SPREAD: distinct nodes
+        (STRICT_ fails if impossible). Reservation debits node availability
+        until remove_pg (reference: GcsPlacementGroupScheduler 2-phase
+        commit; single head process makes one-phase safe here)."""
+        with self._lock:
+            alive = [n for n in self._nodes.values()
+                     if n.alive and n.labels.get("role") != "driver"]
+            placement: List[Optional[str]] = [None] * len(bundles)
+
+            def fits(node: NodeEntry, b: Dict[str, float], scratch) -> bool:
+                avail = scratch.setdefault(
+                    node.node_id, dict(node.available))
+                return all(avail.get(k, 0.0) >= v - 1e-9
+                           for k, v in b.items())
+
+            def take(node: NodeEntry, b: Dict[str, float], scratch) -> None:
+                avail = scratch[node.node_id]
+                for k, v in b.items():
+                    avail[k] = avail.get(k, 0.0) - v
+
+            scratch: Dict[str, Dict[str, float]] = {}
+            if strategy in ("STRICT_PACK", "PACK"):
+                for node in sorted(alive, key=lambda n: -sum(
+                        n.available.get(k, 0) for b in bundles for k in b)):
+                    # Cumulative fit of ALL bundles on this one node.
+                    s: Dict[str, Dict[str, float]] = {}
+                    ok = True
+                    for b in bundles:
+                        if fits(node, b, s):
+                            take(node, b, s)
+                        else:
+                            ok = False
+                            break
+                    if ok:
+                        placement = [node.node_id] * len(bundles)
+                        scratch = s
+                        break
+                if placement and placement[0] is None:
+                    if strategy == "STRICT_PACK":
+                        raise ValueError(
+                            "STRICT_PACK infeasible: no single node fits "
+                            "all bundles")
+                    # PACK fallback: greedy pack-then-spill.
+                    scratch = {}
+                    for i, b in enumerate(bundles):
+                        chosen = None
+                        for node in alive:
+                            if fits(node, b, scratch):
+                                chosen = node
+                                break
+                        if chosen is None:
+                            raise ValueError(
+                                f"PACK infeasible for bundle {i}: {b}")
+                        take(chosen, b, scratch)
+                        placement[i] = chosen.node_id
+            elif strategy in ("SPREAD", "STRICT_SPREAD"):
+                scratch = {}
+                used: Set[str] = set()
+                for i, b in enumerate(bundles):
+                    fresh = [n for n in sorted(alive, key=lambda n: n.node_id)
+                             if n.node_id not in used and fits(n, b, scratch)]
+                    reused = [] if strategy == "STRICT_SPREAD" else [
+                        n for n in sorted(alive, key=lambda n: n.node_id)
+                        if n.node_id in used and fits(n, b, scratch)
+                    ]
+                    chosen = (fresh or reused or [None])[0]
+                    if chosen is None:
+                        raise ValueError(
+                            f"{strategy} infeasible for bundle {i}: {b}")
+                    take(chosen, b, scratch)
+                    used.add(chosen.node_id)
+                    placement[i] = chosen.node_id
+            else:
+                raise ValueError(f"unknown strategy {strategy!r}")
+
+            # Commit: debit real availability.
+            for node_id, avail in scratch.items():
+                self._nodes[node_id].available = avail
+            self._pgs[pg_id] = {"bundles": list(bundles),
+                                "nodes": placement,
+                                "strategy": strategy}
+            return {"nodes": placement}
+
+    def _remove_pg(self, peer: Peer, pg_id: str) -> None:
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            for b, node_id in zip(pg["bundles"], pg["nodes"]):
+                entry = self._nodes.get(node_id) if node_id else None
+                if entry is not None and entry.alive:
+                    for k, v in b.items():
+                        entry.available[k] = entry.available.get(k, 0.0) + v
+
+    def _pg_info(self, peer: Peer, pg_id: str) -> Optional[dict]:
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            return dict(pg) if pg else None
+
+    # -- pubsub ------------------------------------------------------------
+
+    def _subscribe(self, peer: Peer, topic: str) -> None:
+        with self._lock:
+            peers = self._subscribers.setdefault(topic, [])
+            if peer not in peers:
+                peers.append(peer)
+
+    def _publish(self, topic: str, data: Any) -> None:
+        with self._lock:
+            peers = list(self._subscribers.get(topic, ()))
+        for p in peers:
+            if not p.closed:
+                p.push(topic, data)
+
+    def _next_job_id(self, peer: Peer) -> int:
+        with self._lock:
+            self._job_counter += 1
+            return self._job_counter
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess in tests
+    import argparse
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6379)
+    args = ap.parse_args()
+    head = HeadServer(args.host, args.port)
+    addr = head.start()
+    print(f"raytpu head listening on {addr}", flush=True)
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    head.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,390 @@
+"""Per-node daemon: execution plane of a cluster node.
+
+Reference analogue: the raylet (``src/ray/raylet/``) + its workers. One
+process per host. Embeds a :class:`NodeBackend` (the single-node scheduler/
+executor, a ``LocalBackend`` subclass) and serves the node RPC surface:
+task/actor submission, object fetch (the chunked-push analogue of
+``src/ray/object_manager/``), placement-group shards, health.
+
+Control flow: the head picks the node (cluster half of the two-level
+scheduler); the driver pushes the spec straight to this node (analogue of
+worker-lease + direct push, ``direct_task_transport.cc:409``); this node's
+backend does local scheduling, dependency waits and execution. Missing
+ref args are fetched from their location (head directory → source node)
+into the local store, which wakes the dependency manager.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
+from raytpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+from raytpu.runtime.local_backend import LocalBackend, _Bundle, _PlacementGroup
+from raytpu.runtime.serialization import SerializedValue
+from raytpu.runtime.task_spec import TaskSpec
+from raytpu.core.resources import ResourceSet
+
+HEARTBEAT_PERIOD_S = 1.0
+
+
+class NodeBackend(LocalBackend):
+    """LocalBackend that reports into the cluster control plane."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Results are owned by remote drivers; only their explicit free
+        # releases them (see Worker.pin_owned).
+        self.worker.pin_owned = True
+        self.on_object_local = None   # cb(oid) -> None (report location)
+        self.on_actor_dead = None     # cb(actor_id, reason)
+        chained = self.store.on_put
+
+        def _on_put(oid):
+            if chained is not None:
+                chained(oid)
+            if self.on_object_local is not None:
+                self.on_object_local(oid)
+
+        self.store.on_put = _on_put
+
+    def _actor_died(self, runtime) -> None:
+        super()._actor_died(runtime)
+        if self.on_actor_dead is not None:
+            try:
+                self.on_actor_dead(runtime.actor_id, runtime.death_reason)
+            except Exception:
+                pass
+
+    def register_pg_shard(self, pg_id: PlacementGroupID,
+                          indexed_bundles: List[Tuple[int, Dict[str, float]]],
+                          strategy: str, total_bundles: int) -> None:
+        """Reserve this node's share of a cluster placement group under the
+        PG id the head assigned (reference: raylet-side bundle commit,
+        ``PrepareBundleResources``/``CommitBundleResources``)."""
+        from raytpu.core.resources import TPU
+
+        slots: List[Optional[_Bundle]] = [None] * total_bundles
+        total = ResourceSet({})
+        bs = []
+        for idx, spec in indexed_bundles:
+            b = _Bundle(idx, ResourceSet(spec))
+            slots[idx] = b
+            bs.append(b)
+            total = total + b.resources
+        with self._lock:
+            if not total.is_subset_of(self.node.available):
+                raise ValueError(
+                    f"pg shard infeasible: needs {total.to_dict()}, "
+                    f"available {self.node.available.to_dict()}")
+            self.node.allocate(total)
+            if self.topology is not None:
+                for b in bs:
+                    chips = int(b.resources.get(TPU))
+                    if chips:
+                        coords = (
+                            self.topology.allocate_subcube(chips)
+                            if strategy in ("PACK", "STRICT_PACK")
+                            else self.topology.allocate_any(chips)
+                        ) or self.topology.allocate_any(chips) or []
+                        b.chip_coords = coords
+            self._pgs[pg_id] = _PlacementGroup(pg_id, slots, strategy)
+
+
+class NodeServer:
+    def __init__(self, head_address: str, *,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 host: str = "127.0.0.1",
+                 serve_only: bool = False):
+        self.node_id = NodeID.from_random()
+        self.head_address = head_address
+        self.labels = dict(labels or {})
+        if serve_only:
+            # Object-plane-only node (the driver): never schedulable.
+            num_cpus, num_tpus, resources = 0, 0, {}
+            self.labels["role"] = "driver"
+        self.backend = NodeBackend(
+            JobID.from_random(), num_cpus=num_cpus, num_tpus=num_tpus,
+            resources=resources,
+        )
+        if serve_only:
+            # The driver OWNS its objects: its refcount must free them
+            # (pinning is for executor nodes holding remotely-owned results).
+            self.backend.worker.pin_owned = False
+        self.backend.node_id = self.node_id
+        self.backend.on_object_local = self._report_object
+        self.backend.on_actor_dead = self._report_actor_dead
+        self._rpc = RpcServer(host, 0)
+        h = self._rpc.register
+        h("submit_task", self._h_submit_task)
+        h("create_actor", self._h_create_actor)
+        h("submit_actor_task", self._h_submit_actor_task)
+        h("kill_actor", self._h_kill_actor)
+        h("cancel_task", self._h_cancel_task)
+        h("fetch_object", self._h_fetch_object)
+        h("has_object", self._h_has_object)
+        h("put_object", self._h_put_object)
+        h("free_object", self._h_free_object)
+        h("create_pg_shard", self._h_create_pg_shard)
+        h("remove_pg_shard", self._h_remove_pg_shard)
+        h("node_info", self._h_node_info)
+        h("debug_state", self._h_debug_state)
+        h("ping", lambda peer: "pong")
+        self._head: Optional[RpcClient] = None
+        self._peers: Dict[str, RpcClient] = {}
+        self._peers_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._fetching: set = set()
+        self._fetch_lock = threading.Lock()
+        self.address: Optional[str] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, adopt_globals: bool = False) -> str:
+        if adopt_globals:
+            # Worker tasks on this node call raytpu.get/put/remote through
+            # the process-global backend (nested tasks run locally; the
+            # reference routes them through the local raylet the same way).
+            from raytpu.runtime import api as _api
+
+            _api._backend = self.backend
+            _api._worker = self.backend.worker
+        self.address = self._rpc.start()
+        self._head = RpcClient(self.head_address)
+        self._head.call(
+            "register_node", self.node_id.hex(), self.address,
+            self.backend.node.total.to_dict(), self.labels,
+        )
+        self._hb = threading.Thread(target=self._heartbeat_loop,
+                                    name="node-heartbeat", daemon=True)
+        self._hb.start()
+        return self.address
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            if self._head is not None:
+                self._head.call("drain_node", self.node_id.hex(), timeout=2.0)
+        except Exception:
+            pass
+        self.backend.shutdown()
+        self._rpc.stop()
+        if self._head is not None:
+            self._head.close()
+        with self._peers_lock:
+            for c in self._peers.values():
+                c.close()
+            self._peers.clear()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(HEARTBEAT_PERIOD_S):
+            try:
+                self._head.call(
+                    "heartbeat", self.node_id.hex(),
+                    self.backend.node.available.to_dict(), timeout=5.0,
+                )
+            except Exception:
+                if self._stop.is_set():
+                    return
+
+    # -- head reporting ----------------------------------------------------
+
+    def _report_object(self, oid: ObjectID) -> None:
+        if self._head is None or self._head.closed:
+            return
+        try:
+            self._head.notify("report_object", oid.hex(), self.node_id.hex())
+        except Exception:
+            pass
+
+    def _report_actor_dead(self, actor_id: ActorID, reason: str) -> None:
+        if self._head is None or self._head.closed:
+            return
+        try:
+            self._head.notify("actor_dead", actor_id.hex(), reason)
+        except Exception:
+            pass
+
+    # -- cross-node object fetch ------------------------------------------
+
+    def _peer_client(self, address: str) -> RpcClient:
+        with self._peers_lock:
+            c = self._peers.get(address)
+            if c is None or c.closed:
+                c = self._peers[address] = RpcClient(address)
+            return c
+
+    def _ensure_args_local(self, spec: TaskSpec) -> None:
+        from raytpu.runtime.task_spec import ArgKind
+        from raytpu.runtime.object_ref import ObjectRef
+
+        missing = []
+        for arg in spec.args:
+            if arg.kind == ArgKind.REF:
+                oid = ObjectRef.from_binary(arg.data).id
+                if not self.backend.store.contains(oid):
+                    missing.append(oid)
+        for rb in spec.inline_refs:
+            oid = ObjectRef.from_binary(rb).id
+            if not self.backend.store.contains(oid):
+                missing.append(oid)
+        for oid in missing:
+            with self._fetch_lock:
+                if oid in self._fetching:
+                    continue
+                self._fetching.add(oid)
+            threading.Thread(target=self._fetch_object, args=(oid,),
+                             daemon=True).start()
+
+    def _fetch_object(self, oid: ObjectID) -> None:
+        """Pull one object into the local store (reference: PullManager)."""
+        try:
+            delay = 0.01
+            while not self._stop.is_set():
+                if self.backend.store.contains(oid):
+                    return
+                try:
+                    locs = self._head.call("locate_object", oid.hex(),
+                                           timeout=10.0)
+                except ConnectionLost:
+                    return
+                for loc in locs or ():
+                    if loc["address"] == self.address:
+                        continue
+                    try:
+                        blob = self._peer_client(loc["address"]).call(
+                            "fetch_object", oid.hex(), timeout=30.0)
+                    except Exception:
+                        continue
+                    if blob is not None:
+                        self.backend.store.put(
+                            oid, SerializedValue.from_buffer(blob))
+                        return
+                time.sleep(delay)
+                delay = min(delay * 2, 0.2)
+        finally:
+            with self._fetch_lock:
+                self._fetching.discard(oid)
+
+    # -- RPC handlers ------------------------------------------------------
+
+    def _h_submit_task(self, peer: Peer, spec_blob: bytes) -> None:
+        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        self._ensure_args_local(spec)
+        self.backend.submit_task(spec)
+
+    def _h_create_actor(self, peer: Peer, spec_blob: bytes) -> None:
+        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        ac = spec.actor_creation
+        # Directory + spec blob first so named lookup works immediately.
+        self._head.call(
+            "register_actor", ac.actor_id.hex(), self.node_id.hex(),
+            ac.name, ac.namespace,
+        )
+        self._head.notify(
+            "kv_put", f"__actor_spec__::{ac.actor_id.hex()}", spec_blob, True,
+        )
+        self._ensure_args_local(spec)
+        self.backend.create_actor(spec)
+
+    def _h_submit_actor_task(self, peer: Peer, spec_blob: bytes) -> None:
+        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        self._ensure_args_local(spec)
+        self.backend.submit_actor_task(spec)
+
+    def _h_kill_actor(self, peer: Peer, actor_id_hex: str,
+                      no_restart: bool) -> None:
+        self.backend.kill_actor(ActorID.from_hex(actor_id_hex), no_restart)
+
+    def _h_cancel_task(self, peer: Peer, task_id_bin: bytes) -> None:
+        from raytpu.core.ids import TaskID
+
+        self.backend.cancel_task(TaskID(task_id_bin))
+
+    def _h_fetch_object(self, peer: Peer, oid_hex: str) -> Optional[bytes]:
+        sv = self.backend.store.try_get(ObjectID.from_hex(oid_hex))
+        return sv.to_bytes() if sv is not None else None
+
+    def _h_has_object(self, peer: Peer, oid_hex: str) -> bool:
+        return self.backend.store.contains(ObjectID.from_hex(oid_hex))
+
+    def _h_put_object(self, peer: Peer, oid_hex: str, blob: bytes) -> None:
+        self.backend.store.put(ObjectID.from_hex(oid_hex),
+                               SerializedValue.from_buffer(blob))
+
+    def _h_free_object(self, peer: Peer, oid_hex: str) -> None:
+        """Owner-directed free (the owner's refcount hit zero)."""
+        oid = ObjectID.from_hex(oid_hex)
+        self.backend.store.delete([oid])
+        try:
+            self._head.notify("forget_object", oid.hex(),
+                              self.node_id.hex())
+        except Exception:
+            pass
+
+    def _h_create_pg_shard(self, peer: Peer, pg_id_bin: bytes,
+                           indexed_bundles, strategy: str,
+                           total_bundles: int) -> None:
+        self.backend.register_pg_shard(
+            PlacementGroupID(pg_id_bin),
+            indexed_bundles, strategy, total_bundles,
+        )
+
+    def _h_remove_pg_shard(self, peer: Peer, pg_id_bin: bytes) -> None:
+        self.backend.remove_placement_group(PlacementGroupID(pg_id_bin))
+
+    def _h_debug_state(self, peer: Peer) -> dict:
+        b = self.backend
+        with b._lock:
+            return {
+                "tasks": {t.hex()[:8]: (r.state,
+                                        [o.hex()[:8] for o in r.missing_deps])
+                          for t, r in b._tasks.items()},
+                "running": [t.hex()[:8] for t in b._running],
+                "store_size": b.store.size(),
+                "actors": [a.hex()[:8] for a in b._actors],
+                "available": b.node.available.to_dict(),
+            }
+
+    def _h_node_info(self, peer: Peer) -> dict:
+        return {
+            "node_id": self.node_id.hex(),
+            "address": self.address,
+            "resources": self.backend.node.total.to_dict(),
+            "available": self.backend.node.available.to_dict(),
+        }
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess in tests
+    import argparse
+    import json
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True)
+    ap.add_argument("--num-cpus", type=float, default=None)
+    ap.add_argument("--num-tpus", type=int, default=0)
+    ap.add_argument("--resources", default="{}")
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args()
+    node = NodeServer(
+        args.head, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources=json.loads(args.resources), host=args.host,
+    )
+    addr = node.start(adopt_globals=True)
+    print(f"raytpu node {node.node_id.hex()[:12]} on {addr}", flush=True)
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    node.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,328 @@
+"""Cluster wire protocol: length-prefixed pickled frames over TCP.
+
+Reference analogue: Ray's control plane is gRPC services (``src/ray/rpc/``,
+protos in ``src/ray/protobuf/``). Ours is a deliberately small asyncio
+protocol — 4-byte little-endian length + cloudpickle frame — because the
+control plane carries tiny messages (specs, directory entries); the data
+plane (tensors) never rides it on TPU: device arrays move via ICI inside
+compiled programs, and host objects move through the object-transfer
+endpoint which streams raw buffers after one header frame.
+
+Server: :class:`RpcServer` dispatches ``{"m": method, "a": args, "i": id}``
+frames to registered handlers (sync or async) on an asyncio loop running in
+a dedicated thread. Client: :class:`RpcClient` is thread-safe, multiplexing
+concurrent requests over one connection with response correlation by id.
+Subscriptions: a handler may return ``Push`` frames later via its
+``peer.push(topic, data)``; clients register topic callbacks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _pack(obj: Any) -> bytes:
+    payload = cloudpickle.dumps(obj)
+    return _LEN.pack(len(payload)) + payload
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    hdr = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    return cloudpickle.loads(await reader.readexactly(n))
+
+
+class Peer:
+    """Server-side view of one connected client."""
+
+    def __init__(self, server: "RpcServer", writer: asyncio.StreamWriter):
+        self._server = server
+        self._writer = writer
+        self.closed = False
+        self.meta: Dict[str, Any] = {}  # handler scratch (e.g. node_id)
+
+    def push(self, topic: str, data: Any) -> None:
+        """Send an unsolicited frame (pubsub). Thread-safe."""
+        self._server._loop.call_soon_threadsafe(
+            self._send_safe, {"p": topic, "d": data}
+        )
+
+    def _send_safe(self, frame: dict) -> None:
+        if not self.closed:
+            try:
+                self._writer.write(_pack(frame))
+            except Exception:
+                self.closed = True
+
+
+class RpcServer:
+    """asyncio TCP server on a dedicated thread; handlers may be sync or
+    async. Handler signature: ``handler(peer, *args)``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._handlers: Dict[str, Callable] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server = None
+        self._started = threading.Event()
+        self._on_disconnect: Optional[Callable[[Peer], None]] = None
+        self.address: Optional[str] = None
+
+    def register(self, name: str, handler: Callable) -> None:
+        self._handlers[name] = handler
+
+    def on_disconnect(self, cb: Callable[[Peer], None]) -> None:
+        self._on_disconnect = cb
+
+    def start(self) -> str:
+        self._thread = threading.Thread(
+            target=self._run, name="raytpu-rpc-server", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RpcError("rpc server failed to start")
+        return self.address
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._serve())
+        finally:
+            self._loop.close()
+
+    async def _serve(self) -> None:
+        self._stopping = asyncio.Event()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        self.address = f"{self._host}:{self._port}"
+        self._started.set()
+        async with self._server:
+            await self._stopping.wait()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        peer = Peer(self, writer)
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                asyncio.ensure_future(self._dispatch(peer, writer, frame))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            peer.closed = True
+            if self._on_disconnect:
+                try:
+                    self._on_disconnect(peer)
+                except Exception:
+                    pass
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, peer: Peer, writer: asyncio.StreamWriter,
+                        frame: dict) -> None:
+        req_id = frame.get("i")
+        handler = self._handlers.get(frame.get("m"))
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for {frame.get('m')!r}")
+            result = handler(peer, *frame.get("a", ()))
+            if asyncio.iscoroutine(result):
+                result = await result
+            reply = {"i": req_id, "r": result}
+        except BaseException as e:  # noqa: BLE001 — errors cross the wire
+            reply = {"i": req_id, "e": e}
+        if req_id is not None and not peer.closed:
+            try:
+                writer.write(_pack(reply))
+                await writer.drain()
+            except Exception:
+                peer.closed = True
+
+    def stop(self) -> None:
+        if self._loop is not None and not self._loop.is_closed():
+            try:
+                self._loop.call_soon_threadsafe(self._stopping.set)
+            except RuntimeError:
+                pass
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+
+
+class RpcClient:
+    """Blocking, thread-safe client. One socket; a reader thread correlates
+    responses and fires subscription callbacks."""
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._pending: Dict[int, "_Waiter"] = {}
+        self._plock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._subs: Dict[str, Callable[[Any], None]] = {}
+        self._closed = False
+        self.address = address
+        # Pushes dispatch on their own thread: a subscription callback may
+        # itself issue RPCs, which would deadlock on the reader thread
+        # (the reader is what completes those calls).
+        import queue as _queue
+
+        self._push_queue: "_queue.Queue" = _queue.Queue()
+        self._push_thread = threading.Thread(
+            target=self._push_loop, name="raytpu-rpc-push", daemon=True
+        )
+        self._push_thread.start()
+        self._reader = threading.Thread(
+            target=self._read_loop, name="raytpu-rpc-client", daemon=True
+        )
+        self._reader.start()
+
+    def subscribe(self, topic: str, cb: Callable[[Any], None]) -> None:
+        self._subs[topic] = cb
+
+    def call(self, method: str, *args, timeout: Optional[float] = 30.0) -> Any:
+        req_id = next(self._ids)
+        waiter = _Waiter()
+        with self._plock:
+            if self._closed:
+                raise ConnectionLost(f"connection to {self.address} closed")
+            self._pending[req_id] = waiter
+        try:
+            self._send({"m": method, "a": args, "i": req_id})
+            return waiter.wait(timeout)
+        finally:
+            with self._plock:
+                self._pending.pop(req_id, None)
+
+    def notify(self, method: str, *args) -> None:
+        """Fire-and-forget (no response expected)."""
+        self._send({"m": method, "a": args})
+
+    def _send(self, frame: dict) -> None:
+        data = _pack(frame)
+        with self._wlock:
+            if self._closed:
+                raise ConnectionLost(f"connection to {self.address} closed")
+            try:
+                self._sock.sendall(data)
+            except OSError as e:
+                self._fail(e)
+                raise ConnectionLost(str(e)) from e
+
+    def _read_loop(self) -> None:
+        try:
+            buf = b""
+            while True:
+                while len(buf) < _LEN.size:
+                    chunk = self._sock.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("peer closed")
+                    buf += chunk
+                (n,) = _LEN.unpack(buf[:_LEN.size])
+                buf = buf[_LEN.size:]
+                while len(buf) < n:
+                    chunk = self._sock.recv(max(65536, n - len(buf)))
+                    if not chunk:
+                        raise ConnectionError("peer closed")
+                    buf += chunk
+                frame = cloudpickle.loads(buf[:n])
+                buf = buf[n:]
+                self._on_frame(frame)
+        except Exception as e:
+            self._fail(e)
+
+    def _push_loop(self) -> None:
+        while True:
+            item = self._push_queue.get()
+            if item is None:
+                return
+            topic, data = item
+            cb = self._subs.get(topic)
+            if cb is not None:
+                try:
+                    cb(data)
+                except Exception:
+                    pass
+
+    def _on_frame(self, frame: dict) -> None:
+        if "p" in frame:  # pubsub push
+            self._push_queue.put((frame["p"], frame["d"]))
+            return
+        with self._plock:
+            waiter = self._pending.get(frame.get("i"))
+        if waiter is not None:
+            if "e" in frame:
+                waiter.set_error(frame["e"])
+            else:
+                waiter.set_result(frame.get("r"))
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._plock:
+            self._closed = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for w in pending:
+            w.set_error(ConnectionLost(str(exc)))
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+        self._push_queue.put(None)
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+
+class _Waiter:
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def set_result(self, r):
+        self._result = r
+        self._ev.set()
+
+    def set_error(self, e: BaseException):
+        self._error = e
+        self._ev.set()
+
+    def wait(self, timeout: Optional[float]):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc call timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result

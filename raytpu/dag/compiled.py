@@ -124,8 +124,11 @@ class CompiledDAGRef:
         self._done = False
 
     def get(self, timeout: Optional[float] = None) -> Any:
-        self._dag._drain_until(self._seq, timeout)
-        value = self._dag._results.pop(self._seq)
+        if not self._done:
+            self._dag._drain_until(self._seq, timeout)
+            self._value = self._dag._results.pop(self._seq)
+            self._done = True
+        value = self._value
         if isinstance(value, _ExecError):
             raise value.exc
         if isinstance(value, list):
@@ -151,6 +154,7 @@ class CompiledDAG:
         self._exec_lock = threading.Lock()  # keeps seq == input-write order
         self._seq = 0            # next execute() sequence number
         self._read_seq = 0       # next sequence to read from outputs
+        self._partial_outs: List[Any] = []  # mid-tuple reads after timeout
         self._results: Dict[int, Any] = {}
         self._multi_output = isinstance(root, MultiOutputNode)
         self._torn_down = False
@@ -262,14 +266,19 @@ class CompiledDAG:
     def _drain_until(self, seq: int, timeout: Optional[float]) -> None:
         with self._drain_lock:
             while self._read_seq <= seq:
-                outs = [
-                    ch.read(rid, timeout=timeout)
-                    for ch, rid in zip(self._output_channels,
-                                       self._output_reader_ids)
-                ]
+                # _partial_outs survives a timeout mid-tuple so a retried
+                # get() resumes at the unread channel instead of re-reading
+                # channel 0 (which would misalign MultiOutputNode results
+                # across sequence numbers).
+                outs = self._partial_outs
+                while len(outs) < len(self._output_channels):
+                    i = len(outs)
+                    outs.append(self._output_channels[i].read(
+                        self._output_reader_ids[i], timeout=timeout))
+                self._partial_outs = []
                 with self._meta_lock:
                     self._results[self._read_seq] = (
-                        outs if self._multi_output else outs[0]
+                        list(outs) if self._multi_output else outs[0]
                     )
                     self._read_seq += 1
 

@@ -28,6 +28,7 @@ from raytpu.core.config import cfg
 from raytpu.core.errors import (
     ActorDiedError,
     PlacementGroupError,
+    RayTpuError,
     TaskCancelledError,
     TaskError,
 )
@@ -473,6 +474,8 @@ class LocalBackend:
             pg.state = "removed"
             total = ResourceSet({})
             for b in pg.bundles:
+                if b is None:  # cluster shard: bundle lives on another node
+                    continue
                 total = total + b.resources
                 if self.topology is not None and b.chip_coords:
                     self.topology.release(b.chip_coords)
@@ -570,11 +573,18 @@ class LocalBackend:
         if pg is None:
             raise PlacementGroupError(f"placement group {sched.pg_id.hex()} gone")
         if sched.bundle_index >= 0:
-            return pg.bundles[sched.bundle_index]
-        for b in pg.bundles:
+            b = pg.bundles[sched.bundle_index]
+            if b is None:
+                # Cluster PG shard: this bundle lives on another node.
+                raise PlacementGroupError(
+                    f"bundle {sched.bundle_index} of pg "
+                    f"{sched.pg_id.hex()} is not on this node")
+            return b
+        local = [b for b in pg.bundles if b is not None]
+        for b in local:
             if b.node.can_fit(ResourceSet(spec.resources)):
                 return b
-        return pg.bundles[0] if pg.bundles else None
+        return local[0] if local else None
 
     def _try_allocate(self, rec: _TaskRecord) -> bool:
         bundle = self._bundle_for(rec.spec)
@@ -606,7 +616,11 @@ class LocalBackend:
         target.allocate(rec.required, force=force)
 
     def _release_resources(self, rec: _TaskRecord) -> None:
-        bundle = self._bundle_for(rec.spec)
+        try:
+            bundle = self._bundle_for(rec.spec)
+        except Exception:
+            # PG vanished while the task ran; its ledger died with it.
+            return
         target = bundle.node if bundle is not None else self.node
         target.release(rec.required)
 
@@ -623,7 +637,18 @@ class LocalBackend:
                     if rec is None or rec.state != "ready":
                         self._ready.remove(tid)
                         continue
-                    if self._try_allocate(rec):
+                    try:
+                        allocated = self._try_allocate(rec)
+                    except Exception as e:
+                        # e.g. PG removed/rerouted while queued — fail the
+                        # task, never the scheduler thread.
+                        self._ready.remove(tid)
+                        rec.state = "done"
+                        self._fail_spec(rec.spec, e if isinstance(
+                            e, RayTpuError) else TaskError.from_exception(
+                            rec.spec.name, e))
+                        continue
+                    if allocated:
                         self._ready.remove(tid)
                         rec.state = "running"
                         self._running[tid] = rec

@@ -45,10 +45,19 @@ class Worker:
         self._function_cache: Dict[bytes, Callable] = {}
         self._cancelled: set = set()
         self._cancel_lock = threading.Lock()
+        # Cluster nodes set this: results whose owner is a REMOTE driver
+        # must not be freed by the local refcount (the owner's handles are
+        # not visible here; the owner sends an explicit free instead —
+        # reference: owner-based object lifetime, reference_count.h:61).
+        self.pin_owned = False
 
     # -- ownership ------------------------------------------------------------
 
     def _on_out_of_scope(self, oid: ObjectID) -> None:
+        if self.pin_owned:
+            # Cluster node: locally-visible refs don't own this object; only
+            # the owner's explicit free (free_object RPC) may delete it.
+            return
         self._delete_object(oid)
 
     def _delete_object(self, oid: ObjectID) -> None:
@@ -94,7 +103,7 @@ class Worker:
         # Fire-and-forget: if every handle to this return object was dropped
         # before the task finished, nothing will ever trigger deletion — free
         # it now (including the stored_in edges just added).
-        if self.reference_counter.is_unreferenced(oid):
+        if not self.pin_owned and self.reference_counter.is_unreferenced(oid):
             self._delete_object(oid)
 
     # -- cancellation ---------------------------------------------------------

@@ -116,6 +116,7 @@ class ServeController(LongPollHost):
                     app_name, rc.deployment_name, rc
                 )
             else:
+                existing.deleting = False  # re-added after a removal
                 await self._update_deployment(existing, rc)
         # Deployments removed from the app: drain to 0, reconcile drops the
         # state once the last replica is gone (``deleting`` flag).
@@ -317,10 +318,10 @@ class ServeController(LongPollHost):
             return 0.0
         cutoff = time.monotonic() - 2.0
         fresh = [(t, n) for (t, n) in entries if t >= cutoff]
-        if fresh:
-            self._pending_demand[full_name] = fresh
-        else:
+        if not fresh:
             self._pending_demand.pop(full_name, None)
+            return 0.0
+        self._pending_demand[full_name] = fresh
         # Each waiting request contributes ~2 reports per window; halve,
         # but any fresh report counts as at least one waiting request.
         return max(sum(n for _, n in fresh) / 2.0, 1.0)

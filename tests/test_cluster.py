@@ -1,0 +1,291 @@
+"""Cluster-mode tests: real head + node processes on one host.
+
+Reference analogue: python/ray/tests/ with the ``ray_start_cluster``
+fixture (conftest.py:493) over ``Cluster`` (cluster_utils.py:135), plus
+chaos node-kill (test_utils.py:1497).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import raytpu
+from raytpu.cluster import Cluster
+from raytpu.cluster.head import HeadServer
+from raytpu.cluster.protocol import RpcClient, RpcServer
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(num_nodes=2, node_resources={"num_cpus": 2})
+    c.wait_for_nodes(2)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def driver(cluster):
+    raytpu.shutdown()
+    raytpu.init(address=f"tcp://{cluster.address}")
+    yield raytpu
+    raytpu.shutdown()
+
+
+class TestProtocol:
+    def test_rpc_roundtrip_and_errors(self):
+        srv = RpcServer()
+        srv.register("add", lambda peer, a, b: a + b)
+
+        def boom(peer):
+            raise ValueError("bad")
+
+        srv.register("boom", boom)
+        addr = srv.start()
+        cli = RpcClient(addr)
+        assert cli.call("add", 2, 3) == 5
+        with pytest.raises(ValueError, match="bad"):
+            cli.call("boom")
+        cli.close()
+        srv.stop()
+
+    def test_pubsub_push(self):
+        srv = RpcServer()
+        peers = []
+        srv.register("sub", lambda peer: peers.append(peer))
+        addr = srv.start()
+        cli = RpcClient(addr)
+        got = []
+        cli.subscribe("news", got.append)
+        cli.call("sub")
+        peers[0].push("news", {"x": 1})
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got == [{"x": 1}]
+        cli.close()
+        srv.stop()
+
+
+class TestHeadServer:
+    def test_kv_and_schedule(self):
+        head = HeadServer()
+        addr = head.start()
+        cli = RpcClient(addr)
+        assert cli.call("kv_put", "k", b"v", True)
+        assert cli.call("kv_get", "k") == b"v"
+        assert cli.call("kv_keys", "") == ["k"]
+        # No nodes: schedule returns None.
+        assert cli.call("schedule", {"CPU": 1.0}) is None
+        cli.call("register_node", "n1", "127.0.0.1:1", {"CPU": 4.0}, {})
+        assert cli.call("schedule", {"CPU": 1.0}) == "n1"
+        assert cli.call("schedule", {"CPU": 8.0}) is None
+        cli.close()
+        head.stop()
+
+    def test_hybrid_pack_then_spread(self):
+        head = HeadServer()
+        addr = head.start()
+        cli = RpcClient(addr)
+        cli.call("register_node", "a", "x:1", {"CPU": 10.0}, {})
+        cli.call("register_node", "b", "x:2", {"CPU": 10.0}, {})
+        # a at 40% utilization, b empty: hybrid packs onto a.
+        cli.call("heartbeat", "a", {"CPU": 6.0})
+        assert cli.call("schedule", {"CPU": 1.0}) == "a"
+        # a above the 0.5 spread threshold: spread to b.
+        cli.call("heartbeat", "a", {"CPU": 2.0})
+        assert cli.call("schedule", {"CPU": 1.0}) == "b"
+        cli.close()
+        head.stop()
+
+
+class TestClusterTasks:
+    def test_remote_task_roundtrip(self, driver):
+        @raytpu.remote
+        def add(a, b):
+            return a + b
+
+        assert raytpu.get(add.remote(2, 40), timeout=30) == 42
+
+    def test_tasks_spread_across_nodes(self, driver):
+        @raytpu.remote
+        def whoami(i):
+            import os
+            import time as t
+            t.sleep(0.3)
+            return os.getpid()
+
+        refs = [whoami.remote(i) for i in range(4)]
+        pids = set(raytpu.get(refs, timeout=60))
+        assert len(pids) == 2  # both node processes executed tasks
+
+    def test_object_transfer_between_tasks(self, driver):
+        @raytpu.remote
+        def produce():
+            return np.arange(1000, dtype=np.float32)
+
+        @raytpu.remote
+        def consume(arr):
+            return float(arr.sum())
+
+        ref = produce.remote()
+        total = raytpu.get(consume.remote(ref), timeout=60)
+        assert total == float(np.arange(1000, dtype=np.float32).sum())
+
+    def test_driver_put_fetchable_by_tasks(self, driver):
+        big = np.ones((256, 256), dtype=np.float32)
+        ref = raytpu.put(big)
+
+        @raytpu.remote
+        def shape(arr):
+            return arr.shape
+
+        assert tuple(raytpu.get(shape.remote(ref), timeout=60)) == (256, 256)
+
+    def test_task_error_propagates(self, driver):
+        @raytpu.remote
+        def fail():
+            raise RuntimeError("remote boom")
+
+        with pytest.raises(raytpu.TaskError, match="remote boom"):
+            raytpu.get(fail.remote(), timeout=60)
+
+    def test_wait_on_cluster(self, driver):
+        @raytpu.remote
+        def quick():
+            return 1
+
+        @raytpu.remote
+        def slow():
+            time.sleep(3)
+            return 2
+
+        q, s = quick.remote(), slow.remote()
+        ready, rest = raytpu.wait([q, s], num_returns=1, timeout=20)
+        assert ready and ready[0].id == q.id
+        raytpu.get(s, timeout=20)  # drain so later tests see free CPUs
+
+
+class TestClusterActors:
+    def test_actor_roundtrip_and_named(self, driver):
+        @raytpu.remote
+        class Counter:
+            def __init__(self, start=0):
+                self.v = start
+
+            def inc(self, n=1):
+                self.v += n
+                return self.v
+
+        c = Counter.options(name="ctr").remote(10)
+        assert raytpu.get(c.inc.remote(), timeout=30) == 11
+        assert raytpu.get(c.inc.remote(5), timeout=30) == 16
+        # Named lookup from the same driver.
+        c2 = raytpu.get_actor("ctr")
+        assert raytpu.get(c2.inc.remote(), timeout=30) == 17
+
+    def test_actor_kill(self, driver):
+        @raytpu.remote
+        class Victim:
+            def ping(self):
+                return "pong"
+
+        v = Victim.remote()
+        assert raytpu.get(v.ping.remote(), timeout=30) == "pong"
+        raytpu.kill(v)
+        with pytest.raises(raytpu.RayTpuError):
+            raytpu.get(v.ping.remote(), timeout=30)
+
+
+class TestClusterPlacementGroups:
+    def test_strict_spread_two_nodes(self, driver):
+        pg = raytpu.placement_group([{"CPU": 1}, {"CPU": 1}],
+                                    strategy="STRICT_SPREAD")
+        info = pg.info()
+        assert info["state"] == "created"
+        assert len(set(info["nodes"])) == 2
+
+        @raytpu.remote
+        def where():
+            import os
+            return os.getpid()
+
+        pids = raytpu.get([
+            where.options(placement_group=pg,
+                          placement_group_bundle_index=i).remote()
+            for i in range(2)
+        ], timeout=60)
+        assert len(set(pids)) == 2
+        raytpu.remove_placement_group(pg)
+
+    def test_strict_pack_one_node(self, driver):
+        pg = raytpu.placement_group([{"CPU": 1}, {"CPU": 1}],
+                                    strategy="STRICT_PACK")
+        info = pg.info()
+        assert len(set(info["nodes"])) == 1
+        raytpu.remove_placement_group(pg)
+
+
+class TestChaos:
+    def test_node_death_task_retry(self):
+        """Kill a node mid-task: retriable tasks re-execute elsewhere
+        (owner-side resubmit; reference: TaskManager retries +
+        lineage reconstruction)."""
+        c = Cluster(num_nodes=2, node_resources={"num_cpus": 1})
+        c.wait_for_nodes(2)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            @raytpu.remote(max_retries=2)
+            def slow_then_value(i):
+                time.sleep(2.0)
+                return i * 2
+
+            refs = [slow_then_value.remote(i) for i in range(2)]
+            time.sleep(0.5)  # both nodes now mid-execution
+            c.kill_node(c.nodes[0])
+            results = raytpu.get(refs, timeout=90)
+            assert sorted(results) == [0, 2]
+        finally:
+            raytpu.shutdown()
+            c.shutdown()
+
+    def test_node_death_actor_dies(self):
+        c = Cluster(num_nodes=2, node_resources={"num_cpus": 1})
+        c.wait_for_nodes(2)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            @raytpu.remote
+            class Pinned:
+                def pid(self):
+                    import os
+                    return os.getpid()
+
+            a = Pinned.remote()
+            pid = raytpu.get(a.pid.remote(), timeout=30)
+            victim = next(n for n in c.nodes if n.proc.pid != pid
+                          and n.alive)
+            survivor_actor_node = next(n for n in c.nodes
+                                       if n.proc.pid == pid)
+            del survivor_actor_node
+            # Kill the node hosting the actor.
+            target = next(n for n in c.nodes if n.proc.pid == pid)
+            c.kill_node(target)
+            deadline = time.monotonic() + 30
+            saw_death = False
+            while time.monotonic() < deadline:
+                try:
+                    raytpu.get(a.pid.remote(), timeout=5)
+                except raytpu.RayTpuError:
+                    saw_death = True
+                    break
+                except Exception:
+                    saw_death = True
+                    break
+                time.sleep(0.5)
+            assert saw_death
+            del victim
+        finally:
+            raytpu.shutdown()
+            c.shutdown()
