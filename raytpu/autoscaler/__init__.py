@@ -1,0 +1,24 @@
+"""raytpu.autoscaler — slice-atomic node-group autoscaling.
+
+Reference analogue: ``python/ray/autoscaler/`` (v1 StandardAutoscaler +
+v2 reconciler; see module docstrings for the mapping).
+"""
+
+from raytpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerMonitor,
+    ResourceDemand,
+    StandardAutoscaler,
+)
+from raytpu.autoscaler.node_provider import (
+    FakeSliceProvider,
+    NodeGroup,
+    NodeGroupSpec,
+    NodeProvider,
+)
+
+__all__ = [
+    "AutoscalerConfig", "AutoscalerMonitor", "FakeSliceProvider",
+    "NodeGroup", "NodeGroupSpec", "NodeProvider", "ResourceDemand",
+    "StandardAutoscaler",
+]

@@ -1,0 +1,125 @@
+"""Node providers: the cloud-facing half of the autoscaler.
+
+Reference analogue: ``python/ray/autoscaler/node_provider.py`` (the
+``NodeProvider`` ABC) and the fake in-memory provider used for e2e tests
+(``python/ray/autoscaler/_private/fake_multi_node/node_provider.py:237``).
+
+TPU-first difference: the unit of provisioning is a **slice** (node
+group), not a single VM. A v4-32 is 4 hosts that exist or die together —
+``create_node_group``/``terminate_node_group`` are therefore the primitive
+operations, and a group carries its slice topology so the scheduler can
+treat it as one ICI domain (reference bolts single-VM TPUs on via
+``_private/accelerators/tpu.py``; v2's instance-group abstraction is the
+closer shape, ``autoscaler/v2/instance_manager/``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class NodeGroupSpec:
+    """A launchable node-group type (e.g. one TPU slice or one CPU VM)."""
+
+    name: str                      # e.g. "v4-8", "cpu-16"
+    hosts: int = 1                 # hosts per group (slice hosts)
+    resources_per_host: Dict[str, float] = field(default_factory=dict)
+    topology: Optional[tuple] = None  # ICI box, e.g. (2, 2, 1)
+    min_groups: int = 0
+    max_groups: int = 10
+
+    @property
+    def resources_per_group(self) -> Dict[str, float]:
+        return {k: v * self.hosts for k, v in
+                self.resources_per_host.items()}
+
+
+@dataclass
+class NodeGroup:
+    group_id: str
+    spec: NodeGroupSpec
+    status: str = "pending"        # pending | running | terminated | failed
+    host_ids: List[str] = field(default_factory=list)
+
+
+class NodeProvider:
+    """ABC. Implementations talk to GCE/GKE; tests use FakeSliceProvider."""
+
+    def create_node_group(self, spec: NodeGroupSpec) -> NodeGroup:
+        raise NotImplementedError
+
+    def terminate_node_group(self, group_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_groups(self) -> List[NodeGroup]:
+        raise NotImplementedError
+
+    def poll(self) -> None:
+        """Advance async provisioning state (cloud polling tick)."""
+
+
+class FakeSliceProvider(NodeProvider):
+    """In-memory provider: groups become ``running`` after
+    ``provision_ticks`` polls; supports fault injection via ``fail_next``
+    (reference analogue: FakeMultiNodeProvider)."""
+
+    def __init__(self, provision_ticks: int = 1):
+        self._lock = threading.Lock()
+        self._groups: Dict[str, NodeGroup] = {}
+        self._pending_ticks: Dict[str, int] = {}
+        self._ids = itertools.count(1)
+        self.provision_ticks = provision_ticks
+        self.fail_next = 0  # next N creations fail at provision time
+        self.create_calls = 0
+        self.terminate_calls = 0
+
+    def create_node_group(self, spec: NodeGroupSpec) -> NodeGroup:
+        with self._lock:
+            gid = f"{spec.name}-{next(self._ids)}"
+            group = NodeGroup(gid, spec)
+            self._groups[gid] = group
+            self._pending_ticks[gid] = self.provision_ticks
+            self.create_calls += 1
+            return group
+
+    def terminate_node_group(self, group_id: str) -> None:
+        with self._lock:
+            g = self._groups.get(group_id)
+            if g is not None:
+                g.status = "terminated"
+                g.host_ids = []
+                self._pending_ticks.pop(group_id, None)
+                self.terminate_calls += 1
+
+    def non_terminated_groups(self) -> List[NodeGroup]:
+        with self._lock:
+            return [g for g in self._groups.values()
+                    if g.status in ("pending", "running")]
+
+    def poll(self) -> None:
+        with self._lock:
+            for gid, left in list(self._pending_ticks.items()):
+                if left > 1:
+                    self._pending_ticks[gid] = left - 1
+                    continue
+                del self._pending_ticks[gid]
+                g = self._groups[gid]
+                if self.fail_next > 0:
+                    self.fail_next -= 1
+                    g.status = "failed"
+                else:
+                    g.status = "running"
+                    g.host_ids = [f"{gid}-host{i}"
+                                  for i in range(g.spec.hosts)]
+
+    # test helper: simulate a running slice dying under us
+    def kill_group(self, group_id: str) -> None:
+        with self._lock:
+            g = self._groups.get(group_id)
+            if g is not None:
+                g.status = "failed"
+                g.host_ids = []

@@ -168,7 +168,8 @@ class ClusterBackend:
             node_id = pg["nodes"][idx]
             return node_id
         return self._head.call(
-            "schedule", self._required_resources(spec))
+            "schedule", self._required_resources(spec), None, 0.5,
+            spec.task_id.hex())
 
     def _send_to_node(self, spec: TaskSpec, node_id: str,
                       method: str) -> None:

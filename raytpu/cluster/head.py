@@ -63,6 +63,10 @@ class HeadServer:
         # placement groups: pg_id -> {"bundles": [...], "nodes": [node_id per bundle]}
         self._pgs: Dict[str, dict] = {}
         self._subscribers: Dict[str, List[Peer]] = {}  # topic -> peers
+        # Unmet schedule() requests keyed by request id so client RETRIES
+        # refresh one entry instead of inflating demand (the autoscaler's
+        # feed; reference: GcsAutoscalerStateManager pending demand).
+        self._unmet: Dict[str, Tuple[float, Dict[str, float]]] = {}
         self._job_counter = 0
         self._stop = threading.Event()
         h = self._rpc.register
@@ -86,6 +90,7 @@ class HeadServer:
         h("remove_pg", self._remove_pg)
         h("pg_info", self._pg_info)
         h("subscribe", self._subscribe)
+        h("get_demand", self._get_demand)
         h("next_job_id", self._next_job_id)
         h("ping", lambda peer: "pong")
         self._rpc.on_disconnect(self._peer_gone)
@@ -213,7 +218,8 @@ class HeadServer:
 
     def _schedule(self, peer: Peer, resources: Dict[str, float],
                   node_hint: Optional[str] = None,
-                  spread_threshold: float = 0.5) -> Optional[str]:
+                  spread_threshold: float = 0.5,
+                  req_id: Optional[str] = None) -> Optional[str]:
         """Pick a node for a task/actor of this shape. Hybrid policy
         (reference: hybrid_scheduling_policy.h:50): prefer the hinted /
         most-utilized feasible node until utilization crosses the spread
@@ -227,7 +233,17 @@ class HeadServer:
                        for k, v in resources.items()):
                     feasible.append(entry)
             if not feasible:
+                import os as _os
+
+                key = req_id or _os.urandom(8).hex()
+                self._unmet[key] = (time.monotonic(), dict(resources))
+                if len(self._unmet) > 10_000:
+                    cutoff = time.monotonic() - 10.0
+                    self._unmet = {k: v for k, v in self._unmet.items()
+                                   if v[0] >= cutoff}
                 return None
+            if req_id is not None:
+                self._unmet.pop(req_id, None)
             if node_hint:
                 for entry in feasible:
                     if entry.node_id == node_hint:
@@ -454,6 +470,19 @@ class HeadServer:
         for p in peers:
             if not p.closed:
                 p.push(topic, data)
+
+    def _get_demand(self, peer: Peer, window_s: float = 10.0) -> List[dict]:
+        """Aggregated unmet demand in the look-back window: the input to
+        the autoscaler's get_desired_groups (bundle -> count)."""
+        cutoff = time.monotonic() - window_s
+        with self._lock:
+            self._unmet = {k: v for k, v in self._unmet.items()
+                           if v[0] >= cutoff}
+            agg: Dict[tuple, int] = {}
+            for _, b in self._unmet.values():
+                key = tuple(sorted(b.items()))
+                agg[key] = agg.get(key, 0) + 1
+        return [{"bundle": dict(k), "count": n} for k, n in agg.items()]
 
     def _next_job_id(self, peer: Peer) -> int:
         with self._lock:

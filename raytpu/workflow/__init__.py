@@ -1,0 +1,20 @@
+"""raytpu.workflow — durable DAG execution (reference: python/ray/workflow/)."""
+
+from raytpu.workflow.api import (
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    list_steps,
+    resume,
+    resume_all,
+    run,
+    run_async,
+)
+from raytpu.workflow.storage import WorkflowStorage
+
+__all__ = [
+    "WorkflowStorage", "delete", "get_output", "get_status", "init",
+    "list_all", "list_steps", "resume", "resume_all", "run", "run_async",
+]

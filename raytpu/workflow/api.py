@@ -1,0 +1,178 @@
+"""Workflow public API.
+
+Reference analogue: ``python/ray/workflow/api.py`` — ``workflow.run`` /
+``run_async``, ``resume``, ``resume_all``, ``get_status``, ``get_output``,
+``list_all``, ``delete``. A workflow is a DAG of ``.bind()`` task nodes
+executed with per-step durable checkpoints; resuming re-executes only the
+steps that never checkpointed.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from raytpu.dag.node import DAGNode
+from raytpu.workflow.executor import WorkflowExecutor
+from raytpu.workflow.storage import WorkflowStorage
+
+_storage: Optional[WorkflowStorage] = None
+_lock = threading.Lock()
+_running: Dict[str, threading.Thread] = {}
+
+
+def init(storage_root: Optional[str] = None) -> None:
+    """Optional: choose the durable storage root before the first run."""
+    global _storage
+    with _lock:
+        _storage = WorkflowStorage(storage_root)
+
+
+def _get_storage() -> WorkflowStorage:
+    global _storage
+    with _lock:
+        if _storage is None:
+            _storage = WorkflowStorage()
+        return _storage
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        workflow_input: Any = None) -> Any:
+    """Execute a DAG durably; blocks and returns the output."""
+    import raytpu
+
+    if not raytpu.is_initialized():
+        raytpu.init()
+    storage = _get_storage()
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:12]}"
+    if storage.get_status(workflow_id) == "SUCCESSFUL":
+        return storage.load_output(workflow_id)
+    storage.create_workflow(workflow_id, cloudpickle.dumps(dag),
+                            workflow_input)
+    return _execute_tracked(storage, workflow_id, dag, workflow_input)
+
+
+def _execute_tracked(storage, workflow_id, dag, workflow_input) -> Any:
+    me = threading.current_thread()
+    with _lock:
+        _running[workflow_id] = me
+    try:
+        return WorkflowExecutor(storage).execute(workflow_id, dag,
+                                                 workflow_input)
+    finally:
+        with _lock:
+            if _running.get(workflow_id) is me:
+                del _running[workflow_id]
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              workflow_input: Any = None) -> str:
+    """Start a workflow in the background; returns its id. The durable
+    record (dag + input + RUNNING status) is written synchronously so
+    get_status/get_output on the returned id never race the thread."""
+    import raytpu
+
+    if not raytpu.is_initialized():
+        raytpu.init()
+    storage = _get_storage()
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:12]}"
+    if storage.get_status(workflow_id) != "SUCCESSFUL":
+        storage.create_workflow(workflow_id, cloudpickle.dumps(dag),
+                                workflow_input)
+        t = threading.Thread(
+            target=lambda: _swallow(_execute_tracked, storage, workflow_id,
+                                    dag, workflow_input),
+            name=f"workflow-{workflow_id}", daemon=True,
+        )
+        t.start()
+    return workflow_id
+
+
+def _swallow(fn, *a, **kw):
+    try:
+        fn(*a, **kw)
+    except Exception:
+        pass  # status already persisted as FAILED
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a stored workflow; completed steps load from checkpoints."""
+    import raytpu
+
+    if not raytpu.is_initialized():
+        raytpu.init()
+    storage = _get_storage()
+    status = storage.get_status(workflow_id)
+    if status is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if status == "SUCCESSFUL":
+        return storage.load_output(workflow_id)
+    with _lock:
+        live = _running.get(workflow_id)
+    if live is not None and live.is_alive() and \
+            live is not threading.current_thread():
+        raise RuntimeError(
+            f"workflow {workflow_id} is already executing in this process")
+    dag = cloudpickle.loads(storage.load_dag(workflow_id))
+    workflow_input = storage.load_input(workflow_id)
+    storage.set_status(workflow_id, "RUNNING")
+    return _execute_tracked(storage, workflow_id, dag, workflow_input)
+
+
+def resume_all(include_running: bool = False) -> List[str]:
+    """Resume FAILED workflows (and, with ``include_running=True``, ones
+    left RUNNING by a crashed process — only safe when no other process is
+    still executing them)."""
+    storage = _get_storage()
+    states = ("RUNNING", "FAILED") if include_running else ("FAILED",)
+    resumed = []
+    for meta in storage.list_workflows():
+        wid = meta["workflow_id"]
+        with _lock:
+            live = _running.get(wid)
+        if live is not None and live.is_alive():
+            continue  # executing in THIS process right now
+        if meta["status"] in states:
+            try:
+                resume(wid)
+                resumed.append(wid)
+            except Exception:
+                pass
+    return resumed
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _get_storage().get_status(workflow_id)
+
+
+def get_output(workflow_id: str, *, timeout: Optional[float] = None) -> Any:
+    import time as _t
+
+    storage = _get_storage()
+    deadline = None if timeout is None else _t.monotonic() + timeout
+    while True:
+        status = storage.get_status(workflow_id)
+        if status == "SUCCESSFUL":
+            return storage.load_output(workflow_id)
+        if status == "FAILED":
+            raise RuntimeError(f"workflow {workflow_id} failed")
+        if status is None:
+            raise ValueError(f"no workflow {workflow_id!r}")
+        if deadline is not None and _t.monotonic() >= deadline:
+            raise TimeoutError(f"workflow {workflow_id} still {status}")
+        _t.sleep(0.05)
+
+
+def list_all() -> List[Dict[str, Any]]:
+    return _get_storage().list_workflows()
+
+
+def list_steps(workflow_id: str) -> List[Dict[str, Any]]:
+    return _get_storage().list_steps(workflow_id)
+
+
+def delete(workflow_id: str) -> None:
+    _get_storage().delete_workflow(workflow_id)

@@ -1,0 +1,160 @@
+"""Autoscaler tests (reference: python/ray/tests/test_autoscaler.py with
+mock providers + test_autoscaler_fake_multinode.py)."""
+
+import time
+
+from raytpu.autoscaler import (
+    AutoscalerConfig,
+    FakeSliceProvider,
+    NodeGroupSpec,
+    ResourceDemand,
+    StandardAutoscaler,
+)
+
+V4_8 = NodeGroupSpec(name="v4-8", hosts=1,
+                     resources_per_host={"TPU": 8, "CPU": 16},
+                     topology=(2, 2, 1), max_groups=8)
+V4_32 = NodeGroupSpec(name="v4-32", hosts=4,
+                      resources_per_host={"TPU": 8, "CPU": 16},
+                      topology=(2, 2, 4), max_groups=4)
+CPU_VM = NodeGroupSpec(name="cpu-16", hosts=1,
+                       resources_per_host={"CPU": 16}, max_groups=10)
+
+
+def make(provider_ticks=1, **cfg):
+    provider = FakeSliceProvider(provision_ticks=provider_ticks)
+    config = AutoscalerConfig(
+        node_groups=[V4_8, V4_32, CPU_VM],
+        idle_timeout_s=cfg.pop("idle_timeout_s", 0.2), **cfg)
+    return StandardAutoscaler(config, provider), provider
+
+
+class TestDemandScheduling:
+    def test_single_bundle_launches_smallest_fit(self):
+        asc, prov = make()
+        asc.update([ResourceDemand({"TPU": 8})])
+        groups = prov.non_terminated_groups()
+        assert [g.spec.name for g in groups] == ["v4-8"]
+
+    def test_large_bundle_needs_multi_host_slice(self):
+        asc, prov = make()
+        # 32 chips don't fit a v4-8 (8 chips); needs the 4-host v4-32.
+        asc.update([ResourceDemand({"TPU": 32})])
+        groups = prov.non_terminated_groups()
+        assert [g.spec.name for g in groups] == ["v4-32"]
+
+    def test_demand_count_packs_spare_capacity(self):
+        asc, prov = make()
+        # 4 bundles of 4 chips pack into two v4-8 groups (8 chips each).
+        asc.update([ResourceDemand({"TPU": 4}, count=4)])
+        groups = prov.non_terminated_groups()
+        assert sorted(g.spec.name for g in groups) == ["v4-8", "v4-8"]
+
+    def test_cpu_only_demand_avoids_tpu_groups(self):
+        asc, prov = make()
+        asc.update([ResourceDemand({"CPU": 8}, count=2)])
+        groups = prov.non_terminated_groups()
+        # Best-fit by waste: a TPU slice also has 16 CPUs but carries an
+        # unrequested resource kind — the CPU VM wins.
+        assert [g.spec.name for g in groups] == ["cpu-16"]
+
+    def test_max_groups_cap(self):
+        asc, prov = make()
+        asc.update([ResourceDemand({"TPU": 8}, count=100)])
+        names = [g.spec.name for g in prov.non_terminated_groups()]
+        assert names.count("v4-8") <= V4_8.max_groups
+
+    def test_infeasible_demand_ignored(self):
+        asc, prov = make()
+        asc.update([ResourceDemand({"TPU": 1024})])
+        assert prov.non_terminated_groups() == []
+
+
+class TestReconcile:
+    def test_min_groups_maintained(self):
+        provider = FakeSliceProvider()
+        spec = NodeGroupSpec(name="warm", hosts=1,
+                             resources_per_host={"CPU": 4},
+                             min_groups=2, max_groups=5)
+        asc = StandardAutoscaler(AutoscalerConfig(node_groups=[spec]),
+                                 provider)
+        asc.update([])
+        assert len(provider.non_terminated_groups()) == 2
+
+    def test_idle_scale_down_after_timeout(self):
+        asc, prov = make(idle_timeout_s=0.15)
+        asc.update([ResourceDemand({"TPU": 8})])
+        prov.poll()
+        assert len(prov.non_terminated_groups()) == 1
+        # Demand gone: group must idle out, but only after the timeout.
+        asc.update([])
+        assert len(prov.non_terminated_groups()) == 1
+        time.sleep(0.2)
+        asc.update([])
+        assert prov.non_terminated_groups() == []
+
+    def test_busy_groups_never_terminated(self):
+        asc, prov = make(idle_timeout_s=0.05)
+        asc.update([ResourceDemand({"TPU": 8})])
+        prov.poll()
+        gid = prov.non_terminated_groups()[0].group_id
+        time.sleep(0.1)
+        asc.update([], busy_group_ids={gid})
+        assert len(prov.non_terminated_groups()) == 1
+        # Once not busy, it idles out.
+        time.sleep(0.1)
+        asc.update([])
+        time.sleep(0.1)
+        asc.update([])
+        assert prov.non_terminated_groups() == []
+
+    def test_failed_group_replaced(self):
+        asc, prov = make()
+        asc.update([ResourceDemand({"TPU": 8})])
+        prov.poll()
+        gid = prov.non_terminated_groups()[0].group_id
+        prov.kill_group(gid)
+        # Tick: failed group cleared and a replacement launched while
+        # demand persists.
+        asc.update([ResourceDemand({"TPU": 8})])
+        prov.poll()
+        groups = prov.non_terminated_groups()
+        assert len(groups) == 1
+        assert groups[0].group_id != gid
+        assert groups[0].status == "running"
+
+    def test_slow_provision_not_duplicated(self):
+        asc, prov = make(provider_ticks=3)
+        for _ in range(3):
+            asc.update([ResourceDemand({"TPU": 8})])
+        # Still provisioning; reconcile must not launch extras.
+        assert prov.create_calls == 1
+
+
+class TestHeadDemandFeed:
+    def test_unmet_schedule_becomes_demand(self):
+        from raytpu.cluster.head import HeadServer
+        from raytpu.cluster.protocol import RpcClient
+
+        head = HeadServer()
+        addr = head.start()
+        cli = RpcClient(addr)
+        cli.call("register_node", "n1", "x:1", {"CPU": 2.0}, {})
+        # Two distinct pending tasks, each RETRIED several times: retries
+        # refresh their entry (keyed by req_id), never inflate the count.
+        for _ in range(5):
+            assert cli.call("schedule", {"TPU": 8.0}, None, 0.5,
+                            "task-1") is None
+            assert cli.call("schedule", {"TPU": 8.0}, None, 0.5,
+                            "task-2") is None
+        demand = cli.call("get_demand")
+        assert demand == [{"bundle": {"TPU": 8.0}, "count": 2}]
+        # Feed it straight into the autoscaler.
+        asc, prov = make()
+        asc.update([ResourceDemand(d["bundle"], d["count"])
+                    for d in demand])
+        assert sorted(g.spec.name
+                      for g in prov.non_terminated_groups()) == \
+            ["v4-8", "v4-8"]  # one whole slice per pending 8-chip bundle
+        cli.close()
+        head.stop()

@@ -1,0 +1,146 @@
+"""Workflow tests (reference: python/ray/workflow/tests/)."""
+
+import time
+
+import pytest
+
+import raytpu
+from raytpu import workflow
+from raytpu.workflow.storage import WorkflowStorage
+
+
+@pytest.fixture
+def wf(tmp_path, raytpu_local):
+    workflow.init(str(tmp_path))
+    yield workflow
+
+
+@raytpu.remote
+def wf_add(a, b):
+    return a + b
+
+
+@raytpu.remote
+def wf_double(x):
+    return 2 * x
+
+
+class TestWorkflowRun:
+    def test_linear_dag(self, wf):
+        dag = wf_double.bind(wf_add.bind(1, 2))
+        assert wf.run(dag, workflow_id="lin") == 6
+        assert wf.get_status("lin") == "SUCCESSFUL"
+        assert wf.get_output("lin") == 6
+
+    def test_diamond_dag_step_count(self, wf):
+        a = wf_add.bind(1, 1)          # 2
+        left = wf_double.bind(a)       # 4
+        right = wf_double.bind(a)      # 4
+        dag = wf_add.bind(left, right)  # 8
+        assert wf.run(dag, workflow_id="dia") == 8
+        steps = wf.list_steps("dia")
+        assert len(steps) == 4  # shared node `a` ran once (memoized)
+
+    def test_rerun_completed_returns_cached(self, wf):
+        calls = []
+
+        @raytpu.remote
+        def effect():
+            calls.append(1)
+            return "done"
+
+        dag = effect.bind()
+        assert wf.run(dag, workflow_id="cache") == "done"
+        assert wf.run(dag, workflow_id="cache") == "done"
+        # The second run loaded the stored output; no re-execution.
+        assert wf.get_status("cache") == "SUCCESSFUL"
+
+    def test_list_and_delete(self, wf):
+        wf.run(wf_add.bind(1, 2), workflow_id="tolist")
+        ids = [w["workflow_id"] for w in wf.list_all()]
+        assert "tolist" in ids
+        wf.delete("tolist")
+        ids = [w["workflow_id"] for w in wf.list_all()]
+        assert "tolist" not in ids
+
+    def test_run_async_and_get_output(self, wf):
+        @raytpu.remote
+        def slow():
+            time.sleep(0.3)
+            return 99
+
+        wid = wf.run_async(slow.bind())
+        assert wf.get_output(wid, timeout=10) == 99
+
+
+class TestWorkflowResume:
+    def test_failure_then_resume_skips_completed_steps(self, wf, tmp_path):
+        marker = str(tmp_path / "fail_once")
+        log = str(tmp_path / "exec_log")
+        open(marker, "w").write("arm")
+
+        @raytpu.remote
+        def step_a():
+            with open(log, "a") as f:
+                f.write("a\n")
+            return 10
+
+        @raytpu.remote
+        def flaky(x):
+            import os
+            with open(log, "a") as f:
+                f.write("flaky\n")
+            if os.path.exists(marker):
+                os.unlink(marker)
+                raise RuntimeError("transient")
+            return x + 5
+
+        dag = flaky.bind(step_a.bind())
+        with pytest.raises(raytpu.TaskError, match="transient"):
+            wf.run(dag, workflow_id="resume-me")
+        assert wf.get_status("resume-me") == "FAILED"
+        assert open(log).read().splitlines() == ["a", "flaky"]
+        # step_a checkpointed; resume re-runs only flaky.
+        assert wf.resume("resume-me") == 15
+        assert open(log).read().splitlines() == ["a", "flaky", "flaky"]
+        assert wf.get_status("resume-me") == "SUCCESSFUL"
+
+    def test_resume_all(self, wf, tmp_path):
+        marker = tmp_path / "fail_always"
+        marker.write_text("arm")
+
+        @raytpu.remote
+        def fail_once_global(x):
+            import os
+            if os.path.exists(str(marker)):
+                os.unlink(str(marker))
+                raise RuntimeError("boom")
+            return x
+
+        with pytest.raises(raytpu.TaskError):
+            wf.run(fail_once_global.bind(7), workflow_id="ra")
+        resumed = wf.resume_all()
+        assert "ra" in resumed
+        assert wf.get_output("ra") == 7
+
+    def test_actor_nodes_rejected(self, wf):
+        @raytpu.remote
+        class A:
+            def m(self):
+                return 1
+
+        a = A.remote()
+        with pytest.raises(Exception, match="durable|actor"):
+            wf.run(a.m.bind(), workflow_id="bad")
+
+
+class TestStorage:
+    def test_atomic_step_roundtrip(self, tmp_path):
+        st = WorkflowStorage(str(tmp_path))
+        st.create_workflow("w", b"blob")
+        st.save_step("w", "s1", "mystep", {"x": (1, 2)})
+        assert st.has_step("w", "s1")
+        assert st.load_step("w", "s1") == {"x": (1, 2)}
+        assert st.load_dag("w") == b"blob"
+        st.save_output("w", [1, 2, 3])
+        assert st.load_output("w") == [1, 2, 3]
