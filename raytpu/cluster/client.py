@@ -171,6 +171,29 @@ class ClusterBackend:
             "schedule", self._required_resources(spec), None, 0.5,
             spec.task_id.hex())
 
+    def _ship_runtime_env(self, spec: TaskSpec, addr: str) -> None:
+        """Push packaged zip:// URIs to the executing node's cache before
+        the task lands there (reference: runtime-env agent fetch)."""
+        renv = spec.runtime_env or {}
+        uris = []
+        for key in ("working_dir", "py_modules"):
+            v = renv.get(key)
+            if isinstance(v, str):
+                v = [v]
+            uris.extend(u for u in (v or ()) if isinstance(u, str)
+                        and u.startswith("zip://"))
+        if not uris:
+            return
+        from raytpu.runtime_env import read_blob
+
+        peer = self._peer(addr)
+        for uri in uris:
+            try:
+                if not peer.call("has_runtime_env", uri):
+                    peer.call("cache_runtime_env", uri, read_blob(uri))
+            except FileNotFoundError:
+                pass  # not packaged locally either; task will surface it
+
     def _send_to_node(self, spec: TaskSpec, node_id: str,
                       method: str) -> None:
         addr = self._node_addr(node_id)
@@ -178,6 +201,10 @@ class ClusterBackend:
             with self._lock:
                 self._pending.append(spec)
             return
+        try:
+            self._ship_runtime_env(spec, addr)
+        except Exception:
+            pass
         with self._lock:
             self._inflight[spec.task_id] = _InFlight(
                 spec, node_id, attempts=spec.attempt)
@@ -255,6 +282,10 @@ class ClusterBackend:
             raise ValueError("scheduled node vanished; retry")
         with self._lock:
             self._actor_nodes[ac.actor_id] = node_id
+        try:
+            self._ship_runtime_env(spec, addr)
+        except Exception:
+            pass
         self._peer(addr).call("create_actor", cloudpickle.dumps(spec))
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:

@@ -132,6 +132,8 @@ class NodeServer:
         h("has_object", self._h_has_object)
         h("put_object", self._h_put_object)
         h("free_object", self._h_free_object)
+        h("cache_runtime_env", self._h_cache_runtime_env)
+        h("has_runtime_env", self._h_has_runtime_env)
         h("create_pg_shard", self._h_create_pg_shard)
         h("remove_pg_shard", self._h_remove_pg_shard)
         h("node_info", self._h_node_info)
@@ -328,6 +330,22 @@ class NodeServer:
                               self.node_id.hex())
         except Exception:
             pass
+
+    def _h_cache_runtime_env(self, peer: Peer, uri: str,
+                             blob: bytes) -> None:
+        """Install a packaged working_dir/py_modules zip (reference: the
+        runtime-env agent materializing URIs on demand)."""
+        from raytpu.runtime_env import cache_blob
+
+        cache_blob(uri, blob)
+
+    def _h_has_runtime_env(self, peer: Peer, uri: str) -> bool:
+        import os as _os
+
+        from raytpu.runtime_env.context import _CACHE_ROOT
+
+        return _os.path.exists(_os.path.join(
+            _CACHE_ROOT, uri.split("//")[1] + ".zip"))
 
     def _h_create_pg_shard(self, peer: Peer, pg_id_bin: bytes,
                            indexed_bundles, strategy: str,

@@ -1,0 +1,7 @@
+"""raytpu.job — job submission (reference: dashboard/modules/job/)."""
+
+from raytpu.job.manager import JobInfo, JobManager
+from raytpu.job.sdk import JobSubmissionClient
+from raytpu.job.server import JobServer
+
+__all__ = ["JobInfo", "JobManager", "JobServer", "JobSubmissionClient"]

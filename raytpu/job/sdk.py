@@ -1,0 +1,70 @@
+"""Job submission SDK.
+
+Reference analogue: ``dashboard/modules/job/sdk.py:39``
+(``JobSubmissionClient``) — the typed HTTP client for the job REST API.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import requests
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        self.address = address.rstrip("/")
+
+    def _url(self, path: str) -> str:
+        return f"{self.address}{path}"
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        r = requests.post(self._url("/api/jobs/"), json={
+            "entrypoint": entrypoint,
+            "submission_id": submission_id,
+            "runtime_env": runtime_env,
+            "metadata": metadata,
+        }, timeout=30)
+        if r.status_code != 200:
+            raise RuntimeError(f"submit failed: {r.status_code} {r.text}")
+        return r.json()["job_id"]
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        r = requests.get(self._url(f"/api/jobs/{job_id}"), timeout=30)
+        if r.status_code == 404:
+            raise KeyError(job_id)
+        return r.json()
+
+    def get_job_logs(self, job_id: str) -> str:
+        r = requests.get(self._url(f"/api/jobs/{job_id}/logs"), timeout=30)
+        if r.status_code == 404:
+            raise KeyError(job_id)
+        return r.json()["logs"]
+
+    def stop_job(self, job_id: str) -> bool:
+        r = requests.post(self._url(f"/api/jobs/{job_id}/stop"),
+                          timeout=30)
+        if r.status_code == 404:
+            raise KeyError(job_id)
+        return r.json()["stopped"]
+
+    def list_jobs(self) -> List[dict]:
+        r = requests.get(self._url("/api/jobs/"), timeout=30)
+        return r.json()
+
+    def wait_until_finished(self, job_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} not finished in {timeout}s")

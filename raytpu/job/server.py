@@ -1,0 +1,118 @@
+"""Job REST API server.
+
+Reference analogue: ``dashboard/modules/job/job_head.py`` — the REST
+surface (`/api/jobs/`) the SDK and CLI talk to. aiohttp server running in
+its own thread over a :class:`JobManager`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from raytpu.job.manager import JobManager
+
+
+class JobServer:
+    def __init__(self, manager: JobManager, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.manager = manager
+        self._host = host
+        self._port = port
+        self._started = threading.Event()
+        self._stopping: Optional[asyncio.Event] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.address: Optional[str] = None
+
+    def start(self) -> str:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="raytpu-job-server")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("job server failed to start")
+        return self.address
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._serve())
+
+    async def _serve(self) -> None:
+        from aiohttp import web
+
+        self._stopping = asyncio.Event()
+        app = web.Application()
+        app.router.add_post("/api/jobs/", self._submit)
+        app.router.add_get("/api/jobs/", self._list)
+        app.router.add_get("/api/jobs/{job_id}", self._get)
+        app.router.add_get("/api/jobs/{job_id}/logs", self._logs)
+        app.router.add_post("/api/jobs/{job_id}/stop", self._stop)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self._host, self._port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        self.address = f"http://{self._host}:{self._port}"
+        self._started.set()
+        await self._stopping.wait()
+        await runner.cleanup()
+
+    def stop(self) -> None:
+        if self._loop is not None and self._stopping is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._stopping.set)
+            except RuntimeError:
+                pass
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _submit(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        try:
+            job_id = self.manager.submit_job(
+                body["entrypoint"],
+                submission_id=body.get("submission_id"),
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+            )
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"job_id": job_id,
+                                  "submission_id": job_id})
+
+    async def _list(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            [j.to_dict() for j in self.manager.list_jobs()])
+
+    async def _get(self, request):
+        from aiohttp import web
+
+        try:
+            info = self.manager.get_job_info(
+                request.match_info["job_id"])
+        except KeyError:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response(info.to_dict())
+
+    async def _logs(self, request):
+        from aiohttp import web
+
+        try:
+            logs = self.manager.get_job_logs(request.match_info["job_id"])
+        except KeyError:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response({"logs": logs})
+
+    async def _stop(self, request):
+        from aiohttp import web
+
+        try:
+            stopped = self.manager.stop_job(request.match_info["job_id"])
+        except KeyError:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response({"stopped": stopped})

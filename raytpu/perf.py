@@ -1,0 +1,102 @@
+"""Core-ops microbenchmark suite.
+
+Reference analogue: ``python/ray/_private/ray_perf.py:120-241`` — the
+timeit-style ops/s suite the reference runs per release
+(``release/microbenchmark/run_microbenchmark.py``): task submission+get,
+actor calls (sync/async/batched), put/get throughput. Run with
+``python -m raytpu.perf`` or call :func:`run_all` for a dict.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: int = 1,
+           warmup: int = 2, duration_s: float = 1.0) -> Dict[str, float]:
+    for _ in range(warmup):
+        fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration_s:
+        fn()
+        count += 1
+    elapsed = time.perf_counter() - start
+    ops = count * multiplier / elapsed
+    return {"name": name, "ops_per_s": round(ops, 1)}
+
+
+def run_all(duration_s: float = 1.0) -> List[Dict[str, float]]:
+    import raytpu
+
+    results: List[Dict[str, float]] = []
+    raytpu.shutdown()
+    raytpu.init(num_cpus=4)
+
+    @raytpu.remote
+    def tiny():
+        return b"ok"
+
+    @raytpu.remote
+    class Ping:
+        def ping(self):
+            return b"ok"
+
+        def batch(self, n):
+            return n
+
+    # 1. single task submit+get roundtrip
+    results.append(timeit(
+        "single client task sync",
+        lambda: raytpu.get(tiny.remote()), duration_s=duration_s))
+
+    # 2. batched task throughput
+    def batch_tasks():
+        raytpu.get([tiny.remote() for _ in range(100)])
+
+    results.append(timeit("client tasks batch=100", batch_tasks,
+                          multiplier=100, duration_s=duration_s))
+
+    # 3. actor call roundtrip
+    actor = Ping.remote()
+    raytpu.get(actor.ping.remote())
+    results.append(timeit(
+        "single client actor call sync",
+        lambda: raytpu.get(actor.ping.remote()), duration_s=duration_s))
+
+    # 4. batched actor calls
+    def batch_actor():
+        raytpu.get([actor.ping.remote() for _ in range(100)])
+
+    results.append(timeit("client actor calls batch=100", batch_actor,
+                          multiplier=100, duration_s=duration_s))
+
+    # 5. put/get small
+    results.append(timeit(
+        "put small (1KiB)",
+        lambda: raytpu.put(b"x" * 1024), duration_s=duration_s))
+
+    # 6. put/get large numpy (zero-copy path)
+    big = np.zeros((1024, 1024), dtype=np.float32)  # 4 MiB
+
+    def put_get_big():
+        raytpu.get(raytpu.put(big))
+
+    results.append(timeit("put+get 4MiB ndarray", put_get_big,
+                          duration_s=duration_s))
+
+    raytpu.shutdown()
+    return results
+
+
+def main() -> None:  # pragma: no cover
+    for r in run_all():
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

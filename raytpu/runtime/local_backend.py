@@ -210,6 +210,10 @@ class _ActorRuntime:
         self._die(reason)
 
     def _execute(self, spec: TaskSpec):
+        if spec.runtime_env is None:
+            # An actor's runtime_env covers its whole lifetime (reference
+            # semantics), not just __init__: method tasks inherit it.
+            spec.runtime_env = self.creation_spec.runtime_env
         self.backend.worker.execute_task(
             spec, self.backend._get_serialized, actor_instance=self.instance
         )
@@ -218,7 +222,10 @@ class _ActorRuntime:
     async def _execute_async(self, spec: TaskSpec):
         w = self.backend.worker
         from raytpu.runtime import context as ctx_mod
+        from raytpu.runtime_env import RuntimeEnvContext
 
+        if spec.runtime_env is None:
+            spec.runtime_env = self.creation_spec.runtime_env
         try:
             args, kwargs = w.resolve_args(spec, self.backend._get_serialized)
             method = getattr(self.instance, spec.method_name)
@@ -228,9 +235,10 @@ class _ActorRuntime:
                     task_id=spec.task_id, actor_id=self.actor_id,
                 )
             )
-            result = method(*args, **kwargs)
-            if inspect.isawaitable(result):
-                result = await result
+            with RuntimeEnvContext(spec.runtime_env):
+                result = method(*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else TaskError.from_exception(
                 spec.name, e

@@ -183,6 +183,16 @@ class Worker:
         )
         ctx_mod.set_current(new_ctx)
         try:
+            from raytpu.runtime_env import RuntimeEnvContext
+
+            renv = RuntimeEnvContext(spec.runtime_env)
+            renv.__enter__()
+        except BaseException as e:  # invalid env: fail the task cleanly
+            err = TaskError.from_exception(spec.name, e)
+            _maybe_store(return_ids, spec, err)
+            ctx_mod.set_current(old_ctx)
+            return err
+        try:
             args, kwargs = self.resolve_args(spec, get_fn)
             if spec.is_actor_task():
                 if spec.method_name == "__raytpu_exec_compiled__":
@@ -205,6 +215,7 @@ class Worker:
             _maybe_store(return_ids, spec, err)
             return err
         finally:
+            renv.__exit__(None, None, None)
             ctx_mod.set_current(old_ctx)
 
         if spec.num_returns == 1:
@@ -237,8 +248,11 @@ class Worker:
                               get_fn) -> Any:
         """Instantiate the actor class from an actor-creation spec (raises on
         user error — caller stores the error)."""
+        from raytpu.runtime_env import RuntimeEnvContext
+
         cls = self.load_function(spec.function_blob)
         args, kwargs = self.resolve_args(spec, get_fn)
+        renv = RuntimeEnvContext(spec.runtime_env)
         old_ctx = ctx_mod.current()
         ctx_mod.set_current(
             ctx_mod.RuntimeContext(
@@ -250,6 +264,7 @@ class Worker:
             )
         )
         try:
-            return cls(*args, **kwargs)
+            with renv:
+                return cls(*args, **kwargs)
         finally:
             ctx_mod.set_current(old_ctx)

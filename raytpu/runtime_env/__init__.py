@@ -1,0 +1,19 @@
+"""raytpu.runtime_env — per-task/actor environments.
+
+Reference analogue: ``python/ray/_private/runtime_env/`` +
+``python/ray/runtime_env/``.
+"""
+
+from raytpu.runtime_env.context import (
+    RuntimeEnvContext,
+    cache_blob,
+    ensure_uri,
+    package_dir,
+    read_blob,
+    validate,
+)
+
+__all__ = [
+    "RuntimeEnvContext", "cache_blob", "ensure_uri", "package_dir",
+    "read_blob", "validate",
+]

@@ -1,0 +1,204 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Reference analogue: ``python/ray/_private/runtime_env/`` — plugins
+(``pip.py``, ``working_dir.py``, ``py_modules.py``, ``env_vars`` handling
+in ``plugin.py``) materialized on demand by a per-node agent with a URI
+cache. Ours has three plugins:
+
+- ``env_vars``: merged into the process environment while tasks using the
+  env are running (refcounted; restored when the last one finishes).
+- ``working_dir``: a local directory packaged (zip, content-hashed URI),
+  cached per node, extracted once, and prepended to ``sys.path`` — code
+  ships with the task, the cache dedups across tasks (reference:
+  ``working_dir.py`` + URI cache).
+- ``py_modules``: list of directories handled like working_dir.
+
+Isolation note: the reference dedicates worker PROCESSES per runtime env;
+our local fabric runs tasks in threads, so ``env_vars`` are process-global
+while held — concurrent tasks with conflicting values of the same key are
+flagged with a warning rather than isolated. ``pip``/``conda`` are
+rejected explicitly (no installs in this environment) rather than
+silently ignored.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import sys
+import threading
+import zipfile
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("raytpu.runtime_env")
+
+_CACHE_ROOT = os.path.join(os.path.expanduser("~/.raytpu"),
+                           "runtime_env_cache")
+_lock = threading.RLock()
+# env key -> (value, refcount, saved_original)
+_env_refs: Dict[str, List] = {}
+# sys.path entry -> refcount (concurrent tasks sharing a working_dir must
+# not strip each other's import path)
+_path_refs: Dict[str, int] = {}
+_uri_cache: Dict[str, str] = {}  # uri -> extracted path
+
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules"}
+REJECTED_KEYS = {"pip", "conda", "container"}
+
+
+def validate(runtime_env: Optional[dict]) -> None:
+    if not runtime_env:
+        return
+    bad = set(runtime_env) & REJECTED_KEYS
+    if bad:
+        raise ValueError(
+            f"runtime_env keys {sorted(bad)} are not supported in this "
+            f"deployment (no package installs); supported: "
+            f"{sorted(SUPPORTED_KEYS)}")
+    unknown = set(runtime_env) - SUPPORTED_KEYS
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+
+
+def package_dir(path: str) -> str:
+    """Zip a directory into the cache; returns a content-hashed URI."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"not a directory: {path}")
+    h = hashlib.sha1()
+    for root, _, files in sorted(os.walk(path)):
+        for fn in sorted(files):
+            fp = os.path.join(root, fn)
+            h.update(fp.encode())
+            with open(fp, "rb") as f:
+                h.update(f.read())
+    uri = f"zip://{h.hexdigest()[:16]}"
+    os.makedirs(_CACHE_ROOT, exist_ok=True)
+    zip_path = os.path.join(_CACHE_ROOT, uri.split("//")[1] + ".zip")
+    if not os.path.exists(zip_path):
+        tmp = zip_path + ".tmp"
+        with zipfile.ZipFile(tmp, "w") as zf:
+            for root, _, files in sorted(os.walk(path)):
+                for fn in sorted(files):
+                    fp = os.path.join(root, fn)
+                    zf.write(fp, os.path.relpath(fp, path))
+        os.replace(tmp, zip_path)
+    return uri
+
+
+def ensure_uri(uri: str) -> str:
+    """Extract a packaged URI (idempotent, cached). Returns the dir."""
+    with _lock:
+        cached = _uri_cache.get(uri)
+        if cached and os.path.isdir(cached):
+            return cached
+        name = uri.split("//")[1]
+        zip_path = os.path.join(_CACHE_ROOT, name + ".zip")
+        out_dir = os.path.join(_CACHE_ROOT, name)
+        if not os.path.isdir(out_dir):
+            tmp = out_dir + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            with zipfile.ZipFile(zip_path) as zf:
+                zf.extractall(tmp)
+            os.replace(tmp, out_dir)
+        _uri_cache[uri] = out_dir
+        return out_dir
+
+
+def cache_blob(uri: str, blob: bytes) -> None:
+    """Install a packaged zip received from another node (cluster path)."""
+    os.makedirs(_CACHE_ROOT, exist_ok=True)
+    name = uri.split("//")[1]
+    zip_path = os.path.join(_CACHE_ROOT, name + ".zip")
+    if not os.path.exists(zip_path):
+        tmp = zip_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, zip_path)
+
+
+def read_blob(uri: str) -> bytes:
+    with open(os.path.join(_CACHE_ROOT,
+                           uri.split("//")[1] + ".zip"), "rb") as f:
+        return f.read()
+
+
+class RuntimeEnvContext:
+    """Applies a runtime env around one task execution (enter/exit)."""
+
+    def __init__(self, runtime_env: Optional[dict]):
+        validate(runtime_env)
+        self.env = dict(runtime_env or {})
+        self._path_entries: List[str] = []
+        self._held_keys: List[str] = []
+
+    def __enter__(self) -> "RuntimeEnvContext":
+        env_vars = self.env.get("env_vars") or {}
+        with _lock:
+            try:
+                for k, v in env_vars.items():
+                    v = str(v)
+                    entry = _env_refs.get(k)
+                    if entry is None:
+                        _env_refs[k] = [v, 1, os.environ.get(k)]
+                        os.environ[k] = v
+                    else:
+                        if entry[0] != v:
+                            logger.warning(
+                                "concurrent tasks set conflicting env var "
+                                "%r (%r vs %r); thread-based workers share "
+                                "the process environment", k, entry[0], v)
+                        entry[1] += 1
+                    self._held_keys.append(k)
+                for key in ("working_dir", "py_modules"):
+                    spec = self.env.get(key)
+                    if not spec:
+                        continue
+                    items = [spec] if isinstance(spec, str) else list(spec)
+                    for item in items:
+                        target = (ensure_uri(item)
+                                  if item.startswith("zip://")
+                                  else os.path.abspath(item))
+                        refs = _path_refs.get(target, 0)
+                        if refs == 0:
+                            sys.path.insert(0, target)
+                        _path_refs[target] = refs + 1
+                        self._path_entries.append(target)
+            except BaseException:
+                # Half-entered env must be fully rolled back or the leaked
+                # vars/paths pollute every later task in this process.
+                self._release_locked()
+                raise
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        with _lock:
+            self._release_locked()
+        return False
+
+    def _release_locked(self) -> None:
+        for k in self._held_keys:
+            entry = _env_refs.get(k)
+            if entry is None:
+                continue
+            entry[1] -= 1
+            if entry[1] <= 0:
+                if entry[2] is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = entry[2]
+                del _env_refs[k]
+        self._held_keys = []
+        for p in self._path_entries:
+            refs = _path_refs.get(p, 0) - 1
+            if refs <= 0:
+                _path_refs.pop(p, None)
+                try:
+                    sys.path.remove(p)
+                except ValueError:
+                    pass
+            else:
+                _path_refs[p] = refs
+        self._path_entries = []

@@ -1,0 +1,177 @@
+"""The ``raytpu`` command line.
+
+Reference analogue: ``python/ray/scripts/scripts.py`` — ``ray start/stop/
+status/timeline/memory/job ...`` (``cli`` at ``:75``, ``start`` ``:567``).
+Run as ``python -m raytpu <cmd>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+
+def _cmd_start(args) -> int:
+    if args.head:
+        from raytpu.cluster.head import HeadServer
+        from raytpu.job.manager import JobManager
+        from raytpu.job.server import JobServer
+
+        head = HeadServer(args.host, args.port)
+        addr = head.start()
+        jobs = JobServer(JobManager(cluster_address=addr),
+                         args.host, args.job_port)
+        job_addr = jobs.start()
+        print(f"raytpu head listening on {addr}")
+        print(f"job submission API at {job_addr}")
+        print(f"connect drivers with: raytpu.init(address='tcp://{addr}')")
+        if args.block:
+            signal.sigwait({signal.SIGINT, signal.SIGTERM})
+            jobs.stop()
+            head.stop()
+        return 0
+    if not args.address:
+        print("either --head or --address=<head> is required",
+              file=sys.stderr)
+        return 1
+    from raytpu.cluster.node import NodeServer
+
+    node = NodeServer(
+        args.address, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources=json.loads(args.resources), host=args.host,
+    )
+    addr = node.start(adopt_globals=True)
+    print(f"raytpu node {node.node_id.hex()[:12]} on {addr}")
+    if args.block:
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        node.stop()
+    return 0
+
+
+def _cmd_status(args) -> int:
+    from raytpu.cluster.protocol import RpcClient
+
+    cli = RpcClient(args.address)
+    try:
+        nodes = cli.call("list_nodes")
+        demand = cli.call("get_demand")
+    finally:
+        cli.close()
+    alive = [n for n in nodes if n["alive"]]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    for n in alive:
+        role = n["labels"].get("role", "worker")
+        print(f"  {n['node_id'][:12]} [{role}] {n['address']} "
+              f"avail={n['available']}")
+    if demand:
+        print("pending demand:")
+        for d in demand:
+            print(f"  {d['count']}x {d['bundle']}")
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    import raytpu
+    from raytpu.util.tracing import timeline
+
+    raytpu.init(address=args.address, ignore_reinit_error=True)
+    events = timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+    return 0
+
+
+def _cmd_memory(args) -> int:
+    import raytpu
+    from raytpu.state import object_summary
+
+    raytpu.init(address=args.address, ignore_reinit_error=True)
+    s = object_summary()
+    print(f"objects: {s['count']}  bytes: {s['total_bytes']}")
+    return 0
+
+
+def _cmd_job(args) -> int:
+    from raytpu.job.sdk import JobSubmissionClient
+
+    import shlex
+
+    client = JobSubmissionClient(args.api)
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(
+            entrypoint=shlex.join(args.entrypoint))
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_finished(job_id)
+            print(status)
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j['job_id']}\t{j['status']}\t{j['entrypoint']}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="raytpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start a head or worker node")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--address", default=None,
+                   help="head address (worker mode)")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=6379)
+    s.add_argument("--job-port", type=int, default=8265)
+    s.add_argument("--num-cpus", type=float, default=None)
+    s.add_argument("--num-tpus", type=int, default=0)
+    s.add_argument("--resources", default="{}")
+    # Servers run on daemon threads: returning would kill them, so the
+    # foreground block is the default (reference ray start daemonizes;
+    # --no-block exists for embedding/tests).
+    s.add_argument("--block", dest="block", action="store_true",
+                   default=True)
+    s.add_argument("--no-block", dest="block", action="store_false")
+    s.set_defaults(fn=_cmd_start)
+
+    s = sub.add_parser("status", help="cluster status")
+    s.add_argument("--address", required=True)
+    s.set_defaults(fn=_cmd_status)
+
+    s = sub.add_parser("timeline", help="dump chrome-trace timeline")
+    s.add_argument("--address", default=None)
+    s.add_argument("--output", default="timeline.json")
+    s.set_defaults(fn=_cmd_timeline)
+
+    s = sub.add_parser("memory", help="object store summary")
+    s.add_argument("--address", default=None)
+    s.set_defaults(fn=_cmd_memory)
+
+    s = sub.add_parser("job", help="job submission")
+    s.add_argument("--api", default="http://127.0.0.1:8265",
+                   help="job REST endpoint")
+    jsub = s.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+    jsub.add_parser("list")
+    s.set_defaults(fn=_cmd_job)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""raytpu.state — cluster introspection (reference: python/ray/util/state/)."""
+
+from raytpu.state.api import (
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    object_summary,
+    summarize_tasks,
+)
+
+__all__ = [
+    "list_actors", "list_nodes", "list_objects", "list_placement_groups",
+    "list_tasks", "object_summary", "summarize_tasks",
+]

@@ -1,0 +1,152 @@
+"""Cluster introspection: the state API.
+
+Reference analogue: ``python/ray/util/state/api.py`` (``ray list actors /
+tasks / objects / nodes / placement-groups`` and summaries) backed by the
+GCS task-event store (``GcsTaskManager``). Ours reads the live backend:
+single-process mode inspects the local scheduler's tables directly;
+cluster mode aggregates the head's directories plus each node's
+``debug_state``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _backend():
+    from raytpu.runtime import api
+
+    if api._backend is None:
+        raise RuntimeError("raytpu is not initialized")
+    return api._backend
+
+
+def _is_cluster(b) -> bool:
+    return type(b).__name__ == "ClusterBackend"
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    import raytpu
+
+    return raytpu.nodes()
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    b = _backend()
+    if _is_cluster(b):
+        out = []
+        for info in b._head.call("list_nodes"):
+            if not info["alive"] or info["labels"].get("role") == "driver":
+                continue
+            try:
+                st = b._peer(info["address"]).call("debug_state")
+            except Exception:
+                continue
+            for aid in st.get("actors", ()):
+                out.append({"actor_id": aid, "node_id": info["node_id"],
+                            "state": "ALIVE"})
+        return out
+    with b._lock:
+        return [
+            {
+                "actor_id": aid.hex(),
+                "name": rt.name,
+                "state": "DEAD" if rt.dead else "ALIVE",
+                "max_concurrency": rt.max_concurrency,
+                "detached": rt.detached,
+                "pending_tasks": rt.queue.qsize(),
+            }
+            for aid, rt in b._actors.items()
+        ]
+
+
+def list_tasks(state: Optional[str] = None) -> List[Dict[str, Any]]:
+    b = _backend()
+    if _is_cluster(b):
+        out = []
+        with b._lock:
+            for rec in b._inflight.values():
+                out.append({"task_id": rec.spec.task_id.hex(),
+                            "name": rec.spec.name,
+                            "state": "RUNNING_OR_PENDING_NODE",
+                            "node_id": rec.node_id})
+            for spec in b._pending:
+                out.append({"task_id": spec.task_id.hex(),
+                            "name": spec.name,
+                            "state": "PENDING_SCHEDULING",
+                            "node_id": None})
+        return [t for t in out if state is None or t["state"] == state]
+    with b._lock:
+        out = [
+            {
+                "task_id": tid.hex(),
+                "name": rec.spec.name,
+                "state": rec.state.upper(),
+                "attempt": rec.spec.attempt,
+                "missing_deps": len(rec.missing_deps),
+            }
+            for tid, rec in b._tasks.items()
+        ]
+        live = {t["task_id"] for t in out}
+        # Finished tasks live on in the event buffer (reference: finished
+        # tasks come from the GcsTaskManager event store, not live tables).
+        latest: Dict[str, dict] = {}
+        for ev in b._task_events:
+            latest[ev["task_id"]] = ev
+        for tid, ev in latest.items():
+            if tid not in live:
+                out.append({
+                    "task_id": tid,
+                    "name": ev.get("name"),
+                    "state": ev.get("state", "finished").upper(),
+                    "attempt": 0,
+                    "missing_deps": 0,
+                })
+    return [t for t in out if state is None or t["state"] == state]
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    b = _backend()
+    store = b.store
+    with store._cv:
+        entries = [
+            {"object_id": oid.hex(), "size_bytes": sv.total_bytes()}
+            for oid, sv in store._objects.items()
+        ]
+    return entries
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    b = _backend()
+    with b._lock:
+        pgs = dict(b._pgs)
+    out = []
+    for pg_id, pg in pgs.items():
+        if isinstance(pg, dict):  # cluster backend caches dicts
+            out.append({"placement_group_id": pg_id.hex(), **{
+                k: v for k, v in pg.items() if k != "bundles"},
+                "bundles": pg["bundles"]})
+        else:
+            out.append({
+                "placement_group_id": pg_id.hex(),
+                "state": pg.state,
+                "strategy": pg.strategy,
+                "bundles": [x.resources.to_dict() for x in pg.bundles
+                            if x is not None],
+            })
+    return out
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for t in list_tasks():
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return counts
+
+
+def object_summary() -> Dict[str, Any]:
+    objs = list_objects()
+    return {
+        "count": len(objs),
+        "total_bytes": sum(o["size_bytes"] for o in objs),
+    }

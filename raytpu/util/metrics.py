@@ -1,0 +1,178 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference analogue: ``python/ray/util/metrics.py:137,262,187`` — the
+user-facing metric API whose samples flow to Prometheus. The reference
+routes through OpenCensus + a per-node metrics agent; we register directly
+with ``prometheus_client`` (in-process registry) and expose the scrape
+endpoint via :func:`start_metrics_server` — one fewer hop, same exposition
+format. Without ``prometheus_client`` installed, metrics degrade to
+in-memory counters (observable via ``.value``/tests, nothing exported).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+try:
+    import prometheus_client as _prom
+except ImportError:  # pragma: no cover - baked into this image
+    _prom = None
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                    5.0, 10.0, 30.0, 60.0)
+_registry_lock = threading.Lock()
+_registered: Dict[str, object] = {}
+
+
+def _sanitize(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        self._name = _sanitize(name)
+        self._description = description
+        self._tag_keys: Tuple[str, ...] = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        self._prom = self._make_prom() if _prom is not None else None
+
+    def _make_prom(self):
+        raise NotImplementedError
+
+    def _signature(self) -> tuple:
+        return (type(self).__name__, self._tag_keys)
+
+    def _get_or_register(self, factory):
+        with _registry_lock:
+            existing = _registered.get(self._name)
+            if existing is not None:
+                prev_sig, collector = existing
+                if prev_sig != self._signature():
+                    raise ValueError(
+                        f"metric {self._name!r} already registered with a "
+                        f"different type/tag_keys: {prev_sig} vs "
+                        f"{self._signature()}")
+                return collector
+            m = factory()
+            _registered[self._name] = (self._signature(), m)
+            return m
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "_Metric":
+        unknown = set(tags) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys: {sorted(unknown)}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        missing = set(self._tag_keys) - set(merged)
+        if missing:
+            raise ValueError(f"missing tag values for {sorted(missing)}")
+        return tuple(merged[k] for k in self._tag_keys)
+
+    @property
+    def info(self) -> dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+
+class Counter(_Metric):
+    """Monotonic counter (reference: ``ray.util.metrics.Counter``)."""
+
+    def _make_prom(self):
+        return self._get_or_register(lambda: _prom.Counter(
+            self._name, self._description or self._name,
+            labelnames=self._tag_keys))
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+        if self._prom is not None:
+            (self._prom.labels(*key) if key else self._prom).inc(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+
+class Gauge(_Metric):
+    """Point-in-time value (reference: ``ray.util.metrics.Gauge``)."""
+
+    def _make_prom(self):
+        return self._get_or_register(lambda: _prom.Gauge(
+            self._name, self._description or self._name,
+            labelnames=self._tag_keys))
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = value
+        if self._prom is not None:
+            (self._prom.labels(*key) if key else self._prom).set(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            vals = list(self._values.values())
+            return vals[-1] if vals else 0.0
+
+
+class Histogram(_Metric):
+    """Bucketed distribution (reference: ``ray.util.metrics.Histogram``)."""
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        self._boundaries = tuple(boundaries or _DEFAULT_BUCKETS)
+        super().__init__(name, description, tag_keys)
+        self._observations: List[float] = []
+
+    def _signature(self) -> tuple:
+        return (type(self).__name__, self._tag_keys, self._boundaries)
+
+    def _make_prom(self):
+        return self._get_or_register(lambda: _prom.Histogram(
+            self._name, self._description or self._name,
+            labelnames=self._tag_keys, buckets=self._boundaries))
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._observations.append(value)
+        if self._prom is not None:
+            (self._prom.labels(*key) if key else self._prom).observe(value)
+
+    @property
+    def observations(self) -> List[float]:
+        with self._lock:
+            return list(self._observations)
+
+
+_server_started = False
+_server_lock = threading.Lock()
+
+
+def start_metrics_server(port: int = 8090) -> bool:
+    """Expose the Prometheus scrape endpoint (reference: per-node metrics
+    agent → Prometheus exposition)."""
+    global _server_started
+    if _prom is None:
+        return False
+    with _server_lock:
+        if _server_started:
+            return True
+        _prom.start_http_server(port)
+        _server_started = True
+        return True

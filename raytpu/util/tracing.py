@@ -1,0 +1,127 @@
+"""Tracing & profiling.
+
+Reference analogue (SURVEY.md §5 tracing): (a) span wrapping of task/actor
+calls (``python/ray/util/tracing/tracing_helper.py:34``, OpenTelemetry);
+(b) chrome-trace timeline from buffered profile events (``ray timeline``,
+``python/ray/_private/state.py:917``); (c) on-demand worker profiling.
+
+TPU-first: device-side profiling is ``jax.profiler`` (XLA traces viewable
+in TensorBoard/Perfetto include per-op HBM/MXU utilization), host-side is
+the task-event timeline the backend already buffers. Both are exposed
+here: ``profile()`` wraps a region with a jax profiler trace; ``timeline``
+dumps chrome-trace JSON of task events.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_spans: List[dict] = []
+_spans_lock = threading.Lock()
+_enabled = False
+
+
+def enable_tracing() -> None:
+    """Turn on span capture for traced functions (reference: tracing
+    startup hook enables the OpenTelemetry proxy)."""
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def get_spans() -> List[dict]:
+    with _spans_lock:
+        return list(_spans)
+
+
+def clear_spans() -> None:
+    with _spans_lock:
+        _spans.clear()
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Record one span (no-op unless tracing is enabled)."""
+    if not _enabled:
+        yield
+        return
+    start = time.time()
+    err = None
+    try:
+        yield
+    except BaseException as e:
+        err = repr(e)
+        raise
+    finally:
+        with _spans_lock:
+            _spans.append({
+                "name": name,
+                "start": start,
+                "duration_s": time.time() - start,
+                "attributes": dict(attributes or {}),
+                "error": err,
+            })
+
+
+def traced(name: Optional[str] = None) -> Callable:
+    """Decorator version of :func:`span`."""
+
+    def wrap(fn: Callable) -> Callable:
+        label = name or getattr(fn, "__qualname__", "fn")
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with span(label):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+@contextlib.contextmanager
+def profile(logdir: str, *, host_tracer_level: int = 2):
+    """XLA device profiling for the enclosed region. Produces a trace
+    viewable in TensorBoard's profiler / Perfetto (per-op timing, HBM
+    pressure, MXU utilization — the TPU analogue of the reference's
+    nsight runtime-env plugin)."""
+    import jax
+
+    jax.profiler.start_trace(logdir, create_perfetto_trace=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace events from the backend's task-event buffer plus any
+    recorded spans (reference: ``ray timeline``)."""
+    import raytpu
+
+    events = raytpu.timeline()
+    trace = list(events) if isinstance(events, list) else []
+    for s in get_spans():
+        trace.append({
+            "name": s["name"],
+            "cat": "span",
+            "ph": "X",
+            "ts": s["start"] * 1e6,
+            "dur": s["duration_s"] * 1e6,
+            "pid": 0,
+            "tid": 0,
+            "args": s["attributes"],
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -226,6 +226,25 @@ class TestClusterPlacementGroups:
         raytpu.remove_placement_group(pg)
 
 
+class TestClusterRuntimeEnv:
+    def test_working_dir_ships_to_nodes(self, driver, tmp_path):
+        """The packaged zip travels driver → executing node's cache."""
+        from raytpu.runtime_env import package_dir
+
+        mod = tmp_path / "shipme"
+        mod.mkdir()
+        (mod / "shipped_mod_rt.py").write_text("WHO = 'remote'\n")
+        uri = package_dir(str(mod))
+
+        @raytpu.remote
+        def use():
+            import shipped_mod_rt
+            return shipped_mod_rt.WHO
+
+        ref = use.options(runtime_env={"working_dir": uri}).remote()
+        assert raytpu.get(ref, timeout=30) == "remote"
+
+
 class TestChaos:
     def test_node_death_task_retry(self):
         """Kill a node mid-task: retriable tasks re-execute elsewhere

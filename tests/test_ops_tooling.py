@@ -1,0 +1,220 @@
+"""Ops tooling tests: metrics, tracing, state API, job submission, CLI.
+
+Reference analogues: python/ray/tests/test_metrics_agent.py,
+dashboard/modules/job/tests, python/ray/tests/test_state_api.py.
+"""
+
+import json
+import sys
+import time
+
+import pytest
+
+import raytpu
+from raytpu.util.metrics import Counter, Gauge, Histogram
+from raytpu.util import tracing
+
+
+class TestMetrics:
+    def test_counter(self):
+        c = Counter("test_requests_total", "desc", tag_keys=("route",))
+        c.inc(tags={"route": "/a"})
+        c.inc(2.0, tags={"route": "/b"})
+        assert c.value == 3.0
+        with pytest.raises(ValueError):
+            c.inc(-1, tags={"route": "/a"})
+
+    def test_counter_missing_tag(self):
+        c = Counter("test_tagged_total", tag_keys=("k",))
+        with pytest.raises(ValueError, match="missing tag"):
+            c.inc()
+
+    def test_gauge_and_default_tags(self):
+        g = Gauge("test_inflight", tag_keys=("shard",))
+        g.set_default_tags({"shard": "0"})
+        g.set(5.0)
+        assert g.value == 5.0
+
+    def test_histogram(self):
+        h = Histogram("test_latency_s", boundaries=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(0.5)
+        assert h.observations == [0.05, 0.5]
+
+
+class TestTracing:
+    def test_spans_captured_when_enabled(self):
+        tracing.clear_spans()
+        tracing.enable_tracing()
+        try:
+            @tracing.traced("myop")
+            def op(x):
+                return x + 1
+
+            assert op(1) == 2
+            with tracing.span("manual", {"k": "v"}):
+                pass
+            spans = tracing.get_spans()
+            assert [s["name"] for s in spans] == ["myop", "manual"]
+            assert spans[1]["attributes"] == {"k": "v"}
+        finally:
+            tracing.disable_tracing()
+            tracing.clear_spans()
+
+    def test_spans_noop_when_disabled(self):
+        tracing.clear_spans()
+        with tracing.span("ignored"):
+            pass
+        assert tracing.get_spans() == []
+
+    def test_span_records_error(self):
+        tracing.clear_spans()
+        tracing.enable_tracing()
+        try:
+            with pytest.raises(ValueError):
+                with tracing.span("failing"):
+                    raise ValueError("x")
+            assert tracing.get_spans()[0]["error"] is not None
+        finally:
+            tracing.disable_tracing()
+            tracing.clear_spans()
+
+    def test_timeline_includes_task_events(self, raytpu_local, tmp_path):
+        @raytpu.remote
+        def f():
+            return 1
+
+        raytpu.get(f.remote())
+        out = str(tmp_path / "tl.json")
+        events = tracing.timeline(out)
+        assert len(events) > 0
+        assert json.load(open(out))
+
+
+class TestStateApi:
+    def test_list_tasks_actors_objects(self, raytpu_local):
+        from raytpu import state
+
+        @raytpu.remote
+        def f(x):
+            return x
+
+        @raytpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.options(name="state-actor").remote()
+        raytpu.get(a.ping.remote())
+        raytpu.get([f.remote(i) for i in range(3)])
+        held = raytpu.put("hello")  # held ref keeps the object in store
+
+        actors = state.list_actors()
+        assert any(x["name"] == "state-actor" for x in actors)
+        tasks = state.list_tasks()
+        assert len(tasks) >= 3
+        assert state.summarize_tasks().get("FINISHED", 0) >= 3
+        objs = state.list_objects()
+        assert state.object_summary()["count"] == len(objs) > 0
+        nodes = state.list_nodes()
+        assert len(nodes) == 1
+        del held
+
+    def test_list_placement_groups(self, raytpu_local):
+        from raytpu import state
+
+        pg = raytpu.placement_group([{"CPU": 1}], strategy="PACK")
+        pgs = state.list_placement_groups()
+        assert any(p["placement_group_id"] == pg.id.hex() for p in pgs)
+        raytpu.remove_placement_group(pg)
+
+
+@pytest.fixture(scope="module")
+def job_server(tmp_path_factory):
+    from raytpu.job import JobManager, JobServer
+
+    mgr = JobManager(log_dir=str(tmp_path_factory.mktemp("job_logs")))
+    srv = JobServer(mgr)
+    addr = srv.start()
+    yield addr, mgr
+    srv.stop()
+
+
+class TestJobSubmission:
+    def test_submit_and_succeed(self, job_server):
+        from raytpu.job import JobSubmissionClient
+
+        addr, _ = job_server
+        client = JobSubmissionClient(addr)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'print(40 + 2)'")
+        assert client.wait_until_finished(job_id, timeout=60) == "SUCCEEDED"
+        assert "42" in client.get_job_logs(job_id)
+        info = client.get_job_info(job_id)
+        assert info["return_code"] == 0
+
+    def test_failed_job(self, job_server):
+        from raytpu.job import JobSubmissionClient
+
+        addr, _ = job_server
+        client = JobSubmissionClient(addr)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+        assert client.wait_until_finished(job_id, timeout=60) == "FAILED"
+        assert client.get_job_info(job_id)["return_code"] == 3
+
+    def test_stop_job(self, job_server):
+        from raytpu.job import JobSubmissionClient
+
+        addr, _ = job_server
+        client = JobSubmissionClient(addr)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+        deadline = time.monotonic() + 30
+        while client.get_job_status(job_id) == "PENDING" and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert client.stop_job(job_id) is True
+        assert client.wait_until_finished(job_id, timeout=30) == "STOPPED"
+
+    def test_env_vars_runtime_env(self, job_server):
+        from raytpu.job import JobSubmissionClient
+
+        addr, _ = job_server
+        client = JobSubmissionClient(addr)
+        job_id = client.submit_job(
+            entrypoint=(f"{sys.executable} -c "
+                        "'import os; print(os.environ[\"MY_FLAG\"])'"),
+            runtime_env={"env_vars": {"MY_FLAG": "xyzzy"}})
+        client.wait_until_finished(job_id, timeout=60)
+        assert "xyzzy" in client.get_job_logs(job_id)
+
+    def test_list_and_404(self, job_server):
+        from raytpu.job import JobSubmissionClient
+
+        addr, _ = job_server
+        client = JobSubmissionClient(addr)
+        assert isinstance(client.list_jobs(), list)
+        with pytest.raises(KeyError):
+            client.get_job_status("nope")
+
+
+class TestCli:
+    def test_job_cli_roundtrip(self, job_server):
+        from raytpu.scripts.cli import main
+
+        addr, _ = job_server
+        rc = main(["job", "--api", addr, "submit", "--wait",
+                   sys.executable, "-c", "print('cli-ok')"])
+        assert rc == 0
+
+    def test_status_cli(self, capsys):
+        from raytpu.cluster.head import HeadServer
+        from raytpu.scripts.cli import main
+
+        head = HeadServer()
+        addr = head.start()
+        rc = main(["status", "--address", addr])
+        assert rc == 0
+        assert "nodes:" in capsys.readouterr().out
+        head.stop()

@@ -1,0 +1,153 @@
+"""Runtime env + perf harness tests (reference:
+python/ray/tests/test_runtime_env*.py)."""
+
+import os
+import sys
+
+import pytest
+
+import raytpu
+from raytpu.runtime_env import package_dir, ensure_uri, validate
+from raytpu.runtime_env.context import RuntimeEnvContext
+
+
+class TestValidation:
+    def test_pip_rejected(self):
+        with pytest.raises(ValueError, match="not supported"):
+            validate({"pip": ["requests"]})
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown"):
+            validate({"bogus": 1})
+
+    def test_invalid_env_fails_task_cleanly(self, raytpu_local):
+        @raytpu.remote
+        def f():
+            return 1
+
+        ref = f.options(runtime_env={"conda": "env"}).remote()
+        with pytest.raises(raytpu.TaskError, match="not supported"):
+            raytpu.get(ref)
+
+
+class TestEnvVars:
+    def test_env_vars_applied_during_task(self, raytpu_local):
+        @raytpu.remote
+        def read_env():
+            return os.environ.get("RT_TEST_FLAG")
+
+        ref = read_env.options(
+            runtime_env={"env_vars": {"RT_TEST_FLAG": "on"}}).remote()
+        assert raytpu.get(ref) == "on"
+        # Restored afterwards.
+        assert "RT_TEST_FLAG" not in os.environ
+
+    def test_env_vars_on_actor_init(self, raytpu_local):
+        @raytpu.remote
+        class EnvActor:
+            def __init__(self):
+                self.flag = os.environ.get("RT_ACTOR_FLAG")
+
+            def get(self):
+                return self.flag
+
+        a = EnvActor.options(
+            runtime_env={"env_vars": {"RT_ACTOR_FLAG": "yes"}}).remote()
+        assert raytpu.get(a.get.remote()) == "yes"
+
+    def test_refcounted_restore(self):
+        os.environ["RT_SHARED"] = "orig"
+        try:
+            c1 = RuntimeEnvContext({"env_vars": {"RT_SHARED": "new"}})
+            c2 = RuntimeEnvContext({"env_vars": {"RT_SHARED": "new"}})
+            c1.__enter__()
+            c2.__enter__()
+            assert os.environ["RT_SHARED"] == "new"
+            c1.__exit__(None, None, None)
+            assert os.environ["RT_SHARED"] == "new"  # c2 still holds it
+            c2.__exit__(None, None, None)
+            assert os.environ["RT_SHARED"] == "orig"
+        finally:
+            os.environ.pop("RT_SHARED", None)
+
+
+class TestActorLifetimeEnv:
+    def test_env_vars_cover_method_calls(self, raytpu_local):
+        """Regression: an actor's runtime_env applies to every method
+        call, not only __init__ (reference lifetime semantics)."""
+        @raytpu.remote
+        class EnvActor:
+            def read(self):
+                return os.environ.get("RT_LIFETIME_FLAG")
+
+        a = EnvActor.options(
+            runtime_env={"env_vars": {"RT_LIFETIME_FLAG": "live"}}).remote()
+        assert raytpu.get(a.read.remote()) == "live"
+        assert "RT_LIFETIME_FLAG" not in os.environ
+
+    def test_rollback_on_partial_enter(self):
+        """Regression: a failing working_dir must roll back env_vars."""
+        ctx = RuntimeEnvContext({
+            "env_vars": {"RT_ROLLBACK": "x"},
+            "working_dir": "zip://doesnotexist0000",
+        })
+        with pytest.raises(FileNotFoundError):
+            ctx.__enter__()
+        assert "RT_ROLLBACK" not in os.environ
+
+    def test_concurrent_shared_path_refcount(self, tmp_path):
+        d = tmp_path / "shared"
+        d.mkdir()
+        (d / "z.txt").write_text("z")
+        uri = package_dir(str(d))
+        c1 = RuntimeEnvContext({"working_dir": uri})
+        c2 = RuntimeEnvContext({"working_dir": uri})
+        c1.__enter__()
+        c2.__enter__()
+        target = ensure_uri(uri)
+        assert target in sys.path
+        c1.__exit__(None, None, None)
+        assert target in sys.path  # c2 still holds it
+        c2.__exit__(None, None, None)
+        assert target not in sys.path
+
+
+class TestWorkingDir:
+    def test_package_and_import(self, raytpu_local, tmp_path):
+        mod_dir = tmp_path / "proj"
+        mod_dir.mkdir()
+        (mod_dir / "mymodule_rt_test.py").write_text("VALUE = 1234\n")
+        uri = package_dir(str(mod_dir))
+        assert uri.startswith("zip://")
+        # Deterministic URI (content-hashed).
+        assert package_dir(str(mod_dir)) == uri
+
+        @raytpu.remote
+        def use_module():
+            import mymodule_rt_test
+            return mymodule_rt_test.VALUE
+
+        ref = use_module.options(
+            runtime_env={"working_dir": uri}).remote()
+        assert raytpu.get(ref) == 1234
+        sys.modules.pop("mymodule_rt_test", None)
+
+    def test_ensure_uri_cached(self, tmp_path):
+        d = tmp_path / "p2"
+        d.mkdir()
+        (d / "f.txt").write_text("data")
+        uri = package_dir(str(d))
+        p1 = ensure_uri(uri)
+        p2 = ensure_uri(uri)
+        assert p1 == p2
+        assert open(os.path.join(p1, "f.txt")).read() == "data"
+
+
+class TestPerfHarness:
+    def test_perf_suite_runs(self):
+        from raytpu.perf import run_all
+
+        results = run_all(duration_s=0.05)
+        names = [r["name"] for r in results]
+        assert "single client task sync" in names
+        assert all(r["ops_per_s"] > 0 for r in results)
