@@ -87,6 +87,20 @@ class TpuTopology:
 
     shape: Tuple[int, ...]
     _occupied: set = field(default_factory=set)
+    _native: object = field(default=None, repr=False)
+
+    def __post_init__(self):
+        # Native C++ allocator (src/sched/sched_core.cc) when built: the
+        # contiguous-box search is the scheduler's hot combinatorial loop
+        # at pod scale. Pure-Python fallback keeps identical semantics.
+        try:
+            from raytpu.core.sched_native import NativeTopology, available
+
+            if available():
+                object.__setattr__(self, "_native",
+                                   NativeTopology(self.shape))
+        except Exception:
+            pass
 
     @property
     def num_chips(self) -> int:
@@ -94,6 +108,8 @@ class TpuTopology:
 
     @property
     def num_free(self) -> int:
+        if self._native is not None:
+            return self._native.num_free
         return self.num_chips - len(self._occupied)
 
     def _coords(self):
@@ -108,6 +124,8 @@ class TpuTopology:
         """
         if chips <= 0 or chips > self.num_free:
             return None
+        if self._native is not None:
+            return self._native.allocate_subcube(chips)
         for dims in self._box_shapes(chips):
             claimed = self._find_free_box(dims)
             if claimed is not None:
@@ -117,6 +135,10 @@ class TpuTopology:
 
     def allocate_any(self, chips: int) -> Optional[List[Tuple[int, ...]]]:
         """Claim `chips` free coordinates, contiguous if possible."""
+        if self._native is not None:
+            if chips <= 0 or chips > self.num_free:
+                return None
+            return self._native.allocate_any(chips)
         got = self.allocate_subcube(chips)
         if got is not None:
             return got
@@ -128,6 +150,9 @@ class TpuTopology:
         return chosen
 
     def release(self, coords: Sequence[Tuple[int, ...]]) -> None:
+        if self._native is not None:
+            self._native.release(coords)
+            return
         for c in coords:
             self._occupied.discard(c)
 
@@ -151,7 +176,9 @@ class TpuTopology:
                 d += 1
 
         rec(chips, [])
-        return sorted(shapes, key=lambda s: (max(s), sum(s)))
+        # Full deterministic order (max-dim, sum, lexicographic) — matches
+        # the native core so both paths claim identical boxes.
+        return sorted(shapes, key=lambda s: (max(s), sum(s), s))
 
     def _find_free_box(self, dims: Tuple[int, ...]) -> Optional[List[Tuple[int, ...]]]:
         for origin in itertools.product(

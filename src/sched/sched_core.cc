@@ -1,0 +1,288 @@
+// Native scheduler core: ICI topology allocation + node scoring.
+//
+// Reference analogue: the C++ scheduling substrate in
+// src/ray/common/scheduling/ (ResourceSet/FixedPoint arithmetic,
+// cluster_resource_scheduler.cc node scoring) and the bundle packing
+// policies (src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h).
+// TPU-first difference: the hot combinatorial problem here is CONTIGUOUS
+// SUB-BOX search on an ICI mesh (STRICT_PACK bundles must form an
+// ICI-connected box so in-program collectives never leave ICI) — a
+// constraint NCCL-land never had, and one that's O(shapes x origins x
+// volume) per allocation. At pod scale (v4-4096: 16x16x16) the pure-Python
+// scan is milliseconds-to-seconds; this native core keeps it microseconds.
+//
+// Flat C ABI for ctypes (no pybind11 in this image). Semantics mirror
+// raytpu/core/topology.py exactly: most-compact factorization first
+// (min max-dim, then min sum), row-major origin scan, first fit.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <set>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxDim = 8;
+
+struct Topo {
+  int ndim = 0;
+  int shape[kMaxDim] = {0};
+  int strides[kMaxDim] = {0};
+  int64_t volume = 0;
+  int64_t free_count = 0;
+  std::vector<uint8_t> occupied;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Topo*> g_topos;
+int64_t g_next_id = 1;
+
+int64_t FlatIndex(const Topo& t, const int* coord) {
+  int64_t idx = 0;
+  for (int i = 0; i < t.ndim; i++) idx += int64_t(coord[i]) * t.strides[i];
+  return idx;
+}
+
+// All axis-aligned box shapes with the given volume that fit, most compact
+// first (min max-dim, then min sum) — matches TpuTopology._box_shapes.
+void BoxShapes(const Topo& t, int64_t chips,
+               std::vector<std::vector<int>>* out) {
+  std::set<std::vector<int>> shapes;
+  std::vector<int> dims;
+  // recursive factorization without recursion: explicit stack
+  struct Frame { int64_t remaining; std::vector<int> dims; };
+  std::vector<Frame> stack;
+  stack.push_back({chips, {}});
+  while (!stack.empty()) {
+    Frame f = std::move(stack.back());
+    stack.pop_back();
+    int axis = int(f.dims.size());
+    if (axis == t.ndim - 1) {
+      if (f.remaining <= t.shape[t.ndim - 1]) {
+        std::vector<int> s = f.dims;
+        s.push_back(int(f.remaining));
+        shapes.insert(std::move(s));
+      }
+      continue;
+    }
+    int64_t cap = std::min<int64_t>(f.remaining, t.shape[axis]);
+    for (int64_t d = 1; d <= cap; d++) {
+      if (f.remaining % d == 0) {
+        std::vector<int> nd = f.dims;
+        nd.push_back(int(d));
+        stack.push_back({f.remaining / d, std::move(nd)});
+      }
+    }
+  }
+  out->assign(shapes.begin(), shapes.end());
+  std::sort(out->begin(), out->end(),
+            [](const std::vector<int>& a, const std::vector<int>& b) {
+              int ma = *std::max_element(a.begin(), a.end());
+              int mb = *std::max_element(b.begin(), b.end());
+              if (ma != mb) return ma < mb;
+              int sa = 0, sb = 0;
+              for (int x : a) sa += x;
+              for (int x : b) sb += x;
+              if (sa != sb) return sa < sb;
+              return a < b;
+            });
+}
+
+// Scan origins row-major; on first fully-free box, claim it and write the
+// claimed coordinates (row-major within the box) into out_coords.
+bool FindAndClaimBox(Topo& t, const std::vector<int>& dims,
+                     int* out_coords) {
+  int ndim = t.ndim;
+  int origin[kMaxDim] = {0};
+  int limit[kMaxDim];
+  for (int i = 0; i < ndim; i++) {
+    limit[i] = t.shape[i] - dims[i] + 1;
+    if (limit[i] <= 0) return false;
+  }
+  while (true) {
+    // Check the box at `origin`.
+    bool ok = true;
+    int off[kMaxDim] = {0};
+    int coord[kMaxDim];
+    while (ok) {
+      for (int i = 0; i < ndim; i++) coord[i] = origin[i] + off[i];
+      if (t.occupied[FlatIndex(t, coord)]) { ok = false; break; }
+      // advance off (row-major, last axis fastest)
+      int ax = ndim - 1;
+      while (ax >= 0) {
+        if (++off[ax] < dims[ax]) break;
+        off[ax] = 0;
+        ax--;
+      }
+      if (ax < 0) break;  // visited every cell — all free
+    }
+    if (ok) {
+      // Claim + emit coordinates in row-major box order.
+      int n = 0;
+      std::memset(off, 0, sizeof(off));
+      while (true) {
+        for (int i = 0; i < ndim; i++) {
+          coord[i] = origin[i] + off[i];
+          out_coords[n * ndim + i] = coord[i];
+        }
+        t.occupied[FlatIndex(t, coord)] = 1;
+        n++;
+        int ax = ndim - 1;
+        while (ax >= 0) {
+          if (++off[ax] < dims[ax]) break;
+          off[ax] = 0;
+          ax--;
+        }
+        if (ax < 0) break;
+      }
+      t.free_count -= n;
+      return true;
+    }
+    // advance origin (row-major)
+    int ax = ndim - 1;
+    while (ax >= 0) {
+      if (++origin[ax] < limit[ax]) break;
+      origin[ax] = 0;
+      ax--;
+    }
+    if (ax < 0) return false;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t topo_create(const int* shape, int ndim) {
+  if (ndim < 1 || ndim > kMaxDim) return -1;
+  auto* t = new Topo();
+  t->ndim = ndim;
+  t->volume = 1;
+  for (int i = 0; i < ndim; i++) {
+    if (shape[i] < 1) { delete t; return -1; }
+    t->shape[i] = shape[i];
+    t->volume *= shape[i];
+  }
+  int64_t stride = 1;
+  for (int i = ndim - 1; i >= 0; i--) {
+    t->strides[i] = int(stride);
+    stride *= t->shape[i];
+  }
+  t->free_count = t->volume;
+  t->occupied.assign(size_t(t->volume), 0);
+  std::lock_guard<std::mutex> lock(g_mu);
+  int64_t id = g_next_id++;
+  g_topos[id] = t;
+  return id;
+}
+
+void topo_destroy(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_topos.find(id);
+  if (it != g_topos.end()) {
+    delete it->second;
+    g_topos.erase(it);
+  }
+}
+
+int64_t topo_num_free(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_topos.find(id);
+  return it == g_topos.end() ? -1 : it->second->free_count;
+}
+
+// Allocate a contiguous box of `chips`. out_coords must hold chips*ndim
+// ints. Returns chips on success, 0 if no contiguous box fits, -1 error.
+int64_t topo_alloc_subcube(int64_t id, int64_t chips, int* out_coords) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_topos.find(id);
+  if (it == g_topos.end() || chips <= 0) return -1;
+  Topo& t = *it->second;
+  if (chips > t.free_count) return 0;
+  std::vector<std::vector<int>> shapes;
+  BoxShapes(t, chips, &shapes);
+  for (const auto& dims : shapes) {
+    if (FindAndClaimBox(t, dims, out_coords)) return chips;
+  }
+  return 0;
+}
+
+// Contiguous if possible, else any free chips (row-major order).
+int64_t topo_alloc_any(int64_t id, int64_t chips, int* out_coords) {
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_topos.find(id);
+    if (it == g_topos.end() || chips <= 0) return -1;
+    if (chips > it->second->free_count) return 0;
+  }
+  int64_t got = topo_alloc_subcube(id, chips, out_coords);
+  if (got > 0) return got;
+  std::lock_guard<std::mutex> lock(g_mu);
+  Topo& t = *g_topos[id];
+  int64_t n = 0;
+  int coord[kMaxDim] = {0};
+  for (int64_t flat = 0; flat < t.volume && n < chips; flat++) {
+    if (!t.occupied[flat]) {
+      int64_t rem = flat;
+      for (int i = 0; i < t.ndim; i++) {
+        coord[i] = int(rem / t.strides[i]);
+        rem %= t.strides[i];
+      }
+      for (int i = 0; i < t.ndim; i++) out_coords[n * t.ndim + i] = coord[i];
+      t.occupied[flat] = 1;
+      n++;
+    }
+  }
+  t.free_count -= n;
+  return n;
+}
+
+void topo_release(int64_t id, const int* coords, int64_t n) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_topos.find(id);
+  if (it == g_topos.end()) return;
+  Topo& t = *it->second;
+  for (int64_t k = 0; k < n; k++) {
+    int64_t idx = FlatIndex(t, coords + k * t.ndim);
+    if (idx >= 0 && idx < t.volume && t.occupied[size_t(idx)]) {
+      t.occupied[size_t(idx)] = 0;
+      t.free_count++;
+    }
+  }
+}
+
+// Hybrid pack/spread node scoring in one pass (reference:
+// hybrid_scheduling_policy.h:50). avail/total: n_nodes x n_res row-major.
+// Returns best node index or -1 if none feasible. Utilization = max over
+// resources of used/total; pack onto the most-utilized feasible node
+// until it crosses spread_threshold, then pick the least-utilized.
+int64_t score_nodes(const double* avail, const double* total,
+                    int64_t n_nodes, int64_t n_res, const double* request,
+                    double spread_threshold) {
+  constexpr double kEps = 1e-9;
+  int64_t best_pack = -1, best_spread = -1;
+  double best_pack_util = -1.0, best_spread_util = 2.0;
+  for (int64_t n = 0; n < n_nodes; n++) {
+    const double* a = avail + n * n_res;
+    const double* tt = total + n * n_res;
+    bool feasible = true;
+    double util = 0.0;
+    for (int64_t r = 0; r < n_res; r++) {
+      if (a[r] + kEps < request[r]) { feasible = false; break; }
+      if (tt[r] > 0) {
+        double u = (tt[r] - a[r]) / tt[r];
+        if (u > util) util = u;
+      }
+    }
+    if (!feasible) continue;
+    if (util > best_pack_util) { best_pack_util = util; best_pack = n; }
+    if (util < best_spread_util) { best_spread_util = util; best_spread = n; }
+  }
+  if (best_pack < 0) return -1;
+  return best_pack_util < spread_threshold ? best_pack : best_spread;
+}
+
+}  // extern "C"

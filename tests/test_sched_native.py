@@ -1,0 +1,101 @@
+"""Native scheduler core tests: C++ vs pure-Python semantic parity.
+
+Reference analogue: gtest coverage of the scheduling substrate
+(src/ray/common/scheduling/ tests, hybrid_scheduling_policy_test.cc).
+"""
+
+import time
+
+import pytest
+
+from raytpu.core.sched_native import NativeTopology, available, score_nodes
+from raytpu.core.topology import TpuTopology
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="libschedcore.so not built")
+
+
+def make_python_topo(shape):
+    t = TpuTopology(shape=shape)
+    object.__setattr__(t, "_native", None)  # force the pure-Python path
+    return t
+
+
+class TestNativeTopology:
+    def test_subcube_is_contiguous_box(self):
+        t = NativeTopology((4, 4, 4))
+        got = t.allocate_subcube(8)
+        assert got is not None and len(got) == 8
+        # 8 chips in a 2x2x2 box: every axis spans at most 2.
+        for ax in range(3):
+            vals = {c[ax] for c in got}
+            assert max(vals) - min(vals) <= 1
+        assert t.num_free == 64 - 8
+
+    def test_matches_python_semantics(self):
+        """Same alloc sequence → same coordinates as the Python model."""
+        shape = (2, 2, 4)
+        nat, py = NativeTopology(shape), make_python_topo(shape)
+        for chips in (4, 2, 8, 1):
+            a, b = nat.allocate_subcube(chips), py.allocate_subcube(chips)
+            assert (a is None) == (b is None), chips
+            if a is not None:
+                assert sorted(a) == sorted(b), chips
+
+    def test_exhaustion_and_release(self):
+        t = NativeTopology((2, 2))
+        first = t.allocate_subcube(4)
+        assert len(first) == 4
+        assert t.allocate_subcube(1) is None
+        t.release(first[:2])
+        assert t.num_free == 2
+        assert t.allocate_any(2) is not None
+
+    def test_fragmented_falls_back_to_any(self):
+        t = NativeTopology((1, 4))
+        a = t.allocate_any(1)       # (0,0)
+        b = t.allocate_any(1)       # (0,1)
+        t.release(a)                # free: (0,0),(0,2),(0,3) — no 3-box
+        del b
+        got = t.allocate_any(3)
+        assert got is not None and len(got) == 3
+        assert t.allocate_subcube(1) is None  # fully occupied
+
+    def test_large_pod_scale_fast(self):
+        """v4-4096-scale box allocs stay fast (the native core's point)."""
+        t = NativeTopology((16, 16, 16))
+        start = time.perf_counter()
+        blocks = [t.allocate_subcube(64) for _ in range(32)]
+        elapsed = time.perf_counter() - start
+        assert all(b is not None for b in blocks)
+        assert t.num_free == 16 ** 3 - 32 * 64
+        assert elapsed < 2.0, f"native alloc too slow: {elapsed:.2f}s"
+
+
+class TestTopologyIntegration:
+    def test_tpu_topology_uses_native(self):
+        t = TpuTopology(shape=(4, 4))
+        assert t._native is not None
+        got = t.allocate_subcube(4)
+        assert got is not None and len(got) == 4
+        assert t.num_free == 12
+        t.release(got)
+        assert t.num_free == 16
+
+
+class TestScoreNodes:
+    def test_pack_until_threshold_then_spread(self):
+        total = [[10.0], [10.0]]
+        # node0 at 40% util, node1 empty: pack onto node0.
+        assert score_nodes([[6.0], [10.0]], total, [1.0], 0.5) == 0
+        # node0 at 80%: spread to node1.
+        assert score_nodes([[2.0], [10.0]], total, [1.0], 0.5) == 1
+
+    def test_infeasible(self):
+        assert score_nodes([[1.0]], [[4.0]], [2.0]) == -1
+
+    def test_multi_resource_feasibility(self):
+        avail = [[4.0, 0.0], [4.0, 8.0]]
+        total = [[4.0, 8.0], [4.0, 8.0]]
+        # Needs TPU: only node1 feasible.
+        assert score_nodes(avail, total, [1.0, 1.0], 0.5) == 1
