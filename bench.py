@@ -80,15 +80,26 @@ def main() -> None:
 
     # Warmup (compile).
     params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    _host_sync(np, loss)
     params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    _host_sync(np, loss)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    # Timed region. `jax.block_until_ready` proved unreliable on the
+    # experimental axon platform (round-1 bench reported 204x device peak
+    # FLOPs — physically impossible), so the clock stops on a *host fetch*
+    # of the final loss: it transitively depends on every step through the
+    # donated params chain, and a device->host copy cannot complete before
+    # the computation has. Steps double until wall time >= min_wall.
+    min_wall = 0.5 if smoke else 2.0
+    while True:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        loss_host = _host_sync(np, loss)
+        dt = time.perf_counter() - t0
+        if dt >= min_wall:
+            break
+        steps *= 2
 
     tokens_per_step = batch * cfg.block_size
     tokens_per_sec = tokens_per_step * steps / dt
@@ -97,6 +108,16 @@ def main() -> None:
     flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * \
         cfg.block_size
     a100_parity = 0.40 * 312e12 / flops_per_token
+    mfu = _mfu(tokens_per_sec, flops_per_token, dev)
+
+    if on_accel and mfu > 1.0:
+        print(json.dumps({
+            "metric": "gpt2_train_tokens_per_sec_per_chip",
+            "error": f"computed MFU {mfu} > 1.0 is physically impossible: "
+                     f"timing did not synchronize with the device",
+            "value": None,
+        }))
+        sys.exit(1)
 
     print(json.dumps({
         "metric": "gpt2_train_tokens_per_sec_per_chip",
@@ -108,12 +129,18 @@ def main() -> None:
             "batch": batch,
             "seq": cfg.block_size,
             "steps": steps,
+            "wall_s": round(dt, 3),
             "attn": attn_impl or "flash-auto",
             "device": str(dev),
-            "loss": float(jax.device_get(loss)),
-            "mfu_vs_device_peak": _mfu(tokens_per_sec, flops_per_token, dev),
+            "loss": float(loss_host),
+            "mfu_vs_device_peak": mfu,
         },
     }))
+
+
+def _host_sync(np, x):
+    """Force a real device sync by fetching ``x`` to host memory."""
+    return np.asarray(x)
 
 
 def _probe_pallas(jnp) -> str:

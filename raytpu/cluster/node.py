@@ -16,6 +16,7 @@ into the local store, which wakes the dependency manager.
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -23,13 +24,185 @@ from typing import Dict, List, Optional, Tuple
 import cloudpickle
 
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
-from raytpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+from raytpu.core.config import cfg
+from raytpu.core.errors import ActorDiedError, TaskError, WorkerCrashedError
+from raytpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from raytpu.runtime.local_backend import LocalBackend, _Bundle, _PlacementGroup
 from raytpu.runtime.serialization import SerializedValue
-from raytpu.runtime.task_spec import TaskSpec
+from raytpu.runtime.task_spec import SchedulingKind, TaskSpec
 from raytpu.core.resources import ResourceSet
 
 HEARTBEAT_PERIOD_S = 1.0
+
+
+class _ProcActorRuntime:
+    """An actor hosted in a dedicated worker subprocess.
+
+    Daemon-side twin of the in-process ``_ActorRuntime`` (same surface:
+    ``start/submit/kill/ready_event/dead/...``): it leases a dedicated
+    worker (with the actor's chips bound at spawn), forwards creation and
+    method tasks over RPC preserving submission order, and observes worker
+    death as actor death. Reference: GCS-scheduled actor on a leased
+    worker (``gcs_actor_scheduler``), ordered submit queues
+    (``transport/actor_scheduling_queue.cc``).
+    """
+
+    def __init__(self, backend: "NodeBackend", spec: TaskSpec):
+        ac = spec.actor_creation
+        self.backend = backend
+        self.creation_spec = spec
+        self.actor_id = ac.actor_id
+        self.max_concurrency = max(1, ac.max_concurrency)
+        self.is_async = ac.is_async
+        self.name = ac.name
+        self.namespace = ac.namespace
+        self.detached = ac.lifetime_detached
+        self.queue: "queue_mod.Queue" = queue_mod.Queue()
+        self.state_lock = threading.Lock()
+        self.dead = False
+        self.death_reason = ""
+        self.ready_event = threading.Event()
+        self.creation_error: Optional[BaseException] = None
+        self.num_handles = 0
+        self.resources = ResourceSet(spec.resources)
+        self.alloc_target = None
+        self.handle = None
+        self._own_coords: List[Tuple[int, ...]] = []
+        self.thread = threading.Thread(
+            target=self._run, name=f"actor-{self.actor_id.hex()[:8]}",
+            daemon=True)
+
+    def start(self):
+        self.thread.start()
+
+    def submit(self, spec: TaskSpec):
+        with self.state_lock:
+            if not self.dead:
+                self.queue.put(spec)
+                return
+            reason = self.death_reason
+        self.backend._fail_spec(
+            spec, ActorDiedError(self.actor_id.hex(), reason))
+
+    def kill(self, reason: str = "killed via raytpu.kill"):
+        if self.dead:
+            return
+        self.queue.put(("__kill__", reason))
+
+    # -- internals ---------------------------------------------------------
+
+    def _run(self):
+        b = self.backend
+        spec = self.creation_spec
+        chips, self._own_coords = b._chips_for_spec(spec, self.resources)
+        try:
+            self.handle = b.worker_pool.lease(
+                spec.job_id, spec.runtime_env, chips, dedicated=True)
+        except Exception as e:  # spawn/registration failure
+            self._creation_failed(TaskError.from_exception(spec.name, e))
+            return
+        self.handle.on_death = self._on_worker_death
+        try:
+            reply = self.handle.client.call(
+                "create_actor", cloudpickle.dumps(spec), timeout=None)
+        except Exception as e:
+            b.worker_pool.kill(self.handle, "actor creation RPC failed")
+            self._creation_failed(WorkerCrashedError(
+                f"worker died during actor creation: {e}"))
+            return
+        b._ingest_results(reply["results"])
+        if reply["error"] is not None:
+            err = cloudpickle.loads(reply["error"])
+            self.creation_error = err
+            self._die(f"creation failed: {err}")
+            self.ready_event.set()
+            return
+        self.ready_event.set()
+        if self.max_concurrency > 1:
+            self._pump_concurrent()
+        else:
+            self._pump_sequential()
+
+    def _creation_failed(self, err: BaseException):
+        self.creation_error = err
+        self.backend.worker._store_error(
+            self.creation_spec.return_ids(), self.creation_spec, err)
+        self._die(str(err))
+        self.ready_event.set()
+
+    def _dispatch_one(self, spec: TaskSpec):
+        try:
+            reply = self.handle.client.call(
+                "actor_task", cloudpickle.dumps(spec), timeout=None)
+        except Exception as e:
+            self.backend._fail_spec(spec, ActorDiedError(
+                self.actor_id.hex(), f"worker crashed: {e}"))
+            # Broken RPC with a possibly-alive process: terminate it so it
+            # cannot keep its chip binding as an orphan.
+            self.queue.put(("__kill__", f"worker RPC failed: {e}"))
+            return
+        self.backend._ingest_results(reply["results"])
+        self.backend._task_finished(spec)
+
+    def _pump_sequential(self):
+        while True:
+            item = self.queue.get()
+            if isinstance(item, tuple) and item[0] == "__kill__":
+                self._shutdown_worker(item[1])
+                return
+            self._dispatch_one(item)
+
+    def _pump_concurrent(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        # Daemon-side dispatch threads just wait on RPC replies; cap them
+        # (async default max_concurrency is 1000 — worker-side concurrency
+        # is real, daemon-side threads need not match 1:1).
+        pool = ThreadPoolExecutor(
+            max_workers=min(self.max_concurrency, 128))
+        while True:
+            item = self.queue.get()
+            if isinstance(item, tuple) and item[0] == "__kill__":
+                pool.shutdown(wait=False)
+                self._shutdown_worker(item[1])
+                return
+            pool.submit(self._dispatch_one, item)
+
+    def _shutdown_worker(self, reason: str):
+        h = self.handle
+        if h is not None:
+            h.on_death = None  # expected death
+            self.backend.worker_pool.kill(h, reason)
+        self._die(reason)
+
+    def _on_worker_death(self, reason: str):
+        self.queue.put(("__kill__", f"worker died: {reason}"))
+        # The pump may itself be blocked mid-RPC; that call raises on the
+        # closed connection and its spec fails there.
+
+    def _die(self, reason: str):
+        with self.state_lock:
+            if self.dead:
+                return
+            self.dead = True
+            self.death_reason = reason
+            drained = []
+            while True:
+                try:
+                    drained.append(self.queue.get_nowait())
+                except queue_mod.Empty:
+                    break
+        for item in drained:
+            if isinstance(item, TaskSpec):
+                self.backend._fail_spec(
+                    item, ActorDiedError(self.actor_id.hex(), reason))
+        if self._own_coords and self.backend.topology is not None:
+            try:
+                with self.backend._lock:
+                    self.backend.topology.release(self._own_coords)
+            except Exception:
+                pass
+        self.backend._actor_died(self)
 
 
 class NodeBackend(LocalBackend):
@@ -42,6 +215,11 @@ class NodeBackend(LocalBackend):
         self.worker.pin_owned = True
         self.on_object_local = None   # cb(oid) -> None (report location)
         self.on_actor_dead = None     # cb(actor_id, reason)
+        # Worker-process pool (attached by NodeServer after its RPC server
+        # is up); None = in-daemon thread execution (round-1 behavior,
+        # still used by serve-only driver nodes).
+        self.worker_pool = None
+        self._task_worker: Dict[TaskID, object] = {}  # running task -> handle
         chained = self.store.on_put
 
         def _on_put(oid):
@@ -59,6 +237,112 @@ class NodeBackend(LocalBackend):
                 self.on_actor_dead(runtime.actor_id, runtime.death_reason)
             except Exception:
                 pass
+
+    # -- worker-process execution ------------------------------------------
+
+    def _chips_for_spec(self, spec: TaskSpec, required: ResourceSet):
+        """Chip ids for a spec's worker env. PG tasks use their bundle's
+        pre-assigned coords; plain TPU tasks allocate fresh coords that the
+        caller must release. Returns ``(chip_ids, coords_to_release)``."""
+        from raytpu.core.resources import TPU
+
+        nchips = int(required.get(TPU))
+        if not nchips or self.topology is None:
+            return (), []
+        if spec.scheduling.kind == SchedulingKind.PLACEMENT_GROUP:
+            try:
+                with self._lock:
+                    bundle = self._bundle_for(spec)
+            except Exception:
+                bundle = None
+            if bundle is not None and bundle.chip_coords:
+                return self.topology.chip_ids(bundle.chip_coords), []
+        with self._lock:
+            coords = self.topology.allocate_any(nchips)
+        if coords is None:
+            # Ledger admitted the task but coords are claimed (should not
+            # happen now that blocked tasks keep their chips) — fail loud
+            # rather than hand the worker an unrestricted chip view.
+            raise WorkerCrashedError(
+                f"no free chip coordinates for {nchips} TPU(s)")
+        return self.topology.chip_ids(coords), coords
+
+    def _ingest_results(self, results) -> None:
+        """Land a worker reply's return values in the daemon store. ``blob
+        is None`` = already sealed in shared memory — just fire the put
+        hook (dependency wakeup + head location report)."""
+        for oid_bin, blob in results:
+            oid = ObjectID(oid_bin)
+            if blob is None:
+                if self.store.on_put is not None:
+                    self.store.on_put(oid)
+            else:
+                self.store.put(oid, SerializedValue.from_buffer(blob))
+
+    def _execute_plain(self, rec):
+        if self.worker_pool is None:
+            return super()._execute_plain(rec)
+        spec = rec.spec
+        try:
+            chips, own_coords = self._chips_for_spec(spec, rec.required)
+        except WorkerCrashedError as e:
+            return e
+        try:
+            handle = self.worker_pool.lease(
+                spec.job_id, spec.runtime_env, chips)
+        except Exception as e:
+            if own_coords:
+                with self._lock:
+                    self.topology.release(own_coords)
+            return e if isinstance(e, WorkerCrashedError) else \
+                WorkerCrashedError(f"worker lease failed: {e}")
+        with self._lock:
+            self._task_worker[spec.task_id] = handle
+        try:
+            reply = handle.client.call(
+                "execute", cloudpickle.dumps(spec), timeout=None)
+        except Exception as e:
+            # Kill NOW: marks the handle dead (a stale handle must never
+            # return to the idle pool) AND terminates the process if it is
+            # somehow still alive — an orphan would keep its chip binding
+            # while the coords are handed to the next worker.
+            self.worker_pool.kill(handle, f"task RPC failed: {e}")
+            return WorkerCrashedError(f"worker died during task: {e}")
+        finally:
+            with self._lock:
+                self._task_worker.pop(spec.task_id, None)
+            handle.blocked = False
+            self.worker_pool.release(handle)
+            if own_coords:
+                with self._lock:
+                    self.topology.release(own_coords)
+        self._ingest_results(reply["results"])
+        if reply["error"] is not None:
+            return cloudpickle.loads(reply["error"])
+        return None
+
+    def _make_actor_runtime(self, spec: TaskSpec):
+        if self.worker_pool is None:
+            return super()._make_actor_runtime(spec)
+        return _ProcActorRuntime(self, spec)
+
+    def task_blocked(self, task_id: TaskID) -> None:
+        super().task_blocked(task_id)
+        with self._lock:
+            handle = self._task_worker.get(task_id)
+        if handle is not None:
+            # Blocked workers leave the pool soft cap so nested tasks can
+            # always get a worker (reference: blocked-worker accounting).
+            handle.blocked = True
+            with self.worker_pool._cv:
+                self.worker_pool._cv.notify_all()
+
+    def task_unblocked(self, task_id: TaskID) -> None:
+        super().task_unblocked(task_id)
+        with self._lock:
+            handle = self._task_worker.get(task_id)
+        if handle is not None:
+            handle.blocked = False
 
     def register_pg_shard(self, pg_id: PlacementGroupID,
                           indexed_bundles: List[Tuple[int, Dict[str, float]]],
@@ -102,7 +386,10 @@ class NodeServer:
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
                  host: str = "127.0.0.1",
-                 serve_only: bool = False):
+                 serve_only: bool = False,
+                 worker_processes: Optional[bool] = None):
+        import os as _os
+
         self.node_id = NodeID.from_random()
         self.head_address = head_address
         self.labels = dict(labels or {})
@@ -110,10 +397,27 @@ class NodeServer:
             # Object-plane-only node (the driver): never schedulable.
             num_cpus, num_tpus, resources = 0, 0, {}
             self.labels["role"] = "driver"
+        self._worker_processes = (bool(cfg.worker_processes)
+                                  if worker_processes is None
+                                  else worker_processes) and not serve_only
+        # Shared-memory arena: daemon + worker processes attach the same
+        # segment (reference: plasma store inside the raylet).
+        self.shm = None
+        if self._worker_processes:
+            try:
+                from raytpu.runtime.shm_store import SharedMemoryStore
+
+                self.shm = SharedMemoryStore(
+                    capacity=int(cfg.object_store_memory_bytes),
+                    name=f"/raytpu-{_os.getpid()}-"
+                         f"{self.node_id.hex()[:8]}")
+            except Exception:
+                self.shm = None
         self.backend = NodeBackend(
             JobID.from_random(), num_cpus=num_cpus, num_tpus=num_tpus,
-            resources=resources,
+            resources=resources, object_store=self.shm,
         )
+        self.worker_pool = None
         if serve_only:
             # The driver OWNS its objects: its refcount must free them
             # (pinning is for executor nodes holding remotely-owned results).
@@ -139,6 +443,17 @@ class NodeServer:
         h("node_info", self._h_node_info)
         h("debug_state", self._h_debug_state)
         h("ping", lambda peer: "pong")
+        # Worker-process plane
+        h("register_worker", self._h_register_worker)
+        h("task_blocked", self._h_task_blocked)
+        h("task_unblocked", self._h_task_unblocked)
+        h("get_actor_info", self._h_get_actor_info)
+        h("report_put", self._h_report_put)
+        h("available_resources",
+          lambda peer: self.backend.available_resources())
+        h("cluster_resources",
+          lambda peer: self.backend.cluster_resources())
+        h("nodes", lambda peer: self.backend.nodes())
         self._head: Optional[RpcClient] = None
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
@@ -159,6 +474,18 @@ class NodeServer:
             _api._backend = self.backend
             _api._worker = self.backend.worker
         self.address = self._rpc.start()
+        if self._worker_processes:
+            from raytpu.cluster.worker_pool import WorkerPool
+
+            from raytpu.core.resources import CPU
+
+            self.worker_pool = WorkerPool(
+                self.address,
+                self.shm.name if self.shm is not None else "",
+                self.node_id.hex(),
+                soft_limit=int(self.backend.node.total.get(CPU)),
+            )
+            self.backend.worker_pool = self.worker_pool
         self._head = RpcClient(self.head_address)
         self._head.call(
             "register_node", self.node_id.hex(), self.address,
@@ -177,6 +504,13 @@ class NodeServer:
         except Exception:
             pass
         self.backend.shutdown()
+        if self.worker_pool is not None:
+            self.worker_pool.shutdown()
+        if self.shm is not None:
+            try:
+                self.shm.close()
+            except Exception:
+                pass
         self._rpc.stop()
         if self._head is not None:
             self._head.close()
@@ -357,6 +691,46 @@ class NodeServer:
 
     def _h_remove_pg_shard(self, peer: Peer, pg_id_bin: bytes) -> None:
         self.backend.remove_placement_group(PlacementGroupID(pg_id_bin))
+
+    def _h_register_worker(self, peer: Peer, worker_id_hex: str,
+                           address: str, pid: int) -> bool:
+        if self.worker_pool is not None:
+            self.worker_pool.on_register(worker_id_hex, address, pid)
+        return True
+
+    def _h_task_blocked(self, peer: Peer, task_id_bin: bytes) -> None:
+        self.backend.task_blocked(TaskID(task_id_bin))
+
+    def _h_task_unblocked(self, peer: Peer, task_id_bin: bytes) -> None:
+        self.backend.task_unblocked(TaskID(task_id_bin))
+
+    def _h_get_actor_info(self, peer: Peer, name: str, namespace: str):
+        """Named-actor lookup for worker processes: local registry first,
+        then the head directory (cluster-wide names)."""
+        try:
+            actor_id, spec = self.backend.get_actor_handle_info(
+                name, namespace)
+            return actor_id.hex(), cloudpickle.dumps(spec)
+        except Exception:
+            pass
+        try:
+            info = self._head.call("resolve_named_actor", name, namespace)
+            if info is None:
+                return None
+            blob = self._head.call(
+                "kv_get", f"__actor_spec__::{info['actor_id']}")
+            if blob is None:
+                return None
+            return info["actor_id"], blob
+        except Exception:
+            return None
+
+    def _h_report_put(self, peer: Peer, oid_hex: str) -> None:
+        """A worker sealed an object into shared memory: fire the put hook
+        (dependency wakeup + head location report)."""
+        oid = ObjectID.from_hex(oid_hex)
+        if self.backend.store.on_put is not None:
+            self.backend.store.on_put(oid)
 
     def _h_debug_state(self, peer: Peer) -> dict:
         b = self.backend

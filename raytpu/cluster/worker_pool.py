@@ -1,0 +1,315 @@
+"""Node-daemon worker pool: leases, reuse, chip isolation, crash reaping.
+
+Reference analogue: ``src/ray/raylet/worker_pool.cc`` (1652 LoC) — idle
+workers cached per (job, runtime-env) and popped per lease
+(``worker_pool.h:343,354,417``); plus the TPU accelerator manager's
+per-process chip isolation (``python/ray/_private/accelerators/tpu.py:
+30-49``), which here happens at spawn: a worker bound to chips gets
+``TPU_VISIBLE_CHIPS`` et al. in its environment and keeps that binding for
+life (chip visibility can't change after the TPU runtime initializes).
+
+Pool key: ``(job_id, runtime-env-hash, chips-tuple)``. A lease pops a
+matching idle worker or spawns one; crashed workers are reaped by a
+monitor thread which fails their in-flight work with
+:class:`WorkerCrashedError` (the daemon survives — that is the point).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from raytpu.cluster.protocol import RpcClient
+from raytpu.core.config import cfg
+from raytpu.core.errors import WorkerCrashedError
+from raytpu.core.ids import JobID, WorkerID
+
+
+def runtime_env_hash(runtime_env: Optional[dict]) -> str:
+    if not runtime_env:
+        return ""
+    try:
+        return hashlib.sha1(
+            json.dumps(runtime_env, sort_keys=True, default=str).encode()
+        ).hexdigest()[:12]
+    except Exception:
+        return "unhashable"
+
+
+def chip_env(chips: Tuple[int, ...]) -> Dict[str, str]:
+    """Per-worker TPU visibility env (reference ``tpu.py:30-49``)."""
+    if not chips:
+        return {"RAYTPU_VISIBLE_CHIPS": ""}
+    ids = ",".join(str(c) for c in chips)
+    return {
+        "RAYTPU_VISIBLE_CHIPS": ids,
+        "TPU_VISIBLE_CHIPS": ids,
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{len(chips)},1",
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+    }
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, key: tuple,
+                 chips: Tuple[int, ...],
+                 proc: Optional[subprocess.Popen] = None):
+        self.worker_id = worker_id
+        self.key = key
+        self.chips = chips
+        self.proc = proc  # None until _spawn (reserved slot)
+        self.client: Optional[RpcClient] = None
+        self.address: Optional[str] = None
+        self.pid: Optional[int] = None
+        self.ready = threading.Event()
+        self.dead = False
+        self.dedicated = False  # actor-bound: never returned to the pool
+        # True while the worker's task sits in raytpu.get (blocked-worker
+        # protocol): excluded from the pool soft cap so nested tasks can
+        # always obtain a worker (reference: raylets exceed the soft limit
+        # for blocked workers).
+        self.blocked = False
+        self.last_used = time.monotonic()
+        self.on_death: Optional[Callable[[str], None]] = None  # actor hook
+
+    def crash(self, reason: str) -> None:
+        self.dead = True
+        if self.on_death is not None:
+            try:
+                self.on_death(reason)
+            except Exception:
+                pass
+        if self.client is not None:
+            self.client.close()
+
+
+class WorkerPool:
+    def __init__(self, node_address: str, shm_name: Optional[str],
+                 node_id_hex: str, base_env: Optional[Dict[str, str]] = None,
+                 soft_limit: Optional[int] = None):
+        self.node_address = node_address
+        self.shm_name = shm_name or ""
+        self.node_id_hex = node_id_hex
+        self.base_env = dict(base_env or {})
+        # The cap must at least cover the CPU ledger, or tasks the
+        # scheduler admitted would starve waiting for workers.
+        self.soft_limit = max(int(cfg.num_workers_soft_limit) or 8,
+                              int(soft_limit or 0))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._workers: Dict[str, WorkerHandle] = {}  # worker_id hex -> handle
+        self._idle: Dict[tuple, List[WorkerHandle]] = {}
+        self._stopped = False
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="worker-pool-monitor", daemon=True)
+        self._monitor.start()
+
+    # -- registration (called from the node RPC handler) -------------------
+
+    def on_register(self, worker_id_hex: str, address: str, pid: int) -> None:
+        with self._lock:
+            h = self._workers.get(worker_id_hex)
+        if h is None:
+            return
+        h.address = address
+        h.pid = pid
+        try:
+            h.client = RpcClient(address)
+        except Exception:
+            h.crash("worker RPC connect failed")
+            return
+        h.ready.set()
+
+    # -- leasing -----------------------------------------------------------
+
+    def lease(self, job_id: JobID, renv: Optional[dict],
+              chips: Tuple[int, ...], *, dedicated: bool = False,
+              timeout: Optional[float] = None) -> WorkerHandle:
+        """Pop an idle matching worker or spawn one. Blocks on the soft
+        process cap (reference: ``num_workers_soft_limit``)."""
+        key = (job_id.hex(), runtime_env_hash(renv), tuple(chips))
+        if timeout is None:
+            timeout = 300.0  # never wedge the dispatcher forever
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._stopped:
+                    raise WorkerCrashedError("pool stopped")
+                idles = self._idle.get(key)
+                while idles:
+                    h = idles.pop()
+                    if (not h.dead and h.proc is not None
+                            and h.proc.poll() is None):
+                        h.dedicated = dedicated
+                        h.last_used = time.monotonic()
+                        return h
+                # Dedicated (actor) workers are bounded by the resource
+                # ledger, not the pool cap, and blocked workers (sitting
+                # in raytpu.get) are excluded so nested tasks can always
+                # obtain a worker (reference: the soft limit only governs
+                # idle/task workers; raylets exceed it for blocked ones).
+                limit = self.soft_limit
+                live = sum(1 for w in self._workers.values()
+                           if not w.dead and not w.dedicated
+                           and not w.blocked)
+                if live >= limit:
+                    # Over the cap: evict idle workers of other keys (e.g.
+                    # finished jobs) to make room — LRU first. terminate()
+                    # only sends a signal, so it is safe under the lock.
+                    all_idle = sorted(
+                        (h for hs in self._idle.values() for h in hs),
+                        key=lambda h: h.last_used)
+                    for victim in all_idle[:max(1, live - limit + 1)]:
+                        self._drop_locked(victim)
+                        victim.dead = True
+                        try:
+                            if victim.proc is not None:
+                                victim.proc.terminate()
+                        except Exception:
+                            pass
+                        live -= 1
+                if live < limit or dedicated:
+                    h = self._reserve_locked(key, chips)
+                    h.dedicated = dedicated
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise WorkerCrashedError(
+                        "worker lease timed out at pool cap")
+                self._cv.wait(timeout=min(remaining, 0.1))
+        # Popen outside the lock: spawns overlap and never stall
+        # lease/release/on_register traffic.
+        self._spawn(h)
+        if not h.ready.wait(timeout=float(cfg.worker_register_timeout_seconds)):
+            h.crash("worker failed to register in time")
+            try:
+                if h.proc is not None:
+                    h.proc.terminate()  # never leak an orphan holding chips
+            except Exception:
+                pass
+            with self._lock:
+                self._workers.pop(h.worker_id.hex(), None)
+            raise WorkerCrashedError("worker failed to start")
+        if h.dead:
+            raise WorkerCrashedError("worker died during startup")
+        return h
+
+    def release(self, h: WorkerHandle) -> None:
+        """Return a leased worker to the idle cache (or drop it if dead)."""
+        with self._lock:
+            if (h.dead or h.dedicated or self._stopped
+                    or h.client is None or h.client.closed
+                    or h.proc is None or h.proc.poll() is not None):
+                self._drop_locked(h)
+            else:
+                h.last_used = time.monotonic()
+                self._idle.setdefault(h.key, []).append(h)
+            self._cv.notify_all()
+
+    def kill(self, h: WorkerHandle, reason: str = "killed") -> None:
+        try:
+            if h.client is not None and not h.client.closed:
+                h.client.call("kill", reason, timeout=2.0)
+        except Exception:
+            pass
+        try:
+            if h.proc is not None:
+                h.proc.terminate()
+        except Exception:
+            pass
+        with self._lock:
+            self._drop_locked(h)
+            self._cv.notify_all()
+
+    # -- internals ---------------------------------------------------------
+
+    def _reserve_locked(self, key: tuple,
+                        chips: Tuple[int, ...]) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        h = WorkerHandle(worker_id, key, chips, proc=None)
+        self._workers[worker_id.hex()] = h
+        return h
+
+    def _spawn(self, h: WorkerHandle) -> None:
+        if h.proc is not None:
+            return  # popped from idle, already running
+        env = dict(os.environ)
+        env.update(self.base_env)
+        env.update(chip_env(h.chips))
+        cmd = [
+            sys.executable, "-m", "raytpu.cluster.worker_proc",
+            "--node", self.node_address,
+            "--shm", self.shm_name,
+            "--worker-id", h.worker_id.hex(),
+            "--job", h.key[0],
+            "--node-id", self.node_id_hex,
+        ]
+        h.proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    def _drop_locked(self, h: WorkerHandle) -> None:
+        self._workers.pop(h.worker_id.hex(), None)
+        idles = self._idle.get(h.key)
+        if idles and h in idles:
+            idles.remove(h)
+
+    def _monitor_loop(self) -> None:
+        while not self._stopped:
+            time.sleep(0.05)
+            dead: List[WorkerHandle] = []
+            idle_kill: List[WorkerHandle] = []
+            now = time.monotonic()
+            idle_ttl = float(cfg.idle_worker_killing_time_threshold_ms) / 1e3
+            with self._lock:
+                for h in list(self._workers.values()):
+                    if h.dead or h.proc is None:
+                        continue
+                    if h.proc.poll() is not None:
+                        dead.append(h)
+                        self._drop_locked(h)
+                    elif (not h.dedicated and h.ready.is_set()
+                          and now - h.last_used > idle_ttl
+                          and any(h is w for w in
+                                  self._idle.get(h.key, ()))):
+                        idle_kill.append(h)
+                if dead or idle_kill:
+                    self._cv.notify_all()
+            for h in dead:
+                h.crash(f"worker process exited with code "
+                        f"{h.proc.returncode}")
+            for h in idle_kill:
+                self.kill(h, "idle timeout")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "workers": len(self._workers),
+                "idle": sum(len(v) for v in self._idle.values()),
+            }
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        with self._lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+            self._idle.clear()
+        for h in workers:
+            try:
+                if h.proc is not None:
+                    h.proc.terminate()
+            except Exception:
+                pass
+        for h in workers:
+            if h.proc is None:
+                continue
+            try:
+                h.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    h.proc.kill()
+                except Exception:
+                    pass

@@ -1,0 +1,399 @@
+"""Worker subprocess: one leased executor process on a cluster node.
+
+Reference analogue: the worker process popped from the raylet's
+``WorkerPool`` (``src/ray/raylet/worker_pool.h:343,354,417``) hosting a
+CoreWorker. Crash containment is the point: a segfaulting user task (or a
+JAX/TPU runtime abort) kills *this* process, and the node daemon survives,
+fails the task with :class:`WorkerCrashedError` and retries elsewhere.
+
+The worker is an RPC *server* (the daemon pushes ``execute`` /
+``create_actor`` / ``actor_task`` — the analogue of ``PushTask`` after a
+lease grant) and an RPC *client* back to its daemon (object fetch for
+missing args, nested task submission, blocked-worker notifications).
+
+TPU chip isolation: the daemon spawns the worker with
+``TPU_VISIBLE_CHIPS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` (and the
+platform-agnostic ``RAYTPU_VISIBLE_CHIPS``) already in its environment, so
+JAX in this process only ever sees its leased chips — reference:
+``python/ray/_private/accelerators/tpu.py:30-49``.
+
+Object plane: the worker attaches to the node's shared-memory store, so
+large args are read zero-copy and large results are visible to the daemon
+the moment they are sealed; small results ride back in the RPC reply.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+from raytpu.core.errors import ActorDiedError, TaskError
+from raytpu.core.ids import JobID, NodeID, ObjectID, TaskID
+from raytpu.runtime.object_ref import ObjectRef
+from raytpu.runtime.object_store import MemoryStore
+from raytpu.runtime.serialization import SerializedValue, serialize
+from raytpu.runtime.task_spec import TaskSpec
+from raytpu.runtime.worker import Worker
+
+
+class WorkerBackend:
+    """The backend seen by user code *inside* a worker process.
+
+    Nested ``raytpu.remote``/``get``/``put`` calls route through the node
+    daemon (the reference routes nested submissions through the local
+    raylet the same way). Implements the subset of the backend surface
+    that :mod:`raytpu.runtime.api` consumes.
+    """
+
+    def __init__(self, host: "_WorkerHost"):
+        self._host = host
+        self.worker = host.worker
+        self.store = host.store
+
+    # -- submission (forwarded to the daemon) ------------------------------
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
+                for oid in spec.return_ids()]
+        self._host.node.call("submit_task", cloudpickle.dumps(spec))
+        return refs
+
+    def create_actor(self, spec: TaskSpec) -> None:
+        self._host.node.call("create_actor", cloudpickle.dumps(spec))
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
+                for oid in spec.return_ids()]
+        self._host.node.call("submit_actor_task", cloudpickle.dumps(spec))
+        return refs
+
+    def kill_actor(self, actor_id, no_restart: bool = True) -> None:
+        self._host.node.call("kill_actor", actor_id.hex(), no_restart)
+
+    def get_actor_handle_info(self, name: str, namespace: str):
+        info = self._host.node.call("get_actor_info", name, namespace)
+        if info is None:
+            raise ValueError(f"no actor named {name!r} in {namespace!r}")
+        actor_id_hex, spec_blob = info
+        from raytpu.core.ids import ActorID
+
+        return ActorID.from_hex(actor_id_hex), cloudpickle.loads(spec_blob)
+
+    def cancel_task(self, task_id: TaskID) -> None:
+        self._host.node.call("cancel_task", task_id.binary())
+
+    # -- data plane --------------------------------------------------------
+
+    def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
+        return self._host.get_serialized(ref.id, timeout=timeout)
+
+    def object_ready(self, ref: ObjectRef) -> bool:
+        if self.store.contains(ref.id):
+            return True
+        try:
+            return bool(self._host.node.call("has_object", ref.id.hex(),
+                                             timeout=5.0))
+        except Exception:
+            return False
+
+    # -- blocked-worker protocol ------------------------------------------
+
+    def task_blocked(self, task_id: TaskID) -> None:
+        try:
+            self._host.node.notify("task_blocked", task_id.binary())
+        except Exception:
+            pass
+
+    def task_unblocked(self, task_id: TaskID) -> None:
+        try:
+            self._host.node.notify("task_unblocked", task_id.binary())
+        except Exception:
+            pass
+
+    # -- introspection -----------------------------------------------------
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._host.node.call("available_resources")
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._host.node.call("cluster_resources")
+
+    def nodes(self) -> List[dict]:
+        return self._host.node.call("nodes")
+
+    def task_events(self) -> List[dict]:
+        return []
+
+    def shutdown(self) -> None:
+        pass
+
+
+def _dump_err(name: str, err: BaseException) -> bytes:
+    try:
+        return cloudpickle.dumps(err)
+    except Exception:
+        return cloudpickle.dumps(TaskError.from_exception(name, err))
+
+
+class _WorkerHost:
+    """Execution state of one worker process."""
+
+    def __init__(self, node_address: str, shm_name: Optional[str],
+                 job_id: JobID, node_id: NodeID, worker_id_hex: str):
+        self.node = RpcClient(node_address)
+        self.worker_id_hex = worker_id_hex
+        shm = None
+        if shm_name:
+            try:
+                from raytpu.runtime.shm_store import attach
+
+                shm = attach(shm_name)
+            except Exception:
+                shm = None
+        self.store = MemoryStore(shm=shm)
+        self.worker = Worker(job_id, node_id, self.store)
+        # Results the daemon pins; our local refcount must not free them.
+        self.worker.pin_owned = True
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._actor_loop: Optional[Any] = None  # asyncio loop for async actors
+        self._exec_pool = None
+
+    # -- object access -----------------------------------------------------
+
+    def get_serialized(self, oid: ObjectID,
+                       timeout: Optional[float] = None) -> SerializedValue:
+        """Local/shm store first; miss → pull from the daemon."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.002
+        while True:
+            sv = self.store.try_get(oid)
+            if sv is not None:
+                return sv
+            blob = self.node.call("fetch_object", oid.hex(), timeout=30.0)
+            if blob is not None:
+                return SerializedValue.from_buffer(blob)
+            if deadline is not None and time.monotonic() >= deadline:
+                from raytpu.core.errors import GetTimeoutError
+
+                raise GetTimeoutError(f"object {oid.hex()} not ready")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    def collect_results(self, spec: TaskSpec) -> List[Tuple[bytes, Optional[bytes]]]:
+        """Gather return values: ``(oid, None)`` = sealed in shared memory
+        (daemon reads it there); ``(oid, blob)`` = ship inline."""
+        out = []
+        for oid in spec.return_ids():
+            if self.store._shm is not None and self.store._shm.contains(oid):
+                out.append((oid.binary(), None))
+                continue
+            sv = self.store.try_get(oid)
+            if sv is not None:
+                out.append((oid.binary(), sv.to_bytes()))
+                # Shipped — drop the local heap copy.
+                self.store.delete([oid])
+        return out
+
+    # -- execution ---------------------------------------------------------
+
+    def execute_plain(self, spec: TaskSpec) -> dict:
+        # store_errors=False: the daemon owns retry policy — it stores the
+        # error into the return slots only once retries are exhausted.
+        err = self.worker.execute_task(spec, self.get_serialized,
+                                       store_errors=False)
+        return {"results": self.collect_results(spec),
+                "error": None if err is None else _dump_err(spec.name, err)}
+
+    def create_actor(self, spec: TaskSpec) -> dict:
+        self.actor_spec = spec
+        try:
+            self.actor_instance = self.worker.create_actor_instance(
+                spec, self.get_serialized)
+            self.worker.put_serialized(
+                spec.return_ids()[0], serialize(None),
+                creating_task=spec.task_id)
+            err = None
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError.from_exception(
+                spec.name, e)
+            self.worker._store_error(spec.return_ids(), spec, err)
+        ac = spec.actor_creation
+        if err is None and ac is not None and ac.is_async:
+            import asyncio
+
+            self._actor_loop = asyncio.new_event_loop()
+            threading.Thread(target=self._actor_loop.run_forever,
+                             name="actor-async-loop", daemon=True).start()
+        return {"results": self.collect_results(spec),
+                "error": None if err is None else _dump_err(spec.name, err)}
+
+    def execute_actor_task(self, spec: TaskSpec) -> dict:
+        if self.actor_instance is None:
+            err: BaseException = ActorDiedError(
+                spec.actor_id.hex() if spec.actor_id else "?",
+                "actor instance not created in this worker")
+            self.worker._store_error(spec.return_ids(), spec, err)
+            return {"results": self.collect_results(spec),
+                    "error": _dump_err(spec.name, err)}
+        if spec.runtime_env is None and self.actor_spec is not None:
+            spec.runtime_env = self.actor_spec.runtime_env
+        if self._actor_loop is not None:
+            import asyncio
+
+            fut = asyncio.run_coroutine_threadsafe(
+                self._exec_async(spec), self._actor_loop)
+            err = fut.result()
+        else:
+            err = self.worker.execute_task(
+                spec, self.get_serialized, actor_instance=self.actor_instance)
+        return {"results": self.collect_results(spec),
+                "error": None if err is None else _dump_err(spec.name, err)}
+
+    async def actor_task_via_loop(self, spec: TaskSpec) -> dict:
+        """Async-actor dispatch: runs as a coroutine on the worker's RPC
+        server loop, forwarding to the actor's own event loop — no
+        executor thread blocks on the result, so max_concurrency async
+        calls can genuinely interleave (fixes the cross-call-signaling
+        deadlock a thread-per-call bridge would have)."""
+        import asyncio
+
+        if spec.runtime_env is None and self.actor_spec is not None:
+            spec.runtime_env = self.actor_spec.runtime_env
+        if self.actor_instance is None or self._actor_loop is None:
+            return self.execute_actor_task(spec)
+        cf = asyncio.run_coroutine_threadsafe(
+            self._exec_async(spec), self._actor_loop)
+        err = await asyncio.wrap_future(cf)
+        return {"results": self.collect_results(spec),
+                "error": None if err is None else _dump_err(spec.name, err)}
+
+    async def _exec_async(self, spec: TaskSpec) -> Optional[BaseException]:
+        """Async-actor method execution on the worker's event loop."""
+        import inspect
+
+        from raytpu.runtime import context as ctx_mod
+        from raytpu.runtime_env import RuntimeEnvContext
+
+        w = self.worker
+        try:
+            args, kwargs = w.resolve_args(spec, self.get_serialized)
+            method = getattr(self.actor_instance, spec.method_name)
+            ctx_mod.set_current(ctx_mod.RuntimeContext(
+                job_id=w.job_id, node_id=w.node_id,
+                task_id=spec.task_id, actor_id=spec.actor_id))
+            with RuntimeEnvContext(spec.runtime_env):
+                result = method(*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError.from_exception(
+                spec.name, e)
+            w._store_error(spec.return_ids(), spec, err)
+            return err
+        rids = spec.return_ids()
+        if spec.num_returns == 1:
+            w.put_serialized(rids[0], serialize(result),
+                             creating_task=spec.task_id)
+        else:
+            for oid, v in zip(rids, list(result or [])):
+                w.put_serialized(oid, serialize(v), creating_task=spec.task_id)
+        return None
+
+
+def main() -> None:  # pragma: no cover - runs as a subprocess
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node", required=True, help="node daemon RPC address")
+    ap.add_argument("--shm", default="", help="shared-memory store name")
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--job", required=True)
+    ap.add_argument("--node-id", required=True)
+    args = ap.parse_args()
+
+    host = _WorkerHost(
+        args.node, args.shm or None,
+        JobID.from_hex(args.job), NodeID.from_hex(args.node_id),
+        args.worker_id,
+    )
+
+    # Route in-worker raytpu.* API calls through the daemon.
+    from raytpu.runtime import api as _api
+
+    backend = WorkerBackend(host)
+    _api._backend = backend
+    _api._worker = host.worker
+    host.worker.put_object = _forwarding_put(host)
+
+    import asyncio
+
+    server = RpcServer("127.0.0.1", 0)
+
+    async def _offload(fn, *a):
+        return await asyncio.get_event_loop().run_in_executor(
+            None, fn, *a)
+
+    def h_execute(peer: Peer, blob: bytes):
+        return _offload(host.execute_plain, cloudpickle.loads(blob))
+
+    def h_create_actor(peer: Peer, blob: bytes):
+        return _offload(host.create_actor, cloudpickle.loads(blob))
+
+    def h_actor_task(peer: Peer, blob: bytes):
+        spec = cloudpickle.loads(blob)
+        if host._actor_loop is not None:
+            return host.actor_task_via_loop(spec)
+        return _offload(host.execute_actor_task, spec)
+
+    def h_kill(peer: Peer, reason: str = ""):
+        threading.Thread(target=_delayed_exit, daemon=True).start()
+        return True
+
+    server.register("execute", h_execute)
+    server.register("create_actor", h_create_actor)
+    server.register("actor_task", h_actor_task)
+    server.register("kill", h_kill)
+    server.register("ping", lambda peer: "pong")
+    addr = server.start()
+    host.node.call("register_worker", args.worker_id, addr, os.getpid())
+
+    # Die with the daemon: if the control connection drops, exit.
+    while not host.node.closed:
+        time.sleep(0.5)
+    os._exit(0)
+
+
+def _delayed_exit() -> None:  # pragma: no cover
+    time.sleep(0.05)  # let the kill reply flush
+    os._exit(0)
+
+
+def _forwarding_put(host: "_WorkerHost"):
+    """``raytpu.put`` inside a worker: seal large values into shared memory
+    (daemon sees them instantly), ship small ones to the daemon's heap
+    store — either way the daemon can serve them as task args."""
+    inner = host.worker.put_object
+
+    def put(value, oid=None, creating_task=None, sv=None):
+        ref = inner(value, oid=oid, creating_task=creating_task, sv=sv)
+        shm = host.store._shm
+        if shm is not None and shm.contains(ref.id):
+            host.node.notify("report_put", ref.id.hex())
+        else:
+            sv2 = host.store.try_get(ref.id)
+            if sv2 is not None:
+                host.node.call("put_object", ref.id.hex(), sv2.to_bytes())
+                host.store.delete([ref.id])
+        return ref
+
+    return put
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

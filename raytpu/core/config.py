@@ -93,6 +93,7 @@ declare("object_spilling_threshold", 0.8)
 
 # Worker pool.
 declare("num_workers_soft_limit", 8)
+declare("worker_processes", True)
 declare("worker_register_timeout_seconds", 60.0)
 declare("idle_worker_killing_time_threshold_ms", 1000 * 60 * 5)
 declare("prestart_workers", True)

@@ -115,6 +115,18 @@ class TpuTopology:
     def _coords(self):
         return itertools.product(*(range(d) for d in self.shape))
 
+    def chip_ids(self, coords: Sequence[Tuple[int, ...]]) -> Tuple[int, ...]:
+        """Flatten coords to host-local chip indices (row-major), the ids
+        used for per-worker ``TPU_VISIBLE_CHIPS`` isolation (reference:
+        ``python/ray/_private/accelerators/tpu.py:30-49``)."""
+        out = []
+        for c in coords:
+            idx = 0
+            for dim, x in zip(self.shape, c):
+                idx = idx * dim + x
+            out.append(idx)
+        return tuple(sorted(out))
+
     def allocate_subcube(self, chips: int) -> Optional[List[Tuple[int, ...]]]:
         """Find and claim a free axis-aligned box of exactly `chips` chips.
 

@@ -1,18 +1,29 @@
 """Flash attention as a Pallas TPU kernel.
 
-Blockwise-stable softmax with O(T) memory: Q blocks stream from HBM into
-VMEM via the grid; each program visits all K/V blocks of its row with a
-`fori_loop`, keeping running max / denominator / output accumulator in
-registers. Matmuls hit the MXU in fp32 accumulation
-(``preferred_element_type``); the causal upper triangle is skipped
-per-block (fully-masked blocks contribute nothing and early-out via
-`pl.when`-style predication).
+Blockwise-stable softmax with O(T) memory. The grid is
+``(batch*heads, q_blocks, kv_blocks)`` with the K/V walk as the
+*innermost grid dimension*, so the Mosaic pipeline double-buffers the
+K/V block DMAs from HBM into VMEM while running max / denominator /
+output accumulator persist in VMEM scratch across kv iterations (the
+canonical TPU flash pattern — scratch carries state because TPU grids
+execute sequentially over the arbitrary dimension). Matmuls hit the MXU
+in fp32 accumulation (``preferred_element_type``); causally fully-masked
+K/V blocks are skipped with `pl.when` predication.
 
-Backward uses recompute (flash-style): residuals are just (q, k, v, o,
-lse); gradients are computed with the reference einsum formulation — fused
-backward kernels are a later-round optimization. On non-TPU platforms the
-reference jnp path runs instead (tests compare the kernel in interpret
-mode against it).
+Backward is flash-style recompute: residuals are just (q, k, v, o, lse).
+On TPU two Pallas kernels produce the gradients without ever
+materializing the [T, T] score matrix in HBM — a dq kernel (grid walks
+K/V innermost, dq accumulates in VMEM scratch) and a dk/dv kernel (grid
+walks Q innermost, dk/dv accumulate in scratch); `p = exp(s - lse)`
+reuses the saved log-sum-exp so no running max is needed. Elsewhere the
+reference einsum formulation runs instead (tests compare the kernels in
+interpret mode against it).
+
+Cross-length causal (t_q != t_kv) uses a bottom-aligned diagonal
+(``tril(k=t_kv-t_q)``, matching the reference path). For t_q > t_kv the
+leading rows attend nothing; the kernels output 0 for those rows while
+the einsum path degenerates to uniform attention — both are artifacts of
+an ill-defined case (a softmax over zero elements).
 """
 
 from __future__ import annotations
@@ -73,27 +84,37 @@ def _attn_bwd_reference(q, k, v, o, lse, g, causal: bool, sm_scale: float):
 # -- pallas kernel ------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
-                  sm_scale: float, block_k: int, t_kv: int):
+_LANES = 128  # VMEM scratch lane width; m/l broadcast across lanes.
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr, *, causal: bool,
+                  sm_scale: float, block_q: int, block_k: int, n_kb: int,
+                  off: int):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
-    block_q = q_ref.shape[1]
     d = q_ref.shape[2]
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [Bq, D]
+    ik = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    o0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full((block_q, _LANES), -1e30, jnp.float32)
+        l_scr[...] = jnp.zeros((block_q, _LANES), jnp.float32)
+        acc_scr[...] = jnp.zeros((block_q, d), jnp.float32)
 
     q_start = iq * block_q
-    n_kb = t_kv // block_k
+    k_start = ik * block_k
+    # Causally fully-masked K/V blocks contribute nothing. The diagonal is
+    # bottom-aligned for t_q != t_kv (off = t_kv - t_q), matching the
+    # reference path's tril(k=t_kv-t_q).
+    live = (k_start <= q_start + block_q - 1 + off) if causal else True
 
-    def body(jk, carry):
-        m, l, acc = carry
-        k_start = jk * block_k
-        kb = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [Bq, D]
+        kb = k_ref[0].astype(jnp.float32)  # [Bk, D]
+        vb = v_ref[0].astype(jnp.float32)  # [Bk, D]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -102,31 +123,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
                 jnp.int32, (block_q, block_k), 0)
             kpos = k_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            s = jnp.where(kpos <= qpos + off, s, -1e30)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[...] = jnp.broadcast_to(m_new, (block_q, _LANES))
+        l_scr[...] = jnp.broadcast_to(l_new, (block_q, _LANES))
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l, acc
 
-    if causal:
-        # Only blocks with k_start <= q_end contribute.
-        n_visit = jnp.minimum((q_start + block_q + block_k - 1) // block_k,
-                              n_kb)
-    else:
-        n_visit = n_kb
-    m, l, acc = lax.fori_loop(0, n_visit, body, (m0, l0, o0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+    @pl.when(ik == n_kb - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            (m + jnp.log(l)), (block_q, _LANES)).astype(jnp.float32)
 
 
 def _flash_forward_pallas(q, k, v, causal: bool, sm_scale: float,
                           block_q: int, block_k: int, interpret: bool):
     from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
@@ -140,56 +162,291 @@ def _flash_forward_pallas(q, k, v, causal: bool, sm_scale: float,
         raise ValueError(
             f"sequence lengths ({t_q}, {t_kv}) must be divisible by blocks "
             f"({block_q}, {block_k})")
+    n_kb = t_kv // block_k
 
+    off = t_kv - t_q  # bottom-aligned diagonal (reference tril k=off)
     kernel = functools.partial(
         _flash_kernel, causal=causal, sm_scale=sm_scale,
-        block_k=block_k, t_kv=t_kv)
+        block_q=block_q, block_k=block_k, n_kb=n_kb, off=off)
 
+    if causal:
+        # Clamp the K/V walk to the last causally-live block: iterations
+        # past the diagonal re-reference an already-fetched block, so the
+        # pipeline never DMAs fully-masked K/V from HBM (`pl.when` skips
+        # their compute; this skips their bandwidth too).
+        def kv_index(ib, iq, ik):
+            last = (iq * block_q + block_q - 1 + off) // block_k
+            last = jnp.clip(last, 0, n_kb - 1)
+            return (ib, jnp.minimum(ik, last), 0)
+    else:
+        def kv_index(ib, iq, ik):
+            return (ib, ik, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda ib, iq, ik: (ib, iq, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda ib, iq, ik: (ib, iq, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda ib, iq, ik: (ib, iq, 0)),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, _LANES), jnp.float32),
+        pltpu.VMEM((block_q, _LANES), jnp.float32),
+        pltpu.VMEM((block_q, d), jnp.float32),
+    ]
     kwargs = {}
     if not interpret:
-        from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
-
-        vmem = pltpu.VMEM
-        any_space = getattr(pltpu, "ANY", None) or pl.ANY
-        in_specs = [
-            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0),
-                         memory_space=vmem),
-            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0),
-                         memory_space=any_space),
-            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0),
-                         memory_space=any_space),
-        ]
-        out_specs = [
-            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0),
-                         memory_space=vmem),
-            pl.BlockSpec((1, block_q, 1), lambda ib, iq: (ib, iq, 0),
-                         memory_space=vmem),
-        ]
-    else:
-        in_specs = [
-            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
-            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0)),
-            pl.BlockSpec((1, t_kv, d), lambda ib, iq: (ib, 0, 0)),
-        ]
-        out_specs = [
-            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda ib, iq: (ib, iq, 0)),
-        ]
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            ))
 
     o3, lse3 = pl.pallas_call(
         kernel,
-        grid=(bh, t_q // block_q),
+        grid=(bh, t_q // block_q, n_kb),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t_q, _LANES), jnp.float32),
         ],
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
         **kwargs,
     )(q3, k3, v3)
     return (o3.reshape(b, h, t_q, d),
-            lse3.reshape(b, h, t_q, 1))
+            lse3[:, :, :1].reshape(b, h, t_q, 1))
+
+
+# -- pallas backward kernels --------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, causal: bool, sm_scale: float,
+                         block_q: int, block_k: int, n_kb: int, off: int):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    d = q_ref.shape[2]
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros((block_q, d), jnp.float32)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    live = (k_start <= q_start + block_q - 1 + off) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos + off, s, -1e30)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            g, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          sm_scale: float, block_q: int, block_k: int,
+                          n_qb: int, off: int):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    d = q_ref.shape[2]
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros((block_k, d), jnp.float32)
+        dv_scr[...] = jnp.zeros((block_k, d), jnp.float32)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    live = (q_start + block_q - 1 + off >= k_start) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos + off, s, -1e30)
+        p = jnp.exp(s - lse)  # [Bq, Bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            g, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, sm_scale: float,
+                           block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    bh = b * h
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    if t_q % block_q or t_kv % block_k:
+        raise ValueError(
+            f"sequence lengths ({t_q}, {t_kv}) must be divisible by blocks "
+            f"({block_q}, {block_k})")
+    n_qb = t_q // block_q
+    n_kb = t_kv // block_k
+
+    q3 = q.reshape(bh, t_q, d)
+    k3 = k.reshape(bh, t_kv, d)
+    v3 = v.reshape(bh, t_kv, d)
+    g3 = g.reshape(bh, t_q, d)
+    # lse/delta enter lane-broadcast so the kernel reads [Bq, 1] columns
+    # without an in-kernel transpose (Mosaic-friendly layout).
+    lse3 = jnp.broadcast_to(
+        lse.reshape(bh, t_q, 1), (bh, t_q, _LANES)).astype(jnp.float32)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta3 = jnp.broadcast_to(
+        delta.reshape(bh, t_q, 1), (bh, t_q, _LANES))
+
+    def qspec(f):
+        return pl.BlockSpec((1, block_q, d), f)
+
+    def kspec(f):
+        return pl.BlockSpec((1, block_k, d), f)
+
+    def lspec(f):
+        return pl.BlockSpec((1, block_q, _LANES), f)
+
+    off = t_kv - t_q  # bottom-aligned diagonal (reference tril k=off)
+    if causal:
+        # Same bandwidth trick as the forward: clamp dead iterations onto
+        # an already-needed block so masked K/V (dq kernel) and masked Q
+        # rows (dk/dv kernel) are never fetched.
+        def kv_of_q(ib, iq, ik):
+            last = (iq * block_q + block_q - 1 + off) // block_k
+            last = jnp.clip(last, 0, n_kb - 1)
+            return (ib, jnp.minimum(ik, last), 0)
+
+        def q_of_kv(ib, ik, iq):
+            first = (ik * block_k - off) // block_q
+            first = jnp.clip(first, 0, n_qb - 1)
+            return (ib, jnp.maximum(iq, first), 0)
+    else:
+        def kv_of_q(ib, iq, ik):
+            return (ib, ik, 0)
+
+        def q_of_kv(ib, ik, iq):
+            return (ib, iq, 0)
+
+    compiler = {}
+    if not interpret:
+        compiler["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            ))
+
+    dq3 = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, n_kb=n_kb, off=off),
+        grid=(bh, n_qb, n_kb),
+        in_specs=[
+            qspec(lambda ib, iq, ik: (ib, iq, 0)),
+            kspec(kv_of_q),
+            kspec(kv_of_q),
+            qspec(lambda ib, iq, ik: (ib, iq, 0)),
+            lspec(lambda ib, iq, ik: (ib, iq, 0)),
+            lspec(lambda ib, iq, ik: (ib, iq, 0)),
+        ],
+        out_specs=qspec(lambda ib, iq, ik: (ib, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **compiler,
+    )(q3, k3, v3, g3, lse3, delta3)
+
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, n_qb=n_qb, off=off),
+        grid=(bh, n_kb, n_qb),
+        in_specs=[
+            qspec(q_of_kv),
+            kspec(lambda ib, ik, iq: (ib, ik, 0)),
+            kspec(lambda ib, ik, iq: (ib, ik, 0)),
+            qspec(q_of_kv),
+            lspec(q_of_kv),
+            lspec(q_of_kv),
+        ],
+        out_specs=[
+            kspec(lambda ib, ik, iq: (ib, ik, 0)),
+            kspec(lambda ib, ik, iq: (ib, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **compiler,
+    )(q3, k3, v3, g3, lse3, delta3)
+
+    return (dq3.reshape(b, h, t_q, d),
+            dk3.reshape(b, h, t_kv, d),
+            dv3.reshape(b, h, t_kv, d))
 
 
 # -- public op with custom vjp ------------------------------------------------
@@ -217,6 +474,11 @@ def _flash_fwd(q, k, v, causal, sm_scale, use_pallas):
 
 def _flash_bwd(causal, sm_scale, use_pallas, res, g):
     q, k, v, o, lse = res
+    if use_pallas in ("tpu", "interpret"):
+        return _flash_backward_pallas(
+            q, k, v, o, lse, g, causal, sm_scale,
+            DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+            interpret=(use_pallas == "interpret"))
     return _attn_bwd_reference(q, k, v, o, lse, g, causal, sm_scale)
 
 

@@ -49,6 +49,10 @@ class _TaskRecord:
     missing_deps: set
     state: str = "waiting"  # waiting -> ready -> running -> done
     released_while_blocked: int = 0
+    # What a blocked task gave back: CPU only. Accelerator chips are never
+    # released while blocked (reference: raylets return CPU for blocked
+    # workers; GPU/TPU bindings are process-lifetime).
+    blocked_subset: Optional[ResourceSet] = None
 
 
 @dataclass
@@ -349,7 +353,7 @@ class LocalBackend:
         The actor runtime is registered eagerly so method calls submitted
         before creation completes simply queue (the reference buffers these
         in the actor submit queue the same way)."""
-        runtime = _ActorRuntime(self, spec)
+        runtime = self._make_actor_runtime(spec)
         name = spec.actor_creation.name
         with self._lock:
             if name:
@@ -508,7 +512,11 @@ class LocalBackend:
         with self._lock:
             rec = self._running.get(task_id)
             if rec is not None and rec.released_while_blocked == 0:
-                self._release_resources(rec)
+                cpus = rec.required.get(CPU)
+                if not cpus:
+                    return
+                rec.blocked_subset = ResourceSet({CPU: cpus})
+                self._release_resources(rec, subset=rec.blocked_subset)
                 rec.released_while_blocked += 1
                 self._cv.notify_all()
 
@@ -517,7 +525,9 @@ class LocalBackend:
             rec = self._running.get(task_id)
             if rec is not None and rec.released_while_blocked > 0:
                 rec.released_while_blocked -= 1
-                self._allocate_resources(rec, force=True)
+                self._allocate_resources(rec, force=True,
+                                         subset=rec.blocked_subset)
+                rec.blocked_subset = None
 
     # -- info -----------------------------------------------------------------
 
@@ -618,19 +628,22 @@ class LocalBackend:
             return False
         return False
 
-    def _allocate_resources(self, rec: _TaskRecord, force: bool = False) -> None:
+    def _allocate_resources(self, rec: _TaskRecord, force: bool = False,
+                            subset: Optional[ResourceSet] = None) -> None:
         bundle = self._bundle_for(rec.spec)
         target = bundle.node if bundle is not None else self.node
-        target.allocate(rec.required, force=force)
+        target.allocate(subset if subset is not None else rec.required,
+                        force=force)
 
-    def _release_resources(self, rec: _TaskRecord) -> None:
+    def _release_resources(self, rec: _TaskRecord,
+                           subset: Optional[ResourceSet] = None) -> None:
         try:
             bundle = self._bundle_for(rec.spec)
         except Exception:
             # PG vanished while the task ran; its ledger died with it.
             return
         target = bundle.node if bundle is not None else self.node
-        target.release(rec.required)
+        target.release(subset if subset is not None else rec.required)
 
     def _dispatch_loop(self):
         while True:
@@ -695,8 +708,7 @@ class LocalBackend:
             self._record_event(spec, "finished")
             self._after_task(spec)
             return
-        err = self.worker.execute_task(spec, self._get_serialized,
-                                       store_errors=False)
+        err = self._execute_plain(rec)
         retried = False
         if err is not None and self._should_retry(rec, err):
             retried = True
@@ -706,7 +718,15 @@ class LocalBackend:
             self._running.pop(spec.task_id, None)
             if rec.released_while_blocked == 0:
                 self._release_resources(rec)
+            else:
+                # Task ended while blocked: only the CPU subset was given
+                # back — release the accelerator remainder now.
+                remainder = rec.required - (rec.blocked_subset
+                                            or ResourceSet({}))
+                if not remainder.is_empty():
+                    self._release_resources(rec, subset=remainder)
             rec.released_while_blocked = 0
+            rec.blocked_subset = None
             if retried:
                 spec.attempt += 1
                 rec.state = "ready"
@@ -719,12 +739,30 @@ class LocalBackend:
         if not retried:
             self._after_task(spec)
 
+    def _execute_plain(self, rec: _TaskRecord) -> Optional[BaseException]:
+        """Run one plain task; overridden by the cluster node backend to
+        dispatch into a leased worker process (reference: worker lease +
+        ``PushTask``)."""
+        return self.worker.execute_task(rec.spec, self._get_serialized,
+                                        store_errors=False)
+
+    def _make_actor_runtime(self, spec: TaskSpec):
+        """Actor runtime factory; the cluster node backend overrides this
+        to host the actor in a dedicated worker process."""
+        return _ActorRuntime(self, spec)
+
     def _should_retry(self, rec: _TaskRecord, err: BaseException) -> bool:
+        from raytpu.core.errors import NodeDiedError, WorkerCrashedError
+
         spec = rec.spec
         if spec.attempt >= spec.max_retries:
             return False
         if isinstance(err, TaskCancelledError):
             return False
+        if isinstance(err, (WorkerCrashedError, NodeDiedError)):
+            # System failure: retry regardless of ``retry_exceptions``
+            # (reference: TaskManager resubmits on worker/node death).
+            return True
         # User exceptions retry only when opted in (reference:
         # ``retry_exceptions``); system failures always retry.
         return bool(spec.retry_exceptions)

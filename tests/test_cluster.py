@@ -113,11 +113,13 @@ class TestClusterTasks:
             import os
             import time as t
             t.sleep(0.3)
-            return os.getpid()
+            # Tasks run in worker subprocesses; the parent is the node
+            # daemon, so ppid identifies the node.
+            return os.getppid()
 
         refs = [whoami.remote(i) for i in range(4)]
         pids = set(raytpu.get(refs, timeout=60))
-        assert len(pids) == 2  # both node processes executed tasks
+        assert len(pids) == 2  # both nodes executed tasks
 
     def test_object_transfer_between_tasks(self, driver):
         @raytpu.remote
@@ -279,7 +281,9 @@ class TestChaos:
             class Pinned:
                 def pid(self):
                     import os
-                    return os.getpid()
+                    # Actor lives in a worker subprocess whose parent is
+                    # the node daemon.
+                    return os.getppid()
 
             a = Pinned.remote()
             pid = raytpu.get(a.pid.remote(), timeout=30)
