@@ -66,8 +66,10 @@ class ClusterBackend:
         self._head = RpcClient(address)
         self._head.subscribe("nodes", self._on_node_event)
         self._head.subscribe("actors", self._on_actor_event)
+        self._head.subscribe("objects", self._on_object_event)
         self._head.call("subscribe", "nodes")
         self._head.call("subscribe", "actors")
+        self._head.call("subscribe", "objects")
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
         self._lock = threading.RLock()
@@ -77,6 +79,13 @@ class ClusterBackend:
         self._dead_actors: Dict[ActorID, str] = {}      # actor -> reason
         self._pending: List[TaskSpec] = []              # no feasible node yet
         self._pgs: Dict[PlacementGroupID, dict] = {}
+        self._my_actors: Dict[ActorID, bool] = {}       # actor -> detached
+        # Lineage: return oid -> creating spec for plain tasks, so a result
+        # whose only copy died with its node can be re-executed (reference:
+        # ObjectRecoveryManager + lineage pinning, reference_count.h:61).
+        self._lineage: Dict[ObjectID, Tuple[TaskSpec, int]] = {}
+        self._lineage_bytes = 0
+        self._reconstructions: Dict[ObjectID, int] = {}
         self._shutdown_flag = False
         self._retry_thread = threading.Thread(
             target=self._pending_loop, name="cluster-pending", daemon=True
@@ -145,8 +154,55 @@ class ClusterBackend:
         refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
                 for oid in spec.return_ids()]
         self._pin_args(spec)
+        self._record_lineage(spec)
         self._route_task(spec)
         return refs
+
+    def _record_lineage(self, spec: TaskSpec) -> None:
+        """Remember the creating spec of each return object (plain tasks
+        only — actor method outputs depend on actor state and are not
+        reconstructible; reference: same restriction)."""
+        from raytpu.core.config import cfg
+
+        if spec.actor_id is not None or spec.is_actor_creation():
+            return
+        per_oid = (len(spec.function_blob)
+                   + sum(len(a.data) for a in spec.args)
+                   + 256) // max(1, spec.num_returns) + 1
+        with self._lock:
+            for oid in spec.return_ids():
+                self._lineage[oid] = (spec, per_oid)
+                self._lineage_bytes += per_oid
+            # FIFO eviction beyond the lineage budget (reference:
+            # max_lineage_bytes, task_manager.h:210).
+            budget = int(cfg.max_lineage_bytes)
+            while self._lineage_bytes > budget and self._lineage:
+                old_oid = next(iter(self._lineage))
+                _, old_size = self._lineage.pop(old_oid)
+                self._lineage_bytes -= old_size
+
+    def _reconstruct(self, oid: ObjectID) -> bool:
+        """Re-execute the task that created a lost object (reference:
+        ``ObjectRecoveryManager::RecoverObject``). Returns True if a
+        re-execution was started (or is already running)."""
+        with self._lock:
+            entry = self._lineage.get(oid)
+            if entry is None:
+                return False
+            spec = entry[0]
+            if spec.task_id in self._inflight or spec in self._pending:
+                return True  # already being produced
+            n = self._reconstructions.get(oid, 0)
+            if n >= 3:
+                return False
+            self._reconstructions[oid] = n + 1
+        spec.attempt += 1
+        self._pin_args(spec)
+        try:
+            self._route_task(spec)
+        except Exception:
+            return False
+        return True
 
     def _route_task(self, spec: TaskSpec) -> None:
         node_id = self._pick_node(spec)
@@ -282,6 +338,7 @@ class ClusterBackend:
             raise ValueError("scheduled node vanished; retry")
         with self._lock:
             self._actor_nodes[ac.actor_id] = node_id
+            self._my_actors[ac.actor_id] = bool(ac.lifetime_detached)
         try:
             self._ship_runtime_env(spec, addr)
         except Exception:
@@ -300,11 +357,26 @@ class ClusterBackend:
         with self._lock:
             node_id = self._actor_nodes.get(spec.actor_id)
         if node_id is None:
-            info = self._head.call("resolve_actor", spec.actor_id.hex())
-            if info is None:
-                self._fail_refs(spec, ActorDiedError(
-                    spec.actor_id.hex(), "actor not found"))
-                return refs
+            # Resolve via the head; if the head is mid-restart, wait for
+            # the new incarnation instead of failing (reference: client
+            # submissions buffer while GCS restarts an actor).
+            deadline = time.monotonic() + 30.0
+            while True:
+                info = self._head.call("resolve_actor", spec.actor_id.hex())
+                if info is not None and info.get("state") == "alive":
+                    break
+                with self._lock:
+                    dead = self._dead_actors.get(spec.actor_id)
+                if dead is not None or info is None:
+                    self._fail_refs(spec, ActorDiedError(
+                        spec.actor_id.hex(), dead or "actor not found"))
+                    return refs
+                if time.monotonic() >= deadline:
+                    self._fail_refs(spec, ActorDiedError(
+                        spec.actor_id.hex(),
+                        "actor stuck restarting for 30s"))
+                    return refs
+                time.sleep(0.1)
             node_id = info["node_id"]
             with self._lock:
                 self._actor_nodes[spec.actor_id] = node_id
@@ -378,6 +450,7 @@ class ClusterBackend:
                    timeout: Optional[float] = None) -> SerializedValue:
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = 0.005
+        empty_since: Optional[float] = None
         while True:
             sv = self.store.try_get(ref.id)
             if sv is not None:
@@ -398,6 +471,23 @@ class ClusterBackend:
                     sv = SerializedValue.from_buffer(blob)
                     self.store.put(ref.id, sv)
                     return sv
+            if not locs:
+                # No copy anywhere. If the creating task is not running
+                # and we hold its lineage, re-execute it (reference:
+                # ObjectRecoveryManager lineage reconstruction).
+                now = time.monotonic()
+                if empty_since is None:
+                    empty_since = now
+                elif now - empty_since > 0.5:
+                    empty_since = now
+                    with self._lock:
+                        producing = any(
+                            ref.id in rec.spec.return_ids()
+                            for rec in self._inflight.values())
+                    if not producing:
+                        self._reconstruct(ref.id)
+            else:
+                empty_since = None
             if deadline is not None and time.monotonic() >= deadline:
                 raise GetTimeoutError(
                     f"object {ref.id.hex()} not ready within {timeout}s")
@@ -459,11 +549,46 @@ class ClusterBackend:
         except Exception:
             return False
 
-    def _on_actor_event(self, data: dict) -> None:
-        if data.get("event") != "dead":
+    def _on_object_event(self, data: dict) -> None:
+        """A node reported an object with zero copies (its producer's node
+        died after completion): reconstruct from lineage if we own it."""
+        if data.get("event") != "unavailable":
             return
-        self._mark_actor_dead(ActorID.from_hex(data["actor_id"]),
-                              data.get("reason", "actor died"))
+        try:
+            oid = ObjectID.from_hex(data["object_id"])
+        except Exception:
+            return
+        if not self.store.contains(oid):
+            self._reconstruct(oid)
+
+    def _on_actor_event(self, data: dict) -> None:
+        event = data.get("event")
+        aid_hex = data.get("actor_id")
+        if not aid_hex:
+            return
+        actor_id = ActorID.from_hex(aid_hex)
+        if event == "dead":
+            self._mark_actor_dead(actor_id, data.get("reason", "actor died"))
+        elif event == "restarting":
+            # Head is restarting it: drop the stale location and fail only
+            # the tasks that were in flight on the dead incarnation; new
+            # submissions wait for the restart (reference: clients buffer
+            # while GCS restarts the actor).
+            with self._lock:
+                self._actor_nodes.pop(actor_id, None)
+                pending = self._actor_inflight.pop(actor_id, [])
+            err = ActorDiedError(
+                actor_id.hex(),
+                f"actor restarting: {data.get('reason', '')} (in-flight "
+                f"calls on the dead incarnation are lost)")
+            for spec in pending:
+                if not any(self._safe_located(oid)
+                           for oid in spec.return_ids()):
+                    self._fail_refs(spec, err)
+        elif event == "restarted":
+            with self._lock:
+                self._actor_nodes[actor_id] = data.get("node_id")
+                self._dead_actors.pop(actor_id, None)
 
     def _mark_actor_dead(self, actor_id: ActorID, reason: str) -> None:
         with self._lock:
@@ -611,6 +736,16 @@ class ClusterBackend:
 
     def shutdown(self) -> None:
         self._shutdown_flag = True
+        # Non-detached actors die with their driver (reference: actors are
+        # owned by the creating job unless lifetime="detached").
+        with self._lock:
+            own = [aid for aid, detached in self._my_actors.items()
+                   if not detached and aid not in self._dead_actors]
+        for aid in own:
+            try:
+                self.kill_actor(aid, no_restart=True)
+            except Exception:
+                pass
         self._free_queue.put(None)
         try:
             self._node.stop()

@@ -11,6 +11,14 @@ One process per cluster. Tables are in-memory dicts (the reference's
 default ``InMemoryStoreClient``); everything is reconstructible from node
 re-registration, matching the reference's GCS-restart story.
 
+Actor restarts are head-driven (reference: the ``GcsActorManager``
+restart state machine, ``gcs_actor_manager.h:88``): when a restartable
+actor's worker or node dies, the head marks it RESTARTING, re-schedules
+the stored creation spec onto a live node, and publishes
+``restarting``/``restarted`` so drivers hold submissions instead of
+failing them; DEAD is only published when restarts are exhausted or the
+kill was explicit (``no_restart``).
+
 TPU-first twist: a node registers with its slice topology; the scheduler
 packs TPU bundles onto whole hosts of one slice (contiguous ICI) before
 spreading — the topology is a scheduling dimension, not an env var.
@@ -83,6 +91,7 @@ class HeadServer:
         h("resolve_actor", self._resolve_actor)
         h("resolve_named_actor", self._resolve_named_actor)
         h("actor_dead", self._actor_dead)
+        h("object_unavailable", self._object_unavailable)
         h("report_object", self._report_object)
         h("forget_object", self._forget_object)
         h("locate_object", self._locate_object)
@@ -94,6 +103,11 @@ class HeadServer:
         h("next_job_id", self._next_job_id)
         h("ping", lambda peer: "pong")
         self._rpc.on_disconnect(self._peer_gone)
+        # Actor-restart machinery (reference: GcsActorManager).
+        import queue as _q
+
+        self._restart_queue: "_q.Queue" = _q.Queue()
+        self._node_clients: Dict[str, Any] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -103,11 +117,21 @@ class HeadServer:
             target=self._health_loop, name="head-health", daemon=True
         )
         self._checker.start()
+        self._restarter = threading.Thread(
+            target=self._restart_loop, name="head-actor-restart", daemon=True
+        )
+        self._restarter.start()
         return addr
 
     def stop(self) -> None:
         self._stop.set()
+        self._restart_queue.put(None)
         self._rpc.stop()
+        for c in self._node_clients.values():
+            try:
+                c.close()
+            except Exception:
+                pass
 
     @property
     def address(self) -> str:
@@ -169,14 +193,11 @@ class HeadServer:
             if entry is None or not entry.alive:
                 return
             entry.alive = False
-            dead_actors = [
+            self._node_clients.pop(node_id, None)
+            affected = [
                 aid for aid, info in self._actors.items()
-                if info["node_id"] == node_id
+                if info["node_id"] == node_id and info["state"] == "alive"
             ]
-            for aid in dead_actors:
-                info = self._actors.pop(aid)
-                if info.get("name"):
-                    self._named.pop((info["namespace"], info["name"]), None)
             for oid in list(self._objects):
                 self._objects[oid].discard(node_id)
                 if not self._objects[oid]:
@@ -188,9 +209,9 @@ class HeadServer:
                 ]
         self._publish("nodes", {"event": "removed", "node_id": node_id,
                                 "reason": reason})
-        for aid in dead_actors:
-            self._publish("actors", {"event": "dead", "actor_id": aid,
-                                     "reason": f"node {node_id} {reason}"})
+        for aid in affected:
+            self._on_actor_failure(aid, f"node {node_id} {reason}",
+                                   no_restart=False)
 
     # -- kv ----------------------------------------------------------------
 
@@ -272,16 +293,28 @@ class HeadServer:
     # -- actor directory ---------------------------------------------------
 
     def _register_actor(self, peer: Peer, actor_id: str, node_id: str,
-                        name: Optional[str], namespace: str) -> None:
+                        name: Optional[str], namespace: str,
+                        max_restarts: int = 0,
+                        resources: Optional[Dict[str, float]] = None) -> None:
         with self._lock:
+            existing = self._actors.get(actor_id)
             if name:
                 key = (namespace, name)
                 if key in self._named and self._named[key] != actor_id:
                     raise ValueError(f"actor name {name!r} already taken")
                 self._named[key] = actor_id
-            self._actors[actor_id] = {
-                "node_id": node_id, "name": name, "namespace": namespace,
-            }
+            if existing is not None:
+                # Re-registration during a restart: keep restart counters.
+                existing["node_id"] = node_id
+                existing["state"] = "alive"
+            else:
+                self._actors[actor_id] = {
+                    "node_id": node_id, "name": name, "namespace": namespace,
+                    "max_restarts": int(max_restarts),
+                    "restarts_used": 0,
+                    "resources": dict(resources or {}),
+                    "state": "alive",
+                }
         self._publish("actors", {"event": "registered",
                                  "actor_id": actor_id, "node_id": node_id})
 
@@ -290,10 +323,13 @@ class HeadServer:
             info = self._actors.get(actor_id)
             if info is None:
                 return None
+            if info["state"] == "restarting":
+                return {"state": "restarting"}
             node = self._nodes.get(info["node_id"])
             if node is None or not node.alive:
                 return None
-            return {"node_id": info["node_id"], "address": node.address}
+            return {"node_id": info["node_id"], "address": node.address,
+                    "state": "alive"}
 
     def _resolve_named_actor(self, peer: Peer, name: str,
                              namespace: str) -> Optional[dict]:
@@ -307,13 +343,100 @@ class HeadServer:
         info["actor_id"] = actor_id
         return info
 
-    def _actor_dead(self, peer: Peer, actor_id: str, reason: str) -> None:
+    def _actor_dead(self, peer: Peer, actor_id: str, reason: str,
+                    no_restart: bool = True) -> None:
+        self._on_actor_failure(actor_id, reason, no_restart=no_restart)
+
+    def _on_actor_failure(self, actor_id: str, reason: str,
+                          no_restart: bool) -> None:
+        """Restart-or-bury decision (reference: GcsActorManager
+        ``OnActorWorkerDead``/``max_restarts``)."""
         with self._lock:
-            info = self._actors.pop(actor_id, None)
-            if info and info.get("name"):
-                self._named.pop((info["namespace"], info["name"]), None)
-        self._publish("actors", {"event": "dead", "actor_id": actor_id,
-                                 "reason": reason})
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            restartable = (not no_restart
+                           and info["restarts_used"] < info["max_restarts"]
+                           and f"__actor_spec__::{actor_id}" in self._kv)
+            if restartable:
+                info["restarts_used"] += 1
+                info["state"] = "restarting"
+            else:
+                self._actors.pop(actor_id, None)
+                if info.get("name"):
+                    self._named.pop((info["namespace"], info["name"]), None)
+        if restartable:
+            self._publish("actors", {"event": "restarting",
+                                     "actor_id": actor_id, "reason": reason})
+            self._restart_queue.put((actor_id, reason))
+        else:
+            self._publish("actors", {"event": "dead", "actor_id": actor_id,
+                                     "reason": reason})
+
+    def _restart_loop(self) -> None:
+        """Re-schedule restarting actors onto live nodes and push their
+        stored creation specs (the head dials the node — actors must
+        restart even when no driver is attached, e.g. detached actors)."""
+        from raytpu.cluster.protocol import RpcClient
+
+        while True:
+            item = self._restart_queue.get()
+            if item is None or self._stop.is_set():
+                return
+            actor_id, reason = item
+            with self._lock:
+                info = self._actors.get(actor_id)
+                blob = self._kv.get(f"__actor_spec__::{actor_id}")
+            if info is None or info["state"] != "restarting" or blob is None:
+                continue
+            placed = False
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not self._stop.is_set():
+                node_id = self._schedule(None, info.get("resources", {}))
+                if node_id is None:
+                    time.sleep(0.5)
+                    continue
+                with self._lock:
+                    entry = self._nodes.get(node_id)
+                    address = entry.address if entry and entry.alive else None
+                if address is None:
+                    time.sleep(0.2)
+                    continue
+                try:
+                    client = self._node_clients.get(node_id)
+                    if client is None or client.closed:
+                        client = RpcClient(address)
+                        self._node_clients[node_id] = client
+                    client.call("create_actor", blob, timeout=120.0)
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                # The node's create_actor re-registers the actor (state
+                # flips to alive there).
+                self._publish("actors", {"event": "restarted",
+                                         "actor_id": actor_id,
+                                         "node_id": node_id})
+                placed = True
+                break
+            if not placed:
+                with self._lock:
+                    info = self._actors.pop(actor_id, None)
+                    if info and info.get("name"):
+                        self._named.pop(
+                            (info["namespace"], info["name"]), None)
+                self._publish("actors", {
+                    "event": "dead", "actor_id": actor_id,
+                    "reason": f"restart failed after: {reason}"})
+
+    def _object_unavailable(self, peer: Peer, object_id: str) -> None:
+        """A node cannot locate an object anywhere (its last copy died):
+        tell owners so lineage reconstruction can kick in (reference:
+        ObjectRecoveryManager, object_recovery_manager.h:41)."""
+        with self._lock:
+            known = bool(self._objects.get(object_id))
+        if not known:
+            self._publish("objects", {"event": "unavailable",
+                                      "object_id": object_id})
 
     # -- object directory --------------------------------------------------
 

@@ -220,6 +220,10 @@ class NodeBackend(LocalBackend):
         # still used by serve-only driver nodes).
         self.worker_pool = None
         self._task_worker: Dict[TaskID, object] = {}  # running task -> handle
+        # Actors killed with no_restart=True must not be restarted by the
+        # head (reference: GcsActorManager DestroyActor vs restart).
+        self._no_restart_kills: set = set()
+        self._head_managed_restarts = True  # head owns the restart machine
         chained = self.store.on_put
 
         def _on_put(oid):
@@ -230,11 +234,23 @@ class NodeBackend(LocalBackend):
 
         self.store.on_put = _on_put
 
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        if no_restart:
+            self._no_restart_kills.add(actor_id)
+        super().kill_actor(actor_id, no_restart)
+
     def _actor_died(self, runtime) -> None:
         super()._actor_died(runtime)
         if self.on_actor_dead is not None:
+            no_restart = (
+                runtime.actor_id in self._no_restart_kills
+                or runtime.creation_error is not None
+                or runtime.death_reason == "shutdown"
+            )
+            self._no_restart_kills.discard(runtime.actor_id)
             try:
-                self.on_actor_dead(runtime.actor_id, runtime.death_reason)
+                self.on_actor_dead(runtime.actor_id, runtime.death_reason,
+                                   no_restart)
             except Exception:
                 pass
 
@@ -540,11 +556,13 @@ class NodeServer:
         except Exception:
             pass
 
-    def _report_actor_dead(self, actor_id: ActorID, reason: str) -> None:
+    def _report_actor_dead(self, actor_id: ActorID, reason: str,
+                           no_restart: bool = True) -> None:
         if self._head is None or self._head.closed:
             return
         try:
-            self._head.notify("actor_dead", actor_id.hex(), reason)
+            self._head.notify("actor_dead", actor_id.hex(), reason,
+                              no_restart)
         except Exception:
             pass
 
@@ -583,6 +601,7 @@ class NodeServer:
         """Pull one object into the local store (reference: PullManager)."""
         try:
             delay = 0.01
+            last_unavailable = 0.0
             while not self._stop.is_set():
                 if self.backend.store.contains(oid):
                     return
@@ -603,6 +622,17 @@ class NodeServer:
                         self.backend.store.put(
                             oid, SerializedValue.from_buffer(blob))
                         return
+                if not locs:
+                    # No copy anywhere: nudge the owner to reconstruct via
+                    # lineage (reference: pull retry -> ObjectRecovery).
+                    now = time.monotonic()
+                    if now - last_unavailable > 2.0:
+                        last_unavailable = now
+                        try:
+                            self._head.notify("object_unavailable",
+                                              oid.hex())
+                        except Exception:
+                            pass
                 time.sleep(delay)
                 delay = min(delay * 2, 0.2)
         finally:
@@ -619,10 +649,11 @@ class NodeServer:
     def _h_create_actor(self, peer: Peer, spec_blob: bytes) -> None:
         spec: TaskSpec = cloudpickle.loads(spec_blob)
         ac = spec.actor_creation
-        # Directory + spec blob first so named lookup works immediately.
+        # Directory + spec blob first so named lookup works immediately;
+        # max_restarts + resources feed the head's restart state machine.
         self._head.call(
             "register_actor", ac.actor_id.hex(), self.node_id.hex(),
-            ac.name, ac.namespace,
+            ac.name, ac.namespace, ac.max_restarts, dict(spec.resources),
         )
         self._head.notify(
             "kv_put", f"__actor_spec__::{ac.actor_id.hex()}", spec_blob, True,

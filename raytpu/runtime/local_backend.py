@@ -311,6 +311,11 @@ class LocalBackend:
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
         self._shutdown = False
+        # Local actor-restart bookkeeping (cluster nodes defer to the
+        # head's restart state machine instead).
+        self._head_managed_restarts = False
+        self._no_restart_kills: set = set()
+        self._actor_restarts: Dict[ActorID, int] = {}
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="raytpu-dispatcher", daemon=True
         )
@@ -411,6 +416,8 @@ class LocalBackend:
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         with self._lock:
             actor = self._actors.get(actor_id)
+            if no_restart:
+                self._no_restart_kills.add(actor_id)
         if actor is not None:
             actor.kill()
 
@@ -800,6 +807,33 @@ class LocalBackend:
                 except ValueError:
                     pass
             self._cv.notify_all()
+        self._maybe_restart_actor(runtime)
+
+    def _maybe_restart_actor(self, runtime) -> None:
+        """Local-mode ``max_restarts`` (reference: GcsActorManager restart
+        state machine, ``gcs_actor_manager.h:88``). Cluster nodes skip
+        this — the head restarts actors so they can move to live nodes."""
+        if self._head_managed_restarts or self._shutdown:
+            return
+        spec = runtime.creation_spec
+        ac = spec.actor_creation
+        aid = runtime.actor_id
+        with self._lock:
+            used = self._actor_restarts.get(aid, 0)
+            no_restart = aid in self._no_restart_kills
+            self._no_restart_kills.discard(aid)
+        if (no_restart or runtime.creation_error is not None
+                or runtime.death_reason in ("shutdown",
+                                            "all handles out of scope")
+                or used >= ac.max_restarts):
+            return
+        with self._lock:
+            self._actor_restarts[aid] = used + 1
+        spec.attempt += 1
+        try:
+            self.create_actor(spec)
+        except Exception:
+            pass
 
     def _record_event(self, spec: TaskSpec, state: str):
         if not cfg.enable_timeline:
