@@ -16,6 +16,7 @@ reconstruction: the spec IS the lineage).
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -86,6 +87,7 @@ class ClusterBackend:
         self._lineage: Dict[ObjectID, Tuple[TaskSpec, int]] = {}
         self._lineage_bytes = 0
         self._reconstructions: Dict[ObjectID, int] = {}
+        self._reconstructing: set = set()  # TaskIDs being re-routed
         self._shutdown_flag = False
         self._retry_thread = threading.Thread(
             target=self._pending_loop, name="cluster-pending", daemon=True
@@ -169,9 +171,13 @@ class ClusterBackend:
         per_oid = (len(spec.function_blob)
                    + sum(len(a.data) for a in spec.args)
                    + 256) // max(1, spec.num_returns) + 1
+        # Store a private copy: the submitted spec is mutated by the retry
+        # path (`attempt += 1` in _on_node_event) and must not race with
+        # the lineage record a later reconstruction re-routes.
+        stored = copy.copy(spec)
         with self._lock:
             for oid in spec.return_ids():
-                self._lineage[oid] = (spec, per_oid)
+                self._lineage[oid] = (stored, per_oid)
                 self._lineage_bytes += per_oid
             # FIFO eviction beyond the lineage budget (reference:
             # max_lineage_bytes, task_manager.h:210).
@@ -189,19 +195,34 @@ class ClusterBackend:
             entry = self._lineage.get(oid)
             if entry is None:
                 return False
-            spec = entry[0]
-            if spec.task_id in self._inflight or spec in self._pending:
+            stored = entry[0]
+            # The _reconstructing guard holds the dedupe from this check
+            # until _route_task has registered the task inflight/pending,
+            # so two threads (get_object poll + objects pubsub) can't both
+            # route the same task.
+            if (stored.task_id in self._inflight
+                    or stored.task_id in self._reconstructing
+                    or any(s.task_id == stored.task_id
+                           for s in self._pending)):
                 return True  # already being produced
             n = self._reconstructions.get(oid, 0)
             if n >= 3:
                 return False
             self._reconstructions[oid] = n + 1
-        spec.attempt += 1
+            self._reconstructing.add(stored.task_id)
+        # Route a fresh copy: the stored lineage spec stays immutable so
+        # concurrent reconstructions / retries never share mutable state.
+        spec = copy.copy(stored)
+        spec.attempt = stored.attempt + n + 1
         self._pin_args(spec)
         try:
             self._route_task(spec)
         except Exception:
+            self._unpin_args(spec)
             return False
+        finally:
+            with self._lock:
+                self._reconstructing.discard(stored.task_id)
         return True
 
     def _route_task(self, spec: TaskSpec) -> None:
