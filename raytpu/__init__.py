@@ -44,6 +44,7 @@ from raytpu.runtime.api import (
     nodes,
     timeline,
 )
+from raytpu.runtime.generator import ObjectRefGenerator
 from raytpu.runtime.object_ref import ObjectRef
 from raytpu.runtime.placement_group import (
     placement_group,
@@ -75,6 +76,7 @@ __all__ = [
     "nodes",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "placement_group",
     "PlacementGroup",
     "remove_placement_group",

@@ -88,6 +88,7 @@ class ClusterBackend:
         self._lineage_bytes = 0
         self._reconstructions: Dict[ObjectID, int] = {}
         self._reconstructing: set = set()  # TaskIDs being re-routed
+        self._addr_cache: Dict[str, str] = {}  # node_id -> address
         self._shutdown_flag = False
         self._retry_thread = threading.Thread(
             target=self._pending_loop, name="cluster-pending", daemon=True
@@ -126,6 +127,19 @@ class ClusterBackend:
             if n["node_id"] == node_id and n["alive"]:
                 return n["address"]
         return None
+
+    def _node_addr_cached(self, node_id: str) -> Optional[str]:
+        """Per-element hot path (stream acks): avoid a head round-trip per
+        call; entries are dropped on node-removed events."""
+        with self._lock:
+            addr = self._addr_cache.get(node_id)
+        if addr is not None:
+            return addr
+        addr = self._node_addr(node_id)
+        if addr is not None:
+            with self._lock:
+                self._addr_cache[node_id] = addr
+        return addr
 
     def _required_resources(self, spec: TaskSpec) -> Dict[str, float]:
         return dict(spec.resources or {})
@@ -348,8 +362,10 @@ class ClusterBackend:
 
     def create_actor(self, spec: TaskSpec) -> None:
         ac = spec.actor_creation
-        node_id = self._head.call(
-            "schedule", self._required_resources(spec))
+        # _pick_node honors placement-group scheduling (bundle -> node);
+        # a bare schedule call here would strand PG-placed actors on
+        # arbitrary nodes whose bundle shard they cannot reserve.
+        node_id = self._pick_node(spec)
         if node_id is None:
             raise ValueError(
                 f"no feasible node for actor {ac.name or ac.actor_id.hex()} "
@@ -427,8 +443,12 @@ class ClusterBackend:
             raise ValueError(f"actor {name!r} spec not found")
         spec: TaskSpec = cloudpickle.loads(blob)
         actor_id = ActorID.from_hex(info["actor_id"])
-        with self._lock:
-            self._actor_nodes[actor_id] = info["node_id"]
+        # Mid-restart lookups have no node yet; submission resolves the
+        # new incarnation's location via resolve_actor.
+        node_id = info.get("node_id")
+        if node_id is not None:
+            with self._lock:
+                self._actor_nodes[actor_id] = node_id
         return actor_id, spec
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
@@ -452,6 +472,27 @@ class ClusterBackend:
 
     def actor_handle_removed(self, actor_id: ActorID) -> None:
         pass
+
+    # -- streaming generators ----------------------------------------------
+
+    def _stream_notify(self, method: str, task_id: TaskID,
+                       count: int) -> None:
+        with self._lock:
+            rec = self._inflight.get(task_id)
+        if rec is None:
+            return
+        addr = self._node_addr_cached(rec.node_id)
+        if addr is not None:
+            try:
+                self._peer(addr).notify(method, task_id.hex(), count)
+            except Exception:
+                pass
+
+    def stream_ack(self, task_id: TaskID, consumed: int) -> None:
+        self._stream_notify("stream_ack", task_id, consumed)
+
+    def stream_close(self, task_id: TaskID, consumed: int) -> None:
+        self._stream_notify("stream_close", task_id, consumed)
 
     def cancel_task(self, task_id: TaskID) -> None:
         with self._lock:
@@ -535,6 +576,7 @@ class ClusterBackend:
             return
         node_id = data["node_id"]
         with self._lock:
+            self._addr_cache.pop(node_id, None)
             doomed = [rec for rec in self._inflight.values()
                       if rec.node_id == node_id]
             for rec in doomed:

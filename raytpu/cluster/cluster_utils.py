@@ -53,7 +53,8 @@ class Cluster:
 
     def __init__(self, num_nodes: int = 0,
                  node_resources: Optional[Dict] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 head_storage: Optional[str] = None):
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         # Child processes must import raytpu from the same tree as us even
@@ -65,17 +66,45 @@ class Cluster:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         self._env = env
         self._host = host
-        self.head_proc = subprocess.Popen(
-            [sys.executable, "-m", "raytpu.cluster.head",
-             "--host", host, "--port", "0"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, env=env,
-        )
+        self._head_storage = head_storage
+        self.head_proc = self._spawn_head(port=0)
         line = _await_banner(self.head_proc, "listening on", "head")
         self.address = line.strip().rsplit(" ", 1)[-1]
         self.nodes: List[ClusterNodeHandle] = []
         for _ in range(num_nodes):
             self.add_node(**(node_resources or {"num_cpus": 2}))
+
+    def _spawn_head(self, port: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "raytpu.cluster.head",
+               "--host", self._host, "--port", str(port)]
+        if self._head_storage:
+            cmd += ["--storage", self._head_storage]
+        return subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=self._env,
+        )
+
+    def kill_head(self) -> None:
+        """Chaos hook: SIGKILL the head process (control-plane loss)."""
+        self.head_proc.kill()
+        self.head_proc.wait(timeout=10)
+
+    def restart_head(self) -> None:
+        """Restart the head at the SAME address; requires head_storage for
+        tables to survive (reference: GCS restart with persistent store)."""
+        if self.head_proc.poll() is None:
+            self.kill_head()
+        port = int(self.address.rsplit(":", 1)[-1])
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            self.head_proc = self._spawn_head(port=port)
+            try:
+                _await_banner(self.head_proc, "listening on", "head")
+                return
+            except RuntimeError:
+                # Port may linger in TIME_WAIT briefly after the kill.
+                time.sleep(0.5)
+        raise RuntimeError("head failed to restart on its old port")
 
     def add_node(self, num_cpus: float = 2, num_tpus: int = 0,
                  resources: Optional[Dict[str, float]] = None

@@ -36,6 +36,54 @@ HEARTBEAT_TIMEOUT_S = 5.0
 CHECK_PERIOD_S = 1.0
 
 
+class GcsStore:
+    """Durable table storage behind the head (reference:
+    ``src/ray/gcs/gcs_server/gcs_table_storage.cc`` over a StoreClient;
+    our store client is sqlite — single head process, WAL mode).
+
+    Persisted tables: ``kv`` (incl. actor creation specs), ``actors``
+    (directory + restart counters), ``pgs``. Node entries are ephemeral by
+    design — nodes re-register when the head comes back, exactly the
+    reference's GCS-restart story (``in_memory_store_client.h:31`` +
+    node re-registration, SURVEY A3).
+    """
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS tables ("
+            "tbl TEXT, key TEXT, value BLOB, PRIMARY KEY (tbl, key))")
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO tables (tbl, key, value) "
+                "VALUES (?, ?, ?)", (table, key, value))
+            self._conn.commit()
+
+    def delete(self, table: str, key: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM tables WHERE tbl = ? AND key = ?", (table, key))
+            self._conn.commit()
+
+    def load_all(self, table: str) -> Dict[str, bytes]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM tables WHERE tbl = ?",
+                (table,)).fetchall()
+        return {k: v for k, v in rows}
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
 class NodeEntry:
     def __init__(self, node_id: str, address: str, resources: Dict[str, float],
                  labels: Dict[str, str]):
@@ -57,9 +105,12 @@ class NodeEntry:
 
 
 class HeadServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_path: Optional[str] = None):
         self._rpc = RpcServer(host, port)
         self._lock = threading.RLock()
+        self._store: Optional[GcsStore] = (
+            GcsStore(storage_path) if storage_path else None)
         self._nodes: Dict[str, NodeEntry] = {}
         self._kv: Dict[str, bytes] = {}
         # actor_id(hex) -> {"node_id", "name", "namespace", "creation_blob"}
@@ -108,6 +159,56 @@ class HeadServer:
 
         self._restart_queue: "_q.Queue" = _q.Queue()
         self._node_clients: Dict[str, Any] = {}
+        if self._store is not None:
+            self._reload()
+
+    # -- persistence -------------------------------------------------------
+
+    def _reload(self) -> None:
+        """Rebuild tables from durable storage after a head restart.
+        Actors reload as 'alive' at their recorded node; if that node never
+        re-registers, the health loop's death path fires normally."""
+        import json as _json
+
+        self._kv = dict(self._store.load_all("kv"))
+        for aid, blob in self._store.load_all("actors").items():
+            info = _json.loads(blob)
+            self._actors[aid] = info
+            if info.get("name"):
+                self._named[(info["namespace"], info["name"])] = aid
+        for pg_id, blob in self._store.load_all("pgs").items():
+            self._pgs[pg_id] = _json.loads(blob)
+
+    def _persist_kv(self, key: str, value: Optional[bytes]) -> None:
+        if self._store is None:
+            return
+        if value is None:
+            self._store.delete("kv", key)
+        else:
+            self._store.put("kv", key, value)
+
+    def _persist_actor(self, actor_id: str) -> None:
+        if self._store is None:
+            return
+        import json as _json
+
+        info = self._actors.get(actor_id)
+        if info is None:
+            self._store.delete("actors", actor_id)
+        else:
+            self._store.put("actors", actor_id,
+                            _json.dumps(info).encode())
+
+    def _persist_pg(self, pg_id: str) -> None:
+        if self._store is None:
+            return
+        import json as _json
+
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            self._store.delete("pgs", pg_id)
+        else:
+            self._store.put("pgs", pg_id, _json.dumps(pg).encode())
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -121,12 +222,46 @@ class HeadServer:
             target=self._restart_loop, name="head-actor-restart", daemon=True
         )
         self._restarter.start()
+        if self._store is not None:
+            # Recover reloaded actors: re-enqueue interrupted restarts now;
+            # after a node-re-registration grace period, declare actors at
+            # never-returning nodes failed so their restart path fires.
+            with self._lock:
+                for aid, info in self._actors.items():
+                    if info["state"] == "restarting":
+                        self._restart_queue.put((aid, "resumed after head "
+                                                      "restart"))
+            threading.Thread(target=self._reap_orphaned_actors,
+                             name="head-reload-reaper", daemon=True).start()
         return addr
+
+    def _reap_orphaned_actors(self) -> None:
+        """Reloaded 'alive' actors whose node never re-registers would stay
+        resolvable-but-dead forever (the health loop only scans registered
+        nodes). Give nodes 2x the heartbeat window to come back, then run
+        the normal failure path for the rest."""
+        if self._stop.wait(HEARTBEAT_TIMEOUT_S * 2):
+            return
+        with self._lock:
+            orphaned = [
+                aid for aid, info in self._actors.items()
+                if info["state"] == "alive" and (
+                    info["node_id"] not in self._nodes
+                    or not self._nodes[info["node_id"]].alive)
+            ]
+        for aid in orphaned:
+            self._on_actor_failure(
+                aid, "node lost during head downtime", no_restart=False)
 
     def stop(self) -> None:
         self._stop.set()
         self._restart_queue.put(None)
         self._rpc.stop()
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:
+                pass
         for c in self._node_clients.values():
             try:
                 c.close()
@@ -221,6 +356,7 @@ class HeadServer:
             if not overwrite and key in self._kv:
                 return False
             self._kv[key] = value
+            self._persist_kv(key, value)
             return True
 
     def _kv_get(self, peer: Peer, key: str) -> Optional[bytes]:
@@ -229,7 +365,10 @@ class HeadServer:
 
     def _kv_del(self, peer: Peer, key: str) -> bool:
         with self._lock:
-            return self._kv.pop(key, None) is not None
+            existed = self._kv.pop(key, None) is not None
+            if existed:
+                self._persist_kv(key, None)
+            return existed
 
     def _kv_keys(self, peer: Peer, prefix: str = "") -> List[str]:
         with self._lock:
@@ -315,6 +454,7 @@ class HeadServer:
                     "resources": dict(resources or {}),
                     "state": "alive",
                 }
+            self._persist_actor(actor_id)
         self._publish("actors", {"event": "registered",
                                  "actor_id": actor_id, "node_id": node_id})
 
@@ -365,6 +505,7 @@ class HeadServer:
                 self._actors.pop(actor_id, None)
                 if info.get("name"):
                     self._named.pop((info["namespace"], info["name"]), None)
+            self._persist_actor(actor_id)
         if restartable:
             self._publish("actors", {"event": "restarting",
                                      "actor_id": actor_id, "reason": reason})
@@ -424,6 +565,7 @@ class HeadServer:
                     if info and info.get("name"):
                         self._named.pop(
                             (info["namespace"], info["name"]), None)
+                    self._persist_actor(actor_id)
                 self._publish("actors", {
                     "event": "dead", "actor_id": actor_id,
                     "reason": f"restart failed after: {reason}"})
@@ -561,6 +703,7 @@ class HeadServer:
             self._pgs[pg_id] = {"bundles": list(bundles),
                                 "nodes": placement,
                                 "strategy": strategy}
+            self._persist_pg(pg_id)
             return {"nodes": placement}
 
     def _remove_pg(self, peer: Peer, pg_id: str) -> None:
@@ -568,6 +711,7 @@ class HeadServer:
             pg = self._pgs.pop(pg_id, None)
             if pg is None:
                 return
+            self._persist_pg(pg_id)
             for b, node_id in zip(pg["bundles"], pg["nodes"]):
                 entry = self._nodes.get(node_id) if node_id else None
                 if entry is not None and entry.alive:
@@ -621,8 +765,11 @@ def main() -> None:  # pragma: no cover - exercised via subprocess in tests
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6379)
+    ap.add_argument("--storage", default="",
+                    help="durable table storage path (sqlite); empty = "
+                         "in-memory only")
     args = ap.parse_args()
-    head = HeadServer(args.host, args.port)
+    head = HeadServer(args.host, args.port, storage_path=args.storage or None)
     addr = head.start()
     print(f"raytpu head listening on {addr}", flush=True)
     signal.sigwait({signal.SIGINT, signal.SIGTERM})

@@ -131,6 +131,9 @@ class _ProcActorRuntime:
         self.ready_event.set()
 
     def _dispatch_one(self, spec: TaskSpec):
+        # Visible in _task_worker while running so stream acks route here.
+        with self.backend._lock:
+            self.backend._task_worker[spec.task_id] = self.handle
         try:
             reply = self.handle.client.call(
                 "actor_task", cloudpickle.dumps(spec), timeout=None)
@@ -141,6 +144,9 @@ class _ProcActorRuntime:
             # cannot keep its chip binding as an orphan.
             self.queue.put(("__kill__", f"worker RPC failed: {e}"))
             return
+        finally:
+            with self.backend._lock:
+                self.backend._task_worker.pop(spec.task_id, None)
         self.backend._ingest_results(reply["results"])
         self.backend._task_finished(spec)
 
@@ -465,6 +471,8 @@ class NodeServer:
         h("task_unblocked", self._h_task_unblocked)
         h("get_actor_info", self._h_get_actor_info)
         h("report_put", self._h_report_put)
+        h("stream_ack", self._h_stream_ack)
+        h("stream_close", self._h_stream_close)
         h("available_resources",
           lambda peer: self.backend.available_resources())
         h("cluster_resources",
@@ -545,6 +553,57 @@ class NodeServer:
             except Exception:
                 if self._stop.is_set():
                     return
+                self._reconnect_head()
+
+    def _reconnect_head(self) -> None:
+        """Head bounce recovery: dial the (restarted) head, re-register
+        this node under the same node_id, and re-announce live actors and
+        held objects so the reloaded directory regains its ephemeral state
+        (reference: raylet re-registration after GCS restart, SURVEY A3)."""
+        head = None
+        try:
+            head = RpcClient(self.head_address)
+            head.call(
+                "register_node", self.node_id.hex(), self.address,
+                self.backend.node.total.to_dict(), self.labels, timeout=5.0,
+            )
+        except Exception:
+            if head is not None:  # connected but registration failed
+                try:
+                    head.close()
+                except Exception:
+                    pass
+            return  # head still down; next heartbeat retries
+        old = self._head
+        self._head = head
+        try:
+            if old is not None:
+                old.close()
+        except Exception:
+            pass
+        # Re-announce actors hosted here (directory entries reloaded from
+        # durable storage already point at this node_id; refresh anyway for
+        # actors created since the last snapshot).
+        with self.backend._lock:
+            runtimes = list(self.backend._actors.values())
+        for rt in runtimes:
+            if rt.dead:
+                continue
+            ac = rt.creation_spec.actor_creation
+            try:
+                head.call(
+                    "register_actor", ac.actor_id.hex(),
+                    self.node_id.hex(), ac.name, ac.namespace,
+                    ac.max_restarts, dict(rt.creation_spec.resources),
+                )
+            except Exception:
+                pass
+        # Re-announce object locations.
+        for oid in self.backend.store.keys():
+            try:
+                head.notify("report_object", oid.hex(), self.node_id.hex())
+            except Exception:
+                break
 
     # -- head reporting ----------------------------------------------------
 
@@ -597,12 +656,19 @@ class NodeServer:
             threading.Thread(target=self._fetch_object, args=(oid,),
                              daemon=True).start()
 
-    def _fetch_object(self, oid: ObjectID) -> None:
-        """Pull one object into the local store (reference: PullManager)."""
+    def _fetch_object(self, oid: ObjectID,
+                      deadline_s: Optional[float] = None) -> None:
+        """Pull one object into the local store (reference: PullManager).
+        ``deadline_s`` bounds speculative pulls (fetch-miss path); arg
+        pulls for queued tasks run until the object appears."""
         try:
             delay = 0.01
             last_unavailable = 0.0
+            give_up = (None if deadline_s is None
+                       else time.monotonic() + deadline_s)
             while not self._stop.is_set():
+                if give_up is not None and time.monotonic() >= give_up:
+                    return
                 if self.backend.store.contains(oid):
                     return
                 try:
@@ -663,8 +729,50 @@ class NodeServer:
 
     def _h_submit_actor_task(self, peer: Peer, spec_blob: bytes) -> None:
         spec: TaskSpec = cloudpickle.loads(spec_blob)
+        with self.backend._lock:
+            local = spec.actor_id in self.backend._actors
+        if not local:
+            # Actor hosted elsewhere (nested call from a worker on this
+            # node): route via the head directory to the hosting node,
+            # waiting out restarts like the driver does (reference: direct
+            # actor submission buffers while GCS restarts the actor).
+            threading.Thread(
+                target=self._route_remote_actor_task,
+                args=(spec, spec_blob), daemon=True).start()
+            return
         self._ensure_args_local(spec)
         self.backend.submit_actor_task(spec)
+
+    def _route_remote_actor_task(self, spec: TaskSpec,
+                                 spec_blob: bytes) -> None:
+        deadline = time.monotonic() + 30.0
+        reason = "actor not found"
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                info = self._head.call("resolve_actor", spec.actor_id.hex())
+            except Exception:
+                time.sleep(0.5)
+                continue
+            if info is None:
+                reason = "actor not found or dead"
+                break
+            addr = info.get("address")
+            if info.get("state") == "restarting" or addr is None:
+                time.sleep(0.2)
+                continue
+            if addr == self.address:
+                self._ensure_args_local(spec)
+                self.backend.submit_actor_task(spec)
+                return
+            try:
+                self._peer_client(addr).call("submit_actor_task", spec_blob)
+                return
+            except Exception as e:
+                reason = f"actor node unreachable: {e}"
+                time.sleep(0.5)
+        self.backend.worker._store_error(
+            spec.return_ids(), spec,
+            ActorDiedError(spec.actor_id.hex(), reason))
 
     def _h_kill_actor(self, peer: Peer, actor_id_hex: str,
                       no_restart: bool) -> None:
@@ -676,11 +784,33 @@ class NodeServer:
         self.backend.cancel_task(TaskID(task_id_bin))
 
     def _h_fetch_object(self, peer: Peer, oid_hex: str) -> Optional[bytes]:
-        sv = self.backend.store.try_get(ObjectID.from_hex(oid_hex))
-        return sv.to_bytes() if sv is not None else None
+        oid = ObjectID.from_hex(oid_hex)
+        sv = self.backend.store.try_get(oid)
+        if sv is not None:
+            return sv.to_bytes()
+        # Miss: kick a bounded cross-node pull so a worker's retry loop can
+        # reach objects produced on other nodes (e.g. results of nested
+        # actor calls routed elsewhere; reference: PullManager).
+        with self._fetch_lock:
+            already = oid in self._fetching
+            if not already:
+                self._fetching.add(oid)
+        if not already:
+            threading.Thread(target=self._fetch_object,
+                             args=(oid, 120.0), daemon=True).start()
+        return None
 
     def _h_has_object(self, peer: Peer, oid_hex: str) -> bool:
-        return self.backend.store.contains(ObjectID.from_hex(oid_hex))
+        """Local store, falling back to the cluster directory — worker
+        processes use this for ``wait``/stream readiness on objects that
+        may live on other nodes."""
+        if self.backend.store.contains(ObjectID.from_hex(oid_hex)):
+            return True
+        try:
+            return bool(self._head.call("locate_object", oid_hex,
+                                        timeout=5.0))
+        except Exception:
+            return False
 
     def _h_put_object(self, peer: Peer, oid_hex: str, blob: bytes) -> None:
         self.backend.store.put(ObjectID.from_hex(oid_hex),
@@ -728,6 +858,49 @@ class NodeServer:
         if self.worker_pool is not None:
             self.worker_pool.on_register(worker_id_hex, address, pid)
         return True
+
+    def _h_stream_ack(self, peer: Peer, task_id_hex: str,
+                      count: int) -> None:
+        self._route_stream("stream_ack", task_id_hex, count)
+
+    def _h_stream_close(self, peer: Peer, task_id_hex: str,
+                        count: int) -> None:
+        self._route_stream("stream_close", task_id_hex, count)
+
+    def _route_stream(self, method: str, task_id_hex: str,
+                      count: int) -> None:
+        """Forward a consumer's stream ack to whichever worker process is
+        producing that task — on this node, or (for worker-process
+        consumers of a stream produced elsewhere) on the node the head's
+        object directory says holds the stream's elements."""
+        tid = TaskID.from_hex(task_id_hex)
+        with self.backend._lock:
+            handle = self.backend._task_worker.get(tid)
+        if handle is not None:
+            try:
+                handle.client.notify(method, task_id_hex, count)
+                return
+            except Exception:
+                pass
+        with self.backend.worker._streams_cv:
+            local_stream = tid in self.backend.worker._streams
+        if local_stream:
+            getattr(self.backend.worker, method)(tid, count)
+            return
+        # Producer is on another node: its location is wherever the
+        # consumed element was reported (element i lives at return index
+        # i; index max(count,1) exists for any stream that produced
+        # something).
+        try:
+            elem = ObjectID.for_task_return(tid, max(count, 1))
+            locs = self._head.call("locate_object", elem.hex(), timeout=5.0)
+            for loc in locs or ():
+                if loc["address"] != self.address:
+                    self._peer_client(loc["address"]).notify(
+                        method, task_id_hex, count)
+                    return
+        except Exception:
+            pass
 
     def _h_task_blocked(self, peer: Peer, task_id_bin: bytes) -> None:
         self.backend.task_blocked(TaskID(task_id_bin))

@@ -241,6 +241,11 @@ class WorkerPool:
         env = dict(os.environ)
         env.update(self.base_env)
         env.update(chip_env(h.chips))
+        # The host this node is reachable at — gang rendezvous publishes
+        # coordinator addresses on it (a worker cannot otherwise know its
+        # externally visible IP).
+        env.setdefault("RAYTPU_HOST_IP",
+                       self.node_address.rsplit(":", 1)[0])
         cmd = [
             sys.executable, "-m", "raytpu.cluster.worker_proc",
             "--node", self.node_address,

@@ -87,6 +87,12 @@ class WorkerBackend:
     def cancel_task(self, task_id: TaskID) -> None:
         self._host.node.call("cancel_task", task_id.binary())
 
+    def actor_handle_added(self, actor_id) -> None:
+        pass  # cluster actors live until killed or their node dies
+
+    def actor_handle_removed(self, actor_id) -> None:
+        pass
+
     # -- data plane --------------------------------------------------------
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
@@ -100,6 +106,20 @@ class WorkerBackend:
                                              timeout=5.0))
         except Exception:
             return False
+
+    # -- streaming (nested consumption inside a worker) --------------------
+
+    def stream_ack(self, task_id: TaskID, consumed: int) -> None:
+        try:
+            self._host.node.notify("stream_ack", task_id.hex(), consumed)
+        except Exception:
+            pass
+
+    def stream_close(self, task_id: TaskID, consumed: int) -> None:
+        try:
+            self._host.node.notify("stream_close", task_id.hex(), consumed)
+        except Exception:
+            pass
 
     # -- blocked-worker protocol ------------------------------------------
 
@@ -291,6 +311,11 @@ class _WorkerHost:
                 result = method(*args, **kwargs)
                 if inspect.isawaitable(result):
                     result = await result
+                if spec.streaming:
+                    err = await w._run_stream_async(spec, result)
+                    if err is not None:
+                        w._store_error(spec.return_ids(), spec, err)
+                    return err
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else TaskError.from_exception(
                 spec.name, e)
@@ -330,6 +355,7 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     _api._backend = backend
     _api._worker = host.worker
     host.worker.put_object = _forwarding_put(host)
+    host.worker.on_stream_element = _stream_forward(host)
 
     import asyncio
 
@@ -355,9 +381,17 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
         threading.Thread(target=_delayed_exit, daemon=True).start()
         return True
 
+    def h_stream_ack(peer: Peer, task_id_hex: str, count: int):
+        host.worker.stream_ack(TaskID.from_hex(task_id_hex), count)
+
+    def h_stream_close(peer: Peer, task_id_hex: str, count: int):
+        host.worker.stream_close(TaskID.from_hex(task_id_hex), count)
+
     server.register("execute", h_execute)
     server.register("create_actor", h_create_actor)
     server.register("actor_task", h_actor_task)
+    server.register("stream_ack", h_stream_ack)
+    server.register("stream_close", h_stream_close)
     server.register("kill", h_kill)
     server.register("ping", lambda peer: "pong")
     addr = server.start()
@@ -372,6 +406,24 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
 def _delayed_exit() -> None:  # pragma: no cover
     time.sleep(0.05)  # let the kill reply flush
     os._exit(0)
+
+
+def _stream_forward(host: "_WorkerHost"):
+    """Ship each stream element to the daemon the moment it is produced
+    (the task's RPC reply is still in flight — elements must not wait for
+    it). Shm-sealed elements just need a location report."""
+
+    def fwd(oid: ObjectID) -> None:
+        shm = host.store._shm
+        if shm is not None and shm.contains(oid):
+            host.node.notify("report_put", oid.hex())
+            return
+        sv = host.store.try_get(oid)
+        if sv is not None:
+            host.node.call("put_object", oid.hex(), sv.to_bytes())
+            host.store.delete([oid])
+
+    return fwd
 
 
 def _forwarding_put(host: "_WorkerHost"):

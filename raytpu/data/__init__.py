@@ -4,6 +4,7 @@ from raytpu.data.block import Block, BlockAccessor
 from raytpu.data.dataset import DataIterator, Dataset
 from raytpu.data.read_api import (
     from_arrow,
+    from_generator,
     from_items,
     from_numpy,
     from_pandas,
@@ -22,6 +23,7 @@ __all__ = [
     "BlockAccessor",
     "range",
     "range_tensor",
+    "from_generator",
     "from_items",
     "from_numpy",
     "from_pandas",

@@ -52,6 +52,31 @@ def range_tensor(n: int, *, shape=(1,), blocks: int = 8) -> Dataset:
     return Dataset(source, [], name=f"range_tensor({n})")
 
 
+def from_generator(generator, *, name: str = "from_generator") -> Dataset:
+    """Dataset over a streaming task's output.
+
+    ``generator`` is an :class:`~raytpu.ObjectRefGenerator` (from a
+    ``num_returns="streaming"`` task) or a zero-arg callable returning one.
+    Each yielded chunk (dict of arrays, list of rows, arrow table, pandas
+    frame) becomes one block — consumable by ``iter_batches`` while the
+    producer task is still running (reference: Ray Data over streaming
+    generators, ``python/ray/data/read_api.py`` iterator sources).
+
+    A bare generator is single-consumption (like any iterator); pass a
+    callable to make the dataset re-iterable.
+    """
+    from raytpu.data.block import normalize_batch_output
+    from raytpu.data.executor import OpSpec
+
+    def source():
+        gen = generator() if callable(generator) else generator
+        for ref in gen:
+            yield ref
+
+    ds = Dataset(source, [], name=name)
+    return ds._with_op(OpSpec("normalize", normalize_batch_output))
+
+
 def from_items(items: List[Any], *, blocks: int = 8) -> Dataset:
     items = list(items)
     blocks = max(1, min(blocks, len(items) or 1))

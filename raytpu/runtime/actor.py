@@ -36,21 +36,32 @@ def method_meta_from_class(cls: type) -> Dict[str, int]:
     return meta
 
 
+_METHOD_OPTIONS = {"num_returns", "generator_backpressure_num_objects"}
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, opts: Optional[Dict[str, Any]] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._opts = dict(opts or {})
 
     def options(self, **opts) -> "ActorMethod":
-        m = ActorMethod(self._handle, self._method_name,
-                        opts.get("num_returns", self._num_returns))
-        return m
+        bad = set(opts) - _METHOD_OPTIONS
+        if bad:
+            raise ValueError(f"invalid actor method options: {sorted(bad)}")
+        merged = {**self._opts, **opts}
+        return ActorMethod(self._handle, self._method_name,
+                           merged.get("num_returns", self._num_returns),
+                           merged)
 
     def remote(self, *args, **kwargs):
         return self._handle._invoke(
-            self._method_name, args, kwargs, num_returns=self._num_returns
+            self._method_name, args, kwargs,
+            num_returns=self._opts.get("num_returns", self._num_returns),
+            backpressure=int(self._opts.get(
+                "generator_backpressure_num_objects", 0) or 0),
         )
 
     def bind(self, *args, **kwargs):
@@ -92,12 +103,15 @@ class ActorHandle:
             )
         return ActorMethod(self, name, self._method_meta[name])
 
-    def _invoke(self, method_name: str, args, kwargs, num_returns: int = 1):
+    def _invoke(self, method_name: str, args, kwargs, num_returns=1,
+                backpressure: int = 0):
         from raytpu.runtime import api
+        from raytpu.runtime.remote_function import streaming_opts
 
         worker, backend = api._worker_and_backend()
         task_args, kw_keys, keepalive, inline_refs = serialize_args(
             worker, args, kwargs)
+        nret, streaming, _ = streaming_opts({"num_returns": num_returns})
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=worker.job_id,
@@ -106,12 +120,20 @@ class ActorHandle:
             args=task_args,
             kwargs_keys=kw_keys,
             inline_refs=inline_refs,
-            num_returns=num_returns,
+            num_returns=nret,
             actor_id=self._actor_id,
+            streaming=streaming,
+            backpressure=backpressure,
             owner_address=worker.worker_id.binary(),
         )
         refs = backend.submit_actor_task(spec)
         del keepalive
+        if streaming:
+            from raytpu.runtime.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id,
+                                      owner=worker.worker_id.binary(),
+                                      backpressure=backpressure)
         return refs[0] if num_returns == 1 else refs
 
     def __del__(self):

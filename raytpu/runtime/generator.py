@@ -1,0 +1,146 @@
+"""Streaming generator returns — ``num_returns="streaming"``.
+
+Reference analogue: ``ObjectRefGenerator`` (``python/ray/_raylet.pyx:272``)
+over ``ObjectRefStream`` (``src/ray/core_worker/task_manager.h:98``). A
+streaming task's executor stores each yielded value as its own object the
+moment it is produced; the caller iterates refs as they appear instead of
+waiting for the whole task.
+
+Wire protocol (rides entirely on the existing object plane — no new RPCs
+for data): element ``i`` of task ``t`` lives at ``for_task_return(t, i+1)``;
+return index 0 is the *completion slot*, written last with a
+:class:`StreamEnd` sentinel carrying the element count. Failure paths
+(worker crash, cancellation, user exception) store their error into the
+completion slot — exactly where non-streaming tasks store errors — so every
+existing failure mechanism terminates the stream for free.
+
+Backpressure (reference: ``generator_backpressure_num_objects``): the
+consumer acks each consumed element; the producer blocks while
+``produced - acked >= backpressure``. Acks flow through the backend
+(in-process counter locally; a node RPC in cluster mode).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from raytpu.core.errors import GetTimeoutError
+from raytpu.core.ids import ObjectID, TaskID
+from raytpu.runtime.object_ref import ObjectRef
+
+
+@dataclass
+class StreamEnd:
+    """Completion sentinel stored at return index 0 of a streaming task."""
+
+    count: int
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs yielded by a streaming task.
+
+    ``__next__`` blocks until the next element exists *somewhere* in the
+    cluster and returns its ref (it does not fetch the value — call
+    ``raytpu.get`` on the ref). Raises the task's error if the stream
+    failed, ``StopIteration`` when exhausted.
+    """
+
+    def __init__(self, task_id: TaskID, owner: Optional[bytes] = None,
+                 backpressure: int = 0):
+        self._task_id = task_id
+        self._owner = owner
+        # With no backpressure window there is nothing waiting on per-
+        # element acks — skip them (in cluster mode each would be a
+        # multi-hop no-op RPC on the hot path). Pin release still happens
+        # in close().
+        self._ack = backpressure > 0
+        self._idx = 0  # elements consumed so far
+        self._end: Optional[int] = None
+        self._closed = False
+        # A live handle on the completion slot: failure paths store their
+        # error here, and this ref keeps that error alive until consumed
+        # (the producer cannot pin it — it may die before writing it).
+        self._done_ref = ObjectRef(ObjectID.for_task_return(task_id, 0),
+                                   owner=owner)
+
+    @property
+    def task_id(self) -> TaskID:
+        return self._task_id
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next(timeout=None)
+
+    def next_ready(self, timeout: float) -> ObjectRef:
+        """Like ``__next__`` but raises :class:`GetTimeoutError` if no
+        element becomes available within ``timeout`` seconds."""
+        return self._next(timeout=timeout)
+
+    def completed(self) -> bool:
+        return self._closed
+
+    def _next(self, timeout: Optional[float]) -> ObjectRef:
+        from raytpu.runtime import api
+
+        if self._closed:
+            raise StopIteration
+        _, backend = api._worker_and_backend()
+        ready = (backend.object_ready if hasattr(backend, "object_ready")
+                 else lambda r: backend.store.contains(r.id))
+        done_ref = self._done_ref
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            if self._end is None or self._idx < self._end:
+                elem = ObjectID.for_task_return(self._task_id, self._idx + 1)
+                if ready(ObjectRef(elem, owner=self._owner,
+                                   _skip_refcount=True)):
+                    self._idx += 1
+                    ref = ObjectRef(elem, owner=self._owner)
+                    if self._ack:
+                        try:
+                            if hasattr(backend, "stream_ack"):
+                                backend.stream_ack(self._task_id, self._idx)
+                        except Exception:
+                            pass
+                    return ref
+            if self._end is None and ready(done_ref):
+                # May raise the stream's stored error (TaskError etc.).
+                val = api.get(done_ref)
+                if isinstance(val, StreamEnd):
+                    self._end = val.count
+                else:  # pragma: no cover - foreign completion value
+                    self._end = self._idx
+            if self._end is not None and self._idx >= self._end:
+                self.close()
+                raise StopIteration
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(
+                    f"no stream element within {timeout}s "
+                    f"(task {self._task_id.hex()})")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    def close(self) -> None:
+        """Release producer-side buffers for anything not consumed."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            from raytpu.runtime import api
+
+            backend = api._backend
+            if backend is not None and hasattr(backend, "stream_close"):
+                backend.stream_close(self._task_id, self._idx)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except BaseException:
+            pass

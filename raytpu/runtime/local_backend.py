@@ -243,6 +243,12 @@ class _ActorRuntime:
                 result = method(*args, **kwargs)
                 if inspect.isawaitable(result):
                     result = await result
+                if spec.streaming:
+                    err = await w._run_stream_async(spec, result)
+                    if err is not None:
+                        w._store_error(spec.return_ids(), spec, err)
+                    self.backend._task_finished(spec)
+                    return
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else TaskError.from_exception(
                 spec.name, e
@@ -434,6 +440,14 @@ class LocalBackend:
             a.num_handles -= 1
             if a.num_handles <= 0 and not a.detached and not a.dead:
                 a.kill("all handles out of scope")
+
+    # -- streaming generators (consumer-side plumbing) -------------------------
+
+    def stream_ack(self, task_id: TaskID, consumed: int) -> None:
+        self.worker.stream_ack(task_id, consumed)
+
+    def stream_close(self, task_id: TaskID, consumed: int) -> None:
+        self.worker.stream_close(task_id, consumed)
 
     def cancel_task(self, task_id: TaskID) -> None:
         self.worker.cancel(task_id)

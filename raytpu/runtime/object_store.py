@@ -93,6 +93,18 @@ class MemoryStore:
                 except Exception:
                     pass
 
+    def keys(self) -> List[ObjectID]:
+        """All locally-held object ids (heap + shared memory) — used to
+        re-announce locations after a control-plane restart."""
+        with self._cv:
+            out = list(self._objects.keys())
+        if self._shm is not None:
+            try:
+                out.extend(self._shm.keys())
+            except Exception:
+                pass
+        return out
+
     def size(self) -> int:
         with self._cv:
             return len(self._objects)

@@ -33,8 +33,19 @@ _VALID_OPTIONS = {
     "placement_group", "placement_group_bundle_index",
     "placement_group_capture_child_tasks", "runtime_env", "max_restarts",
     "max_concurrency", "lifetime", "namespace", "max_task_retries",
-    "concurrency_groups", "memory",
+    "concurrency_groups", "memory", "generator_backpressure_num_objects",
 }
+
+
+def streaming_opts(options: Dict[str, Any]):
+    """(num_returns, streaming, backpressure) from validated options.
+    ``num_returns="streaming"`` turns the task into a generator stream
+    (reference: same literal, python/ray/remote_function.py)."""
+    nr = options.get("num_returns", 1)
+    if nr == "streaming":
+        bp = int(options.get("generator_backpressure_num_objects", 0) or 0)
+        return 1, True, bp
+    return int(nr), False, 0
 
 
 def validate_options(options: Dict[str, Any]) -> None:
@@ -161,6 +172,7 @@ class RemoteFunction:
         opts = self._options
         task_args, kw_keys, keepalive, inline_refs = serialize_args(
             worker, args, kwargs)
+        num_returns, streaming, backpressure = streaming_opts(opts)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=worker.job_id,
@@ -169,16 +181,24 @@ class RemoteFunction:
             args=task_args,
             kwargs_keys=kw_keys,
             inline_refs=inline_refs,
-            num_returns=opts.get("num_returns", 1),
+            num_returns=num_returns,
             resources=build_resources(opts, default_cpus=1.0),
             max_retries=opts.get("max_retries", cfg.task_max_retries),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             scheduling=build_scheduling(opts),
             runtime_env=opts.get("runtime_env"),
+            streaming=streaming,
+            backpressure=backpressure,
             owner_address=worker.worker_id.binary(),
         )
         refs = backend.submit_task(spec)
         del keepalive  # submitted-task refs are registered now
+        if streaming:
+            from raytpu.runtime.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id,
+                                      owner=worker.worker_id.binary(),
+                                      backpressure=backpressure)
         if spec.num_returns == 1:
             return refs[0]
         return refs

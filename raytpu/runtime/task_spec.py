@@ -80,6 +80,12 @@ class TaskSpec:
     # Actor plumbing
     actor_creation: Optional[ActorCreationSpec] = None
     actor_id: Optional[ActorID] = None  # set for actor method calls
+    # Streaming generator (reference: num_returns="streaming",
+    # ``python/ray/_raylet.pyx:272`` ObjectRefGenerator): element i is
+    # stored at return index i+1; index 0 is the completion slot (holds a
+    # ``StreamEnd`` sentinel, or the error for failed/cancelled streams).
+    streaming: bool = False
+    backpressure: int = 0  # max unconsumed elements; 0 = unbounded
     # Ownership
     owner_address: bytes = b""
     # Bookkeeping

@@ -45,6 +45,15 @@ class Worker:
         self._function_cache: Dict[bytes, Callable] = {}
         self._cancelled: set = set()
         self._cancel_lock = threading.Lock()
+        # Streaming-generator state per producing task: produced/acked
+        # counters for backpressure plus the buffer pins the producer holds
+        # on unconsumed elements (reference: ObjectRefStream,
+        # task_manager.h:98).
+        self._streams: Dict[TaskID, dict] = {}
+        self._streams_cv = threading.Condition()
+        # Cluster worker hook: ship each stream element to the node daemon
+        # as it is produced (set by worker_proc.main).
+        self.on_stream_element: Optional[Callable[[ObjectID], None]] = None
         # Cluster nodes set this: results whose owner is a REMOTE driver
         # must not be freed by the local refcount (the owner's handles are
         # not visible here; the owner sends an explicit free instead —
@@ -105,6 +114,180 @@ class Worker:
         # it now (including the stored_in edges just added).
         if not self.pin_owned and self.reference_counter.is_unreferenced(oid):
             self._delete_object(oid)
+
+    # -- streaming generators -------------------------------------------------
+
+    def stream_ack(self, task_id: TaskID, consumed: int) -> None:
+        """Consumer progress report: element ``consumed-1`` was taken.
+        Unblocks a producer waiting on backpressure and releases the
+        buffer pin the producer held on that element."""
+        release = []
+        with self._streams_cv:
+            st = self._streams.get(task_id)
+            if st is None:  # finished/closed stream; pins already handled
+                return
+            if consumed > st["acked"]:
+                st["acked"] = consumed
+                self._streams_cv.notify_all()
+            if consumed in st["pinned"]:
+                st["pinned"].discard(consumed)
+                release.append(consumed)
+        for i in release:
+            self.reference_counter.remove_local_ref(
+                ObjectID.for_task_return(task_id, i))
+
+    def stream_close(self, task_id: TaskID, consumed: int) -> None:
+        """Consumer abandoned the stream: stop the producer, drop the pins
+        on everything it never took."""
+        with self._streams_cv:
+            st = self._streams.pop(task_id, None)
+            if st is None:
+                return
+            st["closed"] = True
+            pinned = sorted(st["pinned"])
+            st["pinned"] = set()
+            self._streams_cv.notify_all()
+        for i in pinned:
+            self.reference_counter.remove_local_ref(
+                ObjectID.for_task_return(task_id, i))
+
+    def _stream_begin(self, tid: TaskID) -> dict:
+        st = {"produced": 0, "acked": 0, "closed": False, "pinned": set()}
+        with self._streams_cv:
+            self._streams[tid] = st
+        return st
+
+    def _stream_put(self, spec: TaskSpec, st: dict, n: int, value) -> bool:
+        """Store element ``n`` (0-based) at return index ``n+1``. Returns
+        False when the consumer closed the stream — the producer must stop
+        (otherwise an abandoned infinite generator runs forever, pinning
+        every element)."""
+        oid = ObjectID.for_task_return(spec.task_id, n + 1)
+        with self._streams_cv:
+            if st["closed"]:
+                return False
+            if not self.pin_owned:
+                # Buffer pin: no consumer handle exists yet; without this
+                # the fire-and-forget check in put_serialized frees the
+                # element immediately. Recorded in `pinned` under the lock
+                # so ack/close release exactly the pins that exist.
+                st["pinned"].add(n + 1)
+                self.reference_counter.add_local_ref(oid)
+        self.put_serialized(oid, serialize(value),
+                            creating_task=spec.task_id)
+        if self.on_stream_element is not None:
+            self.on_stream_element(oid)
+        with self._streams_cv:
+            st["produced"] = n + 1
+        return True
+
+    def _stream_finish(self, spec: TaskSpec, st: dict, n: int) -> None:
+        from raytpu.runtime.generator import StreamEnd
+
+        done_oid = ObjectID.for_task_return(spec.task_id, 0)
+        self.put_serialized(done_oid, serialize(StreamEnd(n)),
+                            creating_task=spec.task_id)
+        if self.on_stream_element is not None:
+            self.on_stream_element(done_oid)
+        # Cluster workers pin nothing (pin_owned): drop the state now so
+        # long-lived workers don't accumulate one entry per stream. Local
+        # producers keep it until the consumer's stream_close releases the
+        # element pins.
+        if self.pin_owned:
+            with self._streams_cv:
+                if self._streams.get(spec.task_id) is st:
+                    self._streams.pop(spec.task_id, None)
+
+    def _backpressured(self, spec: TaskSpec, st: dict, n: int) -> bool:
+        with self._streams_cv:
+            return (spec.backpressure > 0
+                    and not st["closed"]
+                    and not self.is_cancelled(spec.task_id)
+                    and n - st["acked"] >= spec.backpressure)
+
+    def _run_stream(self, spec: TaskSpec, iterator) -> Optional[BaseException]:
+        """Drain a generator task: store element ``i`` at return index
+        ``i+1`` as produced, then a StreamEnd at index 0. Returns the
+        user/cancel error, if any (stored by the caller's policy at index
+        0 — the completion slot doubles as the failure slot)."""
+        tid = spec.task_id
+        st = self._stream_begin(tid)
+        n = 0
+        try:
+            for value in iterator:
+                if self.is_cancelled(tid):
+                    return TaskCancelledError(f"task {spec.name} cancelled")
+                if not self._stream_put(spec, st, n, value):
+                    break  # consumer closed the stream
+                n += 1
+                with self._streams_cv:
+                    while (spec.backpressure > 0
+                           and not st["closed"]
+                           and not self.is_cancelled(tid)
+                           and n - st["acked"] >= spec.backpressure):
+                        self._streams_cv.wait(timeout=0.1)
+        except BaseException as e:  # noqa: BLE001
+            return e if isinstance(e, TaskError) else TaskError.from_exception(
+                spec.name, e)
+        self._stream_finish(spec, st, n)
+        return None
+
+    async def _run_stream_async(self, spec: TaskSpec,
+                                aiterator) -> Optional[BaseException]:
+        """Async-actor variant of :meth:`_run_stream` — drains an async (or
+        sync) generator on the actor's event loop without blocking it for
+        backpressure waits."""
+        import asyncio
+
+        tid = spec.task_id
+        st = self._stream_begin(tid)
+        n = 0
+        loop = asyncio.get_event_loop()
+        try:
+            if hasattr(aiterator, "__aiter__"):
+                async for value in aiterator:
+                    if self.is_cancelled(tid):
+                        return TaskCancelledError(
+                            f"task {spec.name} cancelled")
+                    # put may do blocking I/O (shm seal / daemon RPC):
+                    # keep it off the actor's event loop.
+                    if not await loop.run_in_executor(
+                            None, self._stream_put, spec, st, n, value):
+                        break
+                    n += 1
+                    while self._backpressured(spec, st, n):
+                        await asyncio.sleep(0.02)
+            else:
+                # Sync generator on an async actor: every next() runs user
+                # compute — drain it on the executor so health checks and
+                # concurrent requests stay live.
+                it = iter(aiterator)
+
+                def _next():
+                    try:
+                        return True, next(it)
+                    except StopIteration:
+                        return False, None
+
+                while True:
+                    ok, value = await loop.run_in_executor(None, _next)
+                    if not ok:
+                        break
+                    if self.is_cancelled(tid):
+                        return TaskCancelledError(
+                            f"task {spec.name} cancelled")
+                    if not await loop.run_in_executor(
+                            None, self._stream_put, spec, st, n, value):
+                        break
+                    n += 1
+                    while self._backpressured(spec, st, n):
+                        await asyncio.sleep(0.02)
+        except BaseException as e:  # noqa: BLE001
+            return e if isinstance(e, TaskError) else TaskError.from_exception(
+                spec.name, e)
+        await loop.run_in_executor(
+            None, self._stream_finish, spec, st, n)
+        return None
 
     # -- cancellation ---------------------------------------------------------
 
@@ -208,6 +391,13 @@ class Worker:
             else:
                 fn = self.load_function(spec.function_blob)
                 result = fn(*args, **kwargs)
+            if spec.streaming:
+                # Iterate inside the runtime-env/context scope: generator
+                # bodies run lazily, element by element.
+                err = self._run_stream(spec, result)
+                if err is not None:
+                    _maybe_store(return_ids, spec, err)
+                return err
         except BaseException as e:  # noqa: BLE001 — must capture everything
             err = e if isinstance(e, TaskError) else TaskError.from_exception(
                 spec.name, e

@@ -140,6 +140,11 @@ class ProxyActor:
         model_id = request.headers.get("serve_multiplexed_model_id")
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
+        # SSE contract (reference: Serve StreamingResponse): a client that
+        # accepts text/event-stream gets the handler's chunks as they are
+        # produced — the token-streaming path for jitted LM serving.
+        if "text/event-stream" in request.headers.get("Accept", ""):
+            return await self._handle_sse(request, handle, req)
         try:
             result = await handle.remote_async(req)
         except TimeoutError:
@@ -148,6 +153,54 @@ class ProxyActor:
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         status, payload, ctype = _encode_response(result)
         return web.Response(status=status, body=payload, content_type=ctype.split(";")[0])
+
+    async def _handle_sse(self, request, handle, req: Request):
+        """Stream the handler's chunks as server-sent events; each chunk is
+        written the moment its object exists, ending with ``[DONE]``."""
+        from aiohttp import web
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        loop = asyncio.get_event_loop()
+        try:
+            gen = await loop.run_in_executor(
+                None, lambda: handle.remote_streaming(req))
+            it = iter(gen)
+
+            def _pull():
+                try:
+                    return True, next(it)
+                except StopIteration:
+                    return False, None
+
+            while True:
+                ok, chunk = await loop.run_in_executor(None, _pull)
+                if not ok:
+                    break
+                if isinstance(chunk, bytes):
+                    data = chunk.decode("utf-8", "replace")
+                elif isinstance(chunk, str):
+                    data = chunk
+                else:
+                    data = _json.dumps(chunk)
+                # SSE framing: every line of a multi-line chunk needs its
+                # own "data:" field or clients drop the extra lines.
+                frame = "".join(f"data: {ln}\n"
+                                for ln in data.split("\n")) + "\n"
+                await resp.write(frame.encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except Exception as e:  # surface mid-stream failures in-band
+            await resp.write(
+                f"event: error\ndata: {type(e).__name__}: {e}\n\n".encode())
+        await resp.write_eof()
+        return resp
 
     async def shutdown(self):
         task = getattr(self, "_poll_task", None)

@@ -160,20 +160,89 @@ class Replica:
                 # The semaphore acquire itself failed/cancelled: undo enqueue.
                 self._num_queued -= 1
 
-    async def _invoke(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+    async def handle_request_streaming(
+        self,
+        method_name: str,
+        request_args: tuple,
+        request_kwargs: dict,
+        request_meta: Optional[dict] = None,
+    ):
+        """Streaming twin of :meth:`handle_request` — an async generator
+        yielding the handler's chunks. Invoked with
+        ``num_returns="streaming"`` so each chunk becomes an object the
+        caller can consume while the handler still runs (reference: Serve
+        StreamingResponse over ObjectRefGenerator)."""
+        if self._shutting_down:
+            raise RuntimeError(f"replica {self._replica_id} is draining")
+        if self._max_queued >= 0 and self._num_queued >= self._max_queued:
+            raise TooManyQueuedRequests(
+                f"replica {self._replica_id}: {self._num_queued} queued >= "
+                f"max_queued_requests={self._max_queued}"
+            )
+        self._num_queued += 1
+        dequeued = False
+        try:
+            async with self._sem:
+                self._num_queued -= 1
+                dequeued = True
+                self._num_ongoing += 1
+                self._metric_samples.append(
+                    (time.monotonic(), self._num_ongoing + self._num_queued)
+                )
+                token = _request_context.set(dict(request_meta or {}))
+                try:
+                    result = await self._invoke_stream(
+                        method_name, request_args, request_kwargs
+                    )
+                    if hasattr(result, "__aiter__"):
+                        async for chunk in result:
+                            yield chunk
+                    elif hasattr(result, "__next__") or hasattr(
+                            result, "__iter__"):
+                        for chunk in result:
+                            yield chunk
+                    else:  # non-streaming handler: one chunk
+                        yield result
+                finally:
+                    _request_context.reset(token)
+                    self._num_ongoing -= 1
+                    self._total_handled += 1
+        finally:
+            if not dequeued:
+                self._num_queued -= 1
+
+    async def _invoke_stream(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        target = self._resolve_target(method_name)
+        fn = target if (inspect.isfunction(target)
+                        or inspect.ismethod(target)) else getattr(
+            target, "__call__", target)
+        if inspect.isasyncgenfunction(fn) or inspect.isgeneratorfunction(fn):
+            # Generator functions return their (a)sync generator instantly;
+            # the stream driver drains it off-loop.
+            return target(*args, **kwargs)
+        # Plain handler used with the streaming path: same executor /
+        # coroutine semantics as the non-streaming invoke (single chunk).
+        return await self._invoke(method_name, args, kwargs)
+
+    def _resolve_target(self, method_name: str):
         if method_name == "__call__":
             target = self._callable
             if not callable(target):
                 raise AttributeError(
                     f"deployment {self._config.deployment_name} is not callable"
                 )
-        else:
-            target = getattr(self._callable, method_name, None)
-            if target is None:
-                raise AttributeError(
-                    f"deployment {self._config.deployment_name} has no method "
-                    f"{method_name!r}"
-                )
+            return target
+        target = getattr(self._callable, method_name, None)
+        if target is None:
+            raise AttributeError(
+                f"deployment {self._config.deployment_name} has no method "
+                f"{method_name!r}"
+            )
+        return target
+
+    async def _invoke(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        target = self._resolve_target(method_name)
         if inspect.iscoroutinefunction(target) or (
             not inspect.isfunction(target) and not inspect.ismethod(target)
             and inspect.iscoroutinefunction(

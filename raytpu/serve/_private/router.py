@@ -154,6 +154,20 @@ class Router:
             method_name, args, kwargs, request_meta or {}
         )
 
+    def assign_request_streaming(
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        request_meta: Optional[dict] = None,
+        timeout_s: float = 30.0,
+    ):
+        """Returns an ObjectRefGenerator of the replica's response chunks."""
+        replica = self._replica_set.choose(timeout_s=timeout_s)
+        return replica.handle_request_streaming.options(
+            num_returns="streaming"
+        ).remote(method_name, args, kwargs, request_meta or {})
+
     @classmethod
     def reset_all(cls):
         with cls._sets_lock:

@@ -32,6 +32,36 @@ class DeploymentResponse:
         return _async_get(self._ref).__await__()
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response's *values* (each chunk
+    the handler yielded), wrapping the underlying ObjectRefGenerator."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self) -> "DeploymentResponseGenerator":
+        return self
+
+    def __next__(self) -> Any:
+        return raytpu.get(next(self._gen))
+
+    def __aiter__(self) -> "DeploymentResponseGenerator":
+        return self
+
+    async def __anext__(self) -> Any:
+        loop = asyncio.get_event_loop()
+        ok, val = await loop.run_in_executor(None, self._pull)
+        if not ok:
+            raise StopAsyncIteration
+        return val
+
+    def _pull(self):
+        try:
+            return True, next(self)
+        except StopIteration:
+            return False, None
+
+
 class DeploymentHandle:
     def __init__(
         self,
@@ -92,6 +122,23 @@ class DeploymentHandle:
             self._method_name, args, kwargs, request_meta=self._meta
         )
         return DeploymentResponse(ref)
+
+    def remote_streaming(self, *args, **kwargs) -> DeploymentResponseGenerator:
+        """Call a streaming handler: returns an iterator of its chunks,
+        consumable while the handler still runs (reference: Serve response
+        streaming over ObjectRefGenerator)."""
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args
+        )
+        kwargs = {
+            k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        gen = self._get_router().assign_request_streaming(
+            self._method_name, args, kwargs, request_meta=self._meta
+        )
+        return DeploymentResponseGenerator(gen)
 
     async def remote_async(self, *args, **kwargs) -> Any:
         loop = asyncio.get_event_loop()

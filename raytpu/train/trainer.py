@@ -36,6 +36,25 @@ from raytpu.train.config import (
 
 
 @raytpu.remote(num_cpus=0)
+class _RendezvousStore:
+    """Named actor publishing the gang coordinator address through the
+    control plane — the analogue of the reference's NCCLUniqueIDStore
+    named actor (SURVEY.md A5; ``util/collective/.../NCCLUniqueIDStore``).
+    Keyed by gang attempt so a restarted gang never reads a dead
+    incarnation's address."""
+
+    def __init__(self):
+        self._addrs: Dict[int, str] = {}
+
+    def set_addr(self, attempt: int, addr: str) -> bool:
+        self._addrs[attempt] = addr
+        return True
+
+    def get_addr(self, attempt: int) -> Optional[str]:
+        return self._addrs.get(attempt)
+
+
+@raytpu.remote(num_cpus=0)
 class TrainWorker:
     """One gang member: hosts the user loop in a thread + a session."""
 
@@ -51,13 +70,56 @@ class TrainWorker:
         self.done = False
 
     def setup_distributed(self, coordinator: Optional[str],
-                          num_processes: int, process_id: int):
+                          num_processes: int, process_id: int,
+                          rdzv_name: Optional[str] = None,
+                          attempt: int = 0):
         """Multi-host rendezvous (reference analogue:
-        ``_setup_torch_process_group``, ``torch/config.py:65``)."""
+        ``_setup_torch_process_group``, ``torch/config.py:65``).
+
+        ``coordinator="auto"``: rank 0 binds a free port on its host and
+        publishes ``host:port`` through the :class:`_RendezvousStore`
+        named actor; other ranks poll it. Then every rank runs
+        ``jax.distributed.initialize`` so the gang forms one global JAX
+        runtime (the mesh spans all hosts' devices).
+        """
         if coordinator is None or num_processes <= 1:
             return True
+        if coordinator == "auto":
+            store = raytpu.get_actor(rdzv_name)
+            if process_id == 0:
+                import socket
+
+                host = os.environ.get("RAYTPU_HOST_IP", "127.0.0.1")
+                s = socket.socket()
+                s.bind((host, 0))
+                port = s.getsockname()[1]
+                s.close()
+                coordinator = f"{host}:{port}"
+                raytpu.get(store.set_addr.remote(attempt, coordinator))
+            else:
+                deadline = time.monotonic() + 60.0
+                while True:
+                    coordinator = raytpu.get(
+                        store.get_addr.remote(attempt))
+                    if coordinator:
+                        break
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            "rendezvous: coordinator address never "
+                            "published")
+                    time.sleep(0.1)
         import jax
 
+        # Honor the spawn-time platform choice: plugin sitecustomize hooks
+        # (e.g. accelerator tunnels) may have overridden jax_platforms at
+        # interpreter startup, and backend init would then block on an
+        # unavailable accelerator instead of using what the node intended.
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -159,13 +221,26 @@ class JaxTrainer(BaseTrainer):
             score_order=rc.checkpoint_config.checkpoint_score_order,
         )
 
+        rdzv = None
+        rdzv_name = None
+        if sc.coordinator_address == "auto" and sc.num_workers > 1:
+            rdzv_name = f"rdzv::{name}"
+            # Restartable: the store must survive node loss — losing it
+            # would burn every gang-retry attempt on rendezvous failures.
+            # A restarted (empty) incarnation is fine: each attempt
+            # publishes under its own key.
+            rdzv = _RendezvousStore.options(
+                name=rdzv_name, max_restarts=100).remote()
+
         attempts = rc.failure_config.max_failures + 1
         last_error = None
         try:
             for attempt in range(attempts):
                 result = self._run_gang(sc, name, run_dir, manager,
                                         cloudpickle.dumps(
-                                            self.train_loop_per_worker))
+                                            self.train_loop_per_worker),
+                                        rdzv_name=rdzv_name,
+                                        attempt=attempt)
                 if result.error is None:
                     return result
                 last_error = result.error
@@ -176,6 +251,11 @@ class JaxTrainer(BaseTrainer):
             return Result(metrics={}, metrics_history=[], checkpoint=None,
                           path=run_dir, error=last_error)
         finally:
+            if rdzv is not None:
+                try:
+                    raytpu.kill(rdzv)
+                except Exception:
+                    pass
             # Staged snapshots that were never registered (failed gangs,
             # undrained reports) are garbage once fit() returns.
             import shutil
@@ -186,9 +266,15 @@ class JaxTrainer(BaseTrainer):
     # -- internals ------------------------------------------------------------
 
     def _run_gang(self, sc: ScalingConfig, name: str, run_dir: str,
-                  manager: CheckpointManager, fn_blob: bytes) -> Result:
+                  manager: CheckpointManager, fn_blob: bytes,
+                  rdzv_name: Optional[str] = None,
+                  attempt: int = 0) -> Result:
+        from raytpu.core.errors import TaskError
+
         pg = None
         workers = []
+        history = []
+        last_ckpt = None
         try:
             bundles = sc.bundle_specs()
             pg = raytpu.placement_group(bundles,
@@ -210,7 +296,8 @@ class JaxTrainer(BaseTrainer):
             # in-process workers share one JAX runtime and must skip it.
             raytpu.get([
                 w.setup_distributed.remote(
-                    sc.coordinator_address, sc.num_workers, i)
+                    sc.coordinator_address, sc.num_workers, i,
+                    rdzv_name, attempt)
                 for i, w in enumerate(workers)])
             resume = (self.resume_from_checkpoint.path
                       if self.resume_from_checkpoint is not None else None)
@@ -219,8 +306,6 @@ class JaxTrainer(BaseTrainer):
                                shards[i], resume)
                 for i, w in enumerate(workers)])
 
-            history = []
-            last_ckpt = None
             error = None
             while True:
                 polls = raytpu.get([w.poll.remote() for w in workers])
@@ -231,8 +316,6 @@ class JaxTrainer(BaseTrainer):
                             Checkpoint(ckpt_path), metrics)
                 errs = [p[2] for p in polls if p[2]]
                 if errs:
-                    from raytpu.core.errors import TaskError
-
                     error = TaskError("train_loop_per_worker", errs[0])
                     break
                 if all(p[1] for p in polls):
@@ -244,6 +327,19 @@ class JaxTrainer(BaseTrainer):
                 checkpoint=last_ckpt or manager.latest(),
                 path=run_dir,
                 error=error,
+            )
+        except Exception as e:
+            # Gang-shaped failure: a member (or its node/PG) died. Surface
+            # it as a failed Result so fit()'s FailureConfig loop restarts
+            # the whole gang from the latest checkpoint (SURVEY §7 hard
+            # part (d)) instead of crashing the driver.
+            return Result(
+                metrics=history[-1] if history else {},
+                metrics_history=history,
+                checkpoint=last_ckpt or manager.latest(),
+                path=run_dir,
+                error=e if isinstance(e, TaskError) else TaskError(
+                    "train_gang", f"gang failure: {type(e).__name__}: {e}"),
             )
         finally:
             for w in workers:

@@ -478,3 +478,98 @@ class TestChaos:
         finally:
             raytpu.shutdown()
             c.shutdown()
+
+
+class TestHeadPersistence:
+    def test_head_restart_cluster_resumes(self, tmp_path):
+        """Kill the head, restart it at the same address with durable
+        tables: nodes re-register, a detached named actor is still
+        resolvable AND retains its state (its process never died), and new
+        work schedules (reference: GCS restart over gcs_table_storage +
+        raylet re-registration, SURVEY A3)."""
+        c = Cluster(num_nodes=1, node_resources={"num_cpus": 2},
+                    head_storage=str(tmp_path / "gcs.db"))
+        c.wait_for_nodes(1)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            @raytpu.remote
+            class Store:
+                def __init__(self):
+                    self.v = {}
+
+                def put(self, k, val):
+                    self.v[k] = val
+                    return True
+
+                def get(self, k):
+                    return self.v.get(k)
+
+            a = Store.options(name="kvstore",
+                              lifetime="detached").remote()
+            assert raytpu.get(a.put.remote("x", 42), timeout=30)
+            raytpu.shutdown()
+
+            c.kill_head()
+            time.sleep(1.0)
+            c.restart_head()
+            # Nodes reconnect on their next heartbeat.
+            c.wait_for_nodes(1, timeout=30)
+
+            raytpu.init(address=f"tcp://{c.address}")
+            b = raytpu.get_actor("kvstore")
+            assert raytpu.get(b.get.remote("x"), timeout=30) == 42, \
+                "detached actor lost across head restart"
+
+            @raytpu.remote
+            def f(v):
+                return v + 1
+
+            assert raytpu.get(f.remote(1), timeout=30) == 2, \
+                "cluster cannot schedule new work after head restart"
+        finally:
+            raytpu.shutdown()
+            c.shutdown()
+
+    def test_head_restart_actor_restart_machinery_survives(self, tmp_path):
+        """After a head bounce, the restart state machine still works: kill
+        the node hosting a max_restarts=1 actor and the NEW head restarts
+        it elsewhere (its spec blob came back from durable KV)."""
+        c = Cluster(num_nodes=2, node_resources={"num_cpus": 1},
+                    head_storage=str(tmp_path / "gcs.db"))
+        c.wait_for_nodes(2)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            @raytpu.remote(max_restarts=1)
+            class Phoenix:
+                def node_pid(self):
+                    import os
+                    return os.getppid()
+
+            a = Phoenix.options(name="phoenix",
+                                lifetime="detached").remote()
+            pid0 = raytpu.get(a.node_pid.remote(), timeout=30)
+            raytpu.shutdown()
+
+            c.kill_head()
+            c.restart_head()
+            c.wait_for_nodes(2, timeout=30)
+
+            raytpu.init(address=f"tcp://{c.address}")
+            victim = next(n for n in c.nodes if n.proc.pid == pid0)
+            c.kill_node(victim)
+            h = raytpu.get_actor("phoenix")
+            deadline = time.monotonic() + 60
+            pid1 = None
+            while time.monotonic() < deadline:
+                try:
+                    pid1 = raytpu.get(h.node_pid.remote(), timeout=10)
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            assert pid1 is not None and pid1 != pid0, \
+                "actor not restarted by the post-bounce head"
+        finally:
+            raytpu.shutdown()
+            c.shutdown()

@@ -1,0 +1,296 @@
+"""Streaming generator tests — ``num_returns="streaming"``.
+
+Reference analogue: ``python/ray/tests/test_streaming_generator.py`` over
+``ObjectRefGenerator`` (``_raylet.pyx:272``) and ObjectRefStream
+backpressure (``task_manager.h:98``).
+"""
+
+import time
+
+import pytest
+
+import raytpu
+from raytpu.runtime.generator import ObjectRefGenerator
+
+
+@pytest.fixture
+def fabric():
+    raytpu.shutdown()
+    raytpu.init(num_cpus=4)
+    yield raytpu
+    raytpu.shutdown()
+
+
+class TestStreamingTasks:
+    def test_basic_iteration(self, fabric):
+        @raytpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * 10
+
+        g = gen.remote(5)
+        assert isinstance(g, ObjectRefGenerator)
+        vals = [raytpu.get(ref) for ref in g]
+        assert vals == [0, 10, 20, 30, 40]
+
+    def test_empty_stream(self, fabric):
+        @raytpu.remote(num_returns="streaming")
+        def gen():
+            if False:
+                yield 1
+
+        assert [raytpu.get(r) for r in gen.remote()] == []
+
+    def test_incremental_delivery(self, fabric):
+        """Early elements are consumable while the producer still runs."""
+        @raytpu.remote(num_returns="streaming")
+        def slow_gen():
+            yield "fast"
+            time.sleep(5.0)
+            yield "slow"
+
+        g = slow_gen.remote()
+        t0 = time.monotonic()
+        first = raytpu.get(next(g))
+        elapsed = time.monotonic() - t0
+        assert first == "fast"
+        assert elapsed < 3.0, "first element waited for the whole task"
+        assert raytpu.get(next(g)) == "slow"
+
+    def test_error_mid_stream(self, fabric):
+        @raytpu.remote(num_returns="streaming")
+        def bad_gen():
+            yield 1
+            yield 2
+            raise ValueError("stream broke")
+
+        g = bad_gen.remote()
+        assert raytpu.get(next(g)) == 1
+        assert raytpu.get(next(g)) == 2
+        with pytest.raises(raytpu.RayTpuError, match="stream broke"):
+            next(g)
+
+    def test_backpressure_pauses_producer(self, fabric):
+        """With generator_backpressure_num_objects=2 the producer cannot
+        run ahead of the consumer by more than 2 elements."""
+        @raytpu.remote(num_returns="streaming",
+                       generator_backpressure_num_objects=2)
+        def counted():
+            import raytpu as r
+            for i in range(10):
+                r.put(("produced", i))  # observable side effect per element
+                yield i
+
+        g = counted.remote()
+        time.sleep(1.0)  # producer should stall at the backpressure cap
+        from raytpu.runtime import api
+
+        # Count elements present in the store before any consumption.
+        from raytpu.core.ids import ObjectID
+
+        backend = api._backend
+        present = sum(
+            1 for i in range(1, 11)
+            if backend.store.contains(
+                ObjectID.for_task_return(g.task_id, i)))
+        assert present <= 3, f"producer ran ahead: {present} elements"
+        vals = [raytpu.get(r) for r in g]
+        assert vals == list(range(10))
+
+    def test_stream_refs_survive_until_consumed(self, fabric):
+        """Unconsumed elements stay alive (producer buffer pins), consumed
+        refs behave like normal ObjectRefs."""
+        @raytpu.remote(num_returns="streaming")
+        def gen():
+            for i in range(3):
+                yield {"i": i}
+
+        g = gen.remote()
+        time.sleep(0.5)  # let the producer finish before we consume
+        refs = list(g)
+        assert [raytpu.get(r)["i"] for r in refs] == [0, 1, 2]
+        # Refs re-read fine (values still pinned by our handles).
+        assert raytpu.get(refs[0])["i"] == 0
+
+    def test_next_ready_timeout(self, fabric):
+        @raytpu.remote(num_returns="streaming")
+        def slow():
+            time.sleep(10)
+            yield 1
+
+        g = slow.remote()
+        with pytest.raises(raytpu.GetTimeoutError):
+            g.next_ready(timeout=0.3)
+
+
+class TestStreamingActors:
+    def test_actor_method_stream(self, fabric):
+        @raytpu.remote
+        class Tokenizer:
+            def stream(self, text):
+                for tok in text.split():
+                    yield tok
+
+        a = Tokenizer.remote()
+        g = a.stream.options(num_returns="streaming").remote("a b c")
+        assert [raytpu.get(r) for r in g] == ["a", "b", "c"]
+
+    def test_method_decorator_streaming(self, fabric):
+        @raytpu.remote
+        class Gen:
+            @raytpu.method(num_returns="streaming")
+            def nums(self, n):
+                for i in range(n):
+                    yield i
+
+        a = Gen.remote()
+        assert [raytpu.get(r) for r in a.nums.remote(4)] == [0, 1, 2, 3]
+
+
+class TestStreamingCluster:
+    def test_cluster_stream_crosses_nodes(self):
+        from raytpu.cluster import Cluster
+
+        c = Cluster(num_nodes=1, node_resources={"num_cpus": 2})
+        c.wait_for_nodes(1)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            @raytpu.remote(num_returns="streaming")
+            def gen(n):
+                for i in range(n):
+                    yield i * i
+
+            g = gen.remote(6)
+            vals = [raytpu.get(ref, timeout=60) for ref in g]
+            assert vals == [0, 1, 4, 9, 16, 25]
+        finally:
+            raytpu.shutdown()
+            c.shutdown()
+
+    def test_cluster_stream_incremental(self):
+        from raytpu.cluster import Cluster
+
+        c = Cluster(num_nodes=1, node_resources={"num_cpus": 2})
+        c.wait_for_nodes(1)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            @raytpu.remote(num_returns="streaming")
+            def slow_gen():
+                yield "first"
+                time.sleep(8.0)
+                yield "last"
+
+            g = slow_gen.remote()
+            t0 = time.monotonic()
+            assert raytpu.get(next(g), timeout=30) == "first"
+            assert time.monotonic() - t0 < 6.0, \
+                "first element waited for task completion"
+            assert raytpu.get(next(g), timeout=30) == "last"
+        finally:
+            raytpu.shutdown()
+            c.shutdown()
+
+
+class TestStreamingConsumers:
+    def test_dataset_from_generator(self, fabric):
+        """A streaming task feeds iter_batches while still producing."""
+        import numpy as np
+
+        from raytpu import data as rdata
+
+        @raytpu.remote(num_returns="streaming")
+        def produce_blocks():
+            for i in range(4):
+                yield {"x": np.full(8, i, dtype=np.int64)}
+
+        ds = rdata.from_generator(produce_blocks.remote())
+        batches = list(ds.iter_batches(batch_size=8))
+        assert len(batches) == 4
+        assert [int(b["x"][0]) for b in batches] == [0, 1, 2, 3]
+
+    def test_dataset_from_generator_with_transform(self, fabric):
+        import numpy as np
+
+        from raytpu import data as rdata
+
+        @raytpu.remote(num_returns="streaming")
+        def produce():
+            for i in range(3):
+                yield {"x": np.arange(4, dtype=np.int64) + 10 * i}
+
+        ds = rdata.from_generator(produce.remote()).map_batches(
+            lambda b: {"x": b["x"] * 2})
+        total = sum(int(b["x"].sum()) for b in ds.iter_batches(batch_size=4))
+        expected = 2 * sum(sum(range(4)) + 4 * 10 * i for i in range(3))
+        assert total == expected
+
+
+class TestServeStreaming:
+    def test_handle_remote_streaming(self):
+        import raytpu.serve as serve
+
+        raytpu.shutdown()
+        raytpu.init(num_cpus=4)
+        try:
+            @serve.deployment
+            class Tokens:
+                def __call__(self, prompt):
+                    for tok in f"echo {prompt}".split():
+                        yield tok + " "
+
+            handle = serve.run(Tokens.bind(), name="stream-app",
+                               route_prefix=None)
+            chunks = list(handle.remote_streaming("hello"))
+            assert "".join(chunks) == "echo hello "
+        finally:
+            import raytpu.serve as serve2
+
+            serve2.shutdown()
+            raytpu.shutdown()
+
+    def test_http_sse_streams_incrementally(self):
+        """SSE endpoint delivers early tokens before the handler finishes
+        — the LM token-streaming story."""
+        import requests as rq
+
+        import raytpu.serve as serve
+
+        raytpu.shutdown()
+        raytpu.init(num_cpus=4)
+        try:
+            @serve.deployment
+            class SlowTokens:
+                def __call__(self, request):
+                    yield "tok0"
+                    time.sleep(4.0)
+                    yield "tok1"
+
+            serve.start(host="127.0.0.1", port=18439)
+            serve.run(SlowTokens.bind(), name="sse", route_prefix="/gen")
+            t0 = time.monotonic()
+            first_at = None
+            events = []
+            with rq.get("http://127.0.0.1:18439/gen",
+                        headers={"Accept": "text/event-stream"},
+                        stream=True, timeout=30) as r:
+                assert r.status_code == 200
+                assert r.headers["Content-Type"].startswith(
+                    "text/event-stream")
+                for line in r.iter_lines():
+                    if not line:
+                        continue
+                    text = line.decode()
+                    if text.startswith("data: "):
+                        events.append(text[len("data: "):])
+                        if first_at is None:
+                            first_at = time.monotonic() - t0
+            assert events == ["tok0", "tok1", "[DONE]"]
+            assert first_at is not None and first_at < 3.0, \
+                f"first token took {first_at}s - not streamed"
+        finally:
+            import raytpu.serve as serve2
+
+            serve2.shutdown()
+            raytpu.shutdown()

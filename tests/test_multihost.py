@@ -1,0 +1,193 @@
+"""Multi-host gang training e2e: 2 node processes x 4 virtual CPU devices
+each, one global JAX mesh spanning both, rendezvous published through the
+control plane, and gang restart after a host death.
+
+Reference analogue: SURVEY.md §7 Milestone B + hard parts (c)/(d); the
+rendezvous pattern mirrors ``_setup_torch_process_group``
+(``python/ray/train/torch/config.py:65``) with the coordinator address
+published via a named actor (A5's NCCLUniqueIDStore analogue).
+
+No TPU needed: each node subprocess exposes 4 virtual CPU devices via
+``--xla_force_host_platform_device_count``; ``jax.distributed`` federates
+them into one 8-device runtime exactly as it federates TPU hosts.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import raytpu
+from raytpu.cluster import Cluster
+from raytpu.train import (
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+VDEVS = "--xla_force_host_platform_device_count=4"
+
+
+def make_gang_loop():
+    """Build the per-worker loop as a NESTED function so cloudpickle ships
+    it by value — a top-level test function would pickle by reference and
+    the worker processes cannot import the test module."""
+
+    def _gang_loop(config):
+        import json
+        import os
+        import tempfile
+        import time
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from raytpu.train import get_checkpoint, get_context, report
+        from raytpu.train.checkpoint import Checkpoint
+
+        ctx = get_context()
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        shard = NamedSharding(mesh, P("dp"))
+
+        @jax.jit
+        def step_fn(x):
+            return jnp.sum(x)  # cross-host reduction inserted by GSPMD
+
+        start = 0
+        ck = get_checkpoint()
+        if ck is not None:
+            with open(os.path.join(ck.path, "state.json")) as f:
+                start = json.load(f)["step"] + 1
+        if config.get("marker"):
+            with open(config["marker"], "a") as f:
+                f.write(f"rank{ctx.get_world_rank()} start_at={start}\n")
+
+        n_dev = jax.device_count()
+        for s in range(start, config["steps"]):
+            x = jax.device_put(
+                jnp.arange(float(n_dev)) + s, shard)
+            total = float(step_fn(x))
+            if config.get("sleep"):
+                time.sleep(config["sleep"])
+            metrics = {
+                "step": s,
+                "sum": total,
+                "nproc": jax.process_count(),
+                "ndev": n_dev,
+            }
+            if ctx.get_world_rank() == 0:
+                with tempfile.TemporaryDirectory() as d:
+                    with open(os.path.join(d, "state.json"), "w") as f:
+                        json.dump({"step": s}, f)
+                    report(metrics, Checkpoint(d))
+            else:
+                report(metrics)
+
+    return _gang_loop
+
+
+@pytest.fixture
+def two_hosts():
+    """Two cluster nodes, each exposing 4 virtual CPU devices to its
+    worker processes."""
+    old = os.environ.get("XLA_FLAGS")
+    old_plat = os.environ.get("JAX_PLATFORMS")
+    os.environ["XLA_FLAGS"] = VDEVS
+    # Children must run CPU JAX even when the outer env selects an
+    # accelerator plugin (Cluster's setdefault would not override it).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        c = Cluster(num_nodes=2, node_resources={"num_cpus": 4})
+        c.wait_for_nodes(2)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        yield c
+    finally:
+        raytpu.shutdown()
+        c.shutdown()
+        if old is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = old
+        if old_plat is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = old_plat
+
+
+class TestMultiHostGang:
+    def test_global_mesh_spans_two_hosts(self, two_hosts, tmp_path):
+        trainer = JaxTrainer(
+            make_gang_loop(),
+            train_loop_config={"steps": 3},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 2},
+                placement_strategy="STRICT_SPREAD",
+                coordinator_address="auto",
+            ),
+            run_config=RunConfig(name="gang-mesh",
+                                 storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.error is None, f"gang failed: {result.error}"
+        assert result.metrics["nproc"] == 2, \
+            "workers did not form a 2-process distributed runtime"
+        assert result.metrics["ndev"] == 8, \
+            "global mesh does not span both hosts' devices"
+        s = result.metrics["step"]
+        assert result.metrics["sum"] == sum(range(8)) + 8 * s
+
+    def test_gang_restart_after_host_death(self, two_hosts, tmp_path):
+        """Kill one host mid-run: the gang fails as a unit, fit() restarts
+        it from the latest checkpoint on replacement capacity, and the run
+        completes having resumed (not restarted from step 0)."""
+        c = two_hosts
+        marker = str(tmp_path / "starts.txt")
+        trainer = JaxTrainer(
+            make_gang_loop(),
+            train_loop_config={"steps": 12, "sleep": 0.5,
+                               "marker": marker},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 2},
+                placement_strategy="STRICT_SPREAD",
+                coordinator_address="auto",
+            ),
+            run_config=RunConfig(
+                name="gang-chaos", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2),
+            ),
+        )
+        box = {}
+
+        def run():
+            box["result"] = trainer.fit()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # Let a few steps (and checkpoints) land, then kill a gang host.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if os.path.exists(marker) and os.path.getsize(marker) > 0:
+                break
+            time.sleep(0.2)
+        time.sleep(2.5)  # a few 0.5s steps' worth of checkpoints
+        c.kill_node(c.nodes[1])
+        c.add_node(num_cpus=4)  # replacement host for the restarted gang
+        t.join(timeout=180)
+        assert not t.is_alive(), "fit() hung after host death"
+        result = box["result"]
+        assert result.error is None, f"gang never recovered: {result.error}"
+        assert result.metrics["step"] == 11
+        assert result.metrics["nproc"] == 2
+        with open(marker) as f:
+            starts = [line.strip() for line in f if "start_at=" in line]
+        restarts = [line for line in starts if not line.endswith("=0")]
+        assert restarts, (
+            f"no gang member resumed from a checkpoint: {starts}")
