@@ -479,14 +479,31 @@ class ClusterBackend:
                        count: int) -> None:
         with self._lock:
             rec = self._inflight.get(task_id)
-        if rec is None:
+        if rec is not None:
+            addr = self._node_addr_cached(rec.node_id)
+            if addr is not None:
+                try:
+                    self._peer(addr).notify(method, task_id.hex(), count)
+                except Exception:
+                    pass
             return
-        addr = self._node_addr_cached(rec.node_id)
-        if addr is not None:
-            try:
-                self._peer(addr).notify(method, task_id.hex(), count)
-            except Exception:
-                pass
+        if method != "stream_close":
+            return
+        # The producing task already completed (inflight record gone) but
+        # its unconsumed elements still sit pinned in node stores; close
+        # must reach every holder so they GC (reference: eager deletion of
+        # un-consumed stream returns).
+        try:
+            elem = ObjectID.for_task_return(task_id, max(count, 1))
+            locs = self._head.call("locate_object", elem.hex(), timeout=5.0)
+            for loc in locs or ():
+                try:
+                    self._peer(loc["address"]).notify(
+                        method, task_id.hex(), count)
+                except Exception:
+                    pass
+        except Exception:
+            pass
 
     def stream_ack(self, task_id: TaskID, consumed: int) -> None:
         self._stream_notify("stream_ack", task_id, consumed)
@@ -525,8 +542,10 @@ class ClusterBackend:
                 if loc["address"] == self._node.address:
                     continue
                 try:
-                    blob = self._peer(loc["address"]).call(
-                        "fetch_object", ref.id.hex(), timeout=60.0)
+                    from raytpu.cluster.transfer import fetch_blob
+
+                    blob = fetch_blob(self._peer(loc["address"]),
+                                      ref.id.hex(), timeout=60.0)
                 except Exception:
                     continue
                 if blob is not None:

@@ -324,12 +324,16 @@ class NodeBackend(LocalBackend):
             reply = handle.client.call(
                 "execute", cloudpickle.dumps(spec), timeout=None)
         except Exception as e:
+            # A deliberate kill (e.g. memory-pressure shedding) carries its
+            # reason on the handle; surface it instead of the raw RPC error.
+            why = handle.kill_reason
             # Kill NOW: marks the handle dead (a stale handle must never
             # return to the idle pool) AND terminates the process if it is
             # somehow still alive — an orphan would keep its chip binding
             # while the coords are handed to the next worker.
             self.worker_pool.kill(handle, f"task RPC failed: {e}")
-            return WorkerCrashedError(f"worker died during task: {e}")
+            return WorkerCrashedError(
+                f"worker died during task: {why or e}")
         finally:
             with self._lock:
                 self._task_worker.pop(spec.task_id, None)
@@ -455,6 +459,8 @@ class NodeServer:
         h("kill_actor", self._h_kill_actor)
         h("cancel_task", self._h_cancel_task)
         h("fetch_object", self._h_fetch_object)
+        h("fetch_object_meta", self._h_fetch_object_meta)
+        h("fetch_object_chunk", self._h_fetch_object_chunk)
         h("has_object", self._h_has_object)
         h("put_object", self._h_put_object)
         h("free_object", self._h_free_object)
@@ -518,16 +524,85 @@ class NodeServer:
         self._hb = threading.Thread(target=self._heartbeat_loop,
                                     name="node-heartbeat", daemon=True)
         self._hb.start()
+        # Memory watcher: shed the newest retriable task under pressure
+        # instead of letting the kernel OOM-kill the daemon (reference:
+        # memory_monitor.h:52 + raylet worker-killing policy).
+        self._last_memory_kill = 0.0
+        if self.worker_pool is not None and (
+                int(cfg.memory_limit_bytes) > 0
+                or float(cfg.memory_usage_threshold) < 1.0):
+            from raytpu.runtime.memory_monitor import MemoryMonitor
+
+            import os as _os
+
+            def _pids():
+                pids = [_os.getpid()]
+                try:
+                    with self.worker_pool._cv:
+                        pids.extend(
+                            h.pid for h in
+                            self.worker_pool._workers.values()
+                            if h.pid)
+                except Exception:
+                    pass
+                return pids
+
+            self._memory_monitor = MemoryMonitor(
+                self._on_memory_breach, pids_fn=_pids)
+            self._memory_monitor.start()
         return self.address
+
+    def _on_memory_breach(self, used: float, limit: float) -> None:
+        """Kill the newest running task's worker; its task fails with a
+        retriable WorkerCrashedError (reference: the raylet kills the
+        last-started retriable task first)."""
+        now = time.monotonic()
+        if now - self._last_memory_kill < 2.0:
+            return  # give the previous kill time to release memory
+        with self.backend._lock:
+            items = list(self.backend._task_worker.items())
+            if not items:
+                return
+            # Prefer the newest retriable plain task; else newest anything.
+            victim = None
+            for tid, handle in reversed(items):
+                rec = self.backend._running.get(tid)
+                if rec is not None and \
+                        rec.spec.attempt < rec.spec.max_retries:
+                    victim = (tid, handle)
+                    break
+            if victim is None:
+                victim = items[-1]
+        tid, handle = victim
+        self._last_memory_kill = now
+        if limit <= 1.0:  # system mode: values are fractions
+            desc = f"{used:.1%} of system memory used (threshold {limit:.0%})"
+        else:
+            desc = (f"{used / 1e6:.0f} MB used over the "
+                    f"{limit / 1e6:.0f} MB limit")
+        try:
+            self.worker_pool.kill(
+                handle,
+                f"memory pressure: {desc}; task {tid.hex()[:8]} shed "
+                f"to protect the node")
+        except Exception:
+            pass
 
     def stop(self) -> None:
         self._stop.set()
+        mon = getattr(self, "_memory_monitor", None)
+        if mon is not None:
+            mon.stop()
         try:
             if self._head is not None:
                 self._head.call("drain_node", self.node_id.hex(), timeout=2.0)
         except Exception:
             pass
         self.backend.shutdown()
+        try:
+            self.backend.store.teardown_spill()
+        except Exception:
+            pass
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
         if self.shm is not None:
@@ -680,8 +755,11 @@ class NodeServer:
                     if loc["address"] == self.address:
                         continue
                     try:
-                        blob = self._peer_client(loc["address"]).call(
-                            "fetch_object", oid.hex(), timeout=30.0)
+                        from raytpu.cluster.transfer import fetch_blob
+
+                        blob = fetch_blob(
+                            self._peer_client(loc["address"]), oid.hex(),
+                            timeout=60.0)
                     except Exception:
                         continue
                     if blob is not None:
@@ -800,6 +878,34 @@ class NodeServer:
                              args=(oid, 120.0), daemon=True).start()
         return None
 
+    def _h_fetch_object_meta(self, peer: Peer, oid_hex: str):
+        oid = ObjectID.from_hex(oid_hex)
+        size = self.backend.store.spilled_wire_size(oid)
+        if size is not None:
+            return {"size": size}
+        sv = self.backend.store.try_get(oid)
+        if sv is None:
+            return None
+        from raytpu.cluster.transfer import wire_size
+
+        return {"size": wire_size(sv)}
+
+    def _h_fetch_object_chunk(self, peer: Peer, oid_hex: str,
+                              offset: int, length: int) -> Optional[bytes]:
+        oid = ObjectID.from_hex(oid_hex)
+        # Spilled values serve straight from the file — never rebuild the
+        # whole object per chunk.
+        piece = self.backend.store.spilled_wire_range(
+            oid, int(offset), int(length))
+        if piece is not None:
+            return piece
+        sv = self.backend.store.try_get(oid)
+        if sv is None:
+            return None
+        from raytpu.cluster.transfer import read_range
+
+        return read_range(sv, int(offset), int(length))
+
     def _h_has_object(self, peer: Peer, oid_hex: str) -> bool:
         """Local store, falling back to the cluster directory — worker
         processes use this for ``wait``/stream readiness on objects that
@@ -866,6 +972,22 @@ class NodeServer:
     def _h_stream_close(self, peer: Peer, task_id_hex: str,
                         count: int) -> None:
         self._route_stream("stream_close", task_id_hex, count)
+        # GC: elements the consumer never took were shipped into this
+        # daemon's store (pin_owned — no refcount entry will ever free
+        # them). Walk forward from the last consumed index and drop them.
+        tid = TaskID.from_hex(task_id_hex)
+        i = int(count) + 1
+        while True:
+            oid = ObjectID.for_task_return(tid, i)
+            if not self.backend.store.contains(oid):
+                break
+            self.backend.store.delete([oid])
+            try:
+                self._head.notify("forget_object", oid.hex(),
+                                  self.node_id.hex())
+            except Exception:
+                pass
+            i += 1
 
     def _route_stream(self, method: str, task_id_hex: str,
                       count: int) -> None:

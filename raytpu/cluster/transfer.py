@@ -1,0 +1,95 @@
+"""Chunked node-to-node object transfer.
+
+Reference analogue: the chunked pull path of
+``src/ray/object_manager/object_manager.cc`` (objects move as
+``chunk_size`` pieces with bounded in-flight chunks, so one multi-GiB
+object cannot monopolize a connection or buffer whole in memory at the
+sender). Wire surface: three RPCs served by every node —
+
+- ``fetch_object(oid)``        → whole blob (small objects; legacy path)
+- ``fetch_object_meta(oid)``   → {"size": wire_bytes} or None
+- ``fetch_object_chunk(oid, off, len)`` → bytes or None (vanished)
+
+A process-wide semaphore caps concurrent chunk fetches (reference:
+``max_bytes_in_flight`` in the pull manager).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from raytpu.core.config import cfg
+from raytpu.runtime.serialization import SerializedValue
+
+_sem: Optional[threading.Semaphore] = None
+_sem_lock = threading.Lock()
+
+
+def _semaphore() -> threading.Semaphore:
+    global _sem
+    with _sem_lock:
+        if _sem is None:
+            _sem = threading.Semaphore(
+                max(1, int(cfg.object_transfer_max_concurrency)))
+        return _sem
+
+
+def wire_size(sv: SerializedValue) -> int:
+    """Bytes of the flattened transfer layout (see to_bytes)."""
+    return 4 + len(sv.header) + sum(len(b) for b in sv.buffers)
+
+
+def read_range(sv: SerializedValue, offset: int, length: int) -> bytes:
+    """Slice the flattened layout WITHOUT materializing the whole blob —
+    walks the [len][header][buffers...] segments."""
+    out = bytearray()
+    segments: List[memoryview] = [
+        memoryview(len(sv.header).to_bytes(4, "little")),
+        memoryview(sv.header),
+        *[memoryview(b) for b in sv.buffers],
+    ]
+    pos = 0
+    remaining = length
+    for seg in segments:
+        seg_len = len(seg)
+        if remaining <= 0:
+            break
+        if offset < pos + seg_len:
+            lo = max(0, offset - pos)
+            take = min(seg_len - lo, remaining)
+            out += seg[lo:lo + take]
+            remaining -= take
+        pos += seg_len
+    return bytes(out)
+
+
+def fetch_blob(client, oid_hex: str, timeout: float = 60.0
+               ) -> Optional[bytes]:
+    """Pull one object's wire bytes from a peer, chunked when large.
+
+    ``client`` is an RpcClient to the holding node. Returns None when the
+    peer no longer holds the object.
+    """
+    chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
+    meta = client.call("fetch_object_meta", oid_hex, timeout=timeout)
+    if meta is None:
+        return None
+    size = int(meta["size"])
+    if size <= chunk:
+        return client.call("fetch_object", oid_hex, timeout=timeout)
+    parts: List[bytes] = []
+    off = 0
+    sem = _semaphore()
+    while off < size:
+        want = min(chunk, size - off)
+        with sem:
+            piece = client.call("fetch_object_chunk", oid_hex, off, want,
+                                timeout=timeout)
+        if piece is None:
+            return None  # holder dropped it mid-transfer; caller re-locates
+        parts.append(piece)
+        off += len(piece)
+        if len(piece) < want:
+            return None  # truncated: object changed under us
+    return b"".join(parts)

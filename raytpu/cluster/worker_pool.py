@@ -69,6 +69,7 @@ class WorkerHandle:
         self.ready = threading.Event()
         self.dead = False
         self.dedicated = False  # actor-bound: never returned to the pool
+        self.kill_reason: Optional[str] = None  # set by pool.kill()
         # True while the worker's task sits in raytpu.get (blocked-worker
         # protocol): excluded from the pool soft cap so nested tasks can
         # always obtain a worker (reference: raylets exceed the soft limit
@@ -212,6 +213,7 @@ class WorkerPool:
             self._cv.notify_all()
 
     def kill(self, h: WorkerHandle, reason: str = "killed") -> None:
+        h.kill_reason = reason  # surfaced in the task's failure message
         try:
             if h.client is not None and not h.client.closed:
                 h.client.call("kill", reason, timeout=2.0)

@@ -90,6 +90,13 @@ declare("max_direct_call_object_size", 100 * 1024)
 declare("object_store_memory_bytes", 2 * 1024 * 1024 * 1024)
 declare("object_store_fallback_directory", "")
 declare("object_spilling_threshold", 0.8)
+# Node-to-node transfer chunking (reference: chunked pull/push,
+# object_manager.cc with chunk_size from ray_config_def.h).
+declare("object_transfer_chunk_bytes", 4 * 1024 * 1024)
+declare("object_transfer_max_concurrency", 8)
+# 0 = monitor whole-system memory fraction (memory_usage_threshold);
+# >0 = hard byte budget for the node's process tree (tests, cgroups).
+declare("memory_limit_bytes", 0)
 
 # Worker pool.
 declare("num_workers_soft_limit", 8)

@@ -580,6 +580,10 @@ class LocalBackend:
             actors = list(self._actors.values())
         for a in actors:
             a.kill("shutdown")
+        try:
+            self.store.teardown_spill()
+        except Exception:
+            pass
 
     # -- internals ------------------------------------------------------------
 

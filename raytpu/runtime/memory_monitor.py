@@ -1,0 +1,88 @@
+"""Node memory watcher: detect memory pressure and shed load.
+
+Reference analogue: ``src/ray/common/memory_monitor.h:52`` (the raylet's
+MemoryMonitor sampling /proc) + the worker-killing policy of the raylet's
+``MemoryMonitor`` integration — when usage crosses the threshold, the
+newest retriable task's worker is killed (its task retries elsewhere /
+later) instead of letting the kernel OOM-killer take down the whole node.
+
+Two modes:
+- system mode (default): usage = 1 - MemAvailable/MemTotal from
+  /proc/meminfo, breach when > ``memory_usage_threshold``.
+- budget mode (``memory_limit_bytes`` > 0, used by tests and cgroup
+  deployments): usage = summed RSS of the watched pids, breach when over
+  the byte budget.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from raytpu.core.config import cfg
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def process_rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+def system_usage_fraction() -> float:
+    try:
+        total = avail = None
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1])
+                if total is not None and avail is not None:
+                    break
+        if not total:
+            return 0.0
+        return 1.0 - (avail or 0) / total
+    except OSError:
+        return 0.0
+
+
+class MemoryMonitor:
+    """Samples memory every ``memory_monitor_refresh_ms``; calls
+    ``on_breach(used_bytes_or_fraction, limit)`` when over."""
+
+    def __init__(self, on_breach: Callable[[float, float], None],
+                 pids_fn: Optional[Callable[[], Iterable[int]]] = None):
+        self._on_breach = on_breach
+        self._pids_fn = pids_fn or (lambda: [os.getpid()])
+        self._limit = int(cfg.memory_limit_bytes)
+        self._threshold = float(cfg.memory_usage_threshold)
+        self._period = max(0.05, cfg.memory_monitor_refresh_ms / 1000.0)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="memory-monitor", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._period):
+            try:
+                if self._limit > 0:
+                    used = sum(process_rss_bytes(p) for p in self._pids_fn())
+                    if used > self._limit:
+                        self._on_breach(float(used), float(self._limit))
+                else:
+                    frac = system_usage_fraction()
+                    if frac > self._threshold:
+                        self._on_breach(frac, self._threshold)
+            except Exception:
+                pass

@@ -21,56 +21,157 @@ from raytpu.runtime.serialization import SerializedValue
 
 
 class MemoryStore:
-    """Thread-safe oid → SerializedValue map with blocking gets."""
+    """Thread-safe oid → SerializedValue map with blocking gets.
+
+    Overflow spills to disk (reference: ``local_object_manager.h:41``
+    spill-to-external-storage): when the shared-memory arena rejects a
+    large object, or the heap exceeds its budget
+    (``object_store_memory_bytes * object_spilling_threshold``), values
+    move to files under ``object_store_fallback_directory`` and are
+    restored transparently on access — a pipeline whose working set
+    exceeds store memory finishes instead of dying.
+    """
 
     def __init__(self, shm=None):
         self._objects: Dict[ObjectID, SerializedValue] = {}
         self._cv = threading.Condition()
         self._shm = shm  # optional SharedMemoryStore for large objects
+        self._spilled: Dict[ObjectID, str] = {}  # oid -> file path
+        self._spill_dir: Optional[str] = None
         # Called (outside the lock) after each put — the scheduler hooks this
         # for dependency wakeups (reference: dependency_manager.cc).
         self.on_put = None
 
+    # -- spill plumbing -------------------------------------------------------
+
+    def _spill_path(self, oid: ObjectID) -> str:
+        import os
+        import tempfile
+
+        if self._spill_dir is None:
+            base = cfg.object_store_fallback_directory or os.path.join(
+                tempfile.gettempdir(), "raytpu_spill")
+            self._spill_dir = os.path.join(base, str(os.getpid()))
+            os.makedirs(self._spill_dir, exist_ok=True)
+        return os.path.join(self._spill_dir, oid.hex())
+
+    def _spill(self, oid: ObjectID, value: SerializedValue,
+               register: bool = True) -> Optional[str]:
+        """Write the wire bytes to disk; returns the path (or None on I/O
+        failure). ``register=False`` lets the evictor defer the _spilled
+        entry until it has re-checked the object wasn't deleted meanwhile."""
+        try:
+            path = self._spill_path(oid)
+            with open(path, "wb") as f:
+                f.write(value.to_bytes())
+        except OSError:
+            return None
+        if register:
+            with self._cv:
+                self._spilled[oid] = path
+                self._cv.notify_all()
+        return path
+
+    def _restore(self, oid: ObjectID) -> Optional[SerializedValue]:
+        with self._cv:
+            path = self._spilled.get(oid)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                return SerializedValue.from_buffer(f.read())
+        except OSError:
+            return None
+
+    def _maybe_evict_heap(self) -> None:
+        """Spill largest heap objects until back under budget (called with
+        nothing held; best effort)."""
+        budget = int(cfg.object_store_memory_bytes
+                     * cfg.object_spilling_threshold)
+        import os
+
+        while True:
+            with self._cv:
+                used = sum(v.total_bytes() for v in self._objects.values())
+                if used <= budget or not self._objects:
+                    return
+                victim = max(self._objects,
+                             key=lambda o: self._objects[o].total_bytes())
+                value = self._objects[victim]
+            path = self._spill(victim, value, register=False)
+            if path is None:
+                return
+            with self._cv:
+                # Register + drop the heap copy only if the object wasn't
+                # deleted while the file was being written — otherwise a
+                # freed object would resurrect from disk.
+                if victim in self._objects:
+                    self._spilled[victim] = path
+                    self._objects.pop(victim, None)
+                    path = None
+            if path is not None:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
     def put(self, oid: ObjectID, value: SerializedValue) -> None:
-        use_shm = (
-            self._shm is not None
-            and value.total_bytes() > cfg.max_direct_call_object_size
-        )
+        big = value.total_bytes() > cfg.max_direct_call_object_size
         stored = False
-        if use_shm:
+        if self._shm is not None and big:
             try:
                 self._shm.put(oid, value)
                 with self._cv:
                     self._cv.notify_all()
                 stored = True
             except Exception:
-                pass  # fall back to heap
+                # Shm full: spill big objects straight to disk rather than
+                # ballooning the daemon heap.
+                stored = self._spill(oid, value) is not None
         if not stored:
+            import os
+
             with self._cv:
                 self._objects[oid] = value
+                stale = self._spilled.pop(oid, None)
                 self._cv.notify_all()
+            if stale is not None:  # overwrite: drop the outdated file
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
+            self._maybe_evict_heap()
         if self.on_put is not None:
             self.on_put(oid)
 
     def contains(self, oid: ObjectID) -> bool:
         with self._cv:
-            if oid in self._objects:
+            if oid in self._objects or oid in self._spilled:
                 return True
         return self._shm is not None and self._shm.contains(oid)
 
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> SerializedValue:
         deadline = None if timeout is None else time.monotonic() + timeout
+        spilled = False
         with self._cv:
             while True:
                 sv = self._objects.get(oid)
                 if sv is not None:
                     return sv
+                if oid in self._spilled:
+                    spilled = True
+                    break  # restore outside the lock
                 if self._shm is not None and self._shm.contains(oid):
                     break  # fetch outside the lock
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise GetTimeoutError(f"object {oid.hex()} not ready")
                 self._cv.wait(timeout=remaining if remaining is None else min(remaining, 0.5))
+        if spilled:
+            sv = self._restore(oid)
+            if sv is not None:
+                return sv
+            return self.get(oid, timeout=0.0)  # raced with delete
         return self._shm.get(oid)
 
     def try_get(self, oid: ObjectID) -> Optional[SerializedValue]:
@@ -78,14 +179,28 @@ class MemoryStore:
             sv = self._objects.get(oid)
         if sv is not None:
             return sv
+        sv = self._restore(oid)
+        if sv is not None:
+            return sv
         if self._shm is not None and self._shm.contains(oid):
             return self._shm.get(oid)
         return None
 
     def delete(self, oids: List[ObjectID]) -> None:
+        import os
+
+        spilled_paths = []
         with self._cv:
             for oid in oids:
                 self._objects.pop(oid, None)
+                path = self._spilled.pop(oid, None)
+                if path is not None:
+                    spilled_paths.append(path)
+        for path in spilled_paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         if self._shm is not None:
             for oid in oids:
                 try:
@@ -93,11 +208,53 @@ class MemoryStore:
                 except Exception:
                     pass
 
+    def spilled_wire_size(self, oid: ObjectID) -> Optional[int]:
+        """Wire-layout size of a spilled object, without reading it (the
+        spill file IS the wire layout)."""
+        import os
+
+        with self._cv:
+            path = self._spilled.get(oid)
+        if path is None:
+            return None
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return None
+
+    def spilled_wire_range(self, oid: ObjectID, offset: int,
+                           length: int) -> Optional[bytes]:
+        """Serve a byte range straight from the spill file — chunked
+        transfers of spilled objects must not re-materialize the whole
+        value per chunk."""
+        with self._cv:
+            path = self._spilled.get(oid)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except OSError:
+            return None
+
+    def teardown_spill(self) -> None:
+        """Remove this process's spill directory (shutdown path)."""
+        import shutil
+
+        with self._cv:
+            d = self._spill_dir
+            self._spill_dir = None
+            self._spilled.clear()
+        if d is not None:
+            shutil.rmtree(d, ignore_errors=True)
+
     def keys(self) -> List[ObjectID]:
-        """All locally-held object ids (heap + shared memory) — used to
-        re-announce locations after a control-plane restart."""
+        """All locally-held object ids (heap + spilled + shared memory) —
+        used to re-announce locations after a control-plane restart."""
         with self._cv:
             out = list(self._objects.keys())
+            out.extend(o for o in self._spilled if o not in self._objects)
         if self._shm is not None:
             try:
                 out.extend(self._shm.keys())

@@ -227,10 +227,20 @@ class Worker:
                            and n - st["acked"] >= spec.backpressure):
                         self._streams_cv.wait(timeout=0.1)
         except BaseException as e:  # noqa: BLE001
+            self._stream_abandon(tid, st)
             return e if isinstance(e, TaskError) else TaskError.from_exception(
                 spec.name, e)
         self._stream_finish(spec, st, n)
         return None
+
+    def _stream_abandon(self, tid: TaskID, st: dict) -> None:
+        """Error-path cleanup: cluster workers hold no pins, so the state
+        entry must not outlive the failed task (long-lived pooled workers
+        would leak one per failed stream)."""
+        if self.pin_owned:
+            with self._streams_cv:
+                if self._streams.get(tid) is st:
+                    self._streams.pop(tid, None)
 
     async def _run_stream_async(self, spec: TaskSpec,
                                 aiterator) -> Optional[BaseException]:
@@ -283,6 +293,7 @@ class Worker:
                     while self._backpressured(spec, st, n):
                         await asyncio.sleep(0.02)
         except BaseException as e:  # noqa: BLE001
+            self._stream_abandon(tid, st)
             return e if isinstance(e, TaskError) else TaskError.from_exception(
                 spec.name, e)
         await loop.run_in_executor(

@@ -172,18 +172,7 @@ class ProxyActor:
         try:
             gen = await loop.run_in_executor(
                 None, lambda: handle.remote_streaming(req))
-            it = iter(gen)
-
-            def _pull():
-                try:
-                    return True, next(it)
-                except StopIteration:
-                    return False, None
-
-            while True:
-                ok, chunk = await loop.run_in_executor(None, _pull)
-                if not ok:
-                    break
+            async for chunk in gen:  # async bridge lives on the generator
                 if isinstance(chunk, bytes):
                     data = chunk.decode("utf-8", "replace")
                 elif isinstance(chunk, str):
