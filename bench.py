@@ -134,8 +134,33 @@ def main() -> None:
             "device": str(dev),
             "loss": float(loss_host),
             "mfu_vs_device_peak": mfu,
+            # Second north-star metric (BASELINE.json): PPO env-steps/s,
+            # measured in a CPU subprocess (host-plane benchmark).
+            "ppo": _ppo_bench(smoke),
         },
     }))
+
+
+def _ppo_bench(smoke: bool) -> dict:
+    """Run the PPO loop benchmark in a subprocess; never fail the headline
+    bench over it."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "bench_ppo.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if smoke:
+        env.setdefault("RAYTPU_PPO_BENCH_ENVS", "8")
+        env.setdefault("RAYTPU_PPO_BENCH_FRAGMENT", "16")
+    try:
+        out = subprocess.run(
+            [sys.executable, script], env=env, capture_output=True,
+            text=True, timeout=600)
+        line = out.stdout.strip().splitlines()[-1]
+        return json.loads(line)
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def _host_sync(np, x):

@@ -1,0 +1,97 @@
+"""PPO env-steps/sec — the second north-star metric (BASELINE.json).
+
+Measures the FULL PPO loop (vectorized env sampling + the one-program
+compiled learner update + weight sync) in env-steps/sec, with the same
+honesty discipline as the GPT-2 bench: warmup iterations excluded, the
+clock stops on a host fetch of the last update's loss, and the timed
+region doubles until a minimum wall time.
+
+The reference's published PPO numbers (BASELINE.md:41-42,
+``rllib/benchmarks/torch_compile/README.md:86-99``) are learner-forward
+throughputs of ~1417-1444 samples/s (bs=1, T4 eager) — ``vs_baseline``
+compares against the 1444 figure.
+
+Usage:  python benchmarks/bench_ppo.py            (prints one JSON line)
+Env:    RAYTPU_PPO_BENCH_ENVS, RAYTPU_PPO_BENCH_FRAGMENT
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_SAMPLES_PER_SEC = 1444.0  # BASELINE.md:41
+
+
+def run(num_envs: int = 64, fragment: int = 64, iters: int = 5,
+        min_wall: float = 2.0) -> dict:
+    import numpy as np
+
+    from raytpu.rllib.algorithms.ppo import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1-vec")
+        .env_runners(num_env_runners=0,
+                     num_envs_per_env_runner=num_envs,
+                     rollout_fragment_length=fragment)
+        .training(lr=3e-4, num_epochs=4, minibatch_size=512)
+        .build()
+    )
+    # Warmup: compile the explore/infer/update programs.
+    algo.training_step()
+    algo.training_step()
+
+    while True:
+        t0 = time.perf_counter()
+        steps = 0
+        for _ in range(iters):
+            metrics = algo.training_step()
+            steps += int(metrics["_env_steps"])
+        # Host-sync: the learner metrics are device values produced by the
+        # final update; fetching forces completion of the whole chain.
+        _ = float(np.asarray(metrics["policy_loss"]))
+        dt = time.perf_counter() - t0
+        if dt >= min_wall:
+            break
+        iters *= 2
+
+    sps = steps / dt
+    return {
+        "ppo_env_steps_per_sec": round(sps, 1),
+        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 4),
+        "num_envs": num_envs,
+        "fragment": fragment,
+        "iters": iters,
+        "wall_s": round(dt, 3),
+        "env": "CartPole-v1-vec",
+    }
+
+
+def main() -> None:
+    # Host-plane benchmark: env stepping is numpy and the policy net is
+    # tiny — force CPU so a remote-accelerator tunnel's per-dispatch
+    # latency doesn't turn a sampling benchmark into a network benchmark.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    num_envs = int(os.environ.get("RAYTPU_PPO_BENCH_ENVS", 64))
+    fragment = int(os.environ.get("RAYTPU_PPO_BENCH_FRAGMENT", 64))
+    out = run(num_envs=num_envs, fragment=fragment)
+    print(json.dumps({"metric": "ppo_env_steps_per_sec",
+                      "value": out["ppo_env_steps_per_sec"],
+                      "unit": "env-steps/s",
+                      "vs_baseline": out["vs_baseline"],
+                      "detail": out}))
+
+
+if __name__ == "__main__":
+    main()

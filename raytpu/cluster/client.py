@@ -491,10 +491,11 @@ class ClusterBackend:
             return
         # The producing task already completed (inflight record gone) but
         # its unconsumed elements still sit pinned in node stores; close
-        # must reach every holder so they GC (reference: eager deletion of
-        # un-consumed stream returns).
+        # must reach every holder so they GC. Probe the FIRST UNCONSUMED
+        # element (count+1 — consumed ones may already be freed); if the
+        # stream was fully drained there is nothing to GC.
         try:
-            elem = ObjectID.for_task_return(task_id, max(count, 1))
+            elem = ObjectID.for_task_return(task_id, count + 1)
             locs = self._head.call("locate_object", elem.hex(), timeout=5.0)
             for loc in locs or ():
                 try:

@@ -37,8 +37,19 @@ class SingleAgentEnvRunner:
         env_config = dict(config.get("env_config") or {})
         if self._seed is not None:
             env_config.setdefault("seed", self._seed)
-        self.envs = [make_env(config["env"], env_config)
-                     for _ in range(self.num_envs)]
+        # Vectorized envs (is_vector_env) batch all copies into one numpy
+        # step — required to keep up with a compiled learner; per-env
+        # Python stepping is the fallback for arbitrary user envs.
+        probe = make_env(config["env"],
+                         {**env_config, "num_envs": self.num_envs})
+        if getattr(probe, "is_vector_env", False):
+            self._vec = probe
+            self.num_envs = probe.num_envs
+            self.envs = []
+        else:
+            self._vec = None
+            self.envs = [probe] + [make_env(config["env"], env_config)
+                                   for _ in range(self.num_envs - 1)]
         self.module = config["module_spec"].build()
         self.params = self.module.init_params(
             jax.random.PRNGKey(self._seed or 0))
@@ -48,7 +59,10 @@ class SingleAgentEnvRunner:
         self._value_fn = jax.jit(
             lambda p, o: self.module.forward_train(p, o)[1])
         # Persistent episode state across sample() calls.
-        self._obs = np.stack([e.reset()[0] for e in self.envs])
+        if self._vec is not None:
+            self._obs = self._vec.reset()[0]
+        else:
+            self._obs = np.stack([e.reset()[0] for e in self.envs])
         self._ep_return = np.zeros(self.num_envs)
         self._ep_len = np.zeros(self.num_envs, dtype=np.int64)
         self._completed: List[dict] = []
@@ -99,6 +113,34 @@ class SingleAgentEnvRunner:
             if vf is not None:
                 vf_buf[t] = np.asarray(vf)
 
+            if self._vec is not None:
+                nobs, r, terminated, truncated, info = \
+                    self._vec.step_batch(actions)
+                self._ep_return += r
+                self._ep_len += 1
+                rew_buf[t] = r
+                done = terminated | truncated
+                term_buf[t] = done
+                pure_trunc = truncated & ~terminated
+                if pure_trunc.any():
+                    # Fold the value bootstrap into the truncation step
+                    # (same semantics as the per-env path below).
+                    vals = np.asarray(self._value_fn(
+                        self.params,
+                        jnp.asarray(info["final_obs"][pure_trunc])))
+                    gamma = float(self.config.get("gamma", 0.99))
+                    rew_buf[t, pure_trunc] += gamma * vals
+                if done.any():
+                    for i in np.nonzero(done)[0]:
+                        self._completed.append({
+                            "episode_return": float(self._ep_return[i]),
+                            "episode_len": int(self._ep_len[i]),
+                        })
+                    self._ep_return[done] = 0.0
+                    self._ep_len[done] = 0
+                self._obs = nobs
+                continue
+
             truncated_next_obs = {}
             for i, env in enumerate(self.envs):
                 nobs, r, terminated, truncated, _ = env.step(
@@ -144,16 +186,26 @@ class SingleAgentEnvRunner:
                  max_steps: int = 1000) -> Dict[str, float]:
         """Greedy episodes on a fresh env (reference: evaluation workers)."""
         env = make_env(self.config["env"],
-                       dict(self.config.get("env_config") or {}))
+                       {**dict(self.config.get("env_config") or {}),
+                        "num_envs": 1})
+        vec = getattr(env, "is_vector_env", False)
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=None if self._seed is None
                                else self._seed + 7919 * (ep + 1))
+            if vec:
+                obs = obs[0]
             total = 0.0
             for _ in range(max_steps):
                 a = int(np.asarray(self._infer_fn(
                     self.params, jnp.asarray(obs[None].astype(np.float32))))[0])
-                obs, r, terminated, truncated, _ = env.step(a)
+                if vec:
+                    nobs, r, term, trunc, _ = env.step_batch(
+                        np.asarray([a]))
+                    obs, r = nobs[0], float(r[0])
+                    terminated, truncated = bool(term[0]), bool(trunc[0])
+                else:
+                    obs, r, terminated, truncated, _ = env.step(a)
                 total += r
                 if terminated or truncated:
                     break

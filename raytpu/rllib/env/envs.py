@@ -106,7 +106,83 @@ class CartPoleEnv:
                 {})
 
 
+class VecCartPoleEnv:
+    """Vectorized cart-pole: ``num_envs`` copies stepped as one batched
+    numpy computation with auto-reset (reference analogue: gymnasium
+    ``SyncVectorEnv`` / RLlib's vectorized sampling — but the dynamics
+    themselves are batched, not a Python loop over envs). This is the
+    sampling-plane answer to TPU-class learners: the policy forward is
+    already batched, so the env must be too or host stepping dominates.
+
+    ``step_batch(actions) -> (obs, rewards, terminated, truncated, info)``
+    where done envs are auto-reset in the returned ``obs`` and their
+    pre-reset observation is at ``info["final_obs"]``.
+    """
+
+    is_vector_env = True
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.num_envs = int(config.get("num_envs", 64))
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.max_steps = int(config.get("max_episode_steps", 500))
+        self.observation_space = Space.box(-np.inf, np.inf, (4,))
+        self.action_space = Space.discrete(2)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._state = None
+        self._steps = None
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(
+            -0.05, 0.05, size=(self.num_envs, 4))
+        self._steps = np.zeros(self.num_envs, dtype=np.int64)
+        return self._state.astype(np.float32), {}
+
+    def step_batch(self, actions):
+        s = self._state
+        x, x_dot, theta, theta_dot = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        force = np.where(np.asarray(actions) == 1, self.force_mag,
+                         -self.force_mag)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot**2 * sintheta) \
+            / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta**2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+        terminated = (np.abs(x) > self.x_threshold) | (
+            np.abs(theta) > self.theta_threshold)
+        truncated = (self._steps >= self.max_steps) & ~terminated
+        done = terminated | truncated
+        rewards = np.ones(self.num_envs, dtype=np.float32)
+        final_obs = self._state.astype(np.float32)
+        if done.any():
+            n = int(done.sum())
+            self._state[done] = self._rng.uniform(-0.05, 0.05, size=(n, 4))
+            self._steps[done] = 0
+        return (self._state.astype(np.float32), rewards, terminated,
+                truncated, {"final_obs": final_obs})
+
+
 register_env("CartPole-v1", CartPoleEnv)
 register_env("CartPole-v0",
              lambda cfg: CartPoleEnv({**(cfg or {}),
                                       "max_episode_steps": 200}))
+register_env("CartPole-v1-vec", VecCartPoleEnv)

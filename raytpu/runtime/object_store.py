@@ -38,6 +38,8 @@ class MemoryStore:
         self._shm = shm  # optional SharedMemoryStore for large objects
         self._spilled: Dict[ObjectID, str] = {}  # oid -> file path
         self._spill_dir: Optional[str] = None
+        self._heap_bytes = 0  # running total; keeps the budget check O(1)
+        self._evict_lock = threading.Lock()  # one evictor at a time
         # Called (outside the lock) after each put — the scheduler hooks this
         # for dependency wakeups (reference: dependency_manager.cc).
         self.on_put = None
@@ -90,30 +92,35 @@ class MemoryStore:
                      * cfg.object_spilling_threshold)
         import os
 
-        while True:
-            with self._cv:
-                used = sum(v.total_bytes() for v in self._objects.values())
-                if used <= budget or not self._objects:
+        # Serialize evictors: two threads picking the same victim would
+        # race file registration vs unlink and could lose the only copy.
+        with self._evict_lock:
+            while True:
+                with self._cv:
+                    if self._heap_bytes <= budget or not self._objects:
+                        return
+                    victim = max(
+                        self._objects,
+                        key=lambda o: self._objects[o].total_bytes())
+                    value = self._objects[victim]
+                path = self._spill(victim, value, register=False)
+                if path is None:
                     return
-                victim = max(self._objects,
-                             key=lambda o: self._objects[o].total_bytes())
-                value = self._objects[victim]
-            path = self._spill(victim, value, register=False)
-            if path is None:
-                return
-            with self._cv:
-                # Register + drop the heap copy only if the object wasn't
-                # deleted while the file was being written — otherwise a
-                # freed object would resurrect from disk.
-                if victim in self._objects:
-                    self._spilled[victim] = path
-                    self._objects.pop(victim, None)
-                    path = None
-            if path is not None:
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+                with self._cv:
+                    # Register + drop the heap copy only if THIS value is
+                    # still current — a concurrent delete must not
+                    # resurrect it, and a concurrent overwrite put() must
+                    # not be shadowed by the stale file.
+                    if self._objects.get(victim) is value:
+                        self._spilled[victim] = path
+                        self._objects.pop(victim, None)
+                        self._heap_bytes -= value.total_bytes()
+                        path = None
+                if path is not None:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
 
     def put(self, oid: ObjectID, value: SerializedValue) -> None:
         big = value.total_bytes() > cfg.max_direct_call_object_size
@@ -132,7 +139,11 @@ class MemoryStore:
             import os
 
             with self._cv:
+                prev = self._objects.get(oid)
+                if prev is not None:
+                    self._heap_bytes -= prev.total_bytes()
                 self._objects[oid] = value
+                self._heap_bytes += value.total_bytes()
                 stale = self._spilled.pop(oid, None)
                 self._cv.notify_all()
             if stale is not None:  # overwrite: drop the outdated file
@@ -171,7 +182,13 @@ class MemoryStore:
             sv = self._restore(oid)
             if sv is not None:
                 return sv
-            return self.get(oid, timeout=0.0)  # raced with delete
+            # Unreadable file (raced with delete / lost disk): drop the
+            # stale entry so the retry can't loop on the same branch.
+            with self._cv:
+                self._spilled.pop(oid, None)
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            return self.get(oid, timeout=remaining)
         return self._shm.get(oid)
 
     def try_get(self, oid: ObjectID) -> Optional[SerializedValue]:
@@ -192,7 +209,9 @@ class MemoryStore:
         spilled_paths = []
         with self._cv:
             for oid in oids:
-                self._objects.pop(oid, None)
+                prev = self._objects.pop(oid, None)
+                if prev is not None:
+                    self._heap_bytes -= prev.total_bytes()
                 path = self._spilled.pop(oid, None)
                 if path is not None:
                     spilled_paths.append(path)
