@@ -93,7 +93,20 @@ class CheckpointManager:
         self.score_attribute = score_attribute
         self.score_order = score_order
         self._entries: List[Tuple[float, int, str]] = []  # (score, idx, path)
+        # Continue numbering past any pre-existing checkpoint_NNNNNN dirs
+        # (restored experiments): restarting at 0 would overwrite dirs a
+        # saved experiment state still references.
         self._counter = 0
+        try:
+            for name in os.listdir(self.root):
+                if name.startswith("checkpoint_"):
+                    try:
+                        self._counter = max(self._counter,
+                                            int(name.split("_")[1]))
+                    except (IndexError, ValueError):
+                        pass
+        except OSError:
+            pass
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> Checkpoint:

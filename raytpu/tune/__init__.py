@@ -4,12 +4,14 @@ from raytpu.train.session import report  # same report API as Train
 from raytpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from raytpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -36,5 +38,7 @@ __all__ = [
     "TrialScheduler",
     "FIFOScheduler",
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "TPESearcher",
     "PopulationBasedTraining",
 ]

@@ -32,6 +32,29 @@ class FIFOScheduler(TrialScheduler):
     pass
 
 
+def _judge_at_rungs(rungs: List[int], rung_results: Dict[Any, List[float]],
+                    rung_key, seen: set, t, value: float, rf: int,
+                    max_t: int) -> str:
+    """Shared successive-halving core (ASHA rung walk, HyperBand
+    per-bracket rung walk): a trial reaching a rung stops unless in the
+    top 1/rf of results completed there. A trial whose time_attr skips
+    past a rung value is still judged at that rung — exact equality would
+    silently degrade the scheduler to FIFO for trials that report every k
+    iterations."""
+    for rung in rungs:
+        if t >= rung and rung not in seen:
+            seen.add(rung)
+            peers = rung_results[rung_key(rung)]
+            peers.append(value)
+            k = max(1, math.ceil(len(peers) / rf))
+            top_k = sorted(peers, reverse=True)[:k]
+            if value < top_k[-1]:
+                return STOP
+    if t >= max_t:
+        return STOP
+    return CONTINUE
+
+
 class ASHAScheduler(TrialScheduler):
     """Asynchronous successive halving (reference:
     ``tune/schedulers/async_hyperband.py``): rungs at
@@ -54,10 +77,6 @@ class ASHAScheduler(TrialScheduler):
             self.rungs.append(t)
             t *= reduction_factor
         self.rung_results: Dict[int, List[float]] = defaultdict(list)
-        # trial_id -> rungs already evaluated (a trial whose time_attr
-        # skips past a rung value is still judged at that rung — exact
-        # equality would silently degrade ASHA to FIFO for trials that
-        # report every k iterations).
         self._completed: Dict[str, set] = defaultdict(set)
 
     def on_result(self, trial, result: Dict[str, Any]) -> str:
@@ -66,22 +85,74 @@ class ASHAScheduler(TrialScheduler):
         if t is None or metric is None:
             return CONTINUE
         value = float(metric) if self.mode == "max" else -float(metric)
-        seen = self._completed[trial.trial_id]
-        for rung in self.rungs:
-            if t >= rung and rung not in seen:
-                seen.add(rung)
-                peers = self.rung_results[rung]
-                peers.append(value)
-                k = max(1, math.ceil(len(peers) / self.rf))
-                top_k = sorted(peers, reverse=True)[:k]
-                if value < top_k[-1]:
-                    return STOP
-        if t >= self.max_t:
-            return STOP
-        return CONTINUE
+        return _judge_at_rungs(
+            self.rungs, self.rung_results, lambda r: r,
+            self._completed[trial.trial_id], t, value, self.rf, self.max_t)
 
     def on_trial_remove(self, trial) -> None:
         self._completed.pop(trial.trial_id, None)
+
+
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand (reference: ``tune/schedulers/hyperband.py``): multiple
+    successive-halving brackets trading off number-of-configs against
+    per-config budget. Trials are assigned to brackets round-robin; each
+    bracket s starts its rung ladder at ``max_t / eta^s`` and halves with
+    factor eta, judged asynchronously like ASHA within the bracket (the
+    reference's HB also fills brackets as trials arrive)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        # Integer arithmetic: float log truncation would silently drop the
+        # cheapest bracket (e.g. log(243)/log(3) = 4.9999... -> 4).
+        self.s_max = 0
+        r = 1
+        while r * reduction_factor <= max_t:
+            r *= reduction_factor
+            self.s_max += 1
+        # Bracket s: rungs at max_t/eta^s, max_t/eta^(s-1), ..., max_t.
+        self.brackets: List[List[int]] = []
+        for s in range(self.s_max, -1, -1):
+            r = max(1, max_t // (reduction_factor ** s))
+            rungs = []
+            while r < max_t:
+                rungs.append(r)
+                r *= reduction_factor
+            self.brackets.append(rungs)
+        self._next_bracket = 0
+        self._trial_bracket: Dict[str, int] = {}
+        # (bracket, rung) -> completed metric values
+        self.rung_results: Dict[tuple, List[float]] = defaultdict(list)
+        self._completed: Dict[str, set] = defaultdict(set)
+
+    def _bracket_of(self, trial_id: str) -> int:
+        b = self._trial_bracket.get(trial_id)
+        if b is None:
+            b = self._trial_bracket[trial_id] = self._next_bracket
+            self._next_bracket = (self._next_bracket + 1) % len(self.brackets)
+        return b
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        value = float(metric) if self.mode == "max" else -float(metric)
+        b = self._bracket_of(trial.trial_id)
+        return _judge_at_rungs(
+            self.brackets[b], self.rung_results, lambda r: (b, r),
+            self._completed[trial.trial_id], t, value, self.eta,
+            self.max_t)
+
+    def on_trial_remove(self, trial) -> None:
+        self._completed.pop(trial.trial_id, None)
+        self._trial_bracket.pop(trial.trial_id, None)
 
 
 class PopulationBasedTraining(TrialScheduler):

@@ -54,6 +54,10 @@ class Trial:
     error: Optional[str] = None
     checkpoint: Optional[Checkpoint] = None
     iterations: int = 0
+    # Iteration count at the latest registered checkpoint — a restored
+    # trial resumes THERE, so counters/history roll back to it (reports
+    # since the checkpoint will be replayed by the relaunched trial).
+    ckpt_iterations: int = 0
     # True when the config came from the searcher (PBT clones don't —
     # the searcher must only see completions for ids it issued).
     from_searcher: bool = False
@@ -176,6 +180,38 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restored: Optional[dict] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Optional[Callable] = None
+                ) -> "Tuner":
+        """Resume an interrupted run from its directory (reference:
+        ``Tuner.restore``, ``python/ray/tune/tuner.py:173``): finished
+        trials keep their results, unfinished ones relaunch from their
+        latest checkpoint, and the (pickled) searcher + scheduler continue
+        from their saved state — the experiment converges to the same
+        outcome as an uninterrupted run."""
+        import cloudpickle
+
+        with open(os.path.join(path, "tuner_state.pkl"), "rb") as f:
+            state = cloudpickle.loads(f.read())
+        rc = state["run_config"]
+        rc.name = os.path.basename(os.path.normpath(path))
+        rc.storage_path = os.path.dirname(os.path.normpath(path))
+        tc = state["tune_config"]
+        tc.search_alg = state["searcher"]
+        # Through __init__ so a re-passed JaxTrainer gets the same
+        # trainable-wrapping as a fresh Tuner (cls.__new__ would store the
+        # raw non-callable trainer).
+        tuner = cls(
+            (trainable if trainable is not None
+             else cloudpickle.loads(state["fn_blob"])),
+            param_space=state.get("param_space") or {},
+            tune_config=tc,
+            run_config=rc,
+        )
+        tuner._restored = state
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
@@ -214,14 +250,62 @@ class Tuner:
             trials.append(trial)
             return trial
 
+        # Open-ended searchers (TPE etc.) suggest forever; num_samples is
+        # the experiment budget (reference: same num_samples semantics).
+        # BasicVariantGenerator embeds its own grid x samples budget.
+        budget = (searcher.total() if hasattr(searcher, "total")
+                  else tc.num_samples)
+        suggested = sum(1 for t in trials if t.from_searcher)
+
         def suggest_and_launch() -> Optional[Trial]:
+            nonlocal suggested
+            if budget is not None and suggested >= budget:
+                return None
             tid = f"trial_{uuid.uuid4().hex[:8]}"
             cfg = searcher.suggest(tid)
             if cfg is None:
                 return None
+            suggested += 1
             t = launch(tid, cfg)
             t.from_searcher = True
             return t
+
+        state_path = os.path.join(run_dir, "tuner_state.pkl")
+        last_save = [0.0]
+
+        def save_state(force: bool = False) -> None:
+            """Durable experiment state (reference: experiment-state file
+            the reference controller writes for Tuner.restore). Written
+            atomically, throttled — the snapshot is O(total history) and
+            must not dominate the 50ms polling loop."""
+            now = time.monotonic()
+            if not force and now - last_save[0] < 2.0:
+                return
+            last_save[0] = now
+            blob = cloudpickle.dumps({
+                "fn_blob": fn_blob,
+                "param_space": self.param_space,
+                "tune_config": tc,
+                "run_config": rc,
+                "searcher": searcher,
+                "trials": [{
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "state": t.state,
+                    "last_result": t.last_result,
+                    "history": t.history,
+                    "iterations": t.iterations,
+                    "ckpt_iterations": t.ckpt_iterations,
+                    "error": t.error,
+                    "checkpoint": (t.checkpoint.path
+                                   if t.checkpoint else None),
+                    "from_searcher": t.from_searcher,
+                } for t in trials],
+            })
+            tmp = state_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, state_path)
 
         def finish(trial: Trial, state: str, error: Optional[str] = None):
             """Completion paths share one exit: state, actor kill (frees
@@ -238,10 +322,41 @@ class Tuner:
                 searcher.on_trial_complete(trial.trial_id, trial.last_result)
             scheduler.on_trial_remove(trial)
 
+        # Restore path: re-seat finished trials, relaunch unfinished ones
+        # from their latest checkpoint.
+        if self._restored is not None:
+            for tr in self._restored["trials"]:
+                ckpt = (Checkpoint(tr["checkpoint"])
+                        if tr["checkpoint"] else None)
+                if tr["state"] in ("TERMINATED", "ERROR", "STOPPED"):
+                    trials.append(Trial(
+                        tr["trial_id"], tr["config"], state=tr["state"],
+                        last_result=tr["last_result"],
+                        history=tr["history"], error=tr["error"],
+                        iterations=tr["iterations"], checkpoint=ckpt,
+                        from_searcher=tr["from_searcher"]))
+                else:
+                    t = launch(tr["trial_id"], tr["config"], resume=ckpt)
+                    # Roll back to the checkpoint point: the relaunched
+                    # trial replays everything after it, so counters and
+                    # history must not double-count those reports.
+                    it = (tr.get("ckpt_iterations", 0) if ckpt
+                          else 0)
+                    t.iterations = it
+                    t.ckpt_iterations = it
+                    t.history = list(tr["history"])[:it]
+                    t.last_result = (t.history[-1] if t.history
+                                     else dict(tr["last_result"]))
+                    t.checkpoint = ckpt
+                    t.from_searcher = tr["from_searcher"]
+            self._restored = None
+            suggested = sum(1 for t in trials if t.from_searcher)
+
         # Prime the first wave.
         while sum(t.state == "RUNNING" for t in trials) < max_conc:
             if suggest_and_launch() is None:
                 break
+        save_state(force=True)
 
         live = [t for t in trials if t.state == "RUNNING"]
         while live:
@@ -258,6 +373,7 @@ class Tuner:
                         trial.checkpoint = self._persist_ckpt(
                             ckpt_managers, run_dir, trial, ckpt_path,
                             metrics)
+                        trial.ckpt_iterations = trial.iterations
                     d = scheduler.on_result(trial, metrics)
                     if d == STOP:
                         # Later buffered results from a to-be-stopped trial
@@ -291,6 +407,7 @@ class Tuner:
                 if suggest_and_launch() is None:
                     break
                 live = [t for t in trials if t.state == "RUNNING"]
+            save_state(force=not live)  # final snapshot is never skipped
             if live:
                 time.sleep(0.05)
 
