@@ -1,0 +1,5 @@
+"""raytpu.dashboard — server-rendered cluster dashboard."""
+
+from raytpu.dashboard.app import DashboardServer
+
+__all__ = ["DashboardServer"]

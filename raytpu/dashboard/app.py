@@ -1,0 +1,230 @@
+"""Dashboard v1: one aiohttp app over the state API + metrics + timeline.
+
+Reference analogue: ``dashboard/head.py:81`` / ``dashboard/agent.py:28``
+— shrunk to the server-rendered essentials: cluster summary, node /
+actor / task / placement-group tables, object-store summary, a
+chrome-trace timeline download, and Prometheus metrics. No React build;
+every page is generated from the live state API the CLI already uses, so
+the dashboard works against any cluster the driver can connect to.
+
+Start via ``raytpu dashboard --address tcp://HEAD`` or embed
+:class:`DashboardServer` in a driver process.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>raytpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2em; color: #222; }}
+ h1 {{ font-size: 1.4em; }} h2 {{ font-size: 1.1em; margin-top: 1.5em; }}
+ table {{ border-collapse: collapse; min-width: 40em; }}
+ th, td {{ border: 1px solid #ccc; padding: 4px 10px; text-align: left;
+           font-size: 0.9em; }}
+ th {{ background: #f0f0f0; }}
+ .pill {{ padding: 1px 8px; border-radius: 8px; font-size: 0.85em; }}
+ .ok {{ background: #d8f5d8; }} .bad {{ background: #f5d8d8; }}
+ nav a {{ margin-right: 1em; }}
+</style></head>
+<body>
+<h1>raytpu dashboard</h1>
+<nav><a href="/">summary</a><a href="/timeline">timeline.json</a>
+<a href="/metrics">metrics</a><a href="/api/summary">api</a></nav>
+{body}
+</body></html>"""
+
+
+def _table(headers: List[str], rows: List[List[Any]]) -> str:
+    head = "".join(f"<th>{html.escape(str(h))}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{cell}</td>" for cell in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _pill(ok: bool, text: str) -> str:
+    return f'<span class="pill {"ok" if ok else "bad"}">{text}</span>'
+
+
+class DashboardServer:
+    """Serves the dashboard for whatever cluster the current raytpu
+    session is connected to (call ``raytpu.init`` first)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._host = host
+        self._port = port
+        self._runner = None
+        self._thread: Optional[threading.Thread] = None
+        self.url: Optional[str] = None
+
+    # -- data --------------------------------------------------------------
+
+    def _snapshot(self) -> Dict[str, Any]:
+        from raytpu.state import api as state
+
+        out: Dict[str, Any] = {}
+        for key, fn in (
+            ("nodes", state.list_nodes),
+            ("actors", state.list_actors),
+            ("tasks", lambda: state.list_tasks()),
+            ("placement_groups", state.list_placement_groups),
+            ("task_summary", state.summarize_tasks),
+            ("objects", state.object_summary),
+        ):
+            try:
+                out[key] = fn()
+            except Exception as e:  # degrade per-section, never 500
+                out[key] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    # -- pages -------------------------------------------------------------
+
+    def _render_summary(self) -> str:
+        snap = self._snapshot()
+        parts = []
+
+        nodes = snap["nodes"]
+        if isinstance(nodes, list):
+            alive = sum(1 for n in nodes if n.get("Alive"))
+            parts.append(f"<h2>Nodes ({alive}/{len(nodes)} alive)</h2>")
+            parts.append(_table(
+                ["node", "alive", "address", "resources", "available"],
+                [[n.get("NodeID", "")[:12],
+                  _pill(bool(n.get("Alive")),
+                        "alive" if n.get("Alive") else "dead"),
+                  html.escape(str(n.get("Address", ""))),
+                  html.escape(json.dumps(n.get("Resources", {}))),
+                  html.escape(json.dumps(n.get("Available", {})))]
+                 for n in nodes]))
+
+        ts = snap["task_summary"]
+        if isinstance(ts, dict) and "error" not in ts:
+            parts.append("<h2>Tasks</h2>")
+            parts.append(_table(["state", "count"],
+                                [[html.escape(k), v]
+                                 for k, v in sorted(ts.items())]))
+
+        actors = snap["actors"]
+        if isinstance(actors, list):
+            parts.append(f"<h2>Actors ({len(actors)})</h2>")
+            parts.append(_table(
+                ["actor", "name", "state", "node"],
+                [[a.get("actor_id", "")[:12],
+                  html.escape(str(a.get("name") or "")),
+                  _pill(a.get("state") == "ALIVE",
+                        str(a.get("state", "?"))),
+                  str(a.get("node_id", ""))[:12]]
+                 for a in actors[:200]]))
+
+        pgs = snap["placement_groups"]
+        if isinstance(pgs, list) and pgs:
+            parts.append(f"<h2>Placement groups ({len(pgs)})</h2>")
+            parts.append(_table(
+                ["id", "strategy", "bundles"],
+                [[p.get("id", "")[:12], html.escape(str(p.get("strategy"))),
+                  html.escape(json.dumps(p.get("bundles")))]
+                 for p in pgs]))
+
+        objs = snap["objects"]
+        if isinstance(objs, dict) and "error" not in objs:
+            parts.append("<h2>Object store</h2>")
+            parts.append(_table(["key", "value"],
+                                [[html.escape(k), html.escape(str(v))]
+                                 for k, v in objs.items()]))
+        return _PAGE.format(body="".join(parts))
+
+    # -- server ------------------------------------------------------------
+
+    async def _start_async(self):
+        from aiohttp import web
+
+        async def index(request):
+            return web.Response(text=self._render_summary(),
+                                content_type="text/html")
+
+        async def api_summary(request):
+            return web.json_response(self._snapshot())
+
+        async def api_section(request):
+            snap = self._snapshot()
+            key = request.match_info["section"]
+            if key not in snap:
+                return web.Response(status=404, text=f"no section {key}")
+            return web.json_response({key: snap[key]})
+
+        async def timeline(request):
+            import raytpu
+
+            events = raytpu.timeline()
+            return web.Response(
+                text=json.dumps(events),
+                content_type="application/json",
+                headers={"Content-Disposition":
+                         "attachment; filename=timeline.json"})
+
+        async def metrics(request):
+            try:
+                import prometheus_client
+
+                text = prometheus_client.generate_latest().decode()
+            except Exception:
+                text = "# prometheus_client unavailable\n"
+            return web.Response(text=text, content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/", index)
+        app.router.add_get("/api/summary", api_summary)
+        app.router.add_get("/api/{section}", api_section)
+        app.router.add_get("/timeline", timeline)
+        app.router.add_get("/metrics", metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        port = self._runner.addresses[0][1] if self._runner.addresses \
+            else self._port
+        self.url = f"http://{self._host}:{port}"
+
+    def start(self) -> str:
+        import asyncio
+
+        started = threading.Event()
+        holder: Dict[str, Any] = {}
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(self._start_async())
+            holder["loop"] = loop
+            started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run, name="raytpu-dashboard",
+                                        daemon=True)
+        self._thread.start()
+        if not started.wait(timeout=15):
+            raise RuntimeError("dashboard failed to start")
+        self._loop = holder["loop"]
+        return self.url
+
+    def stop(self) -> None:
+        import asyncio
+
+        loop = getattr(self, "_loop", None)
+        if loop is None:
+            return
+
+        async def _shutdown():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_shutdown(), loop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -92,6 +92,22 @@ def _cmd_memory(args) -> int:
     return 0
 
 
+def _cmd_dashboard(args) -> int:
+    """Serve the dashboard against a running cluster (reference:
+    ``ray dashboard``; ours is the server-rendered v1)."""
+    import raytpu
+    from raytpu.dashboard import DashboardServer
+
+    raytpu.init(address=args.address, ignore_reinit_error=True)
+    server = DashboardServer(host=args.host, port=args.port)
+    url = server.start()
+    print(f"raytpu dashboard at {url}", flush=True)
+    if args.block:
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        server.stop()
+    return 0
+
+
 def _cmd_job(args) -> int:
     from raytpu.job.sdk import JobSubmissionClient
 
@@ -152,6 +168,18 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("memory", help="object store summary")
     s.add_argument("--address", default=None)
     s.set_defaults(fn=_cmd_memory)
+
+    s = sub.add_parser("dashboard", help="serve the cluster dashboard")
+    s.add_argument("--address", default=None,
+                   help="cluster head address (tcp://...)")
+    s.add_argument("--host", default="127.0.0.1")
+    # 8266: the job REST API owns 8265 as a separate server here (the
+    # reference co-hosts both on one port; ours are distinct processes).
+    s.add_argument("--port", type=int, default=8266)
+    s.add_argument("--block", dest="block", action="store_true",
+                   default=True)
+    s.add_argument("--no-block", dest="block", action="store_false")
+    s.set_defaults(fn=_cmd_dashboard)
 
     s = sub.add_parser("job", help="job submission")
     s.add_argument("--api", default="http://127.0.0.1:8265",

@@ -1,0 +1,96 @@
+"""Dashboard v1 tests (reference analogue scope: ``dashboard/head.py:81``
+shrunk to the server-rendered state-API essentials)."""
+
+import time
+
+import pytest
+import requests as rq
+
+import raytpu
+from raytpu.dashboard import DashboardServer
+
+
+class TestDashboardLocal:
+    def test_pages_and_api(self, raytpu_local):
+        @raytpu.remote
+        class Marker:
+            def ping(self):
+                return "pong"
+
+        a = Marker.options(name="dash-marker").remote()
+        raytpu.get(a.ping.remote())
+
+        server = DashboardServer(port=0)
+        url = server.start()
+        try:
+            # Summary page renders with node + actor sections.
+            r = rq.get(url + "/", timeout=10)
+            assert r.status_code == 200
+            assert "raytpu dashboard" in r.text
+            assert "Nodes" in r.text and "Actors" in r.text
+
+            # JSON API.
+            summary = rq.get(url + "/api/summary", timeout=10).json()
+            assert summary["nodes"], summary
+            assert any(a_.get("name") == "dash-marker"
+                       for a_ in summary["actors"])
+            nodes = rq.get(url + "/api/nodes", timeout=10).json()
+            assert nodes["nodes"]
+            assert rq.get(url + "/api/bogus", timeout=10).status_code == 404
+
+            # Timeline download is valid chrome-trace JSON.
+            t = rq.get(url + "/timeline", timeout=10)
+            assert t.status_code == 200
+            assert isinstance(t.json(), list)
+
+            # Metrics endpoint answers.
+            m = rq.get(url + "/metrics", timeout=10)
+            assert m.status_code == 200
+        finally:
+            server.stop()
+
+
+class TestDashboardCluster:
+    def test_dashboard_against_live_cluster(self):
+        """`raytpu dashboard` story: a driver-side dashboard shows the
+        real cluster (nodes + running work) while chaos happens."""
+        from raytpu.cluster import Cluster
+
+        c = Cluster(num_nodes=2, node_resources={"num_cpus": 2})
+        c.wait_for_nodes(2)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        server = DashboardServer(port=0)
+        url = server.start()
+        try:
+            @raytpu.remote
+            def work(i):
+                time.sleep(1.0)
+                return i
+
+            refs = [work.remote(i) for i in range(4)]
+            summary = rq.get(url + "/api/summary", timeout=10).json()
+            live_nodes = [n for n in summary["nodes"]
+                          if n.get("Alive")
+                          and n.get("Labels", {}).get("role") != "driver"]
+            assert len(live_nodes) == 2
+            raytpu.get(refs, timeout=60)
+
+            # Kill a node; the summary reflects it.
+            c.kill_node(c.nodes[0])
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                summary = rq.get(url + "/api/summary", timeout=10).json()
+                live = [n for n in summary["nodes"]
+                        if n.get("Alive")
+                        and n.get("Labels", {}).get("role") != "driver"]
+                if len(live) == 1:
+                    break
+                time.sleep(0.5)
+            assert len(live) == 1, "dashboard never saw the node die"
+            page = rq.get(url + "/", timeout=10)
+            assert "dead" in page.text
+        finally:
+            server.stop()
+            raytpu.shutdown()
+            c.shutdown()
