@@ -12,6 +12,7 @@ from raytpu.autoscaler.autoscaler import (
 )
 from raytpu.autoscaler.node_provider import (
     FakeSliceProvider,
+    GceTpuSliceProvider,
     NodeGroup,
     NodeGroupSpec,
     NodeProvider,
@@ -19,6 +20,7 @@ from raytpu.autoscaler.node_provider import (
 
 __all__ = [
     "AutoscalerConfig", "AutoscalerMonitor", "FakeSliceProvider",
+    "GceTpuSliceProvider",
     "NodeGroup", "NodeGroupSpec", "NodeProvider", "ResourceDemand",
     "StandardAutoscaler",
 ]

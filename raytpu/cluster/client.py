@@ -71,6 +71,11 @@ class ClusterBackend:
         self._head.call("subscribe", "nodes")
         self._head.call("subscribe", "actors")
         self._head.call("subscribe", "objects")
+        from raytpu.core.config import cfg as _cfg
+
+        if _cfg.log_to_driver:
+            self._head.subscribe("logs", self._on_log_event)
+            self._head.call("subscribe", "logs")
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
         self._lock = threading.RLock()
@@ -631,6 +636,16 @@ class ClusterBackend:
                                         timeout=5.0))
         except Exception:
             return False
+
+    def _on_log_event(self, data: dict) -> None:
+        """Worker output streamed to the driver terminal (reference:
+        ``log_to_driver``; prefix identifies the producing process)."""
+        import sys as _sys
+
+        src = data.get("source", "?")
+        nid = str(data.get("node_id", ""))[:8]
+        for line in data.get("lines", ()):
+            print(f"({src}, node={nid}) {line}", file=_sys.stderr)
 
     def _on_object_event(self, data: dict) -> None:
         """A node reported an object with zero copies (its producer's node

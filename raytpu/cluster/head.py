@@ -150,6 +150,7 @@ class HeadServer:
         h("remove_pg", self._remove_pg)
         h("pg_info", self._pg_info)
         h("subscribe", self._subscribe)
+        h("publish_logs", self._publish_logs)
         h("get_demand", self._get_demand)
         h("next_job_id", self._next_job_id)
         h("ping", lambda peer: "pong")
@@ -737,6 +738,11 @@ class HeadServer:
         for p in peers:
             if not p.closed:
                 p.push(topic, data)
+
+    def _publish_logs(self, peer: Peer, record: dict) -> None:
+        """Rebroadcast a node's worker-log lines to subscribed drivers
+        (reference: log monitor -> GCS pubsub -> driver)."""
+        self._publish("logs", record)
 
     def _get_demand(self, peer: Peer, window_s: float = 10.0) -> List[dict]:
         """Aggregated unmet demand in the look-back window: the input to

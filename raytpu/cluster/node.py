@@ -491,6 +491,17 @@ class NodeServer:
         self._fetching: set = set()
         self._fetch_lock = threading.Lock()
         self.address: Optional[str] = None
+        # Per-process log files live under the session dir (reference:
+        # /tmp/ray/session_*/logs with one file per worker).
+        base = cfg.session_dir or _os.path.join(
+            "/tmp", "raytpu", f"session_{_os.getpid()}")
+        self.log_dir = _os.path.join(base, "logs")
+        try:
+            _os.makedirs(self.log_dir, exist_ok=True)
+        except OSError:
+            self.log_dir = None
+        h("list_logs", self._h_list_logs)
+        h("read_log", self._h_read_log)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -514,8 +525,14 @@ class NodeServer:
                 self.shm.name if self.shm is not None else "",
                 self.node_id.hex(),
                 soft_limit=int(self.backend.node.total.get(CPU)),
+                log_dir=self.log_dir,
             )
             self.backend.worker_pool = self.worker_pool
+            if cfg.log_to_driver and self.log_dir:
+                self._log_monitor = threading.Thread(
+                    target=self._log_monitor_loop, name="node-log-monitor",
+                    daemon=True)
+                self._log_monitor.start()
         self._head = RpcClient(self.head_address)
         self._head.call(
             "register_node", self.node_id.hex(), self.address,
@@ -1057,6 +1074,90 @@ class NodeServer:
         oid = ObjectID.from_hex(oid_hex)
         if self.backend.store.on_put is not None:
             self.backend.store.on_put(oid)
+
+    def _h_list_logs(self, peer: Peer) -> List[dict]:
+        import os as _os
+
+        if not self.log_dir:
+            return []
+        out = []
+        try:
+            for name in sorted(_os.listdir(self.log_dir)):
+                path = _os.path.join(self.log_dir, name)
+                try:
+                    out.append({"name": name,
+                                "size": _os.path.getsize(path)})
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return out
+
+    def _h_read_log(self, peer: Peer, name: str, offset: int = 0,
+                    length: int = 1 << 20) -> Optional[bytes]:
+        import os as _os
+
+        if not self.log_dir or _os.sep in name or name.startswith("."):
+            return None
+        path = _os.path.join(self.log_dir, name)
+        try:
+            with open(path, "rb") as f:
+                f.seek(int(offset))
+                return f.read(int(length))
+        except OSError:
+            return None
+
+    def _log_monitor_loop(self) -> None:
+        """Tail every worker log file; stream new lines to drivers via the
+        head's ``logs`` pubsub topic (reference: the log monitor process
+        feeding ``ray.init(log_to_driver=True)``)."""
+        import os as _os
+
+        offsets: Dict[str, int] = {}
+        partial: Dict[str, bytes] = {}  # carry for chunk-split lines
+        while not self._stop.wait(0.5):
+            try:
+                names = _os.listdir(self.log_dir)
+            except OSError:
+                continue
+            for name in names:
+                path = _os.path.join(self.log_dir, name)
+                try:
+                    size = _os.path.getsize(path)
+                except OSError:
+                    continue
+                off = offsets.get(name, 0)
+                if size <= off:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(min(size - off, 256 * 1024))
+                except OSError:
+                    continue
+                offsets[name] = off + len(chunk)
+                data = partial.pop(name, b"") + chunk
+                raw, sep, rest = data.rpartition(b"\n")
+                if not sep:
+                    partial[name] = data  # no complete line yet
+                    continue
+                if rest:
+                    partial[name] = rest
+                text = raw.decode("utf-8", "replace")
+                lines = [ln for ln in text.splitlines() if ln.strip()]
+                # Publish EVERY line (batched) — dropping burst output
+                # would lose exactly the stack traces users need.
+                while lines:
+                    try:
+                        self._head.notify(
+                            "publish_logs", {
+                                "node_id": self.node_id.hex(),
+                                "source": name,
+                                "lines": lines[:200],
+                            })
+                    except Exception:
+                        break
+                    lines = lines[200:]
 
     def _h_debug_state(self, peer: Peer) -> dict:
         b = self.backend

@@ -92,10 +92,12 @@ class WorkerHandle:
 class WorkerPool:
     def __init__(self, node_address: str, shm_name: Optional[str],
                  node_id_hex: str, base_env: Optional[Dict[str, str]] = None,
-                 soft_limit: Optional[int] = None):
+                 soft_limit: Optional[int] = None,
+                 log_dir: Optional[str] = None):
         self.node_address = node_address
         self.shm_name = shm_name or ""
         self.node_id_hex = node_id_hex
+        self.log_dir = log_dir
         self.base_env = dict(base_env or {})
         # The cap must at least cover the CPU ledger, or tasks the
         # scheduler admitted would starve waiting for workers.
@@ -256,7 +258,21 @@ class WorkerPool:
             "--job", h.key[0],
             "--node-id", self.node_id_hex,
         ]
-        h.proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+        # Per-process log files (reference: worker-<id>-<pid>.out/.err
+        # under the session dir); the node's log monitor tails .out/.err
+        # and streams new lines to drivers.
+        stdout = stderr = None
+        if self.log_dir:
+            wid = h.worker_id.hex()[:12]
+            stdout = open(os.path.join(
+                self.log_dir, f"worker-{wid}.out"), "ab", buffering=0)
+            stderr = open(os.path.join(
+                self.log_dir, f"worker-{wid}.err"), "ab", buffering=0)
+        h.proc = subprocess.Popen(cmd, env=env, start_new_session=True,
+                                  stdout=stdout, stderr=stderr)
+        if stdout is not None:
+            stdout.close()
+            stderr.close()
 
     def _drop_locked(self, h: WorkerHandle) -> None:
         self._workers.pop(h.worker_id.hex(), None)

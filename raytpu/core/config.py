@@ -123,6 +123,10 @@ declare("pubsub_batch_ms", 10)
 declare("metrics_report_interval_ms", 2500)
 declare("task_events_buffer_size", 100000)
 declare("enable_timeline", True)
+# Log infrastructure (reference: per-process log files under the session
+# dir + the log monitor streaming worker output to drivers).
+declare("session_dir", "")  # empty = /tmp/raytpu/session_<node pid>
+declare("log_to_driver", True)
 
 # TPU / mesh.
 declare("tpu_visible_chips_env", "TPU_VISIBLE_CHIPS")

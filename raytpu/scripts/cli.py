@@ -92,6 +92,54 @@ def _cmd_memory(args) -> int:
     return 0
 
 
+def _cmd_logs(args) -> int:
+    """List / read per-process log files across the cluster (reference:
+    ``ray logs``; files live in each node's session dir)."""
+    import time as _time
+
+    from raytpu.cluster.protocol import RpcClient
+
+    head = RpcClient(args.address.replace("tcp://", ""))
+    nodes = [n for n in head.call("list_nodes")
+             if n["alive"] and n["labels"].get("role") != "driver"]
+    try:
+        if args.file is None:
+            for n in nodes:
+                cli = RpcClient(n["address"])
+                try:
+                    for entry in cli.call("list_logs"):
+                        print(f"{n['node_id'][:12]}\t{entry['name']}\t"
+                              f"{entry['size']}")
+                finally:
+                    cli.close()
+            return 0
+        # Read (optionally follow) one file from one node.
+        target = None
+        for n in nodes:
+            if args.node is None or n["node_id"].startswith(args.node):
+                target = n
+                break
+        if target is None:
+            print("no matching node", file=sys.stderr)
+            return 1
+        cli = RpcClient(target["address"])
+        try:
+            offset = 0
+            while True:
+                chunk = cli.call("read_log", args.file, offset)
+                if chunk:
+                    sys.stdout.write(chunk.decode("utf-8", "replace"))
+                    sys.stdout.flush()
+                    offset += len(chunk)
+                if not args.follow:
+                    return 0
+                _time.sleep(0.5)
+        finally:
+            cli.close()
+    finally:
+        head.close()
+
+
 def _cmd_dashboard(args) -> int:
     """Serve the dashboard against a running cluster (reference:
     ``ray dashboard``; ours is the server-rendered v1)."""
@@ -168,6 +216,13 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("memory", help="object store summary")
     s.add_argument("--address", default=None)
     s.set_defaults(fn=_cmd_memory)
+
+    s = sub.add_parser("logs", help="list/read per-process log files")
+    s.add_argument("--address", required=True)
+    s.add_argument("--node", default=None, help="node id prefix")
+    s.add_argument("--follow", action="store_true")
+    s.add_argument("file", nargs="?", default=None)
+    s.set_defaults(fn=_cmd_logs)
 
     s = sub.add_parser("dashboard", help="serve the cluster dashboard")
     s.add_argument("--address", default=None,

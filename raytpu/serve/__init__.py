@@ -10,6 +10,7 @@ from raytpu.serve.api import (
     delete,
     get_app_handle,
     get_deployment_handle,
+    ingress,
     run,
     shutdown,
     start,
@@ -27,5 +28,6 @@ __all__ = [
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "Request",
     "batch", "delete", "deployment", "get_app_handle",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
-    "run", "shutdown", "start", "status",
+    "run",
+    "ingress", "shutdown", "start", "status",
 ]

@@ -64,6 +64,7 @@ class ProxyActor:
         self._route_table: Dict[str, tuple] = {}
         self._route_version = -1
         self._handles: Dict[str, DeploymentHandle] = {}
+        self._asgi: Dict[str, bool] = {}  # deployment key -> transport
         self._runner = None
         self._ready = False
 
@@ -101,6 +102,9 @@ class ProxyActor:
                 upd = updates["route_table"]
                 self._route_table = dict(upd.object_snapshot)
                 self._route_version = upd.snapshot_id
+                # Redeploys can switch a key between ASGI and plain
+                # transports; re-probe on the next request.
+                self._asgi.clear()
 
     def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
         best = None
@@ -140,6 +144,21 @@ class ProxyActor:
         model_id = request.headers.get("serve_multiplexed_model_id")
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
+        # ASGI ingress (reference: @serve.ingress(app)): probe the
+        # deployment's transport once, then forward raw scope+body so real
+        # web frameworks run unmodified inside the replica.
+        loop = asyncio.get_event_loop()
+        if key not in self._asgi:
+            try:
+                # Cache only successful probes: a replica-startup timeout
+                # must not pin the wrong transport forever.
+                self._asgi[key] = await loop.run_in_executor(
+                    None, handle.is_asgi)
+            except Exception:
+                return web.Response(status=503,
+                                    text="deployment starting; retry")
+        if self._asgi[key]:
+            return await self._handle_asgi(request, handle, body, prefix)
         # SSE contract (reference: Serve StreamingResponse): a client that
         # accepts text/event-stream gets the handler's chunks as they are
         # produced — the token-streaming path for jitted LM serving.
@@ -153,6 +172,45 @@ class ProxyActor:
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         status, payload, ctype = _encode_response(result)
         return web.Response(status=status, body=payload, content_type=ctype.split(";")[0])
+
+    async def _handle_asgi(self, request, handle, body: bytes,
+                           prefix: str):
+        from aiohttp import web
+
+        path = request.path
+        root = "" if prefix == "/" else prefix
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request.method,
+            "scheme": "http",
+            "path": path[len(root):] or "/",
+            "root_path": root,
+            "raw_path": path,
+            "query_string": request.query_string,
+            "headers": [(k.lower(), v) for k, v in request.headers.items()],
+            "client": None,
+            "server": None,
+        }
+        loop = asyncio.get_event_loop()
+        try:
+            # Dispatch (replica selection) off-loop; the response itself is
+            # awaitable, so the request's execution never parks a thread.
+            dresp = await loop.run_in_executor(
+                None, lambda: handle.remote_asgi(scope, body))
+            resp = await dresp
+        except Exception as e:
+            return web.Response(status=500,
+                                text=f"{type(e).__name__}: {e}")
+        from multidict import CIMultiDict
+
+        headers = CIMultiDict()
+        for k, v in resp.get("headers", []):
+            if k.lower() not in ("content-length", "transfer-encoding"):
+                headers.add(k, v)  # preserves duplicates (Set-Cookie)
+        return web.Response(status=resp.get("status", 200),
+                            body=resp.get("body", b""), headers=headers)
 
     async def _handle_sse(self, request, handle, req: Request):
         """Stream the handler's chunks as server-sent events; each chunk is

@@ -154,6 +154,18 @@ class Router:
             method_name, args, kwargs, request_meta or {}
         )
 
+    def probe_asgi(self, timeout_s: float = 30.0) -> bool:
+        """One-shot transport probe: does this deployment serve ASGI?"""
+        replica = self._replica_set.choose(timeout_s=timeout_s)
+        return bool(raytpu.get(replica.is_asgi.remote(), timeout=10))
+
+    def assign_request_asgi(self, scope: dict, body: bytes,
+                            request_meta: Optional[dict] = None,
+                            timeout_s: float = 30.0):
+        replica = self._replica_set.choose(timeout_s=timeout_s)
+        return replica.handle_request_asgi.remote(scope, body,
+                                                  request_meta or {})
+
     def assign_request_streaming(
         self,
         method_name: str,

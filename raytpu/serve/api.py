@@ -44,6 +44,29 @@ def start(http_options: Optional[HTTPOptions] = None, **kwargs) -> None:
     raytpu.get(proxy.ready.remote())
 
 
+def ingress(asgi_app):
+    """Class decorator binding an ASGI app to a deployment (reference:
+    ``@serve.ingress(fastapi_app)``, ``python/ray/serve/api.py``): the app
+    runs INSIDE each replica, so any ASGI framework (starlette, FastAPI,
+    or a bare ``async def app(scope, receive, send)``) serves next to the
+    model. The proxy detects the transport automatically and forwards raw
+    HTTP instead of the Request-namedtuple contract.
+
+    ::
+
+        @serve.deployment
+        @serve.ingress(my_asgi_app)
+        class Server:
+            ...
+    """
+
+    def decorator(cls):
+        cls.__raytpu_asgi_app__ = staticmethod(asgi_app)
+        return cls
+
+    return decorator
+
+
 def run(
     app: Application,
     *,

@@ -123,6 +123,15 @@ class DeploymentHandle:
         )
         return DeploymentResponse(ref)
 
+    def is_asgi(self, timeout_s: float = 30.0) -> bool:
+        return self._get_router().probe_asgi(timeout_s=timeout_s)
+
+    def remote_asgi(self, scope: dict, body: bytes) -> DeploymentResponse:
+        """Route one HTTP request into the deployment's ASGI app."""
+        ref = self._get_router().assign_request_asgi(
+            scope, body, request_meta=self._meta)
+        return DeploymentResponse(ref)
+
     def remote_streaming(self, *args, **kwargs) -> DeploymentResponseGenerator:
         """Call a streaming handler: returns an iterator of its chunks,
         consumable while the handler still runs (reference: Serve response

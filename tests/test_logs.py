@@ -1,0 +1,70 @@
+"""Log infrastructure: per-process files + streaming to the driver.
+
+Reference analogue: the session-dir log files plus the log monitor that
+feeds ``ray.init(log_to_driver=True)`` and ``ray logs``.
+"""
+
+import time
+
+import pytest
+
+import raytpu
+from raytpu.cluster import Cluster
+from raytpu.cluster.protocol import RpcClient
+
+
+class TestLogInfra:
+    def test_worker_logs_land_in_files_and_stream_to_driver(self, capfd):
+        c = Cluster(num_nodes=1, node_resources={"num_cpus": 2})
+        c.wait_for_nodes(1)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            @raytpu.remote
+            def chatty():
+                print("hello-from-worker-stdout")
+                import sys
+                print("hello-from-worker-stderr", file=sys.stderr)
+                return 1
+
+            assert raytpu.get(chatty.remote(), timeout=60) == 1
+
+            # (a) Per-process files on the node, readable over RPC.
+            head = RpcClient(c.address)
+            node = next(n for n in head.call("list_nodes")
+                        if n["alive"]
+                        and n["labels"].get("role") != "driver")
+            head.close()
+            cli = RpcClient(node["address"])
+            try:
+                deadline = time.monotonic() + 20
+                found = None
+                while time.monotonic() < deadline and found is None:
+                    for entry in cli.call("list_logs"):
+                        if entry["name"].endswith(".out") and \
+                                entry["size"] > 0:
+                            blob = cli.call("read_log", entry["name"], 0)
+                            if b"hello-from-worker-stdout" in (blob or b""):
+                                found = entry["name"]
+                                break
+                    time.sleep(0.25)
+                assert found, "worker stdout never landed in a log file"
+                # Path traversal is refused.
+                assert cli.call("read_log", "../etc/passwd") is None
+            finally:
+                cli.close()
+
+            # (b) The same line streams to the driver (log monitor ->
+            # head pubsub -> driver stderr).
+            deadline = time.monotonic() + 20
+            streamed = False
+            while time.monotonic() < deadline:
+                err = capfd.readouterr().err
+                if "hello-from-worker-stdout" in err:
+                    streamed = True
+                    break
+                time.sleep(0.25)
+            assert streamed, "worker output never streamed to the driver"
+        finally:
+            raytpu.shutdown()
+            c.shutdown()

@@ -390,3 +390,61 @@ class TestReplicaFaultTolerance:
                 pass
             time.sleep(0.2)
         assert ok, "controller did not replace the dead replica"
+
+
+class TestASGIIngress:
+    def test_asgi_app_serves_http(self, serve_instance):
+        """A bare ASGI app (the protocol every Python web framework
+        speaks) runs inside the replica and serves over the proxy."""
+        import json as _json
+
+        import requests as rq
+
+        async def asgi_app(scope, receive, send):
+            assert scope["type"] == "http"
+            msg = await receive()
+            body = msg.get("body", b"")
+            payload = {
+                "path": scope["path"],
+                "method": scope["method"],
+                "root_path": scope["root_path"],
+                "query": scope["query_string"].decode(),
+                "echo": body.decode() if body else None,
+            }
+            await send({
+                "type": "http.response.start",
+                "status": 201,
+                "headers": [(b"content-type", b"application/json"),
+                            (b"x-served-by", b"raytpu-asgi")],
+            })
+            await send({"type": "http.response.body",
+                        "body": _json.dumps(payload).encode()})
+
+        @serve.deployment
+        @serve.ingress(asgi_app)
+        class AsgiServer:
+            pass
+
+        serve.start(host="127.0.0.1", port=18441)
+        serve.run(AsgiServer.bind(), name="asgi", route_prefix="/svc")
+        r = rq.post("http://127.0.0.1:18441/svc/predict?k=v",
+                    data="hi", timeout=15)
+        assert r.status_code == 201
+        assert r.headers["x-served-by"] == "raytpu-asgi"
+        out = r.json()
+        assert out["path"] == "/predict"
+        assert out["root_path"] == "/svc"
+        assert out["method"] == "POST"
+        assert out["query"] == "k=v"
+        assert out["echo"] == "hi"
+
+        # Non-ASGI deployments on the same proxy still use the
+        # Request-namedtuple contract.
+        @serve.deployment
+        class Plain:
+            def __call__(self, request):
+                return {"plain": True}
+
+        serve.run(Plain.bind(), name="plain", route_prefix="/plain")
+        r2 = rq.get("http://127.0.0.1:18441/plain", timeout=15)
+        assert r2.json() == {"plain": True}
