@@ -2,6 +2,7 @@
 
 from raytpu.data.block import Block, BlockAccessor
 from raytpu.data.dataset import DataIterator, Dataset
+from raytpu.data.executor import ActorPoolStrategy
 from raytpu.data.read_api import (
     from_arrow,
     from_generator,
@@ -19,6 +20,7 @@ from raytpu.data.read_api import (
 __all__ = [
     "Dataset",
     "DataIterator",
+    "ActorPoolStrategy",
     "Block",
     "BlockAccessor",
     "range",

@@ -7,8 +7,10 @@ consumption streams blocks through remote tasks with bounded in-flight
 work (:mod:`raytpu.data.executor`). Blocks live in the object store; the
 driver holds refs only.
 
-Single-node simplifications (documented per method): global ops
-(sort/repartition/random_shuffle) materialize; everything else streams.
+Global ops (sort/repartition/random_shuffle) run as distributed two-phase
+exchanges (map partition tasks + reduce merge tasks) — the driver holds
+refs only, so dataset size is bounded by the cluster object store, not
+driver RAM. Everything else streams.
 """
 
 from __future__ import annotations
@@ -41,17 +43,40 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     num_cpus: float = 1.0, batch_size: Optional[int] = None,
-                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+                    fn_kwargs: Optional[dict] = None,
+                    compute=None) -> "Dataset":
         """Apply fn to whole blocks (reference: ``Dataset.map_batches``).
-        `batch_size=None` keeps source block boundaries (fastest)."""
+        `batch_size=None` keeps source block boundaries (fastest).
+
+        ``compute=ActorPoolStrategy(size=n)`` runs the stage on n
+        long-lived actors; ``fn`` may then be a CLASS whose instances are
+        built once per actor (stateful UDF — the place to load/jit a
+        model once and reuse it per block)."""
+        import inspect as _inspect
+
         kw = fn_kwargs or {}
 
-        def op(block):
-            view = batch_format_view(block, batch_format)
-            return normalize_batch_output(fn(view, **kw))
+        if _inspect.isclass(fn):
+            if compute is None:
+                raise ValueError(
+                    "class-based map_batches UDFs require "
+                    "compute=ActorPoolStrategy(...)")
+            user_cls = fn
+
+            class op:  # instantiated once per pool actor
+                def __init__(self):
+                    self._inner = user_cls()
+
+                def __call__(self, block):
+                    view = batch_format_view(block, batch_format)
+                    return normalize_batch_output(self._inner(view, **kw))
+        else:
+            def op(block):
+                view = batch_format_view(block, batch_format)
+                return normalize_batch_output(fn(view, **kw))
 
         ds = self._with_op(OpSpec(getattr(fn, "__name__", "map_batches"),
-                                  op, num_cpus=num_cpus))
+                                  op, num_cpus=num_cpus, compute=compute))
         if batch_size is not None:
             ds = ds._rechunk(batch_size)
         return ds
@@ -130,67 +155,149 @@ class Dataset:
 
         return Dataset(source, [], name="union")
 
+    def _all_to_all(self, num_out: Optional[int], assign_fn, name: str,
+                    post_fn=None, prepare_fn=None) -> "Dataset":
+        """Two-phase distributed shuffle (reference:
+        ``python/ray/data/_internal/planner/exchange/`` push-based
+        shuffle): map tasks partition each input block into ``n_out``
+        pieces (``assign_fn(block_numpy, rows, block_idx, n_out, aux) ->
+        partition id per row``); reduce tasks concatenate piece j of every
+        map output (+ optional ``post_fn`` e.g. a local sort). The driver
+        only ever holds refs — dataset size is bounded by the cluster's
+        object store, not driver RAM. ``num_out=None`` preserves the input
+        block count (parallelism follows the data); ``prepare_fn(in_refs,
+        n_out)`` computes small driver-side aux state (offsets, sort
+        boundaries) before the exchange."""
+        parent = self
+
+        def source():
+            in_refs = list(parent._iter_block_refs())
+            if not in_refs:
+                return
+            n_out = max(1, int(num_out) if num_out else len(in_refs))
+            aux = prepare_fn(in_refs, n_out) if prepare_fn else None
+
+            @raytpu.remote(num_returns=n_out, name=f"data::{name}-map")
+            def split(block, idx):
+                npd = BlockAccessor(block).to_numpy()
+                rows = BlockAccessor(block).num_rows()
+                assign = assign_fn(npd, rows, idx, n_out, aux)
+                pieces = []
+                for j in range(n_out):
+                    mask = assign == j
+                    pieces.append({k: np.asarray(v)[mask]
+                                   for k, v in npd.items()})
+                return tuple(pieces) if n_out > 1 else pieces[0]
+
+            @raytpu.remote(name=f"data::{name}-reduce")
+            def merge(j, *pieces):
+                live = [p for p in pieces
+                        if BlockAccessor(p).num_rows() > 0]
+                out = concat_blocks(live) if live else pieces[0]
+                if post_fn is not None:
+                    out = post_fn(out, j)
+                return out
+
+            parts = [split.remote(ref, i) for i, ref in enumerate(in_refs)]
+            if n_out == 1:
+                parts = [[p] for p in parts]
+            for j in range(n_out):
+                yield merge.remote(j, *[p[j] for p in parts])
+
+        return Dataset(source, [], name=f"{self._name}.{name}")
+
     def repartition(self, num_blocks: int) -> "Dataset":
-        """Global op — materializes (all-to-all; reference repartition is a
-        shuffle too)."""
-        parent = self
+        """Distributed all-to-all repartition into near-equal blocks,
+        PRESERVING row order (reference: ``Dataset.repartition``): a cheap
+        remote count pass gives global offsets, rows then map to
+        contiguous output ranges."""
 
-        def source():
-            blocks = [raytpu.get(r) for r in parent._iter_block_refs()]
-            if not blocks:
-                return
-            whole = concat_blocks(blocks)
-            total = BlockAccessor(whole).num_rows()
-            per = max(1, -(-total // num_blocks))
-            for i in range(num_blocks):
-                lo, hi = i * per, min((i + 1) * per, total)
-                if lo >= total:
-                    break
-                yield raytpu.put(BlockAccessor(whole).slice(lo, hi))
+        def prepare(in_refs, n_out):
+            @raytpu.remote(name="data::repartition-count")
+            def count(block):
+                return BlockAccessor(block).num_rows()
 
-        return Dataset(source, [], name=f"{self._name}.repartition")
+            counts = raytpu.get([count.remote(r) for r in in_refs])
+            offsets = np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int64)
+            total = int(offsets[-1])
+            per = max(1, -(-total // n_out))
+            return offsets, per
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global op — materializes and row-permutes."""
-        parent = self
+        def assign(npd, rows, idx, n_out, aux):
+            offsets, per = aux
+            return np.minimum(
+                (int(offsets[idx]) + np.arange(rows)) // per, n_out - 1)
 
-        def source():
-            blocks = [raytpu.get(r) for r in parent._iter_block_refs()]
-            if not blocks:
-                return
-            whole = BlockAccessor(concat_blocks(blocks))
-            n = whole.num_rows()
-            rng = np.random.default_rng(seed)
+        return self._all_to_all(num_blocks, assign, "repartition",
+                                prepare_fn=prepare)
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        """Distributed random shuffle: rows hash to random reducers, each
+        reducer permutes locally — a true all-to-all, no driver
+        materialization (reference: ``Dataset.random_shuffle``). Output
+        parallelism follows the input block count unless overridden."""
+
+        def assign(npd, rows, idx, n_out, aux):
+            rng = np.random.default_rng(
+                None if seed is None else seed + 7919 * idx)
+            return rng.integers(0, n_out, size=rows)
+
+        def post(block, j):
+            npd = BlockAccessor(block).to_numpy()
+            n = BlockAccessor(block).num_rows()
+            rng = np.random.default_rng(
+                None if seed is None else seed + 104729 * (j + 1))
             perm = rng.permutation(n)
-            npd = whole.to_numpy()
-            shuffled = {k: np.asarray(v)[perm] for k, v in npd.items()}
-            nblocks = max(1, len(blocks))
-            per = -(-n // nblocks)
-            for i in range(nblocks):
-                lo, hi = i * per, min((i + 1) * per, n)
-                if lo >= n:
-                    break
-                yield raytpu.put({k: v[lo:hi] for k, v in shuffled.items()})
+            return {k: np.asarray(v)[perm] for k, v in npd.items()}
 
-        return Dataset(source, [], name=f"{self._name}.shuffle")
+        return self._all_to_all(num_blocks, assign, "shuffle",
+                                post_fn=post)
 
-    def sort(self, key: str, descending: bool = False) -> "Dataset":
-        """Global op — materializes."""
-        parent = self
+    def sort(self, key: str, descending: bool = False,
+             num_blocks: Optional[int] = None) -> "Dataset":
+        """Distributed sample sort: sample boundaries from every block,
+        range-partition rows to reducers, reducers sort locally — output
+        blocks are globally ordered (reference: ``Dataset.sort`` over the
+        sort exchange). Sampling pulls only small per-block samples to the
+        driver, never the data."""
 
-        def source():
-            blocks = [raytpu.get(r) for r in parent._iter_block_refs()]
-            if not blocks:
-                return
-            whole = BlockAccessor(concat_blocks(blocks))
-            npd = whole.to_numpy()
-            order = np.argsort(npd[key], kind="stable")
+        def prepare(in_refs, n_out):
+            @raytpu.remote(name="data::sort-sample")
+            def sample(block):
+                vals = np.asarray(BlockAccessor(block).to_numpy()[key])
+                if vals.size == 0:
+                    return vals
+                k = min(64, vals.size)
+                idx = np.linspace(0, vals.size - 1, k).astype(np.int64)
+                return np.sort(vals)[idx]
+
+            samples = np.concatenate(
+                [s for s in raytpu.get([sample.remote(r)
+                                        for r in in_refs])
+                 if np.asarray(s).size] or [np.zeros(0)])
+            if samples.size == 0:
+                return np.zeros(0)
+            qs = np.linspace(0, 1, n_out + 1)[1:-1]
+            return np.quantile(np.sort(samples), qs)
+
+        def assign(npd, rows, idx, n_out, boundaries):
+            vals = np.asarray(npd[key])
+            part = np.searchsorted(boundaries, vals, side="right")
+            if descending:
+                part = (n_out - 1) - part
+            return part
+
+        def post(block, j):
+            npd = BlockAccessor(block).to_numpy()
+            order = np.argsort(np.asarray(npd[key]), kind="stable")
             if descending:
                 order = order[::-1]
-            yield raytpu.put({k: np.asarray(v)[order]
-                              for k, v in npd.items()})
+            return {k2: np.asarray(v)[order] for k2, v in npd.items()}
 
-        return Dataset(source, [], name=f"{self._name}.sort")
+        return self._all_to_all(num_blocks, assign, "sort",
+                                post_fn=post, prepare_fn=prepare)
 
     # -- consumption ----------------------------------------------------------
 
@@ -257,12 +364,15 @@ class Dataset:
         return total / max(n, 1)
 
     def min(self, col: str):
-        return min(float(np.asarray(BlockAccessor(b).to_numpy()[col]).min())
-                   for b in self.iter_blocks())
+        # Skip zero-row blocks (exchanges can produce them).
+        return min(float(np.asarray(arr).min()) for arr in (
+            BlockAccessor(b).to_numpy()[col] for b in self.iter_blocks())
+            if np.asarray(arr).size)
 
     def max(self, col: str):
-        return max(float(np.asarray(BlockAccessor(b).to_numpy()[col]).max())
-                   for b in self.iter_blocks())
+        return max(float(np.asarray(arr).max()) for arr in (
+            BlockAccessor(b).to_numpy()[col] for b in self.iter_blocks())
+            if np.asarray(arr).size)
 
     def schema(self):
         for block in self.iter_blocks():

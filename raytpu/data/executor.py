@@ -19,18 +19,94 @@ import raytpu
 from raytpu.runtime.object_ref import ObjectRef
 
 
+class ActorPoolStrategy:
+    """Run a map stage on a pool of long-lived actors instead of per-block
+    tasks (reference: ``ActorPoolStrategy`` / the actor-pool MapOperator,
+    ``execution/operators/map_operator.py:34``) — the TPU-relevant case:
+    a stage whose setup is expensive (load model, jit-compile) amortizes
+    it across every block the actor processes."""
+
+    def __init__(self, size: int = 2):
+        self.size = max(1, int(size))
+
+
 class OpSpec:
     """One pipeline stage: a remote transform over blocks.
 
-    fn(block) -> block (or list of blocks for flat ops).
+    fn(block) -> block. ``fn`` may also be a CLASS: it is instantiated
+    once per pool actor (stateful UDF; requires ``compute``).
     """
 
     def __init__(self, name: str, fn: Callable, *, num_cpus: float = 1.0,
-                 flat: bool = False):
+                 compute: "ActorPoolStrategy" = None):
         self.name = name
         self.fn = fn
         self.num_cpus = num_cpus
-        self.flat = flat
+        self.compute = compute
+
+
+def fuse_ops(ops: List[OpSpec]) -> List[OpSpec]:
+    """Logical-plan optimizer rule: consecutive task-based map stages fuse
+    into ONE remote task so intermediate blocks never hit the object
+    store (reference: ``OperatorFusionRule``,
+    ``_internal/logical/rules/operator_fusion.py``). Actor-pool stages
+    are fusion barriers (different execution substrate)."""
+    fused: List[OpSpec] = []
+    for op in ops:
+        prev = fused[-1] if fused else None
+        if (prev is not None and prev.compute is None
+                and op.compute is None):
+            def composed(block, _f=prev.fn, _g=op.fn):
+                return _g(_f(block))
+
+            fused[-1] = OpSpec(f"{prev.name}->{op.name}", composed,
+                               num_cpus=max(prev.num_cpus, op.num_cpus))
+        else:
+            fused.append(op)
+    return fused
+
+
+class _PoolStage:
+    """Actor-pool execution of one stage: blocks dispatch round-robin to
+    ``size`` actors, each hosting the (possibly stateful) UDF."""
+
+    def __init__(self, op: OpSpec):
+        fn = op.fn
+
+        @raytpu.remote(num_cpus=op.num_cpus)
+        class _MapWorker:
+            def __init__(self):
+                import inspect as _inspect
+
+                self._fn = fn() if _inspect.isclass(fn) else fn
+
+            def apply(self, block):
+                return self._fn(block)
+
+        # Cap the pool at what the cluster can actually schedule: actors
+        # beyond capacity would never start, and blocks round-robined to
+        # them would wait forever (silent pipeline deadlock).
+        size = op.compute.size
+        try:
+            total_cpus = float(raytpu.cluster_resources().get("CPU", 1.0))
+            cap = max(1, int(total_cpus // max(op.num_cpus, 1e-9)))
+            size = min(size, cap)
+        except Exception:
+            pass
+        self.actors = [_MapWorker.remote() for _ in range(size)]
+        self._next = 0
+
+    def submit(self, ref: ObjectRef) -> ObjectRef:
+        actor = self.actors[self._next % len(self.actors)]
+        self._next += 1
+        return actor.apply.remote(ref)
+
+    def stop(self) -> None:
+        for a in self.actors:
+            try:
+                raytpu.kill(a)
+            except Exception:
+                pass
 
 
 def run_pipeline(source: Iterator, ops: List[OpSpec], *,
@@ -38,44 +114,57 @@ def run_pipeline(source: Iterator, ops: List[OpSpec], *,
     """Stream block refs from `source` through `ops`.
 
     `source` yields ObjectRefs of blocks. Returns an iterator of output
-    block refs in order. Each stage runs as remote tasks with a
-    concurrency cap; stages are chained per-block (pipeline, no barrier —
-    block i can be in stage 2 while block j is in stage 0).
+    block refs in order. Each stage runs as remote tasks (fused where
+    adjacent) or on an actor pool, with a concurrency cap; stages are
+    chained per-block (pipeline, no barrier — block i can be in stage 2
+    while block j is in stage 0).
     """
     if not ops:
         yield from source
         return
 
-    remotes = []
+    ops = fuse_ops(ops)
+    stages = []
+    pools: List[_PoolStage] = []
     for op in ops:
-        @raytpu.remote(num_cpus=op.num_cpus, name=f"data::{op.name}")
-        def stage(block, _fn=op.fn):
-            return _fn(block)
+        if op.compute is not None:
+            pool = _PoolStage(op)
+            pools.append(pool)
+            stages.append(pool.submit)
+        else:
+            @raytpu.remote(num_cpus=op.num_cpus, name=f"data::{op.name}")
+            def stage(block, _fn=op.fn):
+                return _fn(block)
 
-        remotes.append(stage)
+            stages.append(stage.remote)
 
     def chain(ref: ObjectRef) -> ObjectRef:
-        for r in remotes:
-            ref = r.remote(ref)
+        for submit in stages:
+            ref = submit(ref)
         return ref
 
-    pending: List[ObjectRef] = []  # ordered
-    source_iter = iter(source)
-    exhausted = False
-    while pending or not exhausted:
-        while not exhausted and len(pending) < max_in_flight:
-            try:
-                in_ref = next(source_iter)
-            except StopIteration:
-                exhausted = True
-                break
-            pending.append(chain(in_ref))
-        if pending:
-            # Ordered streaming: wait on the head (completion order within
-            # the window doesn't matter for memory; order does for output).
-            head = pending.pop(0)
-            raytpu.wait([head], num_returns=1)
-            yield head
+    try:
+        pending: List[ObjectRef] = []  # ordered
+        source_iter = iter(source)
+        exhausted = False
+        while pending or not exhausted:
+            while not exhausted and len(pending) < max_in_flight:
+                try:
+                    in_ref = next(source_iter)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(chain(in_ref))
+            if pending:
+                # Ordered streaming: wait on the head (completion order
+                # within the window doesn't matter for memory; order does
+                # for output).
+                head = pending.pop(0)
+                raytpu.wait([head], num_returns=1)
+                yield head
+    finally:
+        for pool in pools:
+            pool.stop()
 
 
 def materialize_refs(refs: Iterator[ObjectRef]) -> List[ObjectRef]:

@@ -186,3 +186,152 @@ class TestTrainIntegration:
         ).fit()
         assert result.error is None
         assert result.metrics["rows_seen"] > 0
+
+
+class TestDistributedExchange:
+    def test_repartition_spreads_rows(self, raytpu_local):
+        import numpy as np
+
+        from raytpu import data as rdata
+
+        ds = rdata.range(1000, blocks=3).repartition(5)
+        blocks = list(ds.iter_blocks())
+        assert len(blocks) == 5
+        sizes = [len(b["id"]) for b in blocks]
+        assert sum(sizes) == 1000
+        assert max(sizes) - min(sizes) <= len(sizes), sizes  # near-equal
+        seen = np.sort(np.concatenate([b["id"] for b in blocks]))
+        np.testing.assert_array_equal(seen, np.arange(1000))
+
+    def test_random_shuffle_permutes_all_rows(self, raytpu_local):
+        import numpy as np
+
+        from raytpu import data as rdata
+
+        ds = rdata.range(2000, blocks=4).random_shuffle(seed=7)
+        out = np.concatenate([b["id"] for b in ds.iter_blocks()])
+        assert len(out) == 2000
+        np.testing.assert_array_equal(np.sort(out), np.arange(2000))
+        assert not np.array_equal(out, np.arange(2000)), "not shuffled"
+
+    def test_sample_sort_globally_ordered(self, raytpu_local):
+        import numpy as np
+
+        from raytpu import data as rdata
+
+        rng = np.random.default_rng(3)
+        vals = rng.permutation(3000).astype(np.int64)
+        ds = rdata.from_numpy({"v": vals}, blocks=6).sort("v")
+        blocks = [np.asarray(b["v"]) for b in ds.iter_blocks()]
+        flat = np.concatenate(blocks)
+        np.testing.assert_array_equal(flat, np.sort(vals))
+        # Global ordering across block boundaries, not just within.
+        maxes = [b.max() for b in blocks if b.size]
+        mins = [b.min() for b in blocks if b.size]
+        for i in range(len(maxes) - 1):
+            assert maxes[i] <= mins[i + 1]
+
+        desc = rdata.from_numpy({"v": vals}, blocks=6).sort(
+            "v", descending=True)
+        flat_d = np.concatenate([np.asarray(b["v"])
+                                 for b in desc.iter_blocks()])
+        np.testing.assert_array_equal(flat_d, np.sort(vals)[::-1])
+
+
+class TestOperatorFusion:
+    def test_adjacent_map_stages_fuse(self, raytpu_local):
+        from raytpu.data.executor import OpSpec, fuse_ops
+
+        ops = [OpSpec("a", lambda b: b), OpSpec("b", lambda b: b),
+               OpSpec("c", lambda b: b)]
+        fused = fuse_ops(ops)
+        assert len(fused) == 1
+        assert fused[0].name == "a->b->c"
+
+    def test_actor_pool_stage_is_fusion_barrier(self, raytpu_local):
+        from raytpu.data.executor import ActorPoolStrategy, OpSpec, fuse_ops
+
+        ops = [OpSpec("a", lambda b: b), OpSpec("b", lambda b: b),
+               OpSpec("pool", lambda b: b, compute=ActorPoolStrategy(1)),
+               OpSpec("c", lambda b: b)]
+        fused = fuse_ops(ops)
+        assert [o.name for o in fused] == ["a->b", "pool", "c"]
+
+    def test_fused_pipeline_correct(self, raytpu_local):
+        from raytpu import data as rdata
+
+        ds = (rdata.range(100, blocks=4)
+              .map_batches(lambda b: {"id": b["id"] * 2})
+              .map_batches(lambda b: {"id": b["id"] + 1}))
+        total = sum(int(b["id"].sum()) for b in ds.iter_batches(
+            batch_size=25))
+        assert total == sum(2 * i + 1 for i in range(100))
+
+
+class TestActorPoolOperator:
+    def test_stateful_class_udf_amortizes_setup(self, raytpu_local):
+        import numpy as np
+
+        from raytpu import data as rdata
+
+        class ExpensiveModel:
+            def __init__(self):
+                # "Load the model" once per actor.
+                self.offset = 1000
+                self.calls = 0
+
+            def __call__(self, batch):
+                self.calls += 1
+                return {"id": batch["id"] + self.offset,
+                        "calls": np.full(len(batch["id"]), self.calls)}
+
+        ds = rdata.range(80, blocks=8).map_batches(
+            ExpensiveModel, compute=rdata.ActorPoolStrategy(size=2))
+        blocks = list(ds.iter_blocks())
+        assert len(blocks) == 8
+        ids = np.sort(np.concatenate([b["id"] for b in blocks]))
+        np.testing.assert_array_equal(ids, np.arange(80) + 1000)
+        # Two actors x 4 blocks each: per-actor call counters reach 4 —
+        # proving instances persisted across blocks (setup amortized).
+        max_calls = max(int(b["calls"].max()) for b in blocks)
+        assert max_calls == 4, max_calls
+
+    def test_class_udf_without_pool_rejected(self, raytpu_local):
+        from raytpu import data as rdata
+
+        class Udf:
+            def __call__(self, b):
+                return b
+
+        with pytest.raises(ValueError, match="ActorPoolStrategy"):
+            rdata.range(10).map_batches(Udf)
+
+
+class TestExchangeOnCluster:
+    def test_shuffle_and_sort_across_nodes(self):
+        """The exchange runs as distributed tasks on cluster nodes (map +
+        reduce both remote); the driver touches refs only."""
+        import numpy as np
+
+        import raytpu
+        from raytpu import data as rdata
+        from raytpu.cluster import Cluster
+
+        c = Cluster(num_nodes=2, node_resources={"num_cpus": 2})
+        c.wait_for_nodes(2)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{c.address}")
+        try:
+            ds = rdata.range(4000, blocks=4).random_shuffle(seed=1)
+            out = np.concatenate([np.asarray(b["id"])
+                                  for b in ds.iter_blocks()])
+            np.testing.assert_array_equal(np.sort(out), np.arange(4000))
+
+            srt = rdata.range(1000, blocks=4).random_shuffle(
+                seed=2).sort("id")
+            flat = np.concatenate([np.asarray(b["id"])
+                                   for b in srt.iter_blocks()])
+            np.testing.assert_array_equal(flat, np.arange(1000))
+        finally:
+            raytpu.shutdown()
+            c.shutdown()
