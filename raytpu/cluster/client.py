@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from raytpu.cluster import wire
+
 from raytpu.cluster.node import NodeServer
 from raytpu.cluster.protocol import ConnectionLost, RpcClient
 from raytpu.core.errors import (
@@ -305,7 +307,7 @@ class ClusterBackend:
             self._inflight[spec.task_id] = _InFlight(
                 spec, node_id, attempts=spec.attempt)
         try:
-            self._peer(addr).call(method, cloudpickle.dumps(spec))
+            self._peer(addr).call(method, wire.dumps(spec))
         except Exception:
             with self._lock:
                 self._inflight.pop(spec.task_id, None)
@@ -385,7 +387,7 @@ class ClusterBackend:
             self._ship_runtime_env(spec, addr)
         except Exception:
             pass
-        self._peer(addr).call("create_actor", cloudpickle.dumps(spec))
+        self._peer(addr).call("create_actor", wire.dumps(spec))
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
@@ -433,7 +435,7 @@ class ClusterBackend:
             self._inflight[spec.task_id] = _InFlight(spec, node_id)
         try:
             self._peer(addr).call("submit_actor_task",
-                                  cloudpickle.dumps(spec))
+                                  wire.dumps(spec))
         except Exception as e:
             self._fail_refs(spec, ActorDiedError(spec.actor_id.hex(), str(e)))
         return refs
@@ -446,7 +448,7 @@ class ClusterBackend:
             "kv_get", f"__actor_spec__::{info['actor_id']}")
         if blob is None:
             raise ValueError(f"actor {name!r} spec not found")
-        spec: TaskSpec = cloudpickle.loads(blob)
+        spec: TaskSpec = wire.loads(blob)
         actor_id = ActorID.from_hex(info["actor_id"])
         # Mid-restart lookups have no node yet; submission resolves the
         # new incarnation's location via resolve_actor.

@@ -23,6 +23,8 @@ from typing import Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from raytpu.cluster import wire
+
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
 from raytpu.core.config import cfg
 from raytpu.core.errors import ActorDiedError, TaskError, WorkerCrashedError
@@ -104,7 +106,7 @@ class _ProcActorRuntime:
         self.handle.on_death = self._on_worker_death
         try:
             reply = self.handle.client.call(
-                "create_actor", cloudpickle.dumps(spec), timeout=None)
+                "create_actor", wire.dumps(spec), timeout=None)
         except Exception as e:
             b.worker_pool.kill(self.handle, "actor creation RPC failed")
             self._creation_failed(WorkerCrashedError(
@@ -136,7 +138,7 @@ class _ProcActorRuntime:
             self.backend._task_worker[spec.task_id] = self.handle
         try:
             reply = self.handle.client.call(
-                "actor_task", cloudpickle.dumps(spec), timeout=None)
+                "actor_task", wire.dumps(spec), timeout=None)
         except Exception as e:
             self.backend._fail_spec(spec, ActorDiedError(
                 self.actor_id.hex(), f"worker crashed: {e}"))
@@ -322,7 +324,7 @@ class NodeBackend(LocalBackend):
             self._task_worker[spec.task_id] = handle
         try:
             reply = handle.client.call(
-                "execute", cloudpickle.dumps(spec), timeout=None)
+                "execute", wire.dumps(spec), timeout=None)
         except Exception as e:
             # A deliberate kill (e.g. memory-pressure shedding) carries its
             # reason on the handle; surface it instead of the raw RPC error.
@@ -803,12 +805,12 @@ class NodeServer:
     # -- RPC handlers ------------------------------------------------------
 
     def _h_submit_task(self, peer: Peer, spec_blob: bytes) -> None:
-        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        spec: TaskSpec = wire.loads(spec_blob)
         self._ensure_args_local(spec)
         self.backend.submit_task(spec)
 
     def _h_create_actor(self, peer: Peer, spec_blob: bytes) -> None:
-        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        spec: TaskSpec = wire.loads(spec_blob)
         ac = spec.actor_creation
         # Directory + spec blob first so named lookup works immediately;
         # max_restarts + resources feed the head's restart state machine.
@@ -823,7 +825,7 @@ class NodeServer:
         self.backend.create_actor(spec)
 
     def _h_submit_actor_task(self, peer: Peer, spec_blob: bytes) -> None:
-        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        spec: TaskSpec = wire.loads(spec_blob)
         with self.backend._lock:
             local = spec.actor_id in self.backend._actors
         if not local:
@@ -1053,7 +1055,7 @@ class NodeServer:
         try:
             actor_id, spec = self.backend.get_actor_handle_info(
                 name, namespace)
-            return actor_id.hex(), cloudpickle.dumps(spec)
+            return actor_id.hex(), wire.dumps(spec)
         except Exception:
             pass
         try:

@@ -1,12 +1,14 @@
-"""Cluster wire protocol: length-prefixed pickled frames over TCP.
+"""Cluster wire protocol: length-prefixed versioned frames over TCP.
 
 Reference analogue: Ray's control plane is gRPC services (``src/ray/rpc/``,
 protos in ``src/ray/protobuf/``). Ours is a deliberately small asyncio
-protocol — 4-byte little-endian length + cloudpickle frame — because the
-control plane carries tiny messages (specs, directory entries); the data
-plane (tensors) never rides it on TPU: device arrays move via ICI inside
-compiled programs, and host objects move through the object-transfer
-endpoint which streams raw buffers after one header frame.
+protocol — 4-byte little-endian length + a versioned frame encoded by
+:mod:`raytpu.cluster.wire` (schema'd msgpack; see that module for the
+protobuf-equivalence story) — because the control plane carries tiny
+messages (specs, directory entries); the data plane (tensors) never rides
+it on TPU: device arrays move via ICI inside compiled programs, and host
+objects move through the object-transfer endpoint which streams raw
+buffers after one header frame.
 
 Server: :class:`RpcServer` dispatches ``{"m": method, "a": args, "i": id}``
 frames to registered handlers (sync or async) on an asyncio loop running in
@@ -25,7 +27,7 @@ import struct
 import threading
 from typing import Any, Callable, Dict, Optional
 
-import cloudpickle
+from raytpu.cluster import wire
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
@@ -40,7 +42,7 @@ class ConnectionLost(RpcError):
 
 
 def _pack(obj: Any) -> bytes:
-    payload = cloudpickle.dumps(obj)
+    payload = wire.dumps(obj)
     return _LEN.pack(len(payload)) + payload
 
 
@@ -49,7 +51,7 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
     (n,) = _LEN.unpack(hdr)
     if n > MAX_FRAME:
         raise RpcError(f"frame too large: {n}")
-    return cloudpickle.loads(await reader.readexactly(n))
+    return wire.loads(await reader.readexactly(n))
 
 
 class Peer:
@@ -254,7 +256,7 @@ class RpcClient:
                     if not chunk:
                         raise ConnectionError("peer closed")
                     buf += chunk
-                frame = cloudpickle.loads(buf[:n])
+                frame = wire.loads(buf[:n])
                 buf = buf[n:]
                 self._on_frame(frame)
         except Exception as e:

@@ -31,6 +31,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from raytpu.cluster import wire
+
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.core.errors import ActorDiedError, TaskError
 from raytpu.core.ids import JobID, NodeID, ObjectID, TaskID
@@ -60,16 +62,16 @@ class WorkerBackend:
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
                 for oid in spec.return_ids()]
-        self._host.node.call("submit_task", cloudpickle.dumps(spec))
+        self._host.node.call("submit_task", wire.dumps(spec))
         return refs
 
     def create_actor(self, spec: TaskSpec) -> None:
-        self._host.node.call("create_actor", cloudpickle.dumps(spec))
+        self._host.node.call("create_actor", wire.dumps(spec))
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = [ObjectRef(oid, owner=self.worker.worker_id.binary())
                 for oid in spec.return_ids()]
-        self._host.node.call("submit_actor_task", cloudpickle.dumps(spec))
+        self._host.node.call("submit_actor_task", wire.dumps(spec))
         return refs
 
     def kill_actor(self, actor_id, no_restart: bool = True) -> None:
@@ -82,7 +84,7 @@ class WorkerBackend:
         actor_id_hex, spec_blob = info
         from raytpu.core.ids import ActorID
 
-        return ActorID.from_hex(actor_id_hex), cloudpickle.loads(spec_blob)
+        return ActorID.from_hex(actor_id_hex), wire.loads(spec_blob)
 
     def cancel_task(self, task_id: TaskID) -> None:
         self._host.node.call("cancel_task", task_id.binary())
@@ -366,13 +368,13 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
             None, fn, *a)
 
     def h_execute(peer: Peer, blob: bytes):
-        return _offload(host.execute_plain, cloudpickle.loads(blob))
+        return _offload(host.execute_plain, wire.loads(blob))
 
     def h_create_actor(peer: Peer, blob: bytes):
-        return _offload(host.create_actor, cloudpickle.loads(blob))
+        return _offload(host.create_actor, wire.loads(blob))
 
     def h_actor_task(peer: Peer, blob: bytes):
-        spec = cloudpickle.loads(blob)
+        spec = wire.loads(blob)
         if host._actor_loop is not None:
             return host.actor_task_via_loop(spec)
         return _offload(host.execute_actor_task, spec)
