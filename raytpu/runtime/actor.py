@@ -25,18 +25,22 @@ from raytpu.runtime.remote_function import (
 from raytpu.runtime.task_spec import ActorCreationSpec, TaskSpec
 
 
-def method_meta_from_class(cls: type) -> Dict[str, int]:
+def method_meta_from_class(cls: type) -> Dict[str, Dict[str, Any]]:
     """Public-method table shared by ActorClass.remote and get_actor (one
     source of truth for which names a handle exposes)."""
     meta = {}
     for name, member in inspect.getmembers(cls):
         if name.startswith("__") or not callable(member):
             continue
-        meta[name] = getattr(member, "_num_returns", 1)
+        meta[name] = {
+            "num_returns": getattr(member, "_num_returns", 1),
+            "concurrency_group": getattr(member, "_concurrency_group", ""),
+        }
     return meta
 
 
-_METHOD_OPTIONS = {"num_returns", "generator_backpressure_num_objects"}
+_METHOD_OPTIONS = {"num_returns", "generator_backpressure_num_objects",
+                   "concurrency_group"}
 
 
 class ActorMethod:
@@ -62,6 +66,7 @@ class ActorMethod:
             num_returns=self._opts.get("num_returns", self._num_returns),
             backpressure=int(self._opts.get(
                 "generator_backpressure_num_objects", 0) or 0),
+            concurrency_group=self._opts.get("concurrency_group", ""),
         )
 
     def bind(self, *args, **kwargs):
@@ -76,7 +81,8 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: ActorID, method_meta: Dict[str, int],
+    def __init__(self, actor_id: ActorID,
+                 method_meta: Dict[str, Dict[str, Any]],
                  *, _register: bool = True):
         self._actor_id = actor_id
         self._method_meta = method_meta
@@ -101,10 +107,14 @@ class ActorHandle:
                 f"actor has no method {name!r}; methods: "
                 f"{sorted(self._method_meta)}"
             )
-        return ActorMethod(self, name, self._method_meta[name])
+        m = self._method_meta[name]
+        if isinstance(m, int):  # handles serialized before concurrency groups
+            m = {"num_returns": m, "concurrency_group": ""}
+        return ActorMethod(self, name, m["num_returns"],
+                           {"concurrency_group": m["concurrency_group"]})
 
     def _invoke(self, method_name: str, args, kwargs, num_returns=1,
-                backpressure: int = 0):
+                backpressure: int = 0, concurrency_group: str = ""):
         from raytpu.runtime import api
         from raytpu.runtime.remote_function import streaming_opts
 
@@ -125,6 +135,7 @@ class ActorHandle:
             streaming=streaming,
             backpressure=backpressure,
             owner_address=worker.worker_id.binary(),
+            concurrency_group=concurrency_group,
         )
         refs = backend.submit_actor_task(spec)
         del keepalive
@@ -154,7 +165,8 @@ class ActorHandle:
         return f"ActorHandle({self._actor_id.hex()[:16]})"
 
 
-def _rebuild_handle(actor_id: ActorID, method_meta: Dict[str, int]) -> ActorHandle:
+def _rebuild_handle(actor_id: ActorID,
+                    method_meta: Dict[str, Dict[str, Any]]) -> ActorHandle:
     return ActorHandle(actor_id, method_meta)
 
 
@@ -183,7 +195,7 @@ class ActorClass:
         ac._pickled = self._pickled
         return ac
 
-    def _method_meta(self) -> Dict[str, int]:
+    def _method_meta(self) -> Dict[str, Dict[str, Any]]:
         return method_meta_from_class(self._cls)
 
     def _is_async(self) -> bool:
@@ -202,6 +214,14 @@ class ActorClass:
             worker, args, kwargs)
         lifetime = opts.get("lifetime")
         max_conc = opts.get("max_concurrency") or (1000 if self._is_async() else 1)
+        groups = dict(opts.get("concurrency_groups") or {})
+        for mname, m in self._method_meta().items():
+            g = m["concurrency_group"]
+            if g and g not in groups:
+                raise ValueError(
+                    f"method {mname!r} declares concurrency_group={g!r} but "
+                    f"the class defines groups {sorted(groups) or '{}'}; pass "
+                    f"concurrency_groups={{...}} to @raytpu.remote")
         spec = TaskSpec(
             task_id=TaskID.for_actor_creation(actor_id),
             job_id=worker.job_id,
@@ -223,6 +243,7 @@ class ActorClass:
                 namespace=opts.get("namespace", "default"),
                 lifetime_detached=(lifetime == "detached"),
                 is_async=self._is_async(),
+                concurrency_groups=groups,
             ),
             owner_address=worker.worker_id.binary(),
         )
@@ -236,12 +257,14 @@ class ActorClass:
         return ClassNode(self, args, kwargs)
 
 
-def method(*, num_returns: int = 1):
+def method(*, num_returns: int = 1, concurrency_group: str = ""):
     """Decorator to override per-method defaults (reference:
-    ``@ray.method(num_returns=...)``)."""
+    ``@ray.method(num_returns=...)``, ``concurrency_group=`` routing per
+    ``src/ray/core_worker/transport/concurrency_group_manager.cc``)."""
 
     def wrap(fn):
         fn._num_returns = num_returns
+        fn._concurrency_group = concurrency_group
         return fn
 
     return wrap

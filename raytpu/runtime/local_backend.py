@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from raytpu.core.config import cfg
 from raytpu.core.errors import (
     ActorDiedError,
+    ActorError,
     PlacementGroupError,
     RayTpuError,
     TaskCancelledError,
@@ -90,6 +91,8 @@ class _ActorRuntime:
         self.creation_spec = spec
         self.actor_id = spec.actor_creation.actor_id
         self.max_concurrency = spec.actor_creation.max_concurrency
+        self.concurrency_groups = dict(
+            spec.actor_creation.concurrency_groups or {})
         self.is_async = spec.actor_creation.is_async
         self.name = spec.actor_creation.name
         self.namespace = spec.actor_creation.namespace
@@ -112,6 +115,16 @@ class _ActorRuntime:
         self.thread.start()
 
     def submit(self, spec: TaskSpec):
+        if spec.concurrency_group and \
+                spec.concurrency_group not in self.concurrency_groups:
+            # Covers .options(concurrency_group=...) overrides that bypass
+            # class-level validation — silently landing in the default pool
+            # would drop the isolation the caller asked for.
+            self.backend._fail_spec(spec, ActorError(
+                f"actor {self.actor_id.hex()[:8]} has no concurrency group "
+                f"{spec.concurrency_group!r}; declared: "
+                f"{sorted(self.concurrency_groups) or '{}'}"))
+            return
         with self.state_lock:
             if not self.dead:
                 self.queue.put(spec)
@@ -154,7 +167,7 @@ class _ActorRuntime:
 
         if self.is_async:
             self._run_async_loop()
-        elif self.max_concurrency > 1:
+        elif self.max_concurrency > 1 or self.concurrency_groups:
             self._run_threaded()
         else:
             self._run_sync()
@@ -170,25 +183,34 @@ class _ActorRuntime:
     def _run_threaded(self):
         from concurrent.futures import ThreadPoolExecutor
 
-        pool = ThreadPoolExecutor(max_workers=self.max_concurrency)
+        # One executor per concurrency group + the default pool: a saturated
+        # group queues behind itself, never behind another group (reference:
+        # ``transport/concurrency_group_manager.cc`` per-group executors).
+        pools = {"": ThreadPoolExecutor(max_workers=self.max_concurrency)}
+        for group, limit in self.concurrency_groups.items():
+            pools[group] = ThreadPoolExecutor(max_workers=max(1, int(limit)))
         while True:
             item = self.queue.get()
             if isinstance(item, tuple) and item[0] == "__kill__":
-                pool.shutdown(wait=False)
+                for pool in pools.values():
+                    pool.shutdown(wait=False)
                 self._die(item[1])
                 return
+            pool = pools.get(item.concurrency_group, pools[""])
             pool.submit(self._execute, item)
 
     def _run_async_loop(self):
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
-        sem = asyncio.Semaphore(self.max_concurrency)
+        sems = {"": asyncio.Semaphore(self.max_concurrency)}
+        for group, limit in self.concurrency_groups.items():
+            sems[group] = asyncio.Semaphore(max(1, int(limit)))
         stop = loop.create_future()
         inflight: dict = {}
 
         async def handle(spec: TaskSpec):
             try:
-                async with sem:
+                async with sems.get(spec.concurrency_group, sems[""]):
                     await self._execute_async(spec)
             finally:
                 inflight.pop(spec.task_id, None)

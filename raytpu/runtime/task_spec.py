@@ -54,6 +54,11 @@ class ActorCreationSpec:
     namespace: str = "default"
     lifetime_detached: bool = False
     is_async: bool = False
+    # Named concurrency groups: group -> max concurrent methods (reference:
+    # ``src/ray/core_worker/transport/concurrency_group_manager.cc``). Methods
+    # outside any group share the default ``max_concurrency`` budget; each
+    # group gets its own executor so a saturated group never starves others.
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -90,6 +95,8 @@ class TaskSpec:
     owner_address: bytes = b""
     # Bookkeeping
     attempt: int = 0
+    # Concurrency group this actor method executes in ("" = default).
+    concurrency_group: str = ""
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i)

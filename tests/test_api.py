@@ -362,6 +362,98 @@ class TestActors:
         raytpu.get([s.nap.remote(0.3) for _ in range(4)])
         assert time.monotonic() - t0 < 1.0
 
+    def test_concurrency_groups_isolated(self, raytpu_local):
+        """Groups get their own executors: an `io`-group pair overlaps with
+        itself and with the default group even at max_concurrency=1
+        (reference: concurrency_group_manager.cc)."""
+        raytpu = raytpu_local
+
+        @raytpu.remote(concurrency_groups={"io": 2})
+        class Worker:
+            @raytpu.method(concurrency_group="io")
+            def io(self, t):
+                time.sleep(t)
+                return "io"
+
+            def compute(self, t):
+                time.sleep(t)
+                return "c"
+
+        w = Worker.remote()
+        t0 = time.monotonic()
+        out = raytpu.get([w.io.remote(0.3), w.io.remote(0.3),
+                          w.compute.remote(0.3)])
+        assert out == ["io", "io", "c"]
+        assert time.monotonic() - t0 < 0.9
+
+    def test_concurrency_group_limit_enforced(self, raytpu_local):
+        raytpu = raytpu_local
+
+        @raytpu.remote(concurrency_groups={"one": 1})
+        class Worker:
+            @raytpu.method(concurrency_group="one")
+            def slow(self, t):
+                time.sleep(t)
+                return t
+
+        w = Worker.remote()
+        t0 = time.monotonic()
+        raytpu.get([w.slow.remote(0.25), w.slow.remote(0.25)])
+        # Limit 1 serializes the group.
+        assert time.monotonic() - t0 >= 0.45
+
+    def test_undefined_concurrency_group_rejected(self, raytpu_local):
+        raytpu = raytpu_local
+
+        @raytpu.remote
+        class Worker:
+            @raytpu.method(concurrency_group="nope")
+            def f(self):
+                return 1
+
+        with pytest.raises(ValueError, match="nope"):
+            Worker.remote()
+
+    def test_options_override_unknown_group_fails_call(self, raytpu_local):
+        """Per-call .options(concurrency_group=...) bypasses class-level
+        validation; the runtime must reject rather than silently routing
+        to the default pool."""
+        raytpu = raytpu_local
+
+        @raytpu.remote(concurrency_groups={"io": 1})
+        class Worker:
+            def f(self):
+                return 1
+
+        w = Worker.remote()
+        ok = w.f.options(concurrency_group="io").remote()
+        assert raytpu.get(ok) == 1
+        bad = w.f.options(concurrency_group="typo").remote()
+        with pytest.raises(raytpu.ActorError, match="typo"):
+            raytpu.get(bad)
+
+    def test_async_actor_concurrency_groups(self, raytpu_local):
+        import asyncio
+
+        raytpu = raytpu_local
+
+        @raytpu.remote(concurrency_groups={"solo": 1})
+        class AsyncWorker:
+            @raytpu.method(concurrency_group="solo")
+            async def slow(self, t):
+                await asyncio.sleep(t)
+                return t
+
+            async def fast(self):
+                return "f"
+
+        a = AsyncWorker.remote()
+        t0 = time.monotonic()
+        refs = [a.slow.remote(0.25), a.slow.remote(0.25), a.fast.remote()]
+        assert raytpu.get(refs) == [0.25, 0.25, "f"]
+        # solo group serializes; the default group is untouched.
+        assert time.monotonic() - t0 >= 0.45
+
 
 class TestPlacementGroups:
     def test_basic_pg(self, raytpu_local):
