@@ -60,7 +60,7 @@ def main() -> None:
         steps = int(os.environ.get("RAYTPU_BENCH_STEPS", 3))
         min_wall = 0.5
         # Same multi-candidate autotune flow as the real bench, tiny model.
-        candidates = [(batch, base.remat), (batch * 2, False)]
+        candidates = [(batch, base.remat, 0), (batch * 2, False, 64)]
         attn_impls = ["reference"]
     else:
         seq = int(os.environ.get("RAYTPU_BENCH_SEQ", 1024))
@@ -70,21 +70,26 @@ def main() -> None:
         steps = int(os.environ.get("RAYTPU_BENCH_STEPS", 10))
         min_wall = 1.5
         if env_batch is not None:
-            candidates = [(int(env_batch), base.remat)]
+            candidates = [(int(env_batch), base.remat, 0)]
         else:
-            # Runtime autotune (bounded): remat trades ~1/3 extra FLOPs
-            # for memory the 124M model doesn't need at these batches;
-            # larger batches amortize per-step overhead until HBM runs
-            # out (the fp32 logits dominate: ~200MB/batch-row at 50k
-            # vocab). Each candidate costs one compile (~20-40s).
-            candidates = [(16, False), (32, False), (8, True)]
+            # Runtime autotune (bounded): candidates are (batch, remat,
+            # loss_chunk). Full no-remat OOMs at batch>=16 (lax.scan
+            # stacks all 12 layers' activations: 16.9G vs 15.75G HBM,
+            # r3 sweep), so the interesting region is the "dots" policy —
+            # save matmul outputs, recompute elementwise (~few % FLOPs) —
+            # with the chunked LM head killing the fp32 [B,T,V] logits
+            # buffer at the bigger batches. (8, full, 0) is the known-fit
+            # r2 fallback. Each candidate costs one compile (~20-40s).
+            candidates = [(16, "dots", 0), (32, "dots", 8192),
+                          (16, "dots", 8192), (8, True, 0)]
         attn_impls = (["tpu", "reference"] if on_accel
                       else ["reference"])
         if on_accel and _probe_pallas(jnp) != "tpu":
             attn_impls = ["reference"]
 
-    def measure(batch, remat, attn_impl, steps):
-        cfg = dataclasses.replace(base, remat=remat, attn_impl=attn_impl)
+    def measure(batch, remat, chunk, attn_impl, steps):
+        cfg = dataclasses.replace(base, remat=remat, attn_impl=attn_impl,
+                                  loss_chunk=chunk)
         model = GPT2(cfg)
         params = init_params(model, cfg, batch=batch)
         opt = optax.adamw(3e-4, weight_decay=0.1)
@@ -113,38 +118,37 @@ def main() -> None:
                 break
             steps *= 2
         toks = batch * cfg.block_size * steps / dt
-        return {"batch": batch, "remat": remat, "attn": attn_impl,
+        return {"batch": batch, "remat": remat, "chunk": chunk,
+                "attn": attn_impl,
                 "tokens_per_sec": round(toks, 1), "steps": steps,
                 "wall_s": round(dt, 3), "loss": float(loss_host)}
 
     # Attention A/B at the first candidate shape (recorded either way),
     # then batch/remat sweep with the winner.
     sweep = []
-    b0, r0 = candidates[0]
-    ab = {}
-    for impl in attn_impls:
-        try:
-            res = measure(b0, r0, impl, steps)
-        except Exception as e:  # noqa: BLE001 — e.g. OOM
-            res = {"batch": b0, "remat": r0, "attn": impl,
-                   "error": f"{type(e).__name__}: {e}"}
-        ab[impl] = res
-        sweep.append(res)
-    ok_ab = [r for r in ab.values() if "error" not in r]
-    if not ok_ab:
+    best_attn = None
+    ab_done = False
+    for ci, (b0, r0, c0) in enumerate(candidates):
+        # Attention A/B at the first candidate that fits (recorded either
+        # way); remaining candidates swept with the winning impl.
+        impls = attn_impls if not ab_done else [best_attn]
+        ok = []
+        for impl in impls:
+            try:
+                res = measure(b0, r0, c0, impl, steps)
+                ok.append(res)
+            except Exception as e:  # noqa: BLE001 — e.g. OOM
+                res = {"batch": b0, "remat": r0, "chunk": c0, "attn": impl,
+                       "error": f"{type(e).__name__}: {e}"}
+            sweep.append(res)
+        if ok and not ab_done:
+            ab_done = True
+            best_attn = max(ok, key=lambda r: r["tokens_per_sec"])["attn"]
+    if not ab_done:
         print(json.dumps({"metric": "gpt2_train_tokens_per_sec_per_chip",
-                          "error": "all attention impls failed",
+                          "error": "all autotune candidates failed",
                           "value": None, "detail": {"sweep": sweep}}))
         sys.exit(1)
-    best_attn = max(ok_ab, key=lambda r: r["tokens_per_sec"])["attn"]
-
-    for batch, remat in candidates[1:]:
-        try:
-            sweep.append(measure(batch, remat, best_attn, steps))
-        except Exception as e:  # noqa: BLE001
-            sweep.append({"batch": batch, "remat": remat,
-                          "attn": best_attn,
-                          "error": f"{type(e).__name__}: {e}"})
 
     best = max((r for r in sweep if "error" not in r),
                key=lambda r: r["tokens_per_sec"])
@@ -155,7 +159,8 @@ def main() -> None:
     steps = best["steps"]
     dt = best["wall_s"]
     cfg = dataclasses.replace(base, remat=best["remat"],
-                              attn_impl=attn_impl)
+                              attn_impl=attn_impl,
+                              loss_chunk=best["chunk"])
 
     n_params = cfg.n_params_approx
     flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * \

@@ -30,9 +30,20 @@ class GPT2Config:
     n_embd: int = 768
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
-    remat: bool = True
+    # Rematerialization policy per block (memory <-> recompute-FLOPs knob):
+    #   False/"none": save all activations (fastest when HBM allows)
+    #   True/"full":  save nothing, recompute the whole block (~+1/3 FLOPs)
+    #   "dots":       save matmul outputs only, recompute elementwise/norm/
+    #                 attention-score work (few % extra FLOPs; the v5e sweet
+    #                 spot — batch 16 no-remat OOMs 16.9G/15.75G HBM because
+    #                 lax.scan stacks every layer's activations)
+    remat: Any = True
     scan_layers: bool = True
     attn_impl: Optional[str] = None  # None=auto, "reference", "interpret", "tpu"
+    # Cross-entropy chunking: 0 = one [B,T,V] fp32 logits buffer (1.6 GB at
+    # batch 8 / 50k vocab); N>0 = flash-xent style, logits computed N rows at
+    # a time and recomputed in backward, so peak HBM holds one chunk.
+    loss_chunk: int = 0
 
     @classmethod
     def small(cls) -> "GPT2Config":  # 124M
@@ -105,7 +116,8 @@ class GPT2(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         c = self.config
         b, t = tokens.shape
         pos = jnp.arange(t)[None]
@@ -114,8 +126,11 @@ class GPT2(nn.Module):
                          name="wpe")(pos)
 
         block = Block
-        if c.remat:
-            block = nn.remat(Block, prevent_cse=False)
+        if c.remat and c.remat != "none":
+            policy = None  # save nothing
+            if c.remat == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block = nn.remat(Block, prevent_cse=False, policy=policy)
         if c.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, deterministic), None),
@@ -129,6 +144,8 @@ class GPT2(nn.Module):
                 x = block(c, name=f"h_{i}")(x, deterministic)
 
         x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
         # Weight-tied LM head. The matmul runs in the model compute dtype
         # (bf16 → MXU speed; ~27% of total model FLOPs live here) with fp32
         # accumulation, so the softmax downstream still sees fp32 logits.
@@ -144,15 +161,58 @@ def gpt2_loss_fn(model: GPT2, params, tokens):
 
     logsumexp form — never materializes the full [B, T, V] log-softmax
     (1.6 GB fp32 at the bench shape), only the logits the head already
-    produced plus two [B, T] reductions.
+    produced plus two [B, T] reductions. With ``config.loss_chunk > 0`` even
+    the logits are never fully materialized: the weight-tied head runs
+    chunk-by-chunk under `jax.checkpoint` (flash-xent), trading one extra
+    head matmul in backward (~9% model FLOPs) for the whole logits buffer.
     """
-    logits = model.apply({"params": params}, tokens)
+    c = model.config
     targets = tokens[:, 1:]
+    if c.loss_chunk:
+        x = model.apply({"params": params}, tokens, return_hidden=True)
+        return _chunked_xent(x[:, :-1], targets,
+                             params["wte"]["embedding"], c)
+    logits = model.apply({"params": params}, tokens)
     logits = logits[:, :-1]
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     label_logits = jnp.take_along_axis(
         logits, targets[..., None], axis=-1)[..., 0]
     return (lse - label_logits).mean()
+
+
+def _chunked_xent(x, targets, wte, c: GPT2Config):
+    """Mean next-token NLL with the LM head computed ``loss_chunk`` rows at
+    a time; `jax.checkpoint` makes backward recompute each chunk's logits so
+    peak HBM holds one [chunk, V] fp32 buffer instead of [B, T, V]."""
+    b, t, e = x.shape
+    n = b * t
+    chunk = min(c.loss_chunk, n)
+    xf = x.reshape(n, e)
+    tf = targets.reshape(n)
+    pad = (-n) % chunk
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        tf = jnp.pad(tf, (0, pad))
+    mask = (jnp.arange(n + pad) < n).astype(jnp.float32)
+    xs = xf.reshape(-1, chunk, e)
+    ts = tf.reshape(-1, chunk)
+    ms = mask.reshape(-1, chunk)
+    w = wte.astype(c.dtype)
+
+    @jax.checkpoint
+    def chunk_nll(xc, tc, mc):
+        logits = jax.lax.dot_general(
+            xc, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        label = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return ((lse - label) * mc).sum()
+
+    def body(acc, xtm):
+        return acc + chunk_nll(*xtm), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts, ms))
+    return total / n
 
 
 def make_train_step(model: GPT2, optimizer):
