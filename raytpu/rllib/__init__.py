@@ -8,24 +8,47 @@ XLA programs; multi-learner sync is an in-program ``pmean`` over a
 """
 
 from raytpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from raytpu.rllib.algorithms.appo import APPO, APPOConfig
 from raytpu.rllib.algorithms.dqn import DQN, DQNConfig
 from raytpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from raytpu.rllib.algorithms.ppo import PPO, PPOConfig
+from raytpu.rllib.algorithms.sac import SAC, SACConfig
+from raytpu.rllib.connectors import (
+    ClipActions,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    FrameStack,
+    ObsScaler,
+)
 from raytpu.rllib.core.learner import Learner, compute_gae, vtrace
 from raytpu.rllib.core.rl_module import (
+    ConvPolicyModule,
     DiscretePolicyModule,
+    GaussianPolicyModule,
     QModule,
     RLModule,
     RLModuleSpec,
+    SACModule,
 )
 from raytpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
-from raytpu.rllib.env.envs import CartPoleEnv, make_env, register_env
+from raytpu.rllib.env.envs import (
+    CartPoleEnv,
+    CatchEnv,
+    PendulumEnv,
+    make_env,
+    register_env,
+)
 from raytpu.rllib.utils.replay_buffer import ReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "DQN", "DQNConfig", "Learner", "compute_gae", "vtrace",
+    "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "SAC",
+    "SACConfig", "Learner", "compute_gae", "vtrace",
     "RLModule", "RLModuleSpec", "DiscretePolicyModule", "QModule",
+    "ConvPolicyModule", "GaussianPolicyModule", "SACModule",
+    "Connector", "ConnectorPipeline", "ObsScaler", "FlattenObs",
+    "FrameStack", "ClipActions",
     "EnvRunnerGroup", "SingleAgentEnvRunner", "register_env", "make_env",
-    "CartPoleEnv", "ReplayBuffer",
+    "CartPoleEnv", "PendulumEnv", "CatchEnv", "ReplayBuffer",
 ]

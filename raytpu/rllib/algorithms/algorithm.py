@@ -41,6 +41,11 @@ class AlgorithmConfig:
         self.model: Dict[str, Any] = {}
         # learners
         self.num_learners = 1
+        # connectors (env->module obs transforms, module->env action
+        # transforms); instances are prototypes — each runner deep-copies
+        # so stateful connectors (FrameStack) never share state.
+        self.env_to_module_connectors: list = []
+        self.module_to_env_connectors: list = []
         # debugging
         self.seed: Optional[int] = None
         # evaluation
@@ -83,6 +88,14 @@ class AlgorithmConfig:
             self.num_learners = num_learners
         return self
 
+    def connectors(self, *, env_to_module: Optional[list] = None,
+                   module_to_env: Optional[list] = None):
+        if env_to_module is not None:
+            self.env_to_module_connectors = list(env_to_module)
+        if module_to_env is not None:
+            self.module_to_env_connectors = list(module_to_env)
+        return self
+
     def debugging(self, *, seed: Optional[int] = None):
         if seed is not None:
             self.seed = seed
@@ -105,16 +118,48 @@ class AlgorithmConfig:
 
     # -- build ----------------------------------------------------------------
 
-    def spaces(self):
+    def space_info(self) -> Dict[str, Any]:
+        from raytpu.rllib.connectors import ConnectorPipeline
+
         env = make_env(self.env, self.env_config)
-        obs_dim = int(np.prod(env.observation_space.shape))
-        act_dim = int(env.action_space.n)
-        return obs_dim, act_dim
+        obs_shape = ConnectorPipeline(
+            self.env_to_module_connectors).transform_obs_shape(
+            tuple(env.observation_space.shape))
+        space = env.action_space
+        # getattr: gymnasium Box has no .n at all (our Space sets n=None).
+        if getattr(space, "n", None) is not None:
+            return {"obs_dim": int(np.prod(obs_shape)),
+                    "obs_shape": obs_shape, "act_dim": int(space.n),
+                    "continuous": False, "low": 0.0, "high": 0.0}
+        act_dim = int(np.prod(space.shape))
+        # Per-dimension bounds (an env may mix e.g. [-1,1] and [-10,10]
+        # dims); broadcast scalars up so the squashing policy rescales
+        # each dim into its own interval.
+        low = np.broadcast_to(np.asarray(space.low, np.float32),
+                              space.shape).reshape(act_dim)
+        high = np.broadcast_to(np.asarray(space.high, np.float32),
+                               space.shape).reshape(act_dim)
+        return {"obs_dim": int(np.prod(obs_shape)), "obs_shape": obs_shape,
+                "act_dim": act_dim, "continuous": True,
+                "low": low.tolist(), "high": high.tolist()}
 
     def rl_module_spec(self) -> RLModuleSpec:
-        obs_dim, act_dim = self.spaces()
-        return RLModuleSpec(observation_dim=obs_dim, action_dim=act_dim,
-                            model_config=dict(self.model))
+        info = self.space_info()
+        if info["continuous"]:
+            # The categorical default module cannot score Box actions; a
+            # confusing take_along_axis trace error would surface deep in
+            # the learner otherwise.
+            raise ValueError(
+                f"{type(self).__name__}: env {self.env!r} has a continuous "
+                f"(Box) action space; use SAC (SACConfig) for continuous "
+                f"control, or supply a custom module spec")
+        structured = len(info["obs_shape"]) > 1
+        return RLModuleSpec(
+            observation_dim=info["obs_dim"], action_dim=info["act_dim"],
+            model_config=dict(self.model),
+            observation_shape=info["obs_shape"] if structured else None,
+            continuous=info["continuous"], action_low=info["low"],
+            action_high=info["high"])
 
     def build(self) -> "Algorithm":
         if self.algo_class is None:
@@ -150,6 +195,8 @@ class Algorithm:
             "num_envs_per_env_runner": config.num_envs_per_env_runner,
             "seed": config.seed,
             "gamma": config.gamma,
+            "env_to_module_connectors": config.env_to_module_connectors,
+            "module_to_env_connectors": config.module_to_env_connectors,
         }
         self.env_runner_group = EnvRunnerGroup(
             runner_config, config.num_env_runners)
@@ -237,6 +284,28 @@ class Algorithm:
             steps += s.get("env_steps", 0)
         self._timesteps_total += steps
         return steps
+
+    @staticmethod
+    def _replay_transitions(sample) -> Dict[str, np.ndarray]:
+        """Flatten a time-major fragment into replay transitions (shared
+        by the off-policy algorithms). Pure time-limit truncations are
+        dropped: their stored next_obs is the post-reset state and
+        terminateds=True would wrongly zero the Bellman bootstrap at a
+        state that did not really terminate (reference SAC/DQN exclude
+        truncations from the done mask)."""
+        s = sample
+        T, B = s["rewards"].shape
+        next_obs = np.concatenate(
+            [s["obs"][1:], s["bootstrap_obs"][None]], axis=0)
+        keep = ~s["truncateds"].reshape(T * B)
+        actions = s["actions"].reshape((T * B,) + s["actions"].shape[2:])
+        return {
+            "obs": s["obs"].reshape(T * B, -1)[keep],
+            "actions": actions[keep],
+            "rewards": s["rewards"].reshape(T * B)[keep],
+            "terminateds": s["terminateds"].reshape(T * B)[keep],
+            "next_obs": next_obs.reshape(T * B, -1)[keep],
+        }
 
     @staticmethod
     def _concat_time_major(samples) -> Dict[str, np.ndarray]:

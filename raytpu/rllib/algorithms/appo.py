@@ -1,0 +1,119 @@
+"""APPO — asynchronous PPO: IMPALA's actor-plane asynchrony with PPO's
+clipped surrogate on v-trace-corrected advantages.
+
+Reference analogue: ``rllib/algorithms/appo/appo.py`` (APPO extends
+IMPALA; ``appo_torch_learner.py``: surrogate clip on vtrace pg advantages
++ periodically-updated target network for the KL/value baseline,
+``target_network_update_freq``). Inherits IMPALA's training_step —
+samplers keep one fragment in flight each — and only swaps the loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from raytpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
+from raytpu.rllib.core.learner import vtrace
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param = 0.2
+        self.use_kl_loss = False
+        self.kl_coeff = 0.2
+        self.target_network_update_freq = 2  # training_step() calls
+
+
+class APPOLearner(IMPALALearner):
+    """IMPALA loss with the PPO clip: ratio against the *behavior* policy,
+    advantages from v-trace against the target network's values."""
+
+    def __init__(self, module, config):
+        super().__init__(module, config)
+        self.target_params = self.params
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        T, B = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
+        logp_flat, entropy_flat, vf_flat = self.module.logp_entropy(
+            params, obs_flat, batch["actions"].reshape(T * B))
+        target_logp = logp_flat.reshape(T, B)
+        values = vf_flat.reshape(T, B)
+        entropy = entropy_flat.reshape(T, B)
+        # v-trace targets from the target network's values: the stable
+        # baseline the reference uses to decouple actor lag from the
+        # fast-moving online critic.
+        t_logp_flat, _, t_vf_flat = self.module.logp_entropy(
+            batch["target_params"], obs_flat,
+            batch["actions"].reshape(T * B))
+        t_logp = t_logp_flat.reshape(T, B)
+        t_values = t_vf_flat.reshape(T, B)
+        bootstrap_v = self.module.forward_train(
+            batch["target_params"], batch["bootstrap_obs"])[1]
+        # v-trace rhos come from the TARGET policy, not the online one:
+        # the surrogate below already multiplies by the online/behavior
+        # ratio, so using online logp here would weight stale fragments
+        # by ~rho^2 (reference: appo_torch_learner.py uses the old-policy
+        # distribution for the vtrace correction).
+        vs, pg_adv = vtrace(
+            batch["action_logp"], t_logp,
+            batch["rewards"], t_values,
+            batch["terminateds"], bootstrap_v, cfg["gamma"],
+            cfg["clip_rho_threshold"], cfg["clip_c_threshold"])
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+
+        ratio = jnp.exp(target_logp - batch["action_logp"])
+        clipped = jnp.clip(ratio, 1 - cfg["clip_param"],
+                           1 + cfg["clip_param"])
+        policy_loss = -jnp.mean(jnp.minimum(pg_adv * ratio,
+                                            pg_adv * clipped))
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        ent = jnp.mean(entropy)
+        total = (policy_loss + cfg["vf_loss_coeff"] * vf_loss
+                 - cfg["entropy_coeff"] * ent)
+        if cfg.get("use_kl_loss"):
+            # Sample-based KL(pi_behavior || pi): actions already come from
+            # the behavior policy, so no extra importance weight.
+            kl = jnp.mean(batch["action_logp"] - target_logp)
+            total = total + cfg["kl_coeff"] * kl
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": ent}
+
+    def _batch_leaf_spec(self, key, value):
+        from jax.sharding import PartitionSpec as P
+
+        if key == "target_params":
+            return P()  # replicated parameters, not data
+        return super()._batch_leaf_spec(key, value)
+
+    def update(self, batch):
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        return super().update(batch)
+
+    def sync_target(self):
+        self.target_params = self.params
+
+
+class APPO(IMPALA):
+    learner_class = APPOLearner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        out = super()._learner_config()
+        c = self.config
+        out.update({"clip_param": c.clip_param,
+                    "use_kl_loss": c.use_kl_loss, "kl_coeff": c.kl_coeff})
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        metrics = super().training_step()
+        if self.iteration % max(1, self.config.target_network_update_freq) \
+                == 0:
+            self.learner.sync_target()
+        return metrics

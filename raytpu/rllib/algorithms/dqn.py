@@ -34,9 +34,13 @@ class DQNConfig(AlgorithmConfig):
         self.double_q = True
 
     def rl_module_spec(self) -> RLModuleSpec:
-        obs_dim, act_dim = self.spaces()
-        return RLModuleSpec(module_class=QModule, observation_dim=obs_dim,
-                            action_dim=act_dim,
+        info = self.space_info()
+        if info["continuous"]:
+            raise ValueError("DQN requires a discrete action space; use "
+                             "SAC (SACConfig) for continuous control")
+        return RLModuleSpec(module_class=QModule,
+                            observation_dim=info["obs_dim"],
+                            action_dim=info["act_dim"],
                             model_config=dict(self.model))
 
 
@@ -112,16 +116,7 @@ class DQN(Algorithm):
         steps = self._absorb_episodes(samples)
         # Flatten fragments into (s, a, r, s', done) transitions.
         for s in samples:
-            T, B = s["rewards"].shape
-            next_obs = np.concatenate(
-                [s["obs"][1:], s["bootstrap_obs"][None]], axis=0)
-            self.buffer.add({
-                "obs": s["obs"].reshape(T * B, -1),
-                "actions": s["actions"].reshape(T * B),
-                "rewards": s["rewards"].reshape(T * B),
-                "terminateds": s["terminateds"].reshape(T * B),
-                "next_obs": next_obs.reshape(T * B, -1),
-            })
+            self.buffer.add(self._replay_transitions(s))
         metrics: Dict[str, Any] = {"epsilon": self._epsilon(),
                                    "replay_size": len(self.buffer)}
         if len(self.buffer) >= c.num_steps_sampled_before_learning_starts:

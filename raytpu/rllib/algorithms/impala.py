@@ -47,7 +47,7 @@ class IMPALALearner(Learner):
     def compute_loss(self, params, batch, rng):
         cfg = self.config
         T, B = batch["rewards"].shape
-        obs_flat = batch["obs"].reshape(T * B, -1)
+        obs_flat = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
         logp_flat, entropy_flat, vf_flat = self.module.logp_entropy(
             params, obs_flat, batch["actions"].reshape(T * B))
         target_logp = logp_flat.reshape(T, B)

@@ -85,7 +85,8 @@ class PPOLearner(Learner):
 
         T, B = batch["rewards"].shape
         flat = {
-            "obs": batch["obs"].reshape(T * B, -1),
+            # Structured (pixel) observations keep their trailing dims.
+            "obs": batch["obs"].reshape((T * B,) + batch["obs"].shape[2:]),
             "actions": batch["actions"].reshape(T * B),
             "action_logp": batch["action_logp"].reshape(T * B),
             "advantages": advs.reshape(T * B),

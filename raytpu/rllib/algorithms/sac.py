@@ -1,0 +1,188 @@
+"""SAC — soft actor-critic for continuous control.
+
+Reference analogue: ``rllib/algorithms/sac/sac.py`` (training_step:
+sample → replay → critic/actor/alpha updates → polyak target sync) and
+``sac_torch_policy.py`` (twin-Q loss, auto entropy temperature). TPU
+redesign: the critic, actor, and temperature updates plus the polyak
+target move are ONE jitted program — a single dispatch per gradient step;
+the host only owns the replay buffer (numpy, sampling-plane).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from raytpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from raytpu.rllib.core.rl_module import RLModuleSpec, SACModule
+from raytpu.rllib.utils.replay_buffer import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.lr = 3e-4
+        self.tau = 0.005                  # polyak coefficient
+        self.initial_alpha = 1.0
+        self.target_entropy = None        # None -> -action_dim
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.train_batch_size = 256
+        self.updates_per_step = 1
+
+    def rl_module_spec(self) -> RLModuleSpec:
+        info = self.space_info()
+        if not info["continuous"]:
+            raise ValueError("SAC requires a continuous (Box) action space")
+        return RLModuleSpec(
+            module_class=SACModule, observation_dim=info["obs_dim"],
+            action_dim=info["act_dim"], model_config=dict(self.model),
+            continuous=True, action_low=info["low"], action_high=info["high"])
+
+
+class SACLearner:
+    """Self-contained learner (not the shard_map base Learner): SAC has
+    three optimizers (critic / actor / temperature) and a target pytree,
+    all advanced inside one compiled step."""
+
+    def __init__(self, module: SACModule, config: Dict[str, Any]):
+        self.module = module
+        self.config = dict(config)
+        seed = int(self.config.get("seed", 0))
+        self._rng = jax.random.PRNGKey(seed + 7)
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.log_alpha = jnp.asarray(
+            np.log(self.config.get("initial_alpha", 1.0)), jnp.float32)
+        lr = self.config.get("lr", 3e-4)
+        self.opt = optax.adam(lr)
+        self.opt_state = {
+            "pi": self.opt.init(self.params["pi"]),
+            "q": self.opt.init({"q1": self.params["q1"],
+                                "q2": self.params["q2"]}),
+            "alpha": self.opt.init(self.log_alpha),
+        }
+        te = self.config.get("target_entropy")
+        self.target_entropy = float(
+            te if te is not None else -module.action_dim)
+        self._step_fn = jax.jit(partial(self._step, self.config["gamma"],
+                                        self.config["tau"]))
+
+    # One compiled SGD step: critic -> actor -> alpha -> polyak.
+    def _step(self, gamma, tau, params, target_q, log_alpha, opt_state,
+              batch, rng):
+        m = self.module
+        r_next, r_pi = jax.random.split(rng)
+        alpha = jnp.exp(log_alpha)
+
+        next_a, next_logp = m.sample(params, batch["next_obs"], r_next)
+        tq1, tq2 = (m.q1.apply({"params": target_q["q1"]},
+                               batch["next_obs"], next_a),
+                    m.q2.apply({"params": target_q["q2"]},
+                               batch["next_obs"], next_a))
+        nonterminal = 1.0 - batch["terminateds"].astype(jnp.float32)
+        target = batch["rewards"] + gamma * nonterminal * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        target = jax.lax.stop_gradient(target)
+
+        def critic_loss(qs):
+            q1 = m.q1.apply({"params": qs["q1"]}, batch["obs"],
+                            batch["actions"])
+            q2 = m.q2.apply({"params": qs["q2"]}, batch["obs"],
+                            batch["actions"])
+            return jnp.mean((q1 - target) ** 2) + \
+                jnp.mean((q2 - target) ** 2), (q1, q2)
+
+        qs = {"q1": params["q1"], "q2": params["q2"]}
+        (qf_loss, (q1, _)), qgrads = jax.value_and_grad(
+            critic_loss, has_aux=True)(qs)
+        qup, opt_q = self.opt.update(qgrads, opt_state["q"], qs)
+        qs = optax.apply_updates(qs, qup)
+
+        def actor_loss(pi):
+            a, logp = m.sample({"pi": pi}, batch["obs"], r_pi)
+            aq1 = m.q1.apply({"params": qs["q1"]}, batch["obs"], a)
+            aq2 = m.q2.apply({"params": qs["q2"]}, batch["obs"], a)
+            return jnp.mean(alpha * logp - jnp.minimum(aq1, aq2)), logp
+
+        (pi_loss, logp), pigrads = jax.value_and_grad(
+            actor_loss, has_aux=True)(params["pi"])
+        piup, opt_pi = self.opt.update(pigrads, opt_state["pi"],
+                                       params["pi"])
+        pi = optax.apply_updates(params["pi"], piup)
+
+        def alpha_loss(la):
+            return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(
+                logp + self.target_entropy))
+
+        al, agrads = jax.value_and_grad(alpha_loss)(log_alpha)
+        aup, opt_a = self.opt.update(agrads, opt_state["alpha"], log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, aup)
+
+        target_q = jax.tree_util.tree_map(
+            lambda t, o: (1 - tau) * t + tau * o, target_q, qs)
+        params = {"pi": pi, "q1": qs["q1"], "q2": qs["q2"]}
+        opt_state = {"pi": opt_pi, "q": opt_q, "alpha": opt_a}
+        metrics = {"qf_loss": qf_loss, "actor_loss": pi_loss,
+                   "alpha_loss": al, "alpha": jnp.exp(log_alpha),
+                   "q_mean": jnp.mean(q1)}
+        return params, target_q, log_alpha, opt_state, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self._rng, key = jax.random.split(self._rng)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (self.params, self.target_q, self.log_alpha, self.opt_state,
+         metrics) = self._step_fn(self.params, self.target_q,
+                                  self.log_alpha, self.opt_state, batch, key)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # Weight-sync / checkpoint surface shared with the base Learner.
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def get_state(self):
+        return {"params": self.get_weights(),
+                "target_q": jax.tree_util.tree_map(np.asarray,
+                                                   self.target_q),
+                "log_alpha": float(self.log_alpha)}
+
+    def set_state(self, state):
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.target_q = jax.tree_util.tree_map(jnp.asarray,
+                                               state["target_q"])
+        self.log_alpha = jnp.asarray(state["log_alpha"], jnp.float32)
+
+
+class SAC(Algorithm):
+    learner_class = SACLearner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        c = self.config
+        return {"gamma": c.gamma, "tau": c.tau,
+                "initial_alpha": c.initial_alpha,
+                "target_entropy": c.target_entropy}
+
+    def setup(self, config):
+        super().setup(config)
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        samples = self.env_runner_group.sample()
+        steps = self._absorb_episodes(samples)
+        for s in samples:
+            self.buffer.add(self._replay_transitions(s))
+        metrics: Dict[str, Any] = {"replay_size": len(self.buffer)}
+        if len(self.buffer) >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.updates_per_step):
+                metrics.update(self.learner.update(
+                    self.buffer.sample(c.train_batch_size)))
+            self.env_runner_group.sync_weights(self.learner.get_weights())
+        metrics["_env_steps"] = steps
+        return metrics

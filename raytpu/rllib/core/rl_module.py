@@ -36,10 +36,35 @@ class RLModuleSpec:
     observation_dim: int = 0
     action_dim: int = 0
     model_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Structured observations (pixel envs): when set, modules see
+    # (B, *observation_shape) instead of flat (B, observation_dim).
+    observation_shape: Optional[Tuple[int, ...]] = None
+    # Continuous (Box) action spaces: bounds for squashed policies —
+    # scalar, or per-dimension sequence of length action_dim.
+    continuous: bool = False
+    action_low: Any = -1.0
+    action_high: Any = 1.0
 
     def build(self) -> "RLModule":
-        cls = self.module_class or DiscretePolicyModule
-        return cls(self.observation_dim, self.action_dim, self.model_config)
+        cls = self.module_class
+        if cls is None:
+            if self.continuous:
+                cls = GaussianPolicyModule
+            elif self.observation_shape is not None:
+                cls = ConvPolicyModule
+            else:
+                cls = DiscretePolicyModule
+        # Only forward the newer kwargs when set, so custom module classes
+        # written against the original (obs_dim, act_dim, model_config)
+        # signature keep working.
+        kwargs = {}
+        if self.observation_shape is not None:
+            kwargs["observation_shape"] = self.observation_shape
+        if self.continuous:
+            kwargs["action_low"] = self.action_low
+            kwargs["action_high"] = self.action_high
+        return cls(self.observation_dim, self.action_dim, self.model_config,
+                   **kwargs)
 
 
 class _PolicyValueNet(nn.Module):
@@ -75,10 +100,25 @@ class RLModule:
       - ``forward_train(params, batch)`` → logits, vf (used by losses)
     """
 
+    # Sampling-plane contract (env runners size their buffers off these):
+    action_shape: Tuple[int, ...] = ()      # per-env action shape
+    action_dtype: Any = np.int32
+    is_continuous: bool = False
+    has_value_head: bool = True  # forward_train returns (logits, vf)
+
     def __init__(self, observation_dim: int, action_dim: int,
-                 model_config: Optional[Dict[str, Any]] = None):
+                 model_config: Optional[Dict[str, Any]] = None,
+                 observation_shape: Optional[Tuple[int, ...]] = None,
+                 action_low: Any = -1.0, action_high: Any = 1.0):
         self.observation_dim = observation_dim
+        self.observation_shape = (tuple(observation_shape)
+                                  if observation_shape else None)
         self.action_dim = action_dim
+        # Per-dimension bound vectors (scalars broadcast up).
+        self.action_low = np.broadcast_to(
+            np.asarray(action_low, np.float32), (action_dim,)).copy()
+        self.action_high = np.broadcast_to(
+            np.asarray(action_high, np.float32), (action_dim,)).copy()
         self.model_config = model_config or {}
         self.net = self._build_net()
 
@@ -90,8 +130,9 @@ class RLModule:
         )
 
     def init_params(self, rng) -> Any:
-        obs = jnp.zeros((1, self.observation_dim), jnp.float32)
-        return self.net.init(rng, obs)["params"]
+        shape = ((1,) + self.observation_shape if self.observation_shape
+                 else (1, self.observation_dim))
+        return self.net.init(rng, jnp.zeros(shape, jnp.float32))["params"]
 
     # -- pure forwards --------------------------------------------------------
 
@@ -132,6 +173,8 @@ class QModule(RLModule):
     """Q-network module for DQN-family algorithms: the "policy head" emits
     Q-values; no value head."""
 
+    has_value_head = False
+
     def _build_net(self) -> nn.Module:
         return _PolicyValueNet(
             action_dim=self.action_dim,
@@ -155,3 +198,160 @@ class QModule(RLModule):
     def forward_inference(self, params, obs):
         q, _ = self.forward_train(params, obs)
         return jnp.argmax(q, axis=-1)
+
+
+class _ConvTorso(nn.Module):
+    """Small CNN for pixel observations (reference: ``rllib/models``
+    vision nets). Channels-last (B, H, W, C) — NHWC is the conv layout XLA
+    lowers best on TPU."""
+
+    features: Sequence[int] = (16, 32)
+    dense: int = 256
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, f in enumerate(self.features):
+            x = nn.relu(nn.Conv(f, (3, 3), strides=(2, 2),
+                                name=f"conv_{i}")(x))
+        x = x.reshape(x.shape[:-3] + (-1,))
+        return nn.relu(nn.Dense(self.dense, name="torso_out")(x))
+
+
+class _ConvPolicyValueNet(nn.Module):
+    action_dim: int
+    features: Sequence[int] = (16, 32)
+    dense: int = 256
+
+    @nn.compact
+    def __call__(self, obs):
+        x = _ConvTorso(self.features, self.dense, name="torso")(obs)
+        logits = nn.Dense(self.action_dim, name="pi_out",
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        value = nn.Dense(1, name="vf_out")(x)
+        return logits, value[..., 0]
+
+
+class ConvPolicyModule(RLModule):
+    """Categorical policy over a shared CNN torso — the pixel-observation
+    module (reference: RLlib vision catalog models)."""
+
+    def _build_net(self) -> nn.Module:
+        return _ConvPolicyValueNet(
+            action_dim=self.action_dim,
+            features=tuple(self.model_config.get("conv_features", (16, 32))),
+            dense=int(self.model_config.get("dense", 256)),
+        )
+
+
+class _GaussianPolicyNet(nn.Module):
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"pi_{i}")(x))
+        mean = nn.Dense(self.action_dim, name="mean")(x)
+        log_std = nn.Dense(self.action_dim, name="log_std")(x)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+
+class GaussianPolicyModule(RLModule):
+    """Tanh-squashed diagonal Gaussian for continuous (Box) actions.
+
+    ``sample(params, obs, rng)`` returns (action, logp) with the tanh
+    change-of-variables correction; actions land in
+    [action_low, action_high].
+    """
+
+    action_dtype = np.float32
+    is_continuous = True
+    has_value_head = False
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.action_shape = (self.action_dim,)
+
+    def _build_net(self) -> nn.Module:
+        return _GaussianPolicyNet(
+            action_dim=self.action_dim,
+            hidden=tuple(self.model_config.get("fcnet_hiddens", (256, 256))))
+
+    def _squash(self, u):
+        lo = jnp.asarray(self.action_low)
+        hi = jnp.asarray(self.action_high)
+        return lo + (jnp.tanh(u) + 1.0) * 0.5 * (hi - lo)
+
+    def sample(self, params, obs, rng):
+        mean, log_std = self.net.apply({"params": params}, obs)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(rng, mean.shape)
+        # logp under the squashed distribution: N(u) minus the tanh and
+        # per-dimension affine-rescale jacobians.
+        logp_u = -0.5 * (((u - mean) / std) ** 2 + 2 * log_std
+                         + jnp.log(2 * jnp.pi))
+        logp = jnp.sum(logp_u - 2.0 * (jnp.log(2.0) - u
+                                       - jax.nn.softplus(-2.0 * u)), axis=-1)
+        logp = logp - jnp.sum(jnp.log(
+            (jnp.asarray(self.action_high) - jnp.asarray(self.action_low))
+            * 0.5 + 1e-8))
+        return self._squash(u), logp
+
+    def forward_exploration(self, params, obs, rng):
+        a, logp = self.sample(params, obs, rng)
+        return a, logp, None
+
+    def forward_inference(self, params, obs):
+        mean, _ = self.net.apply({"params": params}, obs)
+        return self._squash(mean)
+
+
+class _QCriticNet(nn.Module):
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"q_{i}")(x))
+        return nn.Dense(1, name="q_out")(x)[..., 0]
+
+
+class SACModule(GaussianPolicyModule):
+    """SAC container: squashed-Gaussian actor + twin Q critics
+    (reference: ``rllib/algorithms/sac/sac_torch_model.py`` twin-Q)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        hidden = tuple(self.model_config.get("fcnet_hiddens", (256, 256)))
+        self.q1 = _QCriticNet(hidden)
+        self.q2 = _QCriticNet(hidden)
+
+    def init_params(self, rng) -> Any:
+        r_pi, r_q1, r_q2 = jax.random.split(rng, 3)
+        obs = jnp.zeros((1, self.observation_dim), jnp.float32)
+        act = jnp.zeros((1, self.action_dim), jnp.float32)
+        return {
+            "pi": self.net.init(r_pi, obs)["params"],
+            "q1": self.q1.init(r_q1, obs, act)["params"],
+            "q2": self.q2.init(r_q2, obs, act)["params"],
+        }
+
+    def sample(self, params, obs, rng):
+        return super().sample(params["pi"] if "pi" in params else params,
+                              obs, rng)
+
+    def forward_exploration(self, params, obs, rng):
+        a, logp = self.sample(params, obs, rng)
+        return a, logp, None
+
+    def forward_inference(self, params, obs):
+        pi = params["pi"] if "pi" in params else params
+        mean, _ = self.net.apply({"params": pi}, obs)
+        return self._squash(mean)
+
+    def q_values(self, params, obs, act):
+        return (self.q1.apply({"params": params["q1"]}, obs, act),
+                self.q2.apply({"params": params["q2"]}, obs, act))

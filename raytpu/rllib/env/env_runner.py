@@ -18,6 +18,22 @@ import raytpu
 from raytpu.rllib.env.envs import make_env
 
 
+def _build_pipelines(config: Dict[str, Any]):
+    """Fresh (env→module, module→env) connector pipelines from the config's
+    prototypes — deep-copied so stateful connectors never share state
+    between consumers (sampling vs eval vs other runners)."""
+    import copy
+
+    from raytpu.rllib.connectors import ConnectorPipeline
+
+    return (
+        ConnectorPipeline([copy.deepcopy(c) for c in
+                           config.get("env_to_module_connectors") or []]),
+        ConnectorPipeline([copy.deepcopy(c) for c in
+                           config.get("module_to_env_connectors") or []]),
+    )
+
+
 class SingleAgentEnvRunner:
     """Steps ``num_envs`` copies of one env with the current policy.
 
@@ -51,6 +67,15 @@ class SingleAgentEnvRunner:
             self.envs = [probe] + [make_env(config["env"], env_config)
                                    for _ in range(self.num_envs - 1)]
         self.module = config["module_spec"].build()
+        # Connector pipelines: prototypes are deep-copied so stateful
+        # connectors (FrameStack) are per-runner (reference:
+        # ``rllib/connectors/`` env_to_module / module_to_env pipelines).
+        self._env_to_module, self._module_to_env = _build_pipelines(config)
+        self._act_shape = tuple(getattr(self.module, "action_shape", ()))
+        self._act_dtype = getattr(self.module, "action_dtype", np.int32)
+        self._continuous = bool(getattr(self.module, "is_continuous", False))
+        self._has_value_head = bool(
+            getattr(self.module, "has_value_head", True))
         self.params = self.module.init_params(
             jax.random.PRNGKey(self._seed or 0))
         self._rng = jax.random.PRNGKey((self._seed or 0) + 1)
@@ -89,8 +114,11 @@ class SingleAgentEnvRunner:
         """
         T = num_steps or self.fragment_len
         B = self.num_envs
-        obs_buf = np.zeros((T, B) + self._obs.shape[1:], np.float32)
-        act_buf = np.zeros((T, B), np.int32)
+        obs_shape = self._env_to_module.transform_obs_shape(
+            self._obs.shape[1:])
+        obs_buf = np.zeros((T, B) + obs_shape, np.float32)
+        act_buf = np.zeros((T, B) + self._act_shape, self._act_dtype)
+        trunc_buf = np.zeros((T, B), np.bool_)  # pure time-limit cuts
         rew_buf = np.zeros((T, B), np.float32)
         term_buf = np.zeros((T, B), np.bool_)
         logp_buf = np.zeros((T, B), np.float32)
@@ -98,6 +126,8 @@ class SingleAgentEnvRunner:
 
         for t in range(T):
             obs = self._obs.astype(np.float32)
+            if len(self._env_to_module):
+                obs = self._env_to_module(obs)
             obs_buf[t] = obs
             if explore:
                 self._rng, key = jax.random.split(self._rng)
@@ -112,44 +142,57 @@ class SingleAgentEnvRunner:
             logp_buf[t] = np.asarray(logp)
             if vf is not None:
                 vf_buf[t] = np.asarray(vf)
+            env_actions = actions
+            if len(self._module_to_env):
+                env_actions = self._module_to_env(actions)
 
             if self._vec is not None:
                 nobs, r, terminated, truncated, info = \
-                    self._vec.step_batch(actions)
+                    self._vec.step_batch(env_actions)
                 self._ep_return += r
                 self._ep_len += 1
                 rew_buf[t] = r
                 done = terminated | truncated
                 term_buf[t] = done
                 pure_trunc = truncated & ~terminated
-                if pure_trunc.any():
+                trunc_buf[t] = pure_trunc
+                if pure_trunc.any() and self._has_value_head:
                     # Fold the value bootstrap into the truncation step
-                    # (same semantics as the per-env path below).
+                    # (same semantics as the per-env path below). peek is
+                    # fed the FULL batch so stateful connectors
+                    # (FrameStack) see their sampling-time batch shape and
+                    # per-slot history; truncated rows are selected after.
+                    fobs = info["final_obs"].astype(np.float32)
+                    if len(self._env_to_module):
+                        fobs = self._env_to_module.peek(fobs)
                     vals = np.asarray(self._value_fn(
-                        self.params,
-                        jnp.asarray(info["final_obs"][pure_trunc])))
+                        self.params, jnp.asarray(fobs)))
                     gamma = float(self.config.get("gamma", 0.99))
-                    rew_buf[t, pure_trunc] += gamma * vals
+                    rew_buf[t, pure_trunc] += gamma * vals[pure_trunc]
                 if done.any():
                     for i in np.nonzero(done)[0]:
                         self._completed.append({
                             "episode_return": float(self._ep_return[i]),
                             "episode_len": int(self._ep_len[i]),
                         })
+                        self._env_to_module.on_episode_done(int(i))
                     self._ep_return[done] = 0.0
                     self._ep_len[done] = 0
                 self._obs = nobs
                 continue
 
             truncated_next_obs = {}
+            done_idx = []
             for i, env in enumerate(self.envs):
-                nobs, r, terminated, truncated, _ = env.step(
-                    int(actions[i]))
+                a_i = (env_actions[i] if self._continuous
+                       else int(env_actions[i]))
+                nobs, r, terminated, truncated, _ = env.step(a_i)
                 self._ep_return[i] += r
                 self._ep_len[i] += 1
                 rew_buf[t, i] = r
                 done = terminated or truncated
                 term_buf[t, i] = done
+                trunc_buf[t, i] = truncated and not terminated
                 if truncated and not terminated:
                     truncated_next_obs[i] = nobs
                 if done:
@@ -157,27 +200,41 @@ class SingleAgentEnvRunner:
                         "episode_return": float(self._ep_return[i]),
                         "episode_len": int(self._ep_len[i]),
                     })
+                    done_idx.append(i)
                     self._ep_return[i] = 0.0
                     self._ep_len[i] = 0
                     nobs = env.reset()[0]
                 self._obs[i] = nobs
-            if truncated_next_obs:
-                idx = sorted(truncated_next_obs)
+            if truncated_next_obs and self._has_value_head:
+                # Full-batch peek (see vec path): connector state must see
+                # its sampling-time batch shape, and must not be advanced
+                # or zeroed before this transform.
+                full = self._obs.astype(np.float32).copy()
+                for i, fo in truncated_next_obs.items():
+                    full[i] = fo
+                if len(self._env_to_module):
+                    full = self._env_to_module.peek(full)
                 vals = np.asarray(self._value_fn(
-                    self.params,
-                    jnp.asarray(np.stack([truncated_next_obs[i]
-                                          for i in idx]))))
+                    self.params, jnp.asarray(full)))
                 gamma = float(self.config.get("gamma", 0.99))
-                for j, i in enumerate(idx):
-                    rew_buf[t, i] += gamma * float(vals[j])
+                for i in truncated_next_obs:
+                    rew_buf[t, i] += gamma * float(vals[i])
+            for i in done_idx:
+                self._env_to_module.on_episode_done(i)
         self._total_steps += T * B
 
         episodes, self._completed = self._completed, []
+        bootstrap = self._obs.astype(np.float32).copy()
+        if len(self._env_to_module):
+            # peek: the same raw obs is re-transformed for real at the next
+            # fragment's first step, so connector state must not advance.
+            bootstrap = self._env_to_module.peek(bootstrap)
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
-            "terminateds": term_buf, "action_logp": logp_buf,
+            "terminateds": term_buf, "truncateds": trunc_buf,
+            "action_logp": logp_buf,
             "vf_preds": vf_buf,
-            "bootstrap_obs": self._obs.astype(np.float32).copy(),
+            "bootstrap_obs": bootstrap,
             "episodes": episodes,
             "env_steps": T * B,
         }
@@ -189,6 +246,9 @@ class SingleAgentEnvRunner:
                        {**dict(self.config.get("env_config") or {}),
                         "num_envs": 1})
         vec = getattr(env, "is_vector_env", False)
+        # Fresh connector state for eval episodes (FrameStack etc. must not
+        # leak sampling state into greedy rollouts).
+        eval_pipe, eval_act_pipe = _build_pipelines(self.config)
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=None if self._seed is None
@@ -197,8 +257,15 @@ class SingleAgentEnvRunner:
                 obs = obs[0]
             total = 0.0
             for _ in range(max_steps):
-                a = int(np.asarray(self._infer_fn(
-                    self.params, jnp.asarray(obs[None].astype(np.float32))))[0])
+                mobs = obs[None].astype(np.float32)
+                if len(eval_pipe):
+                    mobs = eval_pipe(mobs)
+                a = np.asarray(self._infer_fn(self.params,
+                                              jnp.asarray(mobs)))[0]
+                if len(eval_act_pipe):
+                    a = eval_act_pipe(a[None])[0]
+                if not self._continuous:
+                    a = int(a)
                 if vec:
                     nobs, r, term, trunc, _ = env.step_batch(
                         np.asarray([a]))
@@ -209,6 +276,7 @@ class SingleAgentEnvRunner:
                 total += r
                 if terminated or truncated:
                     break
+            eval_pipe.on_episode_done(0)
             returns.append(total)
         return {"episode_return_mean": float(np.mean(returns)),
                 "num_episodes": num_episodes}

@@ -181,7 +181,99 @@ class VecCartPoleEnv:
                 truncated, {"final_obs": final_obs})
 
 
+class PendulumEnv:
+    """Inverted pendulum swing-up (gymnasium Pendulum-v1 dynamics) — the
+    continuous-control (Box action) smoke env for SAC."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.max_speed = 8.0
+        self.max_torque = 2.0
+        self.dt = 0.05
+        self.g = 10.0
+        self.m = 1.0
+        self.length = 1.0
+        self.max_steps = int(config.get("max_episode_steps", 200))
+        self.observation_space = Space.box(-np.inf, np.inf, (3,))
+        self.action_space = Space.box(-self.max_torque, self.max_torque, (1,))
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._state = None
+        self._steps = 0
+
+    def _obs(self):
+        th, thdot = self._state
+        return np.array([np.cos(th), np.sin(th), thdot], np.float32)
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform([-np.pi, -1.0], [np.pi, 1.0])
+        self._steps = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        th, thdot = self._state
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.max_torque, self.max_torque))
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.g / (2 * self.length) * np.sin(th)
+                         + 3.0 / (self.m * self.length ** 2) * u) * self.dt
+        thdot = np.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        self._state = (th, thdot)
+        self._steps += 1
+        truncated = self._steps >= self.max_steps
+        return self._obs(), -float(cost), False, truncated, {}
+
+
+class CatchEnv:
+    """Pixel-observation catch: a ball falls one row per step; the paddle
+    on the bottom row moves left/stay/right. Observation is a (rows, cols,
+    1) float image — the Atari-class smoke env for CNN modules (reference
+    scope: ``rllib/env`` Atari wrappers; bsuite's Catch is the classic
+    minimal pixel env shape).
+    """
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.rows = int(config.get("rows", 10))
+        self.cols = int(config.get("cols", 5))
+        self.observation_space = Space.box(0.0, 1.0,
+                                           (self.rows, self.cols, 1))
+        self.action_space = Space.discrete(3)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._ball = None
+        self._paddle = 0
+
+    def _obs(self):
+        img = np.zeros((self.rows, self.cols, 1), np.float32)
+        r, c = self._ball
+        img[r, c, 0] = 1.0
+        img[self.rows - 1, self._paddle, 0] = 1.0
+        return img
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._ball = (0, int(self._rng.integers(self.cols)))
+        self._paddle = self.cols // 2
+        return self._obs(), {}
+
+    def step(self, action: int):
+        self._paddle = int(np.clip(self._paddle + (int(action) - 1),
+                                   0, self.cols - 1))
+        r, c = self._ball
+        self._ball = (r + 1, c)
+        if self._ball[0] == self.rows - 1:
+            reward = 1.0 if self._ball[1] == self._paddle else -1.0
+            return self._obs(), reward, True, False, {}
+        return self._obs(), 0.0, False, False, {}
+
+
 register_env("CartPole-v1", CartPoleEnv)
+register_env("Pendulum-v1", PendulumEnv)
+register_env("Catch-v0", CatchEnv)
 register_env("CartPole-v0",
              lambda cfg: CartPoleEnv({**(cfg or {}),
                                       "max_episode_steps": 200}))

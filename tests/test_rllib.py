@@ -335,3 +335,147 @@ class TestVectorizedEnv:
 
         out = run(num_envs=8, fragment=16, iters=2, min_wall=0.2)
         assert out["ppo_env_steps_per_sec"] > 0
+
+
+class TestNewEnvs:
+    def test_pendulum_env_contract(self):
+        from raytpu.rllib import PendulumEnv
+
+        env = PendulumEnv({"seed": 0, "max_episode_steps": 5})
+        obs, _ = env.reset()
+        assert obs.shape == (3,) and env.action_space.n is None
+        for i in range(5):
+            obs, r, term, trunc, _ = env.step(np.array([0.5]))
+            assert obs.shape == (3,) and r <= 0.0 and not term
+        assert trunc  # truncates at max steps
+
+    def test_catch_env_contract(self):
+        from raytpu.rllib import CatchEnv
+
+        env = CatchEnv({"seed": 0})
+        obs, _ = env.reset()
+        assert obs.shape == (10, 5, 1)
+        assert obs.sum() == 2.0  # ball + paddle
+        total = 0.0
+        for _ in range(20):
+            obs, r, term, trunc, _ = env.step(1)
+            total += r
+            if term:
+                break
+        assert term and r in (-1.0, 1.0)
+
+
+class TestConnectors:
+    def test_pipeline_shapes_and_scaling(self):
+        from raytpu.rllib import ConnectorPipeline, FlattenObs, ObsScaler
+
+        pipe = ConnectorPipeline([ObsScaler(0.5), FlattenObs()])
+        out = pipe(np.full((2, 3, 3, 1), 2.0, np.float32))
+        assert out.shape == (2, 9) and np.all(out == 1.0)
+        assert pipe.transform_obs_shape((3, 3, 1)) == (9,)
+
+    def test_frame_stack_state_and_peek(self):
+        from raytpu.rllib import FrameStack
+
+        fs = FrameStack(3)
+        o1 = np.ones((1, 2, 2, 1), np.float32)
+        s1 = fs(o1)
+        assert s1.shape == (1, 2, 2, 3)
+        # peek does not advance state
+        p = fs.peek(o1 * 2)
+        assert p[..., -1].max() == 2.0
+        s2 = fs(o1 * 3)
+        assert s2[..., -1].max() == 3.0 and s2[..., 0].max() == 1.0
+        fs.on_episode_done(0)
+        s3 = fs(o1 * 4)
+        assert s3[..., 0].max() == 0.0  # zero-padded post-reset history
+        assert fs.transform_obs_shape((2, 2, 1)) == (2, 2, 3)
+
+
+class TestSAC:
+    def test_sac_improves_pendulum(self, raytpu_local):
+        from raytpu.rllib import SACConfig
+
+        config = (SACConfig().environment("Pendulum-v1")
+                  .env_runners(num_env_runners=0,
+                               num_envs_per_env_runner=1,
+                               rollout_fragment_length=100)
+                  .training(lr=3e-4, train_batch_size=128,
+                            num_steps_sampled_before_learning_starts=400,
+                            updates_per_step=40)
+                  .debugging(seed=0))
+        algo = config.build()
+        eval0 = algo.evaluate()["episode_return_mean"]
+        for _ in range(60):
+            last = algo.train()
+        # Mechanics: losses finite, alpha auto-tuned downward from 1.0.
+        assert np.isfinite(last["qf_loss"]) and np.isfinite(
+            last["actor_loss"])
+        assert 0.0 < last["alpha"] < 1.0
+        ev = algo.evaluate()["episode_return_mean"]
+        # Greedy policy improves substantially over the untrained one
+        # (seeded curve: ~-1490 -> ~-900 after 6k env steps).
+        assert ev > eval0 + 200 and ev > -1150, (eval0, ev)
+        algo.stop()
+
+    def test_sac_rejects_discrete_env(self, raytpu_local):
+        from raytpu.rllib import SACConfig
+
+        with pytest.raises(ValueError, match="continuous"):
+            SACConfig().environment("CartPole-v1").build()
+
+    def test_gaussian_module_bounds_and_logp(self):
+        from raytpu.rllib import RLModuleSpec
+
+        spec = RLModuleSpec(observation_dim=3, action_dim=2,
+                            continuous=True, action_low=-2.0,
+                            action_high=2.0)
+        m = spec.build()
+        params = m.init_params(jax.random.PRNGKey(0))
+        obs = jnp.zeros((16, 3))
+        a, logp = m.sample(params, obs, jax.random.PRNGKey(1))
+        assert a.shape == (16, 2) and logp.shape == (16,)
+        assert np.all(np.abs(np.asarray(a)) <= 2.0)
+        greedy = m.forward_inference(params, obs)
+        assert np.all(np.abs(np.asarray(greedy)) <= 2.0)
+
+
+class TestAPPO:
+    def test_appo_learns_cartpole(self, raytpu_local):
+        from raytpu.rllib import APPOConfig
+
+        config = (APPOConfig().environment("CartPole-v1")
+                  .env_runners(num_env_runners=2,
+                               num_envs_per_env_runner=2,
+                               rollout_fragment_length=32)
+                  .training(lr=5e-4, entropy_coeff=0.01,
+                            num_fragments_per_step=4)
+                  .debugging(seed=0))
+        algo = config.build()
+        returns = [algo.train()["episode_return_mean"] for _ in range(10)]
+        assert returns[-1] > returns[0], returns
+        algo.stop()
+
+
+class TestPixelPPO:
+    def test_ppo_cnn_learns_catch_with_framestack(self, raytpu_local):
+        from raytpu.rllib import FrameStack, PPOConfig
+
+        config = (PPOConfig().environment("Catch-v0")
+                  .env_runners(num_env_runners=0,
+                               num_envs_per_env_runner=16,
+                               rollout_fragment_length=40)
+                  .connectors(env_to_module=[FrameStack(2)])
+                  .training(lr=1e-3, num_epochs=8, minibatch_size=128,
+                            entropy_coeff=0.01)
+                  .debugging(seed=0))
+        algo = config.build()
+        # CNN module + stacked channels picked automatically.
+        assert algo.module.observation_shape == (10, 5, 2)
+        assert type(algo.module).__name__ == "ConvPolicyModule"
+        for _ in range(15):
+            algo.train()
+        # Seeded curve: greedy eval hits 1.0 (perfect catch) by iter ~15.
+        ev = algo.evaluate()["episode_return_mean"]
+        assert ev >= 0.6, ev
+        algo.stop()
