@@ -36,6 +36,7 @@ from raytpu.core.errors import (
 from raytpu.core.ids import (
     ActorID,
     JobID,
+    NodeID,
     ObjectID,
     PlacementGroupID,
     TaskID,
@@ -59,20 +60,45 @@ class ClusterBackend:
         if address.startswith("tcp://"):
             address = address[len("tcp://"):]
         self.job_id = job_id
-        # Data-plane endpoint: the driver is a serve-only node.
-        self._node = NodeServer(address, serve_only=True)
-        self._node.start()
-        self.node_id = self._node.node_id
-        self.store = self._node.backend.store
-        self.worker = self._node.backend.worker
+        self._relay = None
+        if address.startswith("raytpu://"):
+            # Remote driver behind the proxy (reference: ray:// client
+            # mode): one physical connection carries every logical one,
+            # and the driver hosts NO serve endpoint — nodes cannot reach
+            # it, so argument objects are pushed at submit time
+            # (_push_local_args) instead of pulled.
+            from raytpu.cluster.node import NodeBackend
+            from raytpu.cluster.relay import RelayChannel
+
+            self._relay = RelayChannel(address[len("raytpu://"):])
+            self._connect = self._relay.client_for
+            address = self._relay.head_address
+            backend = NodeBackend(job_id, num_cpus=0, num_tpus=0,
+                                  resources={})
+            backend.worker.pin_owned = False  # driver owns its objects
+            self._node = None
+            self._driver_backend = backend
+            self.node_id = NodeID.from_random()
+        else:
+            self._connect = RpcClient
+            # Data-plane endpoint: the driver is a serve-only node.
+            self._node = NodeServer(address, serve_only=True)
+            self._node.start()
+            self._driver_backend = self._node.backend
+            self.node_id = self._node.node_id
+        self._serve_address = self._node.address if self._node else None
+        self.store = self._driver_backend.store
+        self.worker = self._driver_backend.worker
         self.worker.job_id = job_id
-        self._head = RpcClient(address)
+        self._head = self._connect(address)
         self._head.subscribe("nodes", self._on_node_event)
         self._head.subscribe("actors", self._on_actor_event)
         self._head.subscribe("objects", self._on_object_event)
+        self._head.subscribe("tasks", self._on_task_event)
         self._head.call("subscribe", "nodes")
         self._head.call("subscribe", "actors")
         self._head.call("subscribe", "objects")
+        self._head.call("subscribe", "tasks")
         from raytpu.core.config import cfg as _cfg
 
         if _cfg.log_to_driver:
@@ -126,7 +152,7 @@ class ClusterBackend:
         with self._peers_lock:
             c = self._peers.get(address)
             if c is None or c.closed:
-                c = self._peers[address] = RpcClient(address)
+                c = self._peers[address] = self._connect(address)
             return c
 
     def _node_addr(self, node_id: str) -> Optional[str]:
@@ -303,6 +329,8 @@ class ClusterBackend:
             self._ship_runtime_env(spec, addr)
         except Exception:
             pass
+        if self._relay is not None:
+            self._push_local_args(spec, addr)
         with self._lock:
             self._inflight[spec.task_id] = _InFlight(
                 spec, node_id, attempts=spec.attempt)
@@ -313,18 +341,35 @@ class ClusterBackend:
                 self._inflight.pop(spec.task_id, None)
                 self._pending.append(spec)
 
+    def _push_local_args(self, spec: TaskSpec, addr: str) -> None:
+        """Proxy-mode drivers host no serve endpoint, so nodes cannot pull
+        argument objects from them — ship driver-local args to the
+        executing node with the submission (reference contrast: ray://
+        keeps the driver's objects server-side instead)."""
+        peer = self._peer(addr)
+        for oid in self._arg_ref_ids(spec):
+            sv = self.store.try_get(oid)
+            if sv is None:
+                continue  # produced cluster-side; node pulls normally
+            try:
+                if peer.call("has_object", oid.hex()):
+                    continue
+                peer.call("put_object", oid.hex(), sv.to_bytes(),
+                          timeout=None)
+            except Exception:
+                pass  # submission surfaces the real failure if it matters
+
     def _free_loop(self) -> None:
+        # Head-mediated free (borrower protocol): the head defers the free
+        # while any worker still borrows the ref, and fires it on the last
+        # borrow_released / borrower death (reference: the owner's free
+        # waits on WaitForRefRemoved replies from borrowers).
         while not self._shutdown_flag:
             oid = self._free_queue.get()
             if oid is None or self._shutdown_flag:
                 return
             try:
-                locs = self._head.call("locate_object", oid.hex(),
-                                       timeout=5.0)
-                for loc in locs or ():
-                    if loc["address"] != self._node.address:
-                        self._peer(loc["address"]).notify(
-                            "free_object", oid.hex())
+                self._head.call("request_free", oid.hex(), timeout=5.0)
             except Exception:
                 pass
 
@@ -358,12 +403,17 @@ class ClusterBackend:
                 continue
             if done:
                 with self._lock:
-                    self._inflight.pop(rec.spec.task_id, None)
-                    if rec.spec.actor_id is not None:
+                    # Unpin only if WE removed the record — the task_done
+                    # pubsub path may have already popped and unpinned it;
+                    # a second unpin would double-decrement the submitted
+                    # refs shared with other in-flight tasks.
+                    popped = self._inflight.pop(rec.spec.task_id, None)
+                    if popped is not None and rec.spec.actor_id is not None:
                         lst = self._actor_inflight.get(rec.spec.actor_id)
                         if lst and rec.spec in lst:
                             lst.remove(rec.spec)
-                self._unpin_args(rec.spec)
+                if popped is not None:
+                    self._unpin_args(popped.spec)
 
     # -- actors ------------------------------------------------------------
 
@@ -387,6 +437,8 @@ class ClusterBackend:
             self._ship_runtime_env(spec, addr)
         except Exception:
             pass
+        if self._relay is not None:
+            self._push_local_args(spec, addr)
         self._peer(addr).call("create_actor", wire.dumps(spec))
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -433,6 +485,8 @@ class ClusterBackend:
         with self._lock:
             self._actor_inflight.setdefault(spec.actor_id, []).append(spec)
             self._inflight[spec.task_id] = _InFlight(spec, node_id)
+        if self._relay is not None:
+            self._push_local_args(spec, addr)
         try:
             self._peer(addr).call("submit_actor_task",
                                   wire.dumps(spec))
@@ -547,7 +601,7 @@ class ClusterBackend:
             except ConnectionLost:
                 raise WorkerCrashedError("lost connection to cluster head")
             for loc in locs or ():
-                if loc["address"] == self._node.address:
+                if loc["address"] == self._serve_address:
                     continue
                 try:
                     from raytpu.cluster.transfer import fetch_blob
@@ -648,6 +702,30 @@ class ClusterBackend:
         nid = str(data.get("node_id", ""))[:8]
         for line in data.get("lines", ()):
             print(f"({src}, node={nid}) {line}", file=_sys.stderr)
+
+    def _on_task_event(self, data: dict) -> None:
+        """Explicit completion from the executing node: release the
+        submitted-arg pins now — return-object locations are not a
+        reliable completion signal (a fire-and-forget return may already
+        be freed). The node_id match keeps a late event from a dead
+        node's attempt from unpinning a resubmitted task."""
+        if data.get("event") != "done":
+            return
+        try:
+            tid = TaskID.from_hex(data["task_id"])
+        except Exception:
+            return
+        with self._lock:
+            rec = self._inflight.get(tid)
+            if rec is None or (data.get("node_id")
+                               and rec.node_id != data["node_id"]):
+                return
+            self._inflight.pop(tid, None)
+            if rec.spec.actor_id is not None:
+                lst = self._actor_inflight.get(rec.spec.actor_id)
+                if lst and rec.spec in lst:
+                    lst.remove(rec.spec)
+        self._unpin_args(rec.spec)
 
     def _on_object_event(self, data: dict) -> None:
         """A node reported an object with zero copies (its producer's node
@@ -821,7 +899,7 @@ class ClusterBackend:
         ]
 
     def task_events(self) -> List[dict]:
-        return list(self._node.backend.task_events())
+        return list(self._driver_backend.task_events())
 
     # -- kv (used by job submission / function shipping) -------------------
 
@@ -848,7 +926,10 @@ class ClusterBackend:
                 pass
         self._free_queue.put(None)
         try:
-            self._node.stop()
+            if self._node is not None:
+                self._node.stop()
+            else:
+                self._driver_backend.shutdown()
         except Exception:
             pass
         try:
@@ -859,3 +940,8 @@ class ClusterBackend:
             for c in self._peers.values():
                 c.close()
             self._peers.clear()
+        if self._relay is not None:
+            try:
+                self._relay.close()
+            except Exception:
+                pass

@@ -30,7 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from raytpu.cluster.protocol import Peer, RpcServer
+from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 
 HEARTBEAT_TIMEOUT_S = 5.0
 CHECK_PERIOD_S = 1.0
@@ -118,6 +118,19 @@ class HeadServer:
         self._named: Dict[Tuple[str, str], str] = {}
         # object_id(hex) -> set of node_ids that hold it
         self._objects: Dict[str, Set[str]] = {}
+        # Borrower protocol (reference: reference_count.h borrowers +
+        # WaitForRefRemoved, SURVEY A1): oid -> {"node:worker", ...}. The
+        # head is the authority so an owner's free cannot race a borrow
+        # report — borrow_added rides the task-completion path
+        # synchronously, BEFORE return-object locations are reported.
+        self._borrows: Dict[str, Set[str]] = {}
+        self._pending_free: Set[str] = set()
+        # Early-release tombstones: a worker's async borrow_released can
+        # beat the node's synchronous borrow_added for the same (oid,
+        # borrower) in a narrow drop-during-registration race; the add
+        # then cancels against the tombstone instead of recording a
+        # borrow that would never be released.
+        self._early_releases: Set[Tuple[str, str]] = set()
         self._object_waiters: Dict[str, List[Peer]] = {}
         # placement groups: pg_id -> {"bundles": [...], "nodes": [node_id per bundle]}
         self._pgs: Dict[str, dict] = {}
@@ -146,6 +159,11 @@ class HeadServer:
         h("report_object", self._report_object)
         h("forget_object", self._forget_object)
         h("locate_object", self._locate_object)
+        h("borrow_added", self._borrow_added)
+        h("borrow_released", self._borrow_released)
+        h("request_free", self._request_free)
+        h("borrow_info", self._borrow_info)
+        h("task_done", self._task_done)
         h("create_pg", self._create_pg)
         h("remove_pg", self._remove_pg)
         h("pg_info", self._pg_info)
@@ -345,9 +363,98 @@ class HeadServer:
                 ]
         self._publish("nodes", {"event": "removed", "node_id": node_id,
                                 "reason": reason})
+        self._drop_borrower_prefix(node_id)
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id} {reason}",
                                    no_restart=False)
+
+    # -- borrower protocol --------------------------------------------------
+
+    def _borrow_added(self, peer: Peer, oid_hexes: List[str],
+                      borrower: str) -> bool:
+        with self._lock:
+            for oh in oid_hexes:
+                if (oh, borrower) in self._early_releases:
+                    self._early_releases.discard((oh, borrower))
+                    continue  # released before the add landed
+                self._borrows.setdefault(oh, set()).add(borrower)
+        return True
+
+    def _borrow_released(self, peer: Peer, oid_hex: str,
+                         borrower: str) -> None:
+        free_now = False
+        with self._lock:
+            holders = self._borrows.get(oid_hex)
+            if holders is None or borrower not in holders:
+                self._early_releases.add((oid_hex, borrower))
+            if holders is not None:
+                holders.discard(borrower)
+                if not holders:
+                    del self._borrows[oid_hex]
+                    free_now = oid_hex in self._pending_free
+        if free_now:
+            self._do_free(oid_hex)
+
+    def _task_done(self, peer: Peer, task_id_hex: str,
+                   node_id: str) -> None:
+        self._publish("tasks", {"event": "done", "task_id": task_id_hex,
+                                "node_id": node_id})
+
+    def _borrow_info(self, peer: Peer) -> dict:
+        with self._lock:
+            return {"borrows": {k: sorted(v)
+                                for k, v in self._borrows.items()},
+                    "pending_free": sorted(self._pending_free)}
+
+    def _request_free(self, peer: Peer, oid_hex: str) -> bool:
+        """Owner's refcount hit zero. Frees cluster copies unless borrowers
+        still hold the object — then the free is deferred until the last
+        borrow_released (or borrower death). Returns True when freed now."""
+        with self._lock:
+            if self._borrows.get(oid_hex):
+                self._pending_free.add(oid_hex)
+                return False
+        self._do_free(oid_hex)
+        return True
+
+    def _do_free(self, oid_hex: str) -> None:
+        with self._lock:
+            self._pending_free.discard(oid_hex)
+            holders = []
+            for node_id in self._objects.get(oid_hex, set()):
+                entry = self._nodes.get(node_id)
+                if entry is not None and entry.alive:
+                    holders.append((node_id, entry.address))
+        for node_id, address in holders:
+            try:
+                self._node_client(node_id, address).notify(
+                    "free_object", oid_hex)
+            except Exception:
+                pass
+
+    def _node_client(self, node_id: str, address: str):
+        client = self._node_clients.get(node_id)
+        if client is None or client.closed:
+            client = RpcClient(address)
+            self._node_clients[node_id] = client
+        return client
+
+    def _drop_borrower_prefix(self, node_id: str) -> None:
+        """A node died: every borrower on it is gone; deferred frees whose
+        last borrower lived there fire now."""
+        prefix = node_id + ":"
+        to_free = []
+        with self._lock:
+            for oh in list(self._borrows):
+                holders = self._borrows[oh]
+                holders.difference_update(
+                    {b for b in holders if b.startswith(prefix)})
+                if not holders:
+                    del self._borrows[oh]
+                    if oh in self._pending_free:
+                        to_free.append(oh)
+        for oh in to_free:
+            self._do_free(oh)
 
     # -- kv ----------------------------------------------------------------
 
@@ -545,10 +652,7 @@ class HeadServer:
                     time.sleep(0.2)
                     continue
                 try:
-                    client = self._node_clients.get(node_id)
-                    if client is None or client.closed:
-                        client = RpcClient(address)
-                        self._node_clients[node_id] = client
+                    client = self._node_client(node_id, address)
                     client.call("create_actor", blob, timeout=120.0)
                 except Exception:
                     time.sleep(0.5)

@@ -112,7 +112,7 @@ class _ProcActorRuntime:
             self._creation_failed(WorkerCrashedError(
                 f"worker died during actor creation: {e}"))
             return
-        b._ingest_results(reply["results"])
+        b._absorb_reply(reply, self.handle.worker_id.hex())
         if reply["error"] is not None:
             err = cloudpickle.loads(reply["error"])
             self.creation_error = err
@@ -149,7 +149,7 @@ class _ProcActorRuntime:
         finally:
             with self.backend._lock:
                 self.backend._task_worker.pop(spec.task_id, None)
-        self.backend._ingest_results(reply["results"])
+        self.backend._absorb_reply(reply, self.handle.worker_id.hex())
         self.backend._task_finished(spec)
 
     def _pump_sequential(self):
@@ -223,6 +223,7 @@ class NodeBackend(LocalBackend):
         self.worker.pin_owned = True
         self.on_object_local = None   # cb(oid) -> None (report location)
         self.on_actor_dead = None     # cb(actor_id, reason)
+        self.report_borrows = None    # cb(oid_hexes, worker_id_hex)
         # Worker-process pool (attached by NodeServer after its RPC server
         # is up); None = in-daemon thread execution (round-1 behavior,
         # still used by serve-only driver nodes).
@@ -291,6 +292,32 @@ class NodeBackend(LocalBackend):
                 f"no free chip coordinates for {nchips} TPU(s)")
         return self.topology.chip_ids(coords), coords
 
+    def _after_task(self, spec) -> None:
+        super()._after_task(spec)
+        # Explicit completion signal to the owner (head pubsub "tasks").
+        # The owner cannot infer completion from return-object locations
+        # alone: a fire-and-forget return ref may already be freed, which
+        # would leave the submitted-arg pins leaked forever.
+        cb = getattr(self, "on_task_final", None)
+        if cb is not None:
+            try:
+                cb(spec.task_id.hex())
+            except Exception:
+                pass
+
+    def _absorb_reply(self, reply: dict, worker_id_hex: str) -> None:
+        """Borrows FIRST, results second: the head must know about a
+        still-held argument ref before any return-object location exists,
+        or the owner could free it in the gap (reference: borrows ride the
+        PushTaskReply for the same reason)."""
+        borrows = reply.get("borrows")
+        if borrows and self.report_borrows is not None:
+            try:
+                self.report_borrows(list(borrows), worker_id_hex)
+            except Exception:
+                pass
+        self._ingest_results(reply["results"])
+
     def _ingest_results(self, results) -> None:
         """Land a worker reply's return values in the daemon store. ``blob
         is None`` = already sealed in shared memory — just fire the put
@@ -344,7 +371,7 @@ class NodeBackend(LocalBackend):
             if own_coords:
                 with self._lock:
                     self.topology.release(own_coords)
-        self._ingest_results(reply["results"])
+        self._absorb_reply(reply, handle.worker_id.hex())
         if reply["error"] is not None:
             return cloudpickle.loads(reply["error"])
         return None
@@ -453,6 +480,11 @@ class NodeServer:
         self.backend.node_id = self.node_id
         self.backend.on_object_local = self._report_object
         self.backend.on_actor_dead = self._report_actor_dead
+        self.backend.report_borrows = self._report_borrows
+        self.backend.on_task_final = self._report_task_done
+        # worker_id -> borrowed oid hexes (crash cleanup releases them)
+        self._worker_borrows: Dict[str, set] = {}
+        self._borrow_lock = threading.Lock()
         self._rpc = RpcServer(host, 0)
         h = self._rpc.register
         h("submit_task", self._h_submit_task)
@@ -479,6 +511,7 @@ class NodeServer:
         h("task_unblocked", self._h_task_unblocked)
         h("get_actor_info", self._h_get_actor_info)
         h("report_put", self._h_report_put)
+        h("borrow_released", self._h_borrow_released)
         h("stream_ack", self._h_stream_ack)
         h("stream_close", self._h_stream_close)
         h("available_resources",
@@ -530,6 +563,8 @@ class NodeServer:
                 log_dir=self.log_dir,
             )
             self.backend.worker_pool = self.worker_pool
+            # Dead workers release their borrows (borrower protocol).
+            self.worker_pool.on_worker_gone = self._worker_gone
             if cfg.log_to_driver and self.log_dir:
                 self._log_monitor = threading.Thread(
                     target=self._log_monitor_loop, name="node-log-monitor",
@@ -977,6 +1012,63 @@ class NodeServer:
 
     def _h_remove_pg_shard(self, peer: Peer, pg_id_bin: bytes) -> None:
         self.backend.remove_placement_group(PlacementGroupID(pg_id_bin))
+
+    def _report_task_done(self, task_id_hex: str) -> None:
+        try:
+            self._head.notify("task_done", task_id_hex,
+                              self.node_id.hex())
+        except Exception:
+            pass
+
+    def _report_borrows(self, oid_hexes, worker_id_hex: str) -> None:
+        """Synchronous head report on the task-completion path (the
+        ordering guarantee the borrower protocol rests on). Retried: a
+        missed registration means the owner can free an object the worker
+        still holds, so failure here must be loud, never silent."""
+        key = f"{self.node_id.hex()}:{worker_id_hex}"
+        with self._borrow_lock:
+            self._worker_borrows.setdefault(
+                worker_id_hex, set()).update(oid_hexes)
+        last = None
+        for attempt in range(3):
+            try:
+                self._head.call("borrow_added", list(oid_hexes), key,
+                                timeout=10.0)
+                return
+            except Exception as e:
+                last = e
+                time.sleep(0.2 * (attempt + 1))
+        import logging
+
+        logging.getLogger("raytpu.cluster").error(
+            "borrow_added report failed for %s (borrower %s): %s — the "
+            "owner may free these objects while the worker still holds "
+            "them", [o[:8] for o in oid_hexes], key, last)
+
+    def _h_borrow_released(self, peer: Peer, oid_hex: str,
+                           worker_id_hex: str) -> None:
+        with self._borrow_lock:
+            held = self._worker_borrows.get(worker_id_hex)
+            if held is not None:
+                held.discard(oid_hex)
+        try:
+            self._head.notify("borrow_released", oid_hex,
+                              f"{self.node_id.hex()}:{worker_id_hex}")
+        except Exception:
+            pass
+
+    def _worker_gone(self, worker_id_hex: str) -> None:
+        """Pool callback on worker death/drop: its borrows are gone."""
+        def run():
+            with self._borrow_lock:
+                oids = self._worker_borrows.pop(worker_id_hex, set())
+            key = f"{self.node_id.hex()}:{worker_id_hex}"
+            for oh in oids:
+                try:
+                    self._head.notify("borrow_released", oh, key)
+                except Exception:
+                    pass
+        threading.Thread(target=run, daemon=True).start()
 
     def _h_register_worker(self, peer: Peer, worker_id_hex: str,
                            address: str, pid: int) -> bool:

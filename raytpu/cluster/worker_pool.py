@@ -108,6 +108,7 @@ class WorkerPool:
         self._workers: Dict[str, WorkerHandle] = {}  # worker_id hex -> handle
         self._idle: Dict[tuple, List[WorkerHandle]] = {}
         self._stopped = False
+        self.on_worker_gone = None  # cb(worker_id_hex); set by NodeServer
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="worker-pool-monitor", daemon=True)
         self._monitor.start()
@@ -279,6 +280,13 @@ class WorkerPool:
         idles = self._idle.get(h.key)
         if idles and h in idles:
             idles.remove(h)
+        # Borrow cleanup etc. — the callback must be cheap (it spawns its
+        # own thread for any RPC work; we hold the pool lock here).
+        if self.on_worker_gone is not None:
+            try:
+                self.on_worker_gone(h.worker_id.hex())
+            except Exception:
+                pass
 
     def _monitor_loop(self) -> None:
         while not self._stopped:

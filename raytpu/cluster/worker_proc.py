@@ -181,6 +181,40 @@ class _WorkerHost:
         self.worker = Worker(job_id, node_id, self.store)
         # Results the daemon pins; our local refcount must not free them.
         self.worker.pin_owned = True
+        # Borrower protocol (reference: reference_count.h borrowers): refs
+        # this worker reported as still-held at task completion. When the
+        # last local handle drops, tell the daemon so the owner's deferred
+        # free can fire. Releases are queued: the out-of-scope hook runs
+        # under ReferenceCounter._lock, and a socket write there would
+        # block every ObjectRef create/delete in the process.
+        import queue as _q
+
+        self._borrowed: set = set()
+        self._release_queue: "_q.Queue" = _q.Queue()
+        prev_oos = self.worker.reference_counter._on_out_of_scope
+
+        def _oos(oid):
+            if prev_oos is not None:
+                prev_oos(oid)
+            if oid in self._borrowed:
+                self._borrowed.discard(oid)
+                self._release_queue.put(oid)
+
+        self.worker.reference_counter._on_out_of_scope = _oos
+
+        def _release_loop():
+            while True:
+                oid = self._release_queue.get()
+                if oid is None:
+                    return
+                try:
+                    self.node.notify("borrow_released", oid.hex(),
+                                     self.worker_id_hex)
+                except Exception:
+                    pass
+
+        threading.Thread(target=_release_loop, name="borrow-release",
+                         daemon=True).start()
         self.actor_instance: Any = None
         self.actor_spec: Optional[TaskSpec] = None
         self._actor_loop: Optional[Any] = None  # asyncio loop for async actors
@@ -224,12 +258,54 @@ class _WorkerHost:
 
     # -- execution ---------------------------------------------------------
 
+    def collect_borrows(self, spec: TaskSpec) -> List[str]:
+        """Argument refs still referenced after the task returned — the
+        task (or actor state) kept a handle past its lifetime; the daemon
+        reports them to the head BEFORE result locations, so the owner's
+        free can never race the borrow (reference: borrows ride the
+        PushTaskReply in ``task_manager.cc``).
+
+        TOCTOU guard: another task thread may drop the last handle between
+        our count read and the _borrowed.add — the out-of-scope hook then
+        sees the oid absent and queues nothing. Re-checking the count
+        AFTER the add closes that window: either we see zero and retract,
+        or the hook sees the membership and queues the release (the head
+        tolerates a release beating its borrow via early-release
+        tombstones)."""
+        from raytpu.runtime.task_spec import ArgKind
+
+        rc = self.worker.reference_counter
+        out: List[str] = []
+        seen: set = set()
+        cands = [ObjectRef.from_binary(rb).id for rb in spec.inline_refs]
+        cands += [ObjectRef.from_binary(a.data).id for a in spec.args
+                  if a.kind == ArgKind.REF]
+        for oid in cands:
+            if oid in seen:
+                continue
+            seen.add(oid)
+            ref = rc.get(oid)
+            if ref is None or ref.local_ref_count <= 0 \
+                    or oid in self._borrowed:
+                continue
+            self._borrowed.add(oid)
+            ref = rc.get(oid)
+            if ref is None or ref.local_ref_count <= 0:
+                # Dropped mid-registration: retract unless the oos hook
+                # already consumed the membership (queued a release).
+                if oid in self._borrowed:
+                    self._borrowed.discard(oid)
+                    continue
+            out.append(oid.hex())
+        return out
+
     def execute_plain(self, spec: TaskSpec) -> dict:
         # store_errors=False: the daemon owns retry policy — it stores the
         # error into the return slots only once retries are exhausted.
         err = self.worker.execute_task(spec, self.get_serialized,
                                        store_errors=False)
         return {"results": self.collect_results(spec),
+                "borrows": self.collect_borrows(spec),
                 "error": None if err is None else _dump_err(spec.name, err)}
 
     def create_actor(self, spec: TaskSpec) -> dict:
@@ -253,6 +329,7 @@ class _WorkerHost:
             threading.Thread(target=self._actor_loop.run_forever,
                              name="actor-async-loop", daemon=True).start()
         return {"results": self.collect_results(spec),
+                "borrows": self.collect_borrows(spec),
                 "error": None if err is None else _dump_err(spec.name, err)}
 
     def execute_actor_task(self, spec: TaskSpec) -> dict:
@@ -275,6 +352,7 @@ class _WorkerHost:
             err = self.worker.execute_task(
                 spec, self.get_serialized, actor_instance=self.actor_instance)
         return {"results": self.collect_results(spec),
+                "borrows": self.collect_borrows(spec),
                 "error": None if err is None else _dump_err(spec.name, err)}
 
     async def actor_task_via_loop(self, spec: TaskSpec) -> dict:
@@ -293,6 +371,7 @@ class _WorkerHost:
             self._exec_async(spec), self._actor_loop)
         err = await asyncio.wrap_future(cf)
         return {"results": self.collect_results(spec),
+                "borrows": self.collect_borrows(spec),
                 "error": None if err is None else _dump_err(spec.name, err)}
 
     async def _exec_async(self, spec: TaskSpec) -> Optional[BaseException]:
