@@ -1,0 +1,164 @@
+"""Remote-driver proxy — the ``raytpu://`` endpoint.
+
+Reference analogue: Ray Client (``python/ray/util/client/server/``,
+``ray_client.proto``) — a driver outside the cluster network reaches ONE
+public endpoint instead of the head plus every node. Ours is a frame
+relay rather than a re-implementation of the API server: the driver's
+:class:`~raytpu.cluster.client.ClusterBackend` runs unchanged on the
+driver machine, but every RPC rides one proxy connection
+(``relay_call(target, method, args)``); the proxy fans cluster pubsub
+pushes back to each subscribed driver. Chunked object transfer works
+through the same relay because the data plane is plain ``fetch_object_*``
+calls (:mod:`raytpu.cluster.transfer`).
+
+Targets are restricted to the head and addresses the head reports as
+cluster nodes — the proxy is not an open TCP forwarder.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+
+
+class DriverProxy:
+    def __init__(self, head_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._head_address = head_address
+        self._rpc = RpcServer(host, port)
+        self._lock = threading.Lock()
+        self._targets: Dict[str, RpcClient] = {}
+        # (target, topic) -> driver peers to push to
+        self._subs: Dict[Tuple[str, str], List[Peer]] = {}
+        # target -> topics whose upstream subscription must be (re)wired —
+        # a reconnected upstream RpcClient starts with no subscriptions.
+        self._target_topics: Dict[str, Set[str]] = {}
+        self._allowed: Set[str] = {head_address}
+        self._rpc.register("proxy_info", self._proxy_info)
+        self._rpc.register("relay_call", self._relay_call)
+        self._rpc.register("relay_notify", self._relay_notify)
+        self._rpc.on_disconnect(self._peer_gone)
+        self.address: Optional[str] = None
+
+    def start(self) -> str:
+        self.address = self._rpc.start()
+        # Fail fast if the head is unreachable.
+        self._target(self._head_address).call("ping")
+        return self.address
+
+    def stop(self) -> None:
+        with self._lock:
+            clients = list(self._targets.values())
+            self._targets.clear()
+        for c in clients:
+            c.close()
+        self._rpc.stop()
+
+    # -- handlers ----------------------------------------------------------
+
+    def _proxy_info(self, peer: Peer) -> dict:
+        return {"head": self._head_address, "proxy": self.address}
+
+    def _check_target(self, target: str) -> None:
+        with self._lock:
+            if target in self._allowed:
+                return
+        # Unknown target: refresh from the head every time — a node that
+        # joined moments ago must be reachable immediately (the driver
+        # learns of it via pubsub and routes to it right away).
+        try:
+            nodes = self._target(self._head_address).call("list_nodes")
+            with self._lock:
+                self._allowed = {self._head_address} | {
+                    n["address"] for n in nodes if n.get("address")}
+        except Exception:
+            pass
+        with self._lock:
+            if target not in self._allowed:
+                raise PermissionError(
+                    f"proxy: {target!r} is not a cluster address")
+
+    def _target(self, address: str) -> RpcClient:
+        with self._lock:
+            c = self._targets.get(address)
+            fresh = c is None or c.closed
+            if fresh:
+                c = self._targets[address] = RpcClient(address)
+                topics = set(self._target_topics.get(address, ()))
+            else:
+                topics = ()
+        # A fresh upstream connection carries no server-side subscriber
+        # registration and no client-side callbacks: re-wire both for
+        # every topic the drivers depend on.
+        for topic in topics:
+            try:
+                c.subscribe(topic, self._make_fanout((address, topic)))
+                c.call("subscribe", topic)
+            except Exception:
+                pass
+        return c
+
+    def _make_fanout(self, key: Tuple[str, str]):
+        def fanout(data, _key=key):
+            with self._lock:
+                targets = [p for p in self._subs.get(_key, ())
+                           if not p.closed]
+            for p in targets:
+                p.push(_key[1], data)
+
+        return fanout
+
+    def _relay_call(self, peer: Peer, target: str, method: str, args: list):
+        self._check_target(target)
+        if method == "subscribe":
+            self._wire_subscription(peer, target, str(args[0]))
+        return self._target(target).call(method, *args, timeout=None)
+
+    def _relay_notify(self, peer: Peer, target: str, method: str,
+                      args: list) -> None:
+        self._check_target(target)
+        self._target(target).notify(method, *args)
+
+    def _wire_subscription(self, peer: Peer, target: str,
+                           topic: str) -> None:
+        key = (target, topic)
+        with self._lock:
+            first = key not in self._subs
+            peers = self._subs.setdefault(key, [])
+            if peer not in peers:
+                peers.append(peer)
+            self._target_topics.setdefault(target, set()).add(topic)
+        if first:
+            self._target(target).subscribe(topic, self._make_fanout(key))
+
+    def _peer_gone(self, peer: Peer) -> None:
+        with self._lock:
+            for peers in self._subs.values():
+                if peer in peers:
+                    peers.remove(peer)
+
+
+def main() -> None:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(description="raytpu remote-driver proxy")
+    ap.add_argument("--head", required=True, help="head host:port")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=10001)
+    args = ap.parse_args()
+    proxy = DriverProxy(args.head, args.host, args.port)
+    addr = proxy.start()
+    print(f"raytpu driver proxy at raytpu://{addr} -> head {args.head}",
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    proxy.stop()
+
+
+if __name__ == "__main__":
+    main()

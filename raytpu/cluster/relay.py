@@ -1,0 +1,67 @@
+"""Client side of the remote-driver proxy (``raytpu://`` addresses).
+
+Reference analogue: ``python/ray/util/client/worker.py`` — the driver
+speaks to one endpoint and the server fans out. Ours keeps the full
+:class:`~raytpu.cluster.client.ClusterBackend` on the driver and swaps
+the transport: every logical connection (head, per-node peers) becomes a
+:class:`RelayClient` multiplexed over ONE physical RpcClient to the
+:class:`~raytpu.cluster.driver_proxy.DriverProxy`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from raytpu.cluster.protocol import RpcClient
+
+
+class RelayChannel:
+    """One physical connection to the proxy, shared by all RelayClients."""
+
+    def __init__(self, proxy_address: str, timeout: float = 10.0):
+        self._rpc = RpcClient(proxy_address, timeout=timeout)
+        info = self._rpc.call("proxy_info")
+        self.head_address: str = info["head"]
+        self.proxy_address = proxy_address
+
+    def client_for(self, target: str) -> "RelayClient":
+        return RelayClient(self, target)
+
+    @property
+    def closed(self) -> bool:
+        return self._rpc.closed
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+class RelayClient:
+    """RpcClient-compatible view of one relayed target."""
+
+    def __init__(self, channel: RelayChannel, target: str):
+        self._chan = channel
+        self._target = target
+        self.address = target
+
+    def call(self, method: str, *args,
+             timeout: Optional[float] = 30.0) -> Any:
+        return self._chan._rpc.call("relay_call", self._target, method,
+                                    list(args), timeout=timeout)
+
+    def notify(self, method: str, *args) -> None:
+        self._chan._rpc.notify("relay_notify", self._target, method,
+                               list(args))
+
+    def subscribe(self, topic: str, cb: Callable[[Any], None]) -> None:
+        # Pushes arrive on the shared channel tagged with the topic name
+        # (the proxy subscribes upstream when it relays the "subscribe"
+        # call and fans pushes back).
+        self._chan._rpc.subscribe(topic, cb)
+
+    @property
+    def closed(self) -> bool:
+        return self._chan.closed
+
+    def close(self) -> None:
+        # The channel is shared; the backend closes it once at shutdown.
+        pass

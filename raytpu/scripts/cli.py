@@ -140,6 +140,20 @@ def _cmd_logs(args) -> int:
         head.close()
 
 
+def _cmd_proxy(args) -> int:
+    """Serve the remote-driver proxy (reference: the Ray Client server
+    behind ray:// addresses)."""
+    from raytpu.cluster.driver_proxy import DriverProxy
+
+    proxy = DriverProxy(args.head, args.host, args.port)
+    addr = proxy.start()
+    print(f"raytpu driver proxy at raytpu://{addr} -> head {args.head}",
+          flush=True)
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    proxy.stop()
+    return 0
+
+
 def _cmd_dashboard(args) -> int:
     """Serve the dashboard against a running cluster (reference:
     ``ray dashboard``; ours is the server-rendered v1)."""
@@ -235,6 +249,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True)
     s.add_argument("--no-block", dest="block", action="store_false")
     s.set_defaults(fn=_cmd_dashboard)
+
+    s = sub.add_parser("proxy", help="remote-driver proxy (raytpu://)")
+    s.add_argument("--head", required=True, help="head host:port")
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=10001)
+    s.set_defaults(fn=_cmd_proxy)
 
     s = sub.add_parser("job", help="job submission")
     s.add_argument("--api", default="http://127.0.0.1:8265",
