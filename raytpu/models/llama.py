@@ -1,0 +1,232 @@
+"""Llama-family decoder in Flax — the second flagship model family.
+
+Reference scope: the reference trains torch models through Train/DeepSpeed
+(e.g. ``doc/source/train/examples/deepspeed/gptj_deepspeed_fine_tuning
+.ipynb``) and serves llama-class models via user libs on Serve; the model
+itself is never in-tree. Here the family is first-class and TPU-first:
+RMSNorm + rotary embeddings + grouped-query attention + SwiGLU, bf16
+activations with fp32 logits math, flash attention
+(:mod:`raytpu.ops.flash_attention`), `lax.scan` over layers, selective
+rematerialization, and parameter names chosen to match
+``parallel.sharding.TRANSFORMER_RULES`` (q_proj/k_proj/v_proj column-
+parallel, o_proj/down_proj row-parallel, embed_tokens vocab-sharded), so
+``tree_shardings`` gives Megatron-style tp/fsdp layouts with no
+model-specific code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000          # multiple of 128 for MXU tiling
+    block_size: int = 2048
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: int = 4               # grouped-query attention
+    n_embd: int = 768
+    n_inter: int = 2048              # SwiGLU hidden (≈ 8/3 · n_embd)
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: Any = "dots"              # False/"none" | True/"full" | "dots"
+    scan_layers: bool = True
+    attn_impl: Optional[str] = None
+    loss_chunk: int = 0
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        return cls(vocab_size=512, block_size=128, n_layer=2, n_head=4,
+                   n_kv_head=2, n_embd=128, n_inter=352)
+
+    @classmethod
+    def small(cls) -> "LlamaConfig":  # ~125M, GPT-2-small class
+        return cls()
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls(vocab_size=32000, block_size=4096, n_layer=32,
+                   n_head=32, n_kv_head=32, n_embd=4096, n_inter=11008)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def n_params_approx(self) -> int:
+        c = self
+        attn = c.n_embd * (c.n_head + 2 * c.n_kv_head) * c.head_dim \
+            + c.n_head * c.head_dim * c.n_embd
+        mlp = 3 * c.n_embd * c.n_inter
+        return 2 * c.vocab_size * c.n_embd + c.n_layer * (attn + mlp)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        normed = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def rope_tables(head_dim: int, positions, theta: float):
+    """(cos, sin) tables for rotary embeddings, fp32, [T, head_dim/2]."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                        dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate pairs of channels; x is [B, H, T, D]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[None, None, :, :].astype(x.dtype)
+    sin = sin[None, None, :, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        b, t, e = x.shape
+        h, kv, d = c.n_head, c.n_kv_head, c.head_dim
+        q = nn.Dense(h * d, use_bias=False, dtype=c.dtype,
+                     name="q_proj")(x)
+        k = nn.Dense(kv * d, use_bias=False, dtype=c.dtype,
+                     name="k_proj")(x)
+        v = nn.Dense(kv * d, use_bias=False, dtype=c.dtype,
+                     name="v_proj")(x)
+        q = q.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, kv, d).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, kv, d).transpose(0, 2, 1, 3)
+        cos, sin = rope_tables(d, jnp.arange(t), c.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if kv != h:
+            # GQA: each kv head serves n_head/n_kv_head query heads.
+            rep = h // kv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        from raytpu.ops.flash_attention import flash_attention
+
+        y = flash_attention(q, k, v, causal=True, force=c.attn_impl)
+        y = y.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+        return nn.Dense(e, use_bias=False, dtype=c.dtype, name="o_proj")(y)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        gate = nn.Dense(c.n_inter, use_bias=False, dtype=c.dtype,
+                        name="gate_proj")(x)
+        up = nn.Dense(c.n_inter, use_bias=False, dtype=c.dtype,
+                      name="up_proj")(x)
+        return nn.Dense(c.n_embd, use_bias=False, dtype=c.dtype,
+                        name="down_proj")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        x = x + LlamaAttention(c, name="attn")(
+            RMSNorm(dtype=c.dtype, name="input_norm")(x))
+        x = x + LlamaMLP(c, name="mlp")(
+            RMSNorm(dtype=c.dtype, name="post_attn_norm")(x))
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, return_hidden: bool = False):
+        c = self.config
+        x = nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype,
+                     name="embed_tokens")(tokens)
+        block = LlamaBlock
+        if c.remat and c.remat != "none":
+            policy = None
+            if c.remat == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block = nn.remat(LlamaBlock, prevent_cse=False, policy=policy)
+        if c.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=c.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(c, name="layers"), x, None)
+        else:
+            for i in range(c.n_layer):
+                x = block(c, name=f"layers_{i}")(x)
+        x = RMSNorm(dtype=c.dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
+        # Untied LM head (llama-style), bf16 matmul with fp32 accumulation.
+        logits = nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def llama_loss_fn(model: Llama, params, tokens):
+    """Next-token cross-entropy; same chunked flash-xent option as GPT-2
+    (:func:`raytpu.models.gpt2._chunked_xent` — the LM-head weight is the
+    untied ``lm_head`` kernel here)."""
+    c = model.config
+    targets = tokens[:, 1:]
+    if c.loss_chunk:
+        from raytpu.models.gpt2 import _chunked_xent
+
+        x = model.apply({"params": params}, tokens, return_hidden=True)
+        # lm_head kernel is [embed, vocab]; chunked xent expects
+        # [vocab, embed] (embedding-style), so pass the transpose.
+        w = params["lm_head"]["kernel"].T
+        return _chunked_xent(x[:, :-1], targets, w, c)
+    logits = model.apply({"params": params}, tokens)[:, :-1]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (lse - label).mean()
+
+
+def make_train_step(model: Llama, optimizer):
+    """(params, opt_state, tokens) -> (params, opt_state, loss); pure —
+    jit with shardings from :func:`raytpu.parallel.sharding.tree_shardings`
+    (param names already match TRANSFORMER_RULES)."""
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama_loss_fn(model, p, tokens))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_params(model: Llama, config: LlamaConfig, seed: int = 0,
+                batch: int = 2):
+    tokens = jnp.zeros((batch, config.block_size), jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), tokens)["params"]
