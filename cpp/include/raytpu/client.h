@@ -1,0 +1,60 @@
+// Native C++ client for the raytpu control plane.
+//
+// Reference analogue: the C++ worker API (`cpp/include/ray/api.h`,
+// `cpp/src/ray/runtime/native_ray_runtime.cc`) — a first-class non-Python
+// citizen of the cluster. TPU-first scope note: the compute plane is
+// XLA/Python, so this client targets the *control* plane — cluster
+// state, the KV store, placement-group info, named-actor resolution —
+// speaking the same versioned msgpack wire protocol as every Python
+// process (raytpu/cluster/wire.py), with no pickle (strict peer).
+//
+// Usage:
+//   raytpu::Client c("127.0.0.1", 6379);
+//   c.Ping();
+//   c.KvPut("key", "value");
+//   auto nodes = c.ListNodes();      // wire Value tree
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "raytpu/wire.h"
+
+namespace raytpu {
+
+class Client {
+ public:
+  Client(const std::string& host, int port);
+  ~Client();
+
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Generic RPC: {"m": method, "a": args, "i": id} -> reply["r"].
+  // Throws std::runtime_error on transport errors or remote exceptions.
+  ValuePtr Call(const std::string& method, std::vector<ValuePtr> args = {});
+
+  // Typed conveniences over the head's handler table (cluster/head.py).
+  bool Ping();
+  void KvPut(const std::string& key, const std::string& value,
+             bool overwrite = true);
+  // Returns false when the key is absent.
+  bool KvGet(const std::string& key, std::string* value);
+  void KvDel(const std::string& key);
+  std::vector<std::string> KvKeys(const std::string& prefix);
+  ValuePtr ListNodes();
+  // Named-actor resolution (nullptr Value -> not found).
+  ValuePtr ResolveNamedActor(const std::string& name,
+                             const std::string& ns = "default");
+
+ private:
+  std::string ReadFrame();
+  void WriteFrame(const std::string& body);
+
+  int fd_ = -1;
+  int64_t next_id_ = 1;
+};
+
+}  // namespace raytpu

@@ -1,0 +1,61 @@
+// Minimal msgpack codec for the raytpu control-plane wire protocol.
+//
+// Reference analogue: the C++ worker API (`cpp/include/ray/api.h`) links
+// the full CoreWorker; ours speaks the versioned wire protocol of
+// raytpu/cluster/wire.py directly: every frame is
+//   4-byte LE length | 1-byte wire version | msgpack body
+// This codec covers the subset control messages use: nil, bool, int,
+// float64, str, bin, array, map, and ext 2 (tuple — decoded as array).
+// Pickle extensions (ext 5) are rejected: the C++ client is a strict
+// peer by construction.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace raytpu {
+
+constexpr uint8_t kWireVersion = 1;
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum Type { kNil, kBool, kInt, kFloat, kStr, kBin, kArray, kMap };
+  Type type = kNil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                       // str and bin payloads
+  std::vector<ValuePtr> arr;
+  std::vector<std::pair<ValuePtr, ValuePtr>> map;
+
+  static ValuePtr Nil();
+  static ValuePtr Bool(bool v);
+  static ValuePtr Int(int64_t v);
+  static ValuePtr Float(double v);
+  static ValuePtr Str(const std::string& v);
+  static ValuePtr Bin(const std::string& v);
+  static ValuePtr Array(std::vector<ValuePtr> items);
+  static ValuePtr MapV(std::vector<std::pair<ValuePtr, ValuePtr>> items);
+
+  // Map convenience: value for a string key, or nullptr.
+  ValuePtr Get(const std::string& key) const;
+  std::string Repr() const;  // debugging aid
+};
+
+// Encode one value as msgpack bytes.
+std::string Pack(const ValuePtr& v);
+// Decode msgpack bytes; throws std::runtime_error on malformed/pickle/
+// unknown-ext input. `pos` advances past the decoded value.
+ValuePtr Unpack(const std::string& buf, size_t* pos);
+
+// Frame = version byte + body.
+std::string PackFrame(const ValuePtr& v);
+ValuePtr UnpackFrame(const std::string& frame);
+
+}  // namespace raytpu

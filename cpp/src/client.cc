@@ -1,0 +1,147 @@
+#include "raytpu/client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+
+namespace raytpu {
+
+namespace {
+
+// Frames are 4-byte LITTLE-endian length prefixed (cluster/protocol.py
+// struct "<I"), unlike msgpack's big-endian internals.
+std::string PackLen(uint32_t n) {
+  std::string out(4, '\0');
+  out[0] = static_cast<char>(n & 0xff);
+  out[1] = static_cast<char>((n >> 8) & 0xff);
+  out[2] = static_cast<char>((n >> 16) & 0xff);
+  out[3] = static_cast<char>((n >> 24) & 0xff);
+  return out;
+}
+
+void ReadExact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, buf + got, n - got);
+    if (r <= 0) throw std::runtime_error("raytpu client: connection lost");
+    got += static_cast<size_t>(r);
+  }
+}
+
+void WriteAll(int fd, const std::string& data) {
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t w = ::write(fd, data.data() + sent, data.size() - sent);
+    if (w <= 0) throw std::runtime_error("raytpu client: write failed");
+    sent += static_cast<size_t>(w);
+  }
+}
+
+}  // namespace
+
+Client::Client(const std::string& host, int port) {
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                  &res) != 0 || res == nullptr) {
+    throw std::runtime_error("raytpu client: cannot resolve " + host);
+  }
+  fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd_ >= 0) ::close(fd_);
+    throw std::runtime_error("raytpu client: cannot connect to " + host +
+                             ":" + std::to_string(port));
+  }
+  freeaddrinfo(res);
+}
+
+Client::~Client() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+std::string Client::ReadFrame() {
+  char hdr[4];
+  ReadExact(fd_, hdr, 4);
+  uint32_t n = static_cast<uint8_t>(hdr[0]) |
+               (static_cast<uint8_t>(hdr[1]) << 8) |
+               (static_cast<uint8_t>(hdr[2]) << 16) |
+               (static_cast<uint8_t>(hdr[3]) << 24);
+  std::string body(n, '\0');
+  ReadExact(fd_, body.data(), n);
+  return body;
+}
+
+void Client::WriteFrame(const std::string& body) {
+  WriteAll(fd_, PackLen(static_cast<uint32_t>(body.size())) + body);
+}
+
+ValuePtr Client::Call(const std::string& method,
+                      std::vector<ValuePtr> args) {
+  int64_t id = next_id_++;
+  auto frame = Value::MapV({
+      {Value::Str("m"), Value::Str(method)},
+      {Value::Str("a"), Value::Array(std::move(args))},
+      {Value::Str("i"), Value::Int(id)},
+  });
+  WriteFrame(PackFrame(frame));
+  // Synchronous client: drain frames until our reply id shows up
+  // (pubsub pushes carry a "p" key and are skipped).
+  while (true) {
+    auto reply = UnpackFrame(ReadFrame());
+    if (reply->Get("p") != nullptr) continue;
+    auto rid = reply->Get("i");
+    if (rid == nullptr || rid->i != id) continue;
+    auto err = reply->Get("e");
+    if (err != nullptr && err->type != Value::kNil) {
+      throw std::runtime_error("raytpu remote error: " + err->Repr());
+    }
+    auto r = reply->Get("r");
+    return r != nullptr ? r : Value::Nil();
+  }
+}
+
+bool Client::Ping() {
+  auto r = Call("ping");
+  return r->type == Value::kStr && r->s == "pong";
+}
+
+void Client::KvPut(const std::string& key, const std::string& value,
+                   bool overwrite) {
+  Call("kv_put", {Value::Str(key), Value::Bin(value),
+                  Value::Bool(overwrite)});
+}
+
+bool Client::KvGet(const std::string& key, std::string* value) {
+  auto r = Call("kv_get", {Value::Str(key)});
+  if (r->type == Value::kNil) return false;
+  *value = r->s;
+  return true;
+}
+
+void Client::KvDel(const std::string& key) {
+  Call("kv_del", {Value::Str(key)});
+}
+
+std::vector<std::string> Client::KvKeys(const std::string& prefix) {
+  auto r = Call("kv_keys", {Value::Str(prefix)});
+  std::vector<std::string> out;
+  for (const auto& v : r->arr) out.push_back(v->s);
+  return out;
+}
+
+ValuePtr Client::ListNodes() { return Call("list_nodes"); }
+
+ValuePtr Client::ResolveNamedActor(const std::string& name,
+                                   const std::string& ns) {
+  return Call("resolve_named_actor", {Value::Str(name), Value::Str(ns)});
+}
+
+}  // namespace raytpu

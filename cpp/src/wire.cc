@@ -1,0 +1,313 @@
+#include "raytpu/wire.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace raytpu {
+
+namespace {
+
+void PutBE(std::string* out, uint64_t v, int bytes) {
+  for (int i = bytes - 1; i >= 0; i--) {
+    out->push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+  }
+}
+
+uint64_t GetBE(const std::string& buf, size_t* pos, int bytes) {
+  if (*pos + bytes > buf.size()) throw std::runtime_error("wire: short read");
+  uint64_t v = 0;
+  for (int i = 0; i < bytes; i++) {
+    v = (v << 8) | static_cast<uint8_t>(buf[(*pos)++]);
+  }
+  return v;
+}
+
+}  // namespace
+
+ValuePtr Value::Nil() { return std::make_shared<Value>(); }
+ValuePtr Value::Bool(bool v) {
+  auto p = std::make_shared<Value>();
+  p->type = kBool;
+  p->b = v;
+  return p;
+}
+ValuePtr Value::Int(int64_t v) {
+  auto p = std::make_shared<Value>();
+  p->type = kInt;
+  p->i = v;
+  return p;
+}
+ValuePtr Value::Float(double v) {
+  auto p = std::make_shared<Value>();
+  p->type = kFloat;
+  p->f = v;
+  return p;
+}
+ValuePtr Value::Str(const std::string& v) {
+  auto p = std::make_shared<Value>();
+  p->type = kStr;
+  p->s = v;
+  return p;
+}
+ValuePtr Value::Bin(const std::string& v) {
+  auto p = std::make_shared<Value>();
+  p->type = kBin;
+  p->s = v;
+  return p;
+}
+ValuePtr Value::Array(std::vector<ValuePtr> items) {
+  auto p = std::make_shared<Value>();
+  p->type = kArray;
+  p->arr = std::move(items);
+  return p;
+}
+ValuePtr Value::MapV(std::vector<std::pair<ValuePtr, ValuePtr>> items) {
+  auto p = std::make_shared<Value>();
+  p->type = kMap;
+  p->map = std::move(items);
+  return p;
+}
+
+ValuePtr Value::Get(const std::string& key) const {
+  for (const auto& kv : map) {
+    if (kv.first && kv.first->type == kStr && kv.first->s == key) {
+      return kv.second;
+    }
+  }
+  return nullptr;
+}
+
+std::string Value::Repr() const {
+  switch (type) {
+    case kNil: return "nil";
+    case kBool: return b ? "true" : "false";
+    case kInt: return std::to_string(i);
+    case kFloat: return std::to_string(f);
+    case kStr: return "\"" + s + "\"";
+    case kBin: return "<bin:" + std::to_string(s.size()) + ">";
+    case kArray: {
+      std::string out = "[";
+      for (const auto& v : arr) out += v->Repr() + ",";
+      return out + "]";
+    }
+    case kMap: {
+      std::string out = "{";
+      for (const auto& kv : map)
+        out += kv.first->Repr() + ":" + kv.second->Repr() + ",";
+      return out + "}";
+    }
+  }
+  return "?";
+}
+
+std::string Pack(const ValuePtr& v) {
+  std::string out;
+  struct Rec {
+    static void Go(const ValuePtr& v, std::string* out) {
+      switch (v->type) {
+        case Value::kNil:
+          out->push_back(static_cast<char>(0xc0));
+          break;
+        case Value::kBool:
+          out->push_back(static_cast<char>(v->b ? 0xc3 : 0xc2));
+          break;
+        case Value::kInt: {
+          int64_t n = v->i;
+          if (n >= 0 && n < 128) {
+            out->push_back(static_cast<char>(n));
+          } else if (n < 0 && n >= -32) {
+            out->push_back(static_cast<char>(0xe0 | (n + 32)));
+          } else {
+            out->push_back(static_cast<char>(0xd3));  // int64
+            PutBE(out, static_cast<uint64_t>(n), 8);
+          }
+          break;
+        }
+        case Value::kFloat: {
+          out->push_back(static_cast<char>(0xcb));
+          uint64_t bits;
+          std::memcpy(&bits, &v->f, 8);
+          PutBE(out, bits, 8);
+          break;
+        }
+        case Value::kStr: {
+          size_t n = v->s.size();
+          if (n < 32) {
+            out->push_back(static_cast<char>(0xa0 | n));
+          } else if (n < 256) {
+            out->push_back(static_cast<char>(0xd9));
+            PutBE(out, n, 1);
+          } else {
+            out->push_back(static_cast<char>(0xda));
+            PutBE(out, n, 2);
+          }
+          out->append(v->s);
+          break;
+        }
+        case Value::kBin: {
+          size_t n = v->s.size();
+          if (n < 256) {
+            out->push_back(static_cast<char>(0xc4));
+            PutBE(out, n, 1);
+          } else if (n < 65536) {
+            out->push_back(static_cast<char>(0xc5));
+            PutBE(out, n, 2);
+          } else {
+            out->push_back(static_cast<char>(0xc6));
+            PutBE(out, n, 4);
+          }
+          out->append(v->s);
+          break;
+        }
+        case Value::kArray: {
+          size_t n = v->arr.size();
+          if (n < 16) {
+            out->push_back(static_cast<char>(0x90 | n));
+          } else {
+            out->push_back(static_cast<char>(0xdc));
+            PutBE(out, n, 2);
+          }
+          for (const auto& item : v->arr) Go(item, out);
+          break;
+        }
+        case Value::kMap: {
+          size_t n = v->map.size();
+          if (n < 16) {
+            out->push_back(static_cast<char>(0x80 | n));
+          } else {
+            out->push_back(static_cast<char>(0xde));
+            PutBE(out, n, 2);
+          }
+          for (const auto& kv : v->map) {
+            Go(kv.first, out);
+            Go(kv.second, out);
+          }
+          break;
+        }
+      }
+    }
+  };
+  Rec::Go(v, &out);
+  return out;
+}
+
+ValuePtr Unpack(const std::string& buf, size_t* pos) {
+  if (*pos >= buf.size()) throw std::runtime_error("wire: empty");
+  uint8_t tag = static_cast<uint8_t>(buf[(*pos)++]);
+
+  auto take = [&](size_t n) {
+    if (*pos + n > buf.size()) throw std::runtime_error("wire: short read");
+    std::string s = buf.substr(*pos, n);
+    *pos += n;
+    return s;
+  };
+  auto array_of = [&](size_t n) {
+    std::vector<ValuePtr> items;
+    items.reserve(n);
+    for (size_t i = 0; i < n; i++) items.push_back(Unpack(buf, pos));
+    return Value::Array(std::move(items));
+  };
+  auto map_of = [&](size_t n) {
+    std::vector<std::pair<ValuePtr, ValuePtr>> items;
+    items.reserve(n);
+    for (size_t i = 0; i < n; i++) {
+      auto k = Unpack(buf, pos);
+      auto v = Unpack(buf, pos);
+      items.emplace_back(std::move(k), std::move(v));
+    }
+    return Value::MapV(std::move(items));
+  };
+  auto ext_of = [&](size_t n) -> ValuePtr {
+    if (n < 1) throw std::runtime_error("wire: empty ext");
+    uint8_t code = static_cast<uint8_t>(buf[(*pos)++]);
+    std::string body = take(n - 1);
+    if (code == 2) {  // tuple: nested msgpack array
+      size_t p = 0;
+      return Unpack(body, &p);
+    }
+    if (code == 6) {  // set: nested msgpack array (decoded as array)
+      size_t p = 0;
+      return Unpack(body, &p);
+    }
+    if (code == 5) {
+      throw std::runtime_error(
+          "wire: peer sent a pickle frame; the C++ client is a strict peer");
+    }
+    throw std::runtime_error("wire: unsupported extension " +
+                             std::to_string(code));
+  };
+
+  if (tag < 0x80) return Value::Int(tag);                       // posfixint
+  if (tag >= 0xe0) return Value::Int(static_cast<int8_t>(tag)); // negfixint
+  if ((tag & 0xf0) == 0x90) return array_of(tag & 0x0f);        // fixarray
+  if ((tag & 0xf0) == 0x80) return map_of(tag & 0x0f);          // fixmap
+  if ((tag & 0xe0) == 0xa0) return Value::Str(take(tag & 0x1f));  // fixstr
+
+  switch (tag) {
+    case 0xc0: return Value::Nil();
+    case 0xc2: return Value::Bool(false);
+    case 0xc3: return Value::Bool(true);
+    case 0xc4: return Value::Bin(take(GetBE(buf, pos, 1)));
+    case 0xc5: return Value::Bin(take(GetBE(buf, pos, 2)));
+    case 0xc6: return Value::Bin(take(GetBE(buf, pos, 4)));
+    case 0xca: {
+      uint32_t bits = static_cast<uint32_t>(GetBE(buf, pos, 4));
+      float f;
+      std::memcpy(&f, &bits, 4);
+      return Value::Float(f);
+    }
+    case 0xcb: {
+      uint64_t bits = GetBE(buf, pos, 8);
+      double f;
+      std::memcpy(&f, &bits, 8);
+      return Value::Float(f);
+    }
+    case 0xcc: return Value::Int(static_cast<int64_t>(GetBE(buf, pos, 1)));
+    case 0xcd: return Value::Int(static_cast<int64_t>(GetBE(buf, pos, 2)));
+    case 0xce: return Value::Int(static_cast<int64_t>(GetBE(buf, pos, 4)));
+    case 0xcf: return Value::Int(static_cast<int64_t>(GetBE(buf, pos, 8)));
+    case 0xd0: return Value::Int(static_cast<int8_t>(GetBE(buf, pos, 1)));
+    case 0xd1: return Value::Int(static_cast<int16_t>(GetBE(buf, pos, 2)));
+    case 0xd2: return Value::Int(static_cast<int32_t>(GetBE(buf, pos, 4)));
+    case 0xd3: return Value::Int(static_cast<int64_t>(GetBE(buf, pos, 8)));
+    case 0xd9: return Value::Str(take(GetBE(buf, pos, 1)));
+    case 0xda: return Value::Str(take(GetBE(buf, pos, 2)));
+    case 0xdb: return Value::Str(take(GetBE(buf, pos, 4)));
+    case 0xdc: return array_of(GetBE(buf, pos, 2));
+    case 0xdd: return array_of(GetBE(buf, pos, 4));
+    case 0xde: return map_of(GetBE(buf, pos, 2));
+    case 0xdf: return map_of(GetBE(buf, pos, 4));
+    // ext formats: fixext 1/2/4/8/16, ext8/16/32
+    case 0xd4: return ext_of(2);
+    case 0xd5: return ext_of(3);
+    case 0xd6: return ext_of(5);
+    case 0xd7: return ext_of(9);
+    case 0xd8: return ext_of(17);
+    case 0xc7: return ext_of(GetBE(buf, pos, 1) + 1);
+    case 0xc8: return ext_of(GetBE(buf, pos, 2) + 1);
+    case 0xc9: return ext_of(GetBE(buf, pos, 4) + 1);
+  }
+  throw std::runtime_error("wire: unsupported msgpack tag " +
+                           std::to_string(tag));
+}
+
+std::string PackFrame(const ValuePtr& v) {
+  std::string out;
+  out.push_back(static_cast<char>(kWireVersion));
+  out += Pack(v);
+  return out;
+}
+
+ValuePtr UnpackFrame(const std::string& frame) {
+  if (frame.empty()) throw std::runtime_error("wire: empty frame");
+  uint8_t ver = static_cast<uint8_t>(frame[0]);
+  if (ver != kWireVersion) {
+    throw std::runtime_error("wire: peer speaks version " +
+                             std::to_string(ver) + ", this client speaks " +
+                             std::to_string(kWireVersion));
+  }
+  size_t pos = 1;
+  return Unpack(frame, &pos);
+}
+
+}  // namespace raytpu
