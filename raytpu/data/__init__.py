@@ -1,7 +1,7 @@
 """raytpu.data — lazy streaming datasets (reference: ``python/ray/data/``)."""
 
 from raytpu.data.block import Block, BlockAccessor
-from raytpu.data.dataset import DataIterator, Dataset
+from raytpu.data.dataset import DataIterator, Dataset, GroupedData
 from raytpu.data.executor import ActorPoolStrategy
 from raytpu.data.read_api import (
     from_arrow,
@@ -20,6 +20,7 @@ from raytpu.data.read_api import (
 __all__ = [
     "Dataset",
     "DataIterator",
+    "GroupedData",
     "ActorPoolStrategy",
     "Block",
     "BlockAccessor",
@@ -35,3 +36,7 @@ __all__ = [
     "read_json",
     "read_text",
 ]
+
+from raytpu.util import usage_stats as _usage_stats
+
+_usage_stats.record_library_usage("data")

@@ -52,3 +52,7 @@ __all__ = [
     "EnvRunnerGroup", "SingleAgentEnvRunner", "register_env", "make_env",
     "CartPoleEnv", "PendulumEnv", "CatchEnv", "ReplayBuffer",
 ]
+
+from raytpu.util import usage_stats as _usage_stats
+
+_usage_stats.record_library_usage("rllib")

@@ -73,6 +73,10 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             _backend = ClusterBackend(address, job_id)
             _worker = _backend.worker
         atexit.register(_shutdown_quiet)
+        from raytpu.util import usage_stats
+
+        usage_stats.record_library_usage(
+            "core_local" if address in (None, "local") else "core_cluster")
         return _backend
 
 
@@ -93,6 +97,9 @@ def shutdown():
         finally:
             _backend = None
             _worker = None
+            from raytpu.util import usage_stats
+
+            usage_stats.report()
 
 
 def is_initialized() -> bool:

@@ -13,11 +13,15 @@ cache. Ours has three plugins:
   ``working_dir.py`` + URI cache).
 - ``py_modules``: list of directories handled like working_dir.
 
+- ``pip``: a cached venv per spec, offline-first (``--no-index`` +
+  ``find_links`` wheel dirs; see :mod:`raytpu.runtime_env.pip_env`);
+  its site-packages is path-injected like ``py_modules``.
+
 Isolation note: the reference dedicates worker PROCESSES per runtime env;
 our local fabric runs tasks in threads, so ``env_vars`` are process-global
 while held — concurrent tasks with conflicting values of the same key are
-flagged with a warning rather than isolated. ``pip``/``conda`` are
-rejected explicitly (no installs in this environment) rather than
+flagged with a warning rather than isolated. ``conda``/``container`` are
+rejected explicitly (no such tooling in this environment) rather than
 silently ignored.
 """
 
@@ -44,8 +48,8 @@ _env_refs: Dict[str, List] = {}
 _path_refs: Dict[str, int] = {}
 _uri_cache: Dict[str, str] = {}  # uri -> extracted path
 
-SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules"}
-REJECTED_KEYS = {"pip", "conda", "container"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
+REJECTED_KEYS = {"conda", "container"}
 
 
 def validate(runtime_env: Optional[dict]) -> None:
@@ -60,6 +64,12 @@ def validate(runtime_env: Optional[dict]) -> None:
     unknown = set(runtime_env) - SUPPORTED_KEYS
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+    if "pip" in runtime_env:
+        from raytpu.runtime_env.pip_env import normalize_spec
+
+        # Shape check only: the RAYTPU_ALLOW_PIP policy gate belongs to
+        # the node where the env materializes, not the submitting driver.
+        normalize_spec(runtime_env["pip"], check_gate=False)
 
 
 def package_dir(path: str) -> str:
@@ -136,6 +146,14 @@ class RuntimeEnvContext:
 
     def __enter__(self) -> "RuntimeEnvContext":
         env_vars = self.env.get("env_vars") or {}
+        # Materialize slow resources BEFORE taking the module lock: a pip
+        # venv install can run for minutes and must not serialize every
+        # other task's env entry (pip_env has its own locking).
+        pip_site = None
+        if self.env.get("pip"):
+            from raytpu.runtime_env.pip_env import ensure_pip_env
+
+            pip_site = ensure_pip_env(self.env["pip"])
         with _lock:
             try:
                 for k, v in env_vars.items():
@@ -161,17 +179,22 @@ class RuntimeEnvContext:
                         target = (ensure_uri(item)
                                   if item.startswith("zip://")
                                   else os.path.abspath(item))
-                        refs = _path_refs.get(target, 0)
-                        if refs == 0:
-                            sys.path.insert(0, target)
-                        _path_refs[target] = refs + 1
-                        self._path_entries.append(target)
+                        self._add_path(target)
+                if pip_site is not None:
+                    self._add_path(pip_site)
             except BaseException:
                 # Half-entered env must be fully rolled back or the leaked
                 # vars/paths pollute every later task in this process.
                 self._release_locked()
                 raise
         return self
+
+    def _add_path(self, target: str) -> None:
+        refs = _path_refs.get(target, 0)
+        if refs == 0:
+            sys.path.insert(0, target)
+        _path_refs[target] = refs + 1
+        self._path_entries.append(target)
 
     def __exit__(self, *exc) -> bool:
         with _lock:

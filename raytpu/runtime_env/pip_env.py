@@ -1,0 +1,126 @@
+"""pip runtime environments — offline-first venv materialization.
+
+Reference analogue: ``python/ray/_private/runtime_env/pip.py`` — a cached
+virtualenv per pip spec, created on demand by the runtime-env agent and
+activated for the worker. TPU-deployment redesign: this image is
+zero-egress, so the default mode is **offline** (`--no-index` with local
+``find_links`` wheel dirs); an index-backed install must be explicitly
+enabled with ``RAYTPU_ALLOW_PIP=1`` on the node. The venv is created with
+``--system-site-packages`` so the baked-in jax/flax stack stays visible,
+and the env's site-packages dir is path-injected like ``py_modules``
+(same interpreter, so compiled wheels work too).
+
+Spec forms (mirroring the reference's):
+  ``{"pip": ["pkg", ...]}``                          — offline install
+  ``{"pip": {"packages": [...], "find_links": [...],
+             "no_index": bool}}``
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, List, Union
+
+from raytpu.core.errors import RuntimeEnvError
+
+_ENVS_ROOT = os.path.join(os.path.expanduser("~/.raytpu"), "pip_envs")
+_lock = threading.Lock()
+_ready: Dict[str, str] = {}  # env hash -> site-packages path
+
+
+def normalize_spec(spec: Union[List[str], Dict[str, Any]],
+                   check_gate: bool = True) -> Dict[str, Any]:
+    """``check_gate=False`` is the submission-time (driver-side) shape
+    check: RAYTPU_ALLOW_PIP is a per-NODE policy, enforced where the env
+    actually materializes; find_links stay relative on the driver too."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict) or not spec.get("packages"):
+        raise RuntimeEnvError(
+            "pip runtime_env must be a list of requirements or a dict "
+            "with a 'packages' list")
+    out = {
+        "packages": [str(p) for p in spec["packages"]],
+        "find_links": ([os.path.abspath(p)
+                        for p in spec.get("find_links", [])]
+                       if check_gate
+                       else [str(p) for p in spec.get("find_links", [])]),
+        "no_index": bool(spec.get("no_index", True)),
+    }
+    if check_gate and not out["no_index"] \
+            and os.environ.get("RAYTPU_ALLOW_PIP") != "1":
+        raise RuntimeEnvError(
+            "index-backed pip installs are disabled on this node "
+            "(zero-egress deployment); ship wheels via find_links, or set "
+            "RAYTPU_ALLOW_PIP=1 to enable the index")
+    return out
+
+
+def _env_hash(spec: Dict[str, Any]) -> str:
+    return hashlib.sha1(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _site_packages(env_dir: str) -> str:
+    vi = sys.version_info
+    return os.path.join(env_dir, "lib",
+                        f"python{vi.major}.{vi.minor}", "site-packages")
+
+
+def ensure_pip_env(spec: Union[List[str], Dict[str, Any]]) -> str:
+    """Materialize (or reuse) the venv for ``spec``; returns its
+    site-packages dir. Raises RuntimeEnvError with the pip output tail on
+    failure (reference: pip.py surfacing the install log)."""
+    spec = normalize_spec(spec)
+    key = _env_hash(spec)
+    with _lock:
+        cached = _ready.get(key)
+        if cached and os.path.isdir(cached):
+            return cached
+    env_dir = os.path.join(_ENVS_ROOT, key)
+    site = _site_packages(env_dir)
+    marker = os.path.join(env_dir, ".raytpu_ready")
+    os.makedirs(_ENVS_ROOT, exist_ok=True)
+    # Cross-PROCESS exclusion: multiple worker processes on one node may
+    # materialize the same env concurrently; without the flock one would
+    # rmtree the dir another is mid-install into.
+    import fcntl
+
+    with open(os.path.join(_ENVS_ROOT, key + ".lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(marker):
+                shutil.rmtree(env_dir, ignore_errors=True)
+                r = subprocess.run(
+                    [sys.executable, "-m", "venv", "--system-site-packages",
+                     env_dir], capture_output=True, text=True)
+                if r.returncode != 0:
+                    raise RuntimeEnvError(
+                        f"venv creation failed: {r.stderr[-500:]}")
+                cmd = [os.path.join(env_dir, "bin", "python"), "-m", "pip",
+                       "install", "--disable-pip-version-check",
+                       "--no-warn-script-location"]
+                if spec["no_index"]:
+                    cmd.append("--no-index")
+                for link in spec["find_links"]:
+                    cmd += ["--find-links", link]
+                cmd += spec["packages"]
+                r = subprocess.run(cmd, capture_output=True, text=True)
+                if r.returncode != 0:
+                    shutil.rmtree(env_dir, ignore_errors=True)
+                    raise RuntimeEnvError(
+                        f"pip install failed for {spec['packages']}: "
+                        f"{(r.stderr or r.stdout)[-800:]}")
+                with open(marker, "w") as f:
+                    f.write(json.dumps(spec))
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    with _lock:
+        _ready[key] = site
+    return site

@@ -31,3 +31,7 @@ __all__ = [
     "run",
     "ingress", "shutdown", "start", "status",
 ]
+
+from raytpu.util import usage_stats as _usage_stats
+
+_usage_stats.record_library_usage("serve")

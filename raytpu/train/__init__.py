@@ -39,3 +39,7 @@ __all__ = [
     "get_checkpoint",
     "get_dataset_shard",
 ]
+
+from raytpu.util import usage_stats as _usage_stats
+
+_usage_stats.record_library_usage("train")

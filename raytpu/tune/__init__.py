@@ -42,3 +42,7 @@ __all__ = [
     "TPESearcher",
     "PopulationBasedTraining",
 ]
+
+from raytpu.util import usage_stats as _usage_stats
+
+_usage_stats.record_library_usage("tune")

@@ -18,3 +18,7 @@ __all__ = [
     "WorkflowStorage", "delete", "get_output", "get_status", "init",
     "list_all", "list_steps", "resume", "resume_all", "run", "run_async",
 ]
+
+from raytpu.util import usage_stats as _usage_stats
+
+_usage_stats.record_library_usage("workflow")

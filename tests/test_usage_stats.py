@@ -1,0 +1,46 @@
+"""Opt-out usage stats (raytpu/util/usage_stats.py).
+
+Reference analogue: ``python/ray/_private/usage/usage_lib.py`` — library
+usage counters + cluster metadata, disable-able by env var. Ours is
+local-file-only by design.
+"""
+
+import json
+import os
+
+from raytpu.util import usage_stats
+
+
+class TestUsageStats:
+    def setup_method(self):
+        usage_stats.reset()
+
+    def test_record_and_report(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("RAYTPU_USAGE_STATS_ENABLED", raising=False)
+        usage_stats.record_library_usage("rllib")
+        usage_stats.record_library_usage("rllib")
+        usage_stats.record_library_usage("data")
+        usage_stats.record_extra("num_nodes", 3)
+        path = usage_stats.report(str(tmp_path / "usage.json"))
+        payload = json.load(open(path))
+        assert payload["library_usages"] == {"rllib": 2, "data": 1}
+        assert payload["extra"]["num_nodes"] == 3
+        assert payload["raytpu_version"]
+        assert payload["python_version"]
+
+    def test_opt_out(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "0")
+        usage_stats.record_library_usage("serve")
+        assert usage_stats.report(str(tmp_path / "usage.json")) is None
+        assert not os.path.exists(tmp_path / "usage.json")
+
+    def test_report_never_raises(self, monkeypatch):
+        monkeypatch.delenv("RAYTPU_USAGE_STATS_ENABLED", raising=False)
+        # Unwritable path -> swallowed, returns None.
+        assert usage_stats.report("/no/such/dir/usage.json") is None
+
+    def test_init_records_core_usage(self, raytpu_local):
+        # raytpu.init() wires the counter (library inits also count once
+        # per process; we only assert core is present).
+        with usage_stats._lock:
+            assert any(k.startswith("core_") for k in usage_stats._features)
