@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import functools
 import itertools
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -299,6 +299,94 @@ class Dataset:
         return self._all_to_all(num_blocks, assign, "sort",
                                 post_fn=post, prepare_fn=prepare)
 
+    def groupby(self, key: str) -> "GroupedData":
+        """Distributed group-by (reference: ``Dataset.groupby`` →
+        ``GroupedData``): rows hash-partition to reducers on a
+        deterministic key hash (every group lands whole on one reducer),
+        aggregations/`map_groups` then run per-reducer with no driver
+        materialization."""
+        return GroupedData(self, key)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned column zip (reference: ``Dataset.zip``): both sides
+        are repartitioned to identical row offsets, then blocks merge
+        columnwise in remote tasks. Column collisions raise."""
+        left, right = self, other
+
+        def source():
+            n_l, n_r = left.count(), right.count()
+            if n_l != n_r:
+                raise ValueError(
+                    f"zip requires equal row counts, got {n_l} vs {n_r}")
+            blocks = max(1, -(-n_l // 4096))
+            l_refs = list(left.repartition(blocks)._iter_block_refs())
+            r_refs = list(right.repartition(blocks)._iter_block_refs())
+
+            @raytpu.remote(name="data::zip")
+            def merge(a, b):
+                na = BlockAccessor(a).to_numpy()
+                nb = BlockAccessor(b).to_numpy()
+                clash = set(na) & set(nb)
+                if clash:
+                    raise ValueError(f"zip column collision: {sorted(clash)}")
+                return {**na, **nb}
+
+            for a, b in zip(l_refs, r_refs):
+                yield merge.remote(a, b)
+
+        return Dataset(source, [], name=f"{self._name}.zip")
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into ``n`` disjoint datasets (reference: ``Dataset.split``).
+        ``equal=True`` repartitions first so row counts match to within
+        one block."""
+        src = self.repartition(n) if equal else self
+        refs = list(src._iter_block_refs())
+        shards: List[List] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            shards[i % n].append(ref)
+
+        def make(shard):
+            return Dataset(lambda s=tuple(shard): iter(s), [],
+                           name=f"{self._name}.split")
+
+        return [make(s) for s in shards]
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) row split by global offset (reference:
+        ``Dataset.train_test_split``)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+
+        def prepare(in_refs, n_out):
+            @raytpu.remote(name="data::tts-count")
+            def count(block):
+                return BlockAccessor(block).num_rows()
+
+            counts = raytpu.get([count.remote(r) for r in in_refs])
+            offsets = np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int64)
+            boundary = int(round(offsets[-1] * (1.0 - test_size)))
+            return offsets, boundary
+
+        def assign(npd, rows, idx, n_out, aux):
+            offsets, boundary = aux
+            return ((int(offsets[idx]) + np.arange(rows)) >= boundary
+                    ).astype(np.int64)
+
+        both = ds._all_to_all(2, assign, "train_test_split",
+                              prepare_fn=prepare)
+        refs = list(both._iter_block_refs())
+        train_ref, test_ref = refs[0], refs[1]
+        return (Dataset(lambda r=train_ref: iter([r]), [],
+                        name=f"{self._name}.train"),
+                Dataset(lambda r=test_ref: iter([r]), [],
+                        name=f"{self._name}.test"))
+
     # -- consumption ----------------------------------------------------------
 
     def _iter_block_refs(self) -> Iterator:
@@ -332,6 +420,28 @@ class Dataset:
         if carry_rows and not drop_last:
             whole = concat_blocks(carry)
             yield batch_format_view(whole, batch_format)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = False, device=None,
+                         sharding=None) -> Iterator:
+        """Batches as jax arrays on-device (the TPU-first analogue of the
+        reference's ``iter_torch_batches``): numpy batches are device_put
+        onto ``device``/``sharding`` (default: the default device), so the
+        training loop consumes ready device buffers."""
+        import jax
+        import jax.numpy as jnp
+
+        target = sharding if sharding is not None else device
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if target is not None:
+                # Straight host->target transfer: jnp.asarray first would
+                # commit the full array to device 0 and re-shard — double
+                # traffic, and a device-0 hotspot under a sharding.
+                yield jax.device_put(batch, target)
+            else:
+                yield {k: jnp.asarray(v) for k, v in batch.items()}
 
     def take(self, n: int = 20) -> List[dict]:
         out = []
@@ -533,3 +643,96 @@ class DataIterator:
     def iter_rows(self):
         for block in self.iter_blocks():
             yield from BlockAccessor(block).to_rows()
+
+
+def _stable_hash(vals: np.ndarray) -> np.ndarray:
+    """Deterministic per-row hash for exchange partitioning. Python's
+    ``hash()`` is process-salted for str (PYTHONHASHSEED), which would
+    scatter one group across reducers in different worker processes."""
+    import zlib
+
+    vals = np.asarray(vals)
+    if vals.dtype.kind in "iub":
+        v = vals.astype(np.uint64)
+        v = (v ^ (v >> np.uint64(33))) * np.uint64(0xff51afd7ed558ccd)
+        # Mask to a positive int64 range (2**62 - 1, NOT a single bit).
+        return (v ^ (v >> np.uint64(33))).astype(np.int64) \
+            & np.int64(2 ** 62 - 1)
+    if vals.dtype.kind == "f":
+        return _stable_hash(vals.view(np.uint64)
+                            if vals.dtype == np.float64
+                            else vals.astype(np.float64).view(np.uint64))
+    return np.array([zlib.crc32(str(x).encode()) for x in vals],
+                    dtype=np.int64)
+
+
+class GroupedData:
+    """Distributed group-by surface (reference: ``GroupedData`` in
+    ``python/ray/data/grouped_data.py``): a hash exchange lands every
+    group whole on one reducer; aggregations and ``map_groups`` run
+    reducer-local."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _exchange(self, post, name: str) -> Dataset:
+        key = self._key
+
+        def assign(npd, rows, idx, n_out, aux):
+            return _stable_hash(npd[key]) % n_out
+
+        return self._ds._all_to_all(None, assign, name, post_fn=post)
+
+    def map_groups(self, fn: Callable[[Dict[str, np.ndarray]], Any]
+                   ) -> Dataset:
+        """Apply ``fn(group_numpy_batch) -> batch`` per group."""
+        key = self._key
+
+        def post(block, j):
+            npd = BlockAccessor(block).to_numpy()
+            vals = np.asarray(npd[key])
+            outs = []
+            for g in np.unique(vals):
+                mask = vals == g
+                group = {k: np.asarray(v)[mask] for k, v in npd.items()}
+                outs.append(normalize_batch_output(fn(group)))
+            return concat_blocks(outs) if outs else npd
+        return self._exchange(post, "map_groups")
+
+    def _agg(self, col: Optional[str], reducer: Callable, out_col: str
+             ) -> Dataset:
+        key = self._key
+
+        def post(block, j):
+            npd = BlockAccessor(block).to_numpy()
+            vals = np.asarray(npd[key])
+            groups = np.unique(vals)
+            out_keys, out_vals = [], []
+            for g in groups:
+                mask = vals == g
+                out_keys.append(g)
+                out_vals.append(reducer(
+                    np.asarray(npd[col])[mask] if col else mask))
+            return {key: np.asarray(out_keys),
+                    out_col: np.asarray(out_vals)}
+        return self._exchange(post, f"groupby-{out_col}")
+
+    def count(self) -> Dataset:
+        return self._agg(None, lambda mask: int(mask.sum()), "count()")
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg(col, lambda v: v.sum(), f"sum({col})")
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg(col, lambda v: v.mean(), f"mean({col})")
+
+    def min(self, col: str) -> Dataset:
+        return self._agg(col, lambda v: v.min(), f"min({col})")
+
+    def max(self, col: str) -> Dataset:
+        return self._agg(col, lambda v: v.max(), f"max({col})")
+
+    def std(self, col: str) -> Dataset:
+        return self._agg(col, lambda v: v.std(ddof=1) if v.size > 1
+                         else 0.0, f"std({col})")

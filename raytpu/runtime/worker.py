@@ -15,6 +15,17 @@ from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
 
+# pyarrow's FIRST import must happen on a process's main thread: importing
+# it from a task thread intermittently segfaults in this environment
+# (native init race observed reliably with `pa.table` built shortly after
+# an in-thread first import). Every process that executes tasks imports
+# this module from its main thread, so force the import here; tasks and
+# the data layer then only ever see the already-initialized module.
+try:
+    import pyarrow  # noqa: F401
+except Exception:  # optional at runtime — the data layer degrades
+    pass
+
 from raytpu.core.errors import TaskCancelledError, TaskError
 from raytpu.core.ids import JobID, NodeID, ObjectID, WorkerID, _Counter
 from raytpu.runtime import context as ctx_mod

@@ -335,3 +335,111 @@ class TestExchangeOnCluster:
         finally:
             raytpu.shutdown()
             c.shutdown()
+
+
+class TestGroupBy:
+    """Distributed group-by (reference: GroupedData in
+    python/ray/data/grouped_data.py)."""
+
+    def test_groupby_aggregations(self, raytpu_local):
+        import raytpu.data as rd
+
+        ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(30)],
+                           blocks=4)
+        out = {r["k"]: r["count()"]
+               for r in ds.groupby("k").count().take_all()}
+        assert out == {0: 10, 1: 10, 2: 10}
+        sums = {r["k"]: r["sum(v)"]
+                for r in ds.groupby("k").sum("v").take_all()}
+        assert sums[0] == sum(float(i) for i in range(0, 30, 3))
+        means = {r["k"]: r["mean(v)"]
+                 for r in ds.groupby("k").mean("v").take_all()}
+        assert abs(means[1] - np.mean([float(i)
+                                       for i in range(1, 30, 3)])) < 1e-9
+
+    def test_stable_hash_spreads_keys(self):
+        """Regression: the int-key hash mask must keep entropy — a bad
+        mask (& 2**62) collapsed every key to 2 values, funneling whole
+        datasets through one reducer."""
+        from raytpu.data.dataset import _stable_hash
+
+        for vals in (np.arange(1000), np.arange(1000) * 0.5,
+                     np.array([f"k{i}" for i in range(1000)])):
+            parts = _stable_hash(vals) % 8
+            counts = np.bincount(parts.astype(np.int64), minlength=8)
+            assert (counts > 0).all(), counts
+            assert counts.max() < 400, counts
+
+    def test_groupby_string_keys_land_whole(self, raytpu_local):
+        import raytpu.data as rd
+
+        ds = rd.from_items([{"k": f"key{i % 5}", "v": 1} for i in range(50)],
+                           blocks=5)
+        rows = ds.groupby("k").count().take_all()
+        # every group appears exactly once (no split groups across blocks)
+        keys = [r["k"] for r in rows]
+        assert sorted(keys) == sorted(set(keys))
+        assert all(r["count()"] == 10 for r in rows)
+
+    def test_map_groups(self, raytpu_local):
+        import raytpu.data as rd
+
+        ds = rd.from_items([{"k": i % 2, "v": float(i)} for i in range(10)],
+                           blocks=3)
+
+        def top1(group):
+            i = int(np.argmax(group["v"]))
+            return {"k": group["k"][i:i + 1], "v": group["v"][i:i + 1]}
+
+        rows = sorted(ds.groupby("k").map_groups(top1).take_all(),
+                      key=lambda r: r["k"])
+        assert [r["v"] for r in rows] == [8.0, 9.0]
+
+
+class TestZipSplit:
+    def test_zip(self, raytpu_local):
+        import raytpu.data as rd
+
+        a = rd.from_numpy({"x": np.arange(100)}, blocks=3)
+        b = rd.from_numpy({"y": np.arange(100) * 2}, blocks=2)
+        rows = a.zip(b).take_all()
+        assert len(rows) == 100
+        assert all(r["y"] == 2 * r["x"] for r in rows)
+
+    def test_zip_mismatch_raises(self, raytpu_local):
+        import raytpu.data as rd
+
+        a = rd.range(10)
+        b = rd.range(11)
+        with pytest.raises(Exception, match="equal row counts"):
+            a.zip(b).take_all()
+
+    def test_split(self, raytpu_local):
+        import raytpu.data as rd
+
+        shards = rd.range(100, blocks=8).split(4)
+        assert len(shards) == 4
+        total = sum(s.count() for s in shards)
+        assert total == 100
+
+    def test_train_test_split(self, raytpu_local):
+        import raytpu.data as rd
+
+        train, test = rd.range(100, blocks=5).train_test_split(0.2)
+        assert train.count() == 80 and test.count() == 20
+        # disjoint and complete
+        seen = sorted(r["id"] for r in train.take_all()) + \
+            sorted(r["id"] for r in test.take_all())
+        assert sorted(seen) == list(range(100))
+
+    def test_iter_jax_batches(self, raytpu_local):
+        import jax.numpy as jnp
+
+        import raytpu.data as rd
+
+        ds = rd.from_numpy({"x": np.arange(64, dtype=np.float32)}, blocks=2)
+        batches = list(ds.iter_jax_batches(batch_size=32))
+        assert len(batches) == 2
+        assert isinstance(batches[0]["x"], jnp.ndarray)
+        assert float(sum(b["x"].sum() for b in batches)) == float(
+            np.arange(64).sum())
