@@ -1,0 +1,66 @@
+"""Distributed GPT-2 pretraining with JaxTrainer (reference analogue:
+Ray Train's TorchTrainer DDP quickstart).
+
+Runs on the virtual CPU mesh out of the box:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/train_gpt2.py
+On TPU hardware, drop the env vars and scale num_workers to your slice.
+"""
+
+import os
+import sys
+
+# Run in-repo without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import dataclasses
+
+import jax.numpy as jnp
+import optax
+
+import raytpu
+from raytpu.models.gpt2 import GPT2, GPT2Config, init_params, make_train_step
+from raytpu.train import JaxTrainer, ScalingConfig
+
+
+def train_loop(config):
+    from raytpu import train
+
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), dtype=jnp.float32, attn_impl="reference",
+        remat="dots")
+    model = GPT2(cfg)
+    params = init_params(model, cfg, batch=config["batch"])
+    opt = optax.adamw(config["lr"])
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(model, opt))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(train.get_context().get_world_rank()),
+        (config["batch"], cfg.block_size), 0, cfg.vocab_size, jnp.int32)
+    for i in range(config["steps"]):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        train.report({"step": i, "loss": float(loss)})
+
+
+def main():
+    raytpu.init()
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"batch": 2, "steps": 5, "lr": 1e-3},
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    print("final metrics:", result.metrics)
+    raytpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
