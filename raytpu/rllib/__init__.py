@@ -9,6 +9,8 @@ XLA programs; multi-learner sync is an in-program ``pmean`` over a
 
 from raytpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from raytpu.rllib.algorithms.appo import APPO, APPOConfig
+from raytpu.rllib.algorithms.bc import BC, MARWIL, BCConfig, MARWILConfig
+from raytpu.rllib.algorithms.cql import CQL, CQLConfig
 from raytpu.rllib.algorithms.dqn import DQN, DQNConfig
 from raytpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from raytpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -44,7 +46,9 @@ from raytpu.rllib.utils.replay_buffer import ReplayBuffer
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
     "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "SAC",
-    "SACConfig", "Learner", "compute_gae", "vtrace",
+    "SACConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig",
+    "CQL", "CQLConfig",
+    "Learner", "compute_gae", "vtrace",
     "RLModule", "RLModuleSpec", "DiscretePolicyModule", "QModule",
     "ConvPolicyModule", "GaussianPolicyModule", "SACModule",
     "Connector", "ConnectorPipeline", "ObsScaler", "FlattenObs",

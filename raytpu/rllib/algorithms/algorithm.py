@@ -272,7 +272,8 @@ class Algorithm:
             st = json.load(f)
         self.iteration = st["iteration"]
         self._timesteps_total = st["timesteps_total"]
-        self.env_runner_group.sync_weights(self.learner.get_weights())
+        if self.env_runner_group is not None:  # env-less offline algos
+            self.env_runner_group.sync_weights(self.learner.get_weights())
 
     # -- helpers for subclasses -----------------------------------------------
 

@@ -1,0 +1,179 @@
+"""CQL — conservative Q-learning for offline continuous control.
+
+Reference analogue: ``rllib/algorithms/cql/cql.py`` (SAC + a conservative
+penalty that pushes Q down on out-of-distribution actions, trained from a
+fixed dataset). Built directly on the SAC learner: the critic loss gains
+``min_q_weight * (logsumexp_a Q(s,a) - Q(s, a_data))`` estimated over
+sampled random + policy actions; everything stays one jitted program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from raytpu.rllib.algorithms.bc import BC, BCConfig
+from raytpu.rllib.algorithms.sac import SACConfig, SACLearner
+from raytpu.rllib.core.rl_module import RLModuleSpec, SACModule
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.min_q_weight = 5.0
+        self.num_cql_actions = 4        # sampled actions per state
+        self.offline_dataset = None
+        self.observation_dim = None
+        self.action_dim = None
+        self.action_low = None
+        self.action_high = None
+        self.updates_per_iteration = 50
+
+    offline = BCConfig.offline  # same fluent section
+
+    def rl_module_spec(self) -> RLModuleSpec:
+        if self.env is not None:
+            return super().rl_module_spec()
+        if not (self.observation_dim and self.action_dim):
+            raise ValueError(
+                "offline training without an env needs "
+                ".offline(observation_dim=..., action_dim=...)")
+        return RLModuleSpec(
+            module_class=SACModule, observation_dim=self.observation_dim,
+            action_dim=self.action_dim, model_config=dict(self.model),
+            continuous=True,
+            action_low=(self.action_low if self.action_low is not None
+                        else -1.0),
+            action_high=(self.action_high if self.action_high is not None
+                         else 1.0))
+
+
+class CQLLearner(SACLearner):
+    def __init__(self, module, config):
+        super().__init__(module, config)
+        # Re-jit with the conservative penalty folded into the critic step.
+        self._step_fn = jax.jit(partial(
+            self._step_cql, self.config["gamma"], self.config["tau"],
+            float(self.config.get("min_q_weight", 5.0)),
+            int(self.config.get("num_cql_actions", 4))))
+
+    def _step_cql(self, gamma, tau, min_q_weight, n_actions, params,
+                  target_q, log_alpha, opt_state, batch, rng):
+        m = self.module
+        r_next, r_pi, r_rand, r_cur = jax.random.split(rng, 4)
+        alpha = jnp.exp(log_alpha)
+
+        next_a, next_logp = m.sample(params, batch["next_obs"], r_next)
+        tq1 = m.q1.apply({"params": target_q["q1"]}, batch["next_obs"],
+                         next_a)
+        tq2 = m.q2.apply({"params": target_q["q2"]}, batch["next_obs"],
+                         next_a)
+        nonterminal = 1.0 - batch["terminateds"].astype(jnp.float32)
+        target = batch["rewards"] + gamma * nonterminal * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        target = jax.lax.stop_gradient(target)
+
+        B = batch["obs"].shape[0]
+        A = m.action_dim
+        lo = jnp.asarray(m.action_low)
+        hi = jnp.asarray(m.action_high)
+        rand_a = jax.random.uniform(
+            r_rand, (n_actions, B, A), minval=lo, maxval=hi)
+        cur_a, _ = m.sample(params, batch["obs"], r_cur)
+
+        def critic_loss(qs):
+            q1 = m.q1.apply({"params": qs["q1"]}, batch["obs"],
+                            batch["actions"])
+            q2 = m.q2.apply({"params": qs["q2"]}, batch["obs"],
+                            batch["actions"])
+            bellman = jnp.mean((q1 - target) ** 2) + \
+                jnp.mean((q2 - target) ** 2)
+
+            def q_all(qs_p, acts):
+                return (m.q1.apply({"params": qs_p["q1"]}, batch["obs"],
+                                   acts),
+                        m.q2.apply({"params": qs_p["q2"]}, batch["obs"],
+                                   acts))
+
+            # OOD action set: uniform samples + the current policy action.
+            r1 = jax.vmap(lambda a: q_all(qs, a))(rand_a)
+            p1, p2 = q_all(qs, cur_a)
+            cat1 = jnp.concatenate([r1[0], p1[None]], axis=0)
+            cat2 = jnp.concatenate([r1[1], p2[None]], axis=0)
+            # Conservative gap: push down logsumexp over actions, push up
+            # the dataset action (reference: CQL(H) objective).
+            gap1 = jax.scipy.special.logsumexp(cat1, axis=0) - q1
+            gap2 = jax.scipy.special.logsumexp(cat2, axis=0) - q2
+            cql = jnp.mean(gap1) + jnp.mean(gap2)
+            return bellman + min_q_weight * cql, (q1, bellman, cql)
+
+        qs = {"q1": params["q1"], "q2": params["q2"]}
+        (qf_loss, (q1, bellman, cql)), qgrads = jax.value_and_grad(
+            critic_loss, has_aux=True)(qs)
+        qup, opt_q = self.opt.update(qgrads, opt_state["q"], qs)
+        qs = optax.apply_updates(qs, qup)
+
+        def actor_loss(pi):
+            a, logp = m.sample({"pi": pi}, batch["obs"], r_pi)
+            aq1 = m.q1.apply({"params": qs["q1"]}, batch["obs"], a)
+            aq2 = m.q2.apply({"params": qs["q2"]}, batch["obs"], a)
+            return jnp.mean(alpha * logp - jnp.minimum(aq1, aq2)), logp
+
+        (pi_loss, logp), pigrads = jax.value_and_grad(
+            actor_loss, has_aux=True)(params["pi"])
+        piup, opt_pi = self.opt.update(pigrads, opt_state["pi"],
+                                       params["pi"])
+        pi = optax.apply_updates(params["pi"], piup)
+
+        def alpha_loss(la):
+            return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(
+                logp + self.target_entropy))
+
+        al, agrads = jax.value_and_grad(alpha_loss)(log_alpha)
+        aup, opt_a = self.opt.update(agrads, opt_state["alpha"], log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, aup)
+
+        target_q = jax.tree_util.tree_map(
+            lambda t, o: (1 - tau) * t + tau * o, target_q, qs)
+        params = {"pi": pi, "q1": qs["q1"], "q2": qs["q2"]}
+        opt_state = {"pi": opt_pi, "q": opt_q, "alpha": opt_a}
+        metrics = {"qf_loss": qf_loss, "bellman_loss": bellman,
+                   "cql_penalty": cql, "actor_loss": pi_loss,
+                   "alpha": jnp.exp(log_alpha), "q_mean": jnp.mean(q1)}
+        return params, target_q, log_alpha, opt_state, metrics
+
+
+class CQL(BC):
+    """Inherits BC's offline plumbing (env-optional setup, dataset
+    batches, eval-only runner group) and swaps in the conservative SAC
+    learner."""
+
+    learner_class = CQLLearner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        c = self.config
+        return {"gamma": c.gamma, "tau": c.tau,
+                "initial_alpha": c.initial_alpha,
+                "target_entropy": c.target_entropy,
+                "min_q_weight": c.min_q_weight,
+                "num_cql_actions": c.num_cql_actions}
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        for _ in range(c.updates_per_iteration):
+            batch = self._next_batch()
+            batch["obs"] = batch["obs"].astype(np.float32)
+            batch["next_obs"] = batch["next_obs"].astype(np.float32)
+            metrics = self.learner.update(batch)
+            steps += len(batch["obs"])
+        if self.env_runner_group is not None:
+            self.env_runner_group.sync_weights(self.learner.get_weights())
+        metrics["_env_steps"] = steps
+        return metrics
