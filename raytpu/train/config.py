@@ -73,3 +73,5 @@ class Result:
     checkpoint: Optional[Any]
     path: Optional[str]
     error: Optional[BaseException] = None
+    # The trial's hyperparameter config (reference: ``Result.config``).
+    config: Optional[Dict[str, Any]] = None

@@ -11,6 +11,7 @@ from raytpu.tune.schedulers import (
 from raytpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
+    BOHBSearcher,
     TPESearcher,
     choice,
     grid_search,
@@ -39,6 +40,7 @@ __all__ = [
     "FIFOScheduler",
     "ASHAScheduler",
     "HyperBandScheduler",
+    "BOHBSearcher",
     "TPESearcher",
     "PopulationBasedTraining",
 ]

@@ -132,8 +132,11 @@ class TPESearcher(Searcher):
         self._live: Dict[str, Dict[str, Any]] = {}
         self._history: List[tuple] = []  # (config, score)
 
+    def _model_ready(self) -> bool:
+        return len(self._history) >= self.n_startup
+
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        if len(self._history) < self.n_startup:
+        if not self._model_ready():
             cfg = sample_config(self.param_space, self.rng)
         else:
             cfg = self._tpe_suggest()
@@ -207,3 +210,56 @@ def sample_config(param_space: Dict[str, Any],
         else:
             cfg[k] = v
     return cfg
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based half (reference: ``TuneBOHB`` paired with
+    ``HyperBandForBOHB``; Falkner et al. 2018): a TPE model fitted on the
+    HIGHEST fidelity rung (``training_iteration``) that has enough
+    observations, fed by intermediate results — the model learns from
+    partial budgets long before any trial completes. Pair it with
+    :class:`raytpu.tune.HyperBandScheduler`, which supplies the
+    successive-halving budgets.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", n_startup: int = 8,
+                 n_candidates: int = 24, gamma: float = 0.25,
+                 min_points_per_rung: int = 6,
+                 seed: Optional[int] = None):
+        super().__init__(param_space, metric, mode, n_startup,
+                         n_candidates, gamma, seed)
+        self.min_points_per_rung = min_points_per_rung
+        # rung (iteration) -> trial_id -> (config, score)
+        self._rung_obs: Dict[int, Dict[str, tuple]] = {}
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
+        if self.metric not in (result or {}):
+            return
+        cfg = self._live.get(trial_id)
+        if cfg is None:
+            return
+        rung = int(result.get("training_iteration", 0) or 0)
+        if rung <= 0:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._rung_obs.setdefault(rung, {})[trial_id] = (cfg, score)
+
+    def _model_ready(self) -> bool:
+        return (super()._model_ready()
+                or any(len(v) >= self.min_points_per_rung
+                       for v in self._rung_obs.values()))
+
+    def _split(self):
+        # Highest fidelity first: scores at bigger budgets dominate
+        # (BOHB's core trick); pooled completions are the fallback.
+        for rung in sorted(self._rung_obs, reverse=True):
+            obs = list(self._rung_obs[rung].values())
+            if len(obs) >= self.min_points_per_rung:
+                ranked = sorted(obs, key=lambda cs: cs[1], reverse=True)
+                n_good = max(1, int(len(ranked) * self.gamma))
+                return ranked[:n_good], ranked[n_good:]
+        return super()._split()

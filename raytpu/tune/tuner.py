@@ -374,6 +374,12 @@ class Tuner:
                             ckpt_managers, run_dir, trial, ckpt_path,
                             metrics)
                         trial.ckpt_iterations = trial.iterations
+                    # Model-based searchers that learn from INTERMEDIATE
+                    # fidelities (BOHB) get every result, not just
+                    # completions.
+                    if trial.from_searcher and hasattr(searcher,
+                                                       "on_trial_result"):
+                        searcher.on_trial_result(trial.trial_id, metrics)
                     d = scheduler.on_result(trial, metrics)
                     if d == STOP:
                         # Later buffered results from a to-be-stopped trial
@@ -427,7 +433,8 @@ class Tuner:
                 err = TaskError(t.trial_id, t.error)
             results.append(Result(
                 metrics=t.last_result, metrics_history=t.history,
-                checkpoint=t.checkpoint, path=run_dir, error=err))
+                checkpoint=t.checkpoint, path=run_dir, error=err,
+                config=dict(t.config or {})))
         return ResultGrid(results, trials, tc.metric, tc.mode)
 
     def _pin_ckpt(self, run_dir: str, ckpt: Checkpoint) -> Checkpoint:

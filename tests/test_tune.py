@@ -510,3 +510,53 @@ def slow_objective(config):
                 json.dump({"i": i}, f)
             tune.report({"score": config["x"] * (i + 1), "iter": i},
                         checkpoint=Checkpoint(d))
+
+
+class TestBOHB:
+    """BOHB = HyperBand budgets + TPE on the highest informative rung
+    (reference: TuneBOHB + HyperBandForBOHB)."""
+
+    def test_bohb_converges_with_hyperband(self, raytpu_local):
+        import raytpu.tune as tune
+        from raytpu.tune import BOHBSearcher, HyperBandScheduler, Tuner
+
+        def objective(config):
+            for i in range(8):
+                # optimum at x=0.7; partial results are informative
+                score = 1.0 - (config["x"] - 0.7) ** 2 + 0.01 * i
+                tune.report({"score": score})
+
+        space = {"x": tune.uniform(0.0, 1.0)}
+        searcher = BOHBSearcher(space, metric="score", mode="max",
+                                n_startup=6, min_points_per_rung=4,
+                                seed=0)
+        tuner = Tuner(objective, param_space=space,
+                      tune_config=tune.TuneConfig(
+                          num_samples=20, metric="score", mode="max",
+                          search_alg=searcher,
+                          scheduler=HyperBandScheduler(
+                              metric="score", mode="max", max_t=8,
+                              reduction_factor=2)))
+        results = tuner.fit()
+        best = results.get_best_result("score", "max")
+        assert abs(best.config["x"] - 0.7) < 0.25, best.config
+        # the model actually ingested intermediate results
+        assert searcher._rung_obs, "no rung observations recorded"
+
+    def test_bohb_uses_highest_rung(self):
+        from raytpu.tune import BOHBSearcher
+        from raytpu.tune.search import uniform
+
+        s = BOHBSearcher({"x": uniform(0, 1)}, metric="m",
+                         min_points_per_rung=2, n_startup=100, seed=0)
+        for i, tid in enumerate(["a", "b", "c"]):
+            s.suggest(tid)
+            s.on_trial_result(tid, {"training_iteration": 1, "m": i})
+            if tid != "c":
+                s.on_trial_result(tid, {"training_iteration": 4,
+                                        "m": 10 * i})
+        good, bad = s._split()
+        # rung 4 has 2 points (>= min), rung 1 has 3 — rung 4 wins
+        scores = sorted([g[1] for g in good] + [b[1] for b in bad])
+        assert scores == [0.0, 10.0]
+        assert s._model_ready()
