@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
@@ -94,6 +95,7 @@ class NodeEntry:
         self.labels = dict(labels)
         self.last_heartbeat = time.monotonic()
         self.alive = True
+        self.avail_seq = 0  # last applied availability snapshot
         self.peer: Optional[Peer] = None
 
     def snapshot(self) -> dict:
@@ -131,6 +133,9 @@ class HeadServer:
         # then cancels against the tombstone instead of recording a
         # borrow that would never be released.
         self._early_releases: Set[Tuple[str, str]] = set()
+        # Structured-event ring (reference: dashboard event module over
+        # RAY_EVENT files); nodes forward their events here.
+        self._events = deque(maxlen=2000)
         self._object_waiters: Dict[str, List[Peer]] = {}
         # placement groups: pg_id -> {"bundles": [...], "nodes": [node_id per bundle]}
         self._pgs: Dict[str, dict] = {}
@@ -144,6 +149,7 @@ class HeadServer:
         h = self._rpc.register
         h("register_node", self._register_node)
         h("heartbeat", self._heartbeat)
+        h("resource_update", self._resource_update)
         h("drain_node", self._drain_node)
         h("list_nodes", self._list_nodes)
         h("kv_put", self._kv_put)
@@ -164,6 +170,8 @@ class HeadServer:
         h("request_free", self._request_free)
         h("borrow_info", self._borrow_info)
         h("task_done", self._task_done)
+        h("report_event", self._report_event)
+        h("list_events", self._list_events)
         h("create_pg", self._create_pg)
         h("remove_pg", self._remove_pg)
         h("pg_info", self._pg_info)
@@ -306,12 +314,25 @@ class HeadServer:
         return {"nodes": snap}
 
     def _heartbeat(self, peer: Peer, node_id: str,
-                   available: Dict[str, float]) -> None:
+                   available: Dict[str, float], seq: int = 0) -> None:
         with self._lock:
             entry = self._nodes.get(node_id)
             if entry is not None:
                 entry.last_heartbeat = time.monotonic()
-                entry.available = dict(available)
+                # Ordered by the node's snapshot sequence: a preempted
+                # heartbeat carrying an older snapshot must not overwrite
+                # a fresher streaming delta (seq 0 = legacy, always apply).
+                if seq == 0 or seq >= entry.avail_seq:
+                    entry.available = dict(available)
+                    entry.avail_seq = max(entry.avail_seq, seq)
+
+    def _resource_update(self, peer: Peer, node_id: str,
+                         available: Dict[str, float],
+                         seq: int = 0) -> None:
+        """Streaming delta from the node's resource-sync loop (reference:
+        RaySyncer receiver side). Also proof of life — an alloc-churning
+        node must never be declared dead between heartbeats."""
+        self._heartbeat(peer, node_id, available, seq)
 
     def _drain_node(self, peer: Peer, node_id: str) -> None:
         self._mark_dead(node_id, reason="drained")
@@ -363,6 +384,13 @@ class HeadServer:
                 ]
         self._publish("nodes", {"event": "removed", "node_id": node_id,
                                 "reason": reason})
+        from raytpu.util.events import record_event
+
+        with self._lock:
+            self._events.append(record_event(
+                "ERROR", "NODE_DIED",
+                f"node {node_id[:8]} removed: {reason}",
+                node_id=node_id, reason=reason))
         self._drop_borrower_prefix(node_id)
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id} {reason}",
@@ -399,6 +427,30 @@ class HeadServer:
                    node_id: str) -> None:
         self._publish("tasks", {"event": "done", "task_id": task_id_hex,
                                 "node_id": node_id})
+
+    def _report_event(self, peer: Peer, event: dict) -> None:
+        event = dict(event)
+        # Whitelist the severity: this field drives dashboard rendering
+        # and filtering; arbitrary peer input degrades to INFO.
+        if event.get("severity") not in ("DEBUG", "INFO", "WARNING",
+                                         "ERROR", "FATAL"):
+            event["severity"] = "INFO"
+        with self._lock:
+            self._events.append(event)
+
+    def _list_events(self, peer: Peer, severity: Optional[str] = None,
+                     label: Optional[str] = None,
+                     limit: int = 200) -> List[dict]:
+        with self._lock:
+            events = list(self._events)
+        if severity:
+            events = [e for e in events
+                      if e.get("severity") == severity.upper()]
+        if label:
+            events = [e for e in events if e.get("label") == label]
+        if int(limit) <= 0:
+            return []
+        return events[-int(limit):]
 
     def _borrow_info(self, peer: Peer) -> dict:
         with self._lock:

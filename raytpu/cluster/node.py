@@ -27,6 +27,7 @@ from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
 from raytpu.core.config import cfg
+from raytpu.util.events import record_event
 from raytpu.core.errors import ActorDiedError, TaskError, WorkerCrashedError
 from raytpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from raytpu.runtime.local_backend import LocalBackend, _Bundle, _PlacementGroup
@@ -108,7 +109,8 @@ class _ProcActorRuntime:
             reply = self.handle.client.call(
                 "create_actor", wire.dumps(spec), timeout=None)
         except Exception as e:
-            b.worker_pool.kill(self.handle, "actor creation RPC failed")
+            b.worker_pool.kill(self.handle, "actor creation RPC failed",
+                               failure=True)
             self._creation_failed(WorkerCrashedError(
                 f"worker died during actor creation: {e}"))
             return
@@ -360,7 +362,8 @@ class NodeBackend(LocalBackend):
             # return to the idle pool) AND terminates the process if it is
             # somehow still alive — an orphan would keep its chip binding
             # while the coords are handed to the next worker.
-            self.worker_pool.kill(handle, f"task RPC failed: {e}")
+            self.worker_pool.kill(handle, f"task RPC failed: {e}",
+                                  failure=True)
             return WorkerCrashedError(
                 f"worker died during task: {why or e}")
         finally:
@@ -565,6 +568,13 @@ class NodeServer:
             self.backend.worker_pool = self.worker_pool
             # Dead workers release their borrows (borrower protocol).
             self.worker_pool.on_worker_gone = self._worker_gone
+            # Structured events: file sink + forward to the head's ring
+            # (reference: RAY_EVENT -> event files -> dashboard module).
+            from raytpu.util import events as _events
+
+            _events.configure(
+                log_dir=self.log_dir,
+                reporter=lambda e: self._head.notify("report_event", e))
             if cfg.log_to_driver and self.log_dir:
                 self._log_monitor = threading.Thread(
                     target=self._log_monitor_loop, name="node-log-monitor",
@@ -575,9 +585,17 @@ class NodeServer:
             "register_node", self.node_id.hex(), self.address,
             self.backend.node.total.to_dict(), self.labels,
         )
+        # Availability snapshots carry a sequence number taken atomically
+        # with the snapshot: a preempted heartbeat must not overwrite a
+        # fresher resource_update at the head (the head drops lower seqs).
+        self._avail_lock = threading.Lock()
+        self._avail_seq = 0
         self._hb = threading.Thread(target=self._heartbeat_loop,
                                     name="node-heartbeat", daemon=True)
         self._hb.start()
+        self._rs = threading.Thread(target=self._resource_sync_loop,
+                                    name="node-resource-sync", daemon=True)
+        self._rs.start()
         # Memory watcher: shed the newest retriable task under pressure
         # instead of letting the kernel OOM-kill the daemon (reference:
         # memory_monitor.h:52 + raylet worker-killing policy).
@@ -629,6 +647,10 @@ class NodeServer:
                 victim = items[-1]
         tid, handle = victim
         self._last_memory_kill = now
+        record_event("WARNING", "MEMORY_PRESSURE",
+                     f"killing task {tid.hex()[:8]} under memory pressure",
+                     task_id=tid.hex(), used=float(used),
+                     limit=float(limit))
         if limit <= 1.0:  # system mode: values are fractions
             desc = f"{used:.1%} of system memory used (threshold {limit:.0%})"
         else:
@@ -638,7 +660,7 @@ class NodeServer:
             self.worker_pool.kill(
                 handle,
                 f"memory pressure: {desc}; task {tid.hex()[:8]} shed "
-                f"to protect the node")
+                f"to protect the node", failure=True)
         except Exception:
             pass
 
@@ -672,17 +694,48 @@ class NodeServer:
                 c.close()
             self._peers.clear()
 
+    def _snapshot_avail(self) -> Tuple[Dict[str, float], int]:
+        with self._avail_lock:
+            self._avail_seq += 1
+            return self.backend.node.available.to_dict(), self._avail_seq
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(HEARTBEAT_PERIOD_S):
             try:
+                avail, seq = self._snapshot_avail()
                 self._head.call(
-                    "heartbeat", self.node_id.hex(),
-                    self.backend.node.available.to_dict(), timeout=5.0,
+                    "heartbeat", self.node_id.hex(), avail, seq,
+                    timeout=5.0,
                 )
             except Exception:
                 if self._stop.is_set():
                     return
                 self._reconnect_head()
+
+    def _resource_sync_loop(self) -> None:
+        """Streaming resource view (reference: RaySyncer,
+        ``src/ray/common/ray_syncer/ray_syncer.h:88``): a fast delta
+        push beside the liveness heartbeat. The head's scheduling view
+        tracks allocations within ~100ms instead of the 1s heartbeat
+        period, so a burst of submissions doesn't double-book a node.
+        Change-triggered: nothing is sent while availability is stable."""
+        last: Optional[dict] = None
+        while not self._stop.wait(0.1):
+            try:
+                avail, seq = self._snapshot_avail()
+            except Exception:
+                continue
+            if avail == last:
+                continue
+            try:
+                self._head.notify("resource_update", self.node_id.hex(),
+                                  avail, seq)
+                last = avail
+            except Exception:
+                if self._stop.is_set():
+                    return
+                # Heartbeat loop owns reconnection; just retry later.
+                last = None
 
     def _reconnect_head(self) -> None:
         """Head bounce recovery: dial the (restarted) head, re-register

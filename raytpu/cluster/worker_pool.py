@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from raytpu.cluster.protocol import RpcClient
 from raytpu.core.config import cfg
+from raytpu.util.events import record_event
 from raytpu.core.errors import WorkerCrashedError
 from raytpu.core.ids import JobID, WorkerID
 
@@ -215,8 +216,16 @@ class WorkerPool:
                 self._idle.setdefault(h.key, []).append(h)
             self._cv.notify_all()
 
-    def kill(self, h: WorkerHandle, reason: str = "killed") -> None:
+    def kill(self, h: WorkerHandle, reason: str = "killed",
+             failure: bool = False) -> None:
         h.kill_reason = reason  # surfaced in the task's failure message
+        # Already-dead workers were reported by the reaper (WORKER_CRASHED)
+        # — a cleanup kill must not double-log the incident. Routine kills
+        # (raytpu.kill, idle reaping) stay INFO; callers mark failures.
+        if not h.dead:
+            record_event("ERROR" if failure else "INFO", "WORKER_KILLED",
+                         f"worker {h.worker_id.hex()[:8]} killed: {reason}",
+                         worker_id=h.worker_id.hex(), reason=reason)
         try:
             if h.client is not None and not h.client.closed:
                 h.client.call("kill", reason, timeout=2.0)
@@ -310,6 +319,11 @@ class WorkerPool:
                 if dead or idle_kill:
                     self._cv.notify_all()
             for h in dead:
+                record_event("ERROR", "WORKER_CRASHED",
+                             f"worker {h.worker_id.hex()[:8]} exited with "
+                             f"code {h.proc.returncode}",
+                             worker_id=h.worker_id.hex(),
+                             exit_code=h.proc.returncode)
                 h.crash(f"worker process exited with code "
                         f"{h.proc.returncode}")
             for h in idle_kill:

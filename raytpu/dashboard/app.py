@@ -76,6 +76,7 @@ class DashboardServer:
             ("placement_groups", state.list_placement_groups),
             ("task_summary", state.summarize_tasks),
             ("objects", state.object_summary),
+            ("events", lambda: state.list_events(limit=50)),
         ):
             try:
                 out[key] = fn()
@@ -130,6 +131,17 @@ class DashboardServer:
                 [[p.get("id", "")[:12], html.escape(str(p.get("strategy"))),
                   html.escape(json.dumps(p.get("bundles")))]
                  for p in pgs]))
+
+        events = snap.get("events")
+        if isinstance(events, list) and events:
+            parts.append(f"<h2>Events ({len(events)} recent)</h2>")
+            parts.append(_table(
+                ["severity", "label", "message"],
+                [[_pill(e.get("severity") not in ("ERROR", "FATAL"),
+                        html.escape(str(e.get("severity", "?")))),
+                  html.escape(str(e.get("label", ""))),
+                  html.escape(str(e.get("message", "")))]
+                 for e in events[-50:]]))
 
         objs = snap["objects"]
         if isinstance(objs, dict) and "error" not in objs:

@@ -137,6 +137,21 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     return out
 
 
+def list_events(severity: Optional[str] = None,
+                label: Optional[str] = None,
+                limit: int = 200) -> List[Dict[str, Any]]:
+    """Structured operational events (reference: ``ray list
+    cluster-events`` over the dashboard event module)."""
+    b = _backend()
+    if _is_cluster(b):
+        return b._head.call("list_events", severity, label, limit)
+    from raytpu.util import events
+
+    if int(limit) <= 0:
+        return []
+    return events.recent_events(severity, label)[-int(limit):]
+
+
 def summarize_tasks() -> Dict[str, int]:
     counts: Dict[str, int] = {}
     for t in list_tasks():

@@ -573,3 +573,37 @@ class TestHeadPersistence:
         finally:
             raytpu.shutdown()
             c.shutdown()
+
+
+class TestResourceSync:
+    """Streaming resource view (reference: RaySyncer) — availability
+    deltas reach the head without waiting for the 1s heartbeat."""
+
+    def test_allocation_visible_at_head(self, driver):
+        raytpu = driver
+        backend = raytpu.runtime.api._backend_or_none()
+
+        def cpu_avail():
+            return sum(n["available"].get("CPU", 0)
+                       for n in backend._head.call("list_nodes")
+                       if n["alive"])
+
+        base = cpu_avail()
+
+        @raytpu.remote(num_cpus=2)
+        def hold():
+            import time as _t
+
+            _t.sleep(3.0)
+            return 1
+
+        ref = hold.remote()
+        deadline = time.monotonic() + 2.5
+        seen = base
+        while time.monotonic() < deadline:
+            seen = cpu_avail()
+            if seen <= base - 2:
+                break
+            time.sleep(0.05)
+        assert seen <= base - 2, (base, seen)
+        assert raytpu.get(ref, timeout=30) == 1

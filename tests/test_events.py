@@ -1,0 +1,82 @@
+"""Structured events (raytpu/util/events.py + cluster surfacing).
+
+Reference analogue: ``src/ray/util/event.h`` RAY_EVENT macros + the
+dashboard event module — severity/label/fields, per-process event files,
+cluster-wide querying.
+"""
+
+import json
+import time
+
+import pytest
+
+import raytpu
+from raytpu.util import events
+
+
+class TestEventLogger:
+    def setup_method(self):
+        events.reset()
+
+    def teardown_method(self):
+        events.reset()
+
+    def test_record_and_filter(self):
+        events.record_event("INFO", "TEST", "hello", detail=1)
+        events.record_event("ERROR", "WORKER_CRASHED", "boom", code=139)
+        assert len(events.recent_events()) == 2
+        errs = events.recent_events(severity="error")
+        assert len(errs) == 1 and errs[0]["code"] == 139
+        assert events.recent_events(label="TEST")[0]["detail"] == 1
+
+    def test_file_sink(self, tmp_path):
+        events.configure(log_dir=str(tmp_path))
+        events.record_event("WARNING", "MEMORY_PRESSURE", "tight",
+                            used=0.9)
+        files = list(tmp_path.glob("events-*.jsonl"))
+        assert len(files) == 1
+        line = json.loads(files[0].read_text().strip())
+        assert line["label"] == "MEMORY_PRESSURE" and line["used"] == 0.9
+
+    def test_unknown_severity_degrades(self):
+        e = events.record_event("LOUD", "X", "msg")
+        assert e["severity"] == "INFO"
+
+    def test_non_plain_fields_dropped(self):
+        e = events.record_event("INFO", "X", "msg", ok=1, bad=object())
+        assert "ok" in e and "bad" not in e
+
+
+class TestClusterEvents:
+    def test_worker_crash_event_reaches_head(self):
+        from raytpu.cluster.cluster_utils import Cluster
+        from raytpu.state import api as state
+
+        events.reset()
+        cluster = Cluster()
+        cluster.add_node(num_cpus=2, num_tpus=0)
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote(max_retries=0)
+            def die():
+                import os
+
+                os._exit(139)
+
+            with pytest.raises(Exception):
+                raytpu.get(die.remote(), timeout=60)
+            deadline = time.monotonic() + 10
+            found = []
+            while time.monotonic() < deadline:
+                found = [e for e in state.list_events()
+                         if e.get("label") in ("WORKER_CRASHED",
+                                               "WORKER_KILLED")]
+                if found:
+                    break
+                time.sleep(0.5)
+            assert found, "worker crash event never reached the head"
+            assert found[-1]["severity"] == "ERROR"
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+            events.reset()
