@@ -54,6 +54,19 @@ def _encode_response(result: Any) -> Tuple[int, bytes, str]:
     return status, _json.dumps(result).encode(), "application/json"
 
 
+def match_route(route_table: Dict[str, tuple], path: str
+                ) -> Optional[Tuple[str, str, str]]:
+    """Longest-prefix route match shared by every ingress transport (HTTP
+    + gRPC must agree on trailing-slash normalization)."""
+    best = None
+    for prefix, (app_name, ingress) in route_table.items():
+        norm = prefix.rstrip("/") or "/"
+        if path == norm or path.startswith(norm + "/") or norm == "/":
+            if best is None or len(norm) > len(best[0]):
+                best = (norm, app_name, ingress)
+    return best
+
+
 class ProxyActor:
     """Async actor: runs the aiohttp site on its own event loop."""
 
@@ -107,13 +120,7 @@ class ProxyActor:
                 self._asgi.clear()
 
     def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
-        best = None
-        for prefix, (app_name, ingress) in self._route_table.items():
-            norm = prefix.rstrip("/") or "/"
-            if path == norm or path.startswith(norm + "/") or norm == "/":
-                if best is None or len(norm) > len(best[0]):
-                    best = (norm, app_name, ingress)
-        return best
+        return match_route(self._route_table, path)
 
     async def _handle_http(self, request):
         from aiohttp import web

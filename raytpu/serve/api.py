@@ -20,6 +20,7 @@ from raytpu.serve.deployment import Application, build_app
 from raytpu.serve.handle import DeploymentHandle
 
 PROXY_NAME = "SERVE_PROXY"
+GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 _http_options: Optional[HTTPOptions] = None
 
@@ -42,6 +43,17 @@ def start(http_options: Optional[HTTPOptions] = None, **kwargs) -> None:
             name=PROXY_NAME, lifetime="detached", max_concurrency=10_000
         ).remote(opts.host, opts.port)
     raytpu.get(proxy.ready.remote())
+    if opts.grpc_port is not None:
+        try:
+            gproxy = raytpu.get_actor(GRPC_PROXY_NAME)
+        except Exception:
+            from raytpu.serve._private.grpc_proxy import GrpcProxyActor
+
+            gproxy = raytpu.remote(GrpcProxyActor).options(
+                name=GRPC_PROXY_NAME, lifetime="detached",
+                max_concurrency=10_000
+            ).remote(opts.host, opts.grpc_port)
+        raytpu.get(gproxy.ready.remote())
 
 
 def ingress(asgi_app):
@@ -147,6 +159,12 @@ def shutdown() -> None:
         proxy = raytpu.get_actor(PROXY_NAME)
         raytpu.get(proxy.shutdown.remote(), timeout=5.0)
         raytpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        gproxy = raytpu.get_actor(GRPC_PROXY_NAME)
+        raytpu.get(gproxy.shutdown.remote(), timeout=5.0)
+        raytpu.kill(gproxy)
     except Exception:
         pass
     try:

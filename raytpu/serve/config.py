@@ -70,15 +70,19 @@ class DeploymentConfig:
 
 @dataclass
 class HTTPOptions:
-    """Proxy options (reference: ``HTTPOptions`` in serve/config.py)."""
+    """Proxy options (reference: ``HTTPOptions`` in serve/config.py).
+    ``grpc_port`` also starts the gRPC ingress (reference: gRPCOptions)."""
 
     host: str = "127.0.0.1"
     port: int = 8000
     root_path: str = ""
+    grpc_port: Optional[int] = None
 
     def __post_init__(self):
         if not (0 <= self.port < 65536):
             raise ValueError("port out of range")
+        if self.grpc_port is not None and not (0 <= self.grpc_port < 65536):
+            raise ValueError("grpc_port out of range")
 
 
 @dataclass

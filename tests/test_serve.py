@@ -448,3 +448,47 @@ class TestASGIIngress:
         serve.run(Plain.bind(), name="plain", route_prefix="/plain")
         r2 = rq.get("http://127.0.0.1:18441/plain", timeout=15)
         assert r2.json() == {"plain": True}
+
+
+class TestGrpcIngress:
+    """gRPC proxy (reference: Serve's gRPC ingress over serve.proto; ours
+    is a generic byte service, no protoc plugin required)."""
+
+    def test_grpc_unary_and_stream(self, serve_instance):
+        import json as _json
+
+        import grpc
+
+        serve.start(host="127.0.0.1", port=18455, grpc_port=18456)
+
+        @serve.deployment
+        class Predictor:
+            def __call__(self, request):
+                payload = request.json()
+                return {"doubled": payload["x"] * 2}
+
+        @serve.deployment
+        class Tokens:
+            def __call__(self, request):
+                for i in range(4):
+                    yield f"tok{i}"
+
+        serve.run(Predictor.bind(), name="pred", route_prefix="/predict")
+        serve.run(Tokens.bind(), name="toks", route_prefix="/tokens")
+
+        ch = grpc.insecure_channel("127.0.0.1:18456")
+        call = ch.unary_unary("/raytpu.serve/Call")
+        out = call(_json.dumps({"x": 21}).encode(),
+                   metadata=(("route", "/predict"),), timeout=30)
+        assert _json.loads(out) == {"doubled": 42}
+
+        stream = ch.unary_stream("/raytpu.serve/Stream")
+        chunks = [c for c in stream(b"", metadata=(("route", "/tokens"),),
+                                    timeout=30)]
+        assert chunks == [b"tok0", b"tok1", b"tok2", b"tok3"]
+
+        # Unknown route -> NOT_FOUND, not a hang.
+        with pytest.raises(grpc.RpcError) as err:
+            call(b"{}", metadata=(("route", "/nope"),), timeout=10)
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+        ch.close()
