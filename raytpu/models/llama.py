@@ -210,14 +210,16 @@ def llama_loss_fn(model: Llama, params, tokens):
     return (lse - label).mean()
 
 
-def make_train_step(model: Llama, optimizer):
+def make_train_step(model, optimizer, loss_fn=None):
     """(params, opt_state, tokens) -> (params, opt_state, loss); pure —
     jit with shardings from :func:`raytpu.parallel.sharding.tree_shardings`
-    (param names already match TRANSFORMER_RULES)."""
+    (param names already match TRANSFORMER_RULES). Shared by the llama and
+    mixtral families via ``loss_fn``."""
+    loss_fn = loss_fn or llama_loss_fn
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: llama_loss_fn(model, p, tokens))(params)
+            lambda p: loss_fn(model, p, tokens))(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(
             lambda p, u: (p + u).astype(p.dtype), params, updates)

@@ -1,0 +1,177 @@
+"""Mixtral-family sparse-MoE decoder — the third flagship model family.
+
+Reference scope: MoE machinery is absent from the reference (SURVEY.md
+§2.5 EP row); serving/training MoE models there is delegated to user
+libraries. Here the family is first-class and TPU-shaped: llama blocks
+(RMSNorm/RoPE/GQA via :mod:`raytpu.models.llama`) whose FFN is a top-k
+routed expert layer using the dense one-hot dispatch formulation —
+einsum-only (MXU-shaped, static shapes, no scatter), with a Switch-style
+load-balancing auxiliary loss sown as an intermediate. Expert parameters
+are stacked on a leading experts dim so ``TRANSFORMER_RULES`` shards them
+over the ``ep`` mesh axis with no model-specific code (XLA inserts the
+all-to-alls when tokens meet sharded experts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raytpu.models.llama import LlamaAttention, LlamaConfig, RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    n_expert: int = 8
+    n_expert_per_tok: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+    @classmethod
+    def tiny(cls) -> "MixtralConfig":
+        return cls(vocab_size=512, block_size=128, n_layer=2, n_head=4,
+                   n_kv_head=2, n_embd=128, n_inter=256, n_expert=4,
+                   n_expert_per_tok=2)
+
+
+class MoEFFN(nn.Module):
+    """Top-k routed experts with capacity, dense dispatch einsums.
+
+    FLOPs scale with k·capacity_factor (tokens actually routed), not with
+    the expert count — the einsum shapes stay static so XLA tiles them
+    onto the MXU, and the experts dim shards over ``ep``.
+    """
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        b, t, d = x.shape
+        n = b * t
+        k = c.n_expert_per_tok
+        e = c.n_expert
+        xf = x.reshape(n, d)
+        router = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          name="router")(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(router, axis=-1)           # [N, E]
+        topw, topi = jax.lax.top_k(probs, k)              # [N, k]
+        topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+
+        # Switch-style load balance: E * sum_e(frac_routed_e * mean_prob_e)
+        top1 = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
+        aux = e * jnp.sum(jnp.mean(top1, axis=0)
+                          * jnp.mean(probs, axis=0))
+        self.sow("intermediates", "moe_aux", aux)
+
+        capacity = max(1, int(c.capacity_factor * n * k / e))
+        # Slot-major assignment stream [k*N]: slot 0 of every token claims
+        # buffer positions before slot 1, so primary routes win capacity.
+        flat_idx = topi.T.reshape(k * n)                  # [k*N]
+        flat_w = topw.T.reshape(k * n)
+        onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.float32)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+        pos_in_e = jnp.sum(pos, axis=-1).astype(jnp.int32)
+        keep = (pos_in_e < capacity).astype(jnp.float32)
+        pos_oh = jax.nn.one_hot(pos_in_e, capacity, dtype=jnp.float32)
+        dispatch = onehot[:, :, None] * pos_oh[:, None, :] \
+            * keep[:, None, None]                          # [kN, E, C]
+        combine = dispatch * flat_w[:, None, None]
+
+        # Routing/dispatch math stays fp32; the expert matmuls (the
+        # block's dominant FLOPs) run in the model compute dtype so the
+        # MXU sees bf16 like the dense llama FFN.
+        x_rep = jnp.tile(xf, (k, 1)).astype(jnp.float32)   # [kN, D]
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch,
+                               x_rep).astype(c.dtype)
+        wi = self.param("wi", nn.initializers.normal(d ** -0.5),
+                        (e, d, c.n_inter))
+        wg = self.param("wg", nn.initializers.normal(d ** -0.5),
+                        (e, d, c.n_inter))
+        wo = self.param("wo", nn.initializers.normal(c.n_inter ** -0.5),
+                        (e, c.n_inter, d))
+        h = (nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                wg.astype(c.dtype)))
+             * jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(c.dtype)))
+        expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                wo.astype(c.dtype))      # [E, C, D]
+        y = jnp.einsum("sec,ecd->sd", combine,
+                       expert_out.astype(jnp.float32))    # [kN, D]
+        y = jnp.sum(y.reshape(k, n, d), axis=0)
+        return y.reshape(b, t, d).astype(c.dtype)
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        x = x + LlamaAttention(c, name="attn")(
+            RMSNorm(dtype=c.dtype, name="input_norm")(x))
+        x = x + MoEFFN(c, name="moe")(
+            RMSNorm(dtype=c.dtype, name="post_attn_norm")(x))
+        return x
+
+
+class Mixtral(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, tokens, return_hidden: bool = False):
+        c = self.config
+        x = nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype,
+                     name="embed_tokens")(tokens)
+        block = MixtralBlock
+        if c.remat and c.remat != "none":
+            policy = None
+            if c.remat == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block = nn.remat(MixtralBlock, prevent_cse=False, policy=policy)
+        if c.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry), None),
+                variable_axes={"params": 0, "intermediates": 0},
+                split_rngs={"params": True},
+                length=c.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(c, name="layers"), x, None)
+        else:
+            for i in range(c.n_layer):
+                x = block(c, name=f"layers_{i}")(x)
+        x = RMSNorm(dtype=c.dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
+        logits = nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def mixtral_loss_fn(model: Mixtral, params, tokens):
+    """Next-token cross-entropy + router load-balance auxiliary."""
+    c = model.config
+    targets = tokens[:, 1:]
+    logits, mutables = model.apply({"params": params}, tokens,
+                                   mutable=["intermediates"])
+    logits = logits[:, :-1]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    xent = (lse - label).mean()
+    aux_leaves = jax.tree_util.tree_leaves(mutables.get("intermediates", {}))
+    aux = (sum(jnp.sum(a) for a in aux_leaves) / max(1, c.n_layer)
+           if aux_leaves else 0.0)
+    return xent + c.router_aux_coef * aux
+
+
+def make_train_step(model: Mixtral, optimizer):
+    from raytpu.models.llama import make_train_step as _shared
+
+    return _shared(model, optimizer, loss_fn=mixtral_loss_fn)
+
+
+# Same signature/behavior as the llama helper — reuse it.
+from raytpu.models.llama import init_params  # noqa: E402,F401

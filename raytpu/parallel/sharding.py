@@ -72,6 +72,11 @@ TRANSFORMER_RULES = ShardingRules(rules=(
      ("fsdp", "tp")),
     # attention output (row-parallel): [heads*head_dim, embed]
     (r"(attn|attention).*(o_proj|out_proj|c_proj).*kernel", ("tp", "fsdp")),
+    # MoE experts (leading experts dim shards over ep): wi/wg [E, embed,
+    # ff] column-style, wo [E, ff, embed] row-style; router replicated.
+    (r"(moe|experts).*\bwo$", ("ep", "tp", "fsdp")),
+    (r"(moe|experts).*\bw[ig]$", ("ep", "fsdp", "tp")),
+    (r"(moe|experts).*router", (None, None)),
     # mlp up (column): [embed, ff]
     (r"(mlp|ffn).*(up_proj|gate_proj|c_fc|fc_in|wi).*kernel", ("fsdp", "tp")),
     # mlp down (row): [ff, embed]
