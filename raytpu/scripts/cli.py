@@ -140,6 +140,23 @@ def _cmd_logs(args) -> int:
         head.close()
 
 
+def _cmd_events(args) -> int:
+    """Tail the head's structured-event ring (reference: the dashboard
+    event module / `ray list cluster-events`)."""
+    import datetime
+
+    import raytpu
+    from raytpu.state import api as state
+
+    raytpu.init(address=args.address, ignore_reinit_error=True)
+    for e in state.list_events(args.severity, args.label, args.limit):
+        ts = datetime.datetime.fromtimestamp(
+            e.get("timestamp", 0)).strftime("%H:%M:%S")
+        print(f"{ts} {e.get('severity', '?'):7s} "
+              f"{e.get('label', ''):18s} {e.get('message', '')}")
+    return 0
+
+
 def _cmd_proxy(args) -> int:
     """Serve the remote-driver proxy (reference: the Ray Client server
     behind ray:// addresses)."""
@@ -249,6 +266,14 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True)
     s.add_argument("--no-block", dest="block", action="store_false")
     s.set_defaults(fn=_cmd_dashboard)
+
+    s = sub.add_parser("events", help="recent structured cluster events")
+    s.add_argument("--address", default=None)
+    s.add_argument("--severity", default=None,
+                   help="filter: DEBUG/INFO/WARNING/ERROR/FATAL")
+    s.add_argument("--label", default=None)
+    s.add_argument("--limit", type=int, default=50)
+    s.set_defaults(fn=_cmd_events)
 
     s = sub.add_parser("proxy", help="remote-driver proxy (raytpu://)")
     s.add_argument("--head", required=True, help="head host:port")

@@ -61,6 +61,9 @@ class Trial:
     # True when the config came from the searcher (PBT clones don't —
     # the searcher must only see completions for ids it issued).
     from_searcher: bool = False
+    # Crash-retry count (reference: FailureConfig.max_failures — a failed
+    # trial restarts from its latest checkpoint instead of erroring out).
+    failures: int = 0
 
 
 class ResultGrid:
@@ -236,19 +239,48 @@ class Tuner:
         trials: List[Trial] = []
         ckpt_managers: Dict[str, CheckpointManager] = {}
 
-        def launch(tid: str, config: Dict[str, Any],
-                   resume: Optional[Checkpoint] = None) -> Trial:
-            trial = Trial(tid, config)
+        def spawn_actor(config: Dict[str, Any],
+                        resume: Optional[Checkpoint] = None):
             ctx_kwargs = {"experiment_name": name, "storage_path": run_dir}
             actor = TrainWorker.options(
                 resources=tc.resources_per_trial).remote(0, 1, ctx_kwargs)
             raytpu.get(actor.start.remote(
                 fn_blob, config, None,
                 resume.path if resume else None))
-            trial.actor = actor
+            return actor
+
+        def launch(tid: str, config: Dict[str, Any],
+                   resume: Optional[Checkpoint] = None) -> Trial:
+            trial = Trial(tid, config)
+            # Record the launch checkpoint: a crash BEFORE the trial's
+            # first own checkpoint must retry from here (PBT exploit
+            # clones would otherwise silently restart from random init).
+            trial.checkpoint = resume
+            trial.actor = spawn_actor(config, resume)
             trial.state = "RUNNING"
             trials.append(trial)
             return trial
+
+        def retry_trial(trial: Trial) -> None:
+            """Crash retry from the latest checkpoint (reference:
+            FailureConfig.max_failures): same trial identity, so
+            scheduler rung statistics and the searcher's bookkeeping
+            carry over; counters roll back to the checkpoint exactly as
+            Tuner.restore does."""
+            if trial.actor is not None:
+                try:
+                    raytpu.kill(trial.actor)
+                except Exception:
+                    pass
+            trial.failures += 1
+            trial.error = None
+            it = trial.ckpt_iterations if trial.checkpoint else 0
+            trial.iterations = it
+            trial.history = list(trial.history)[:it]
+            trial.last_result = (trial.history[-1] if trial.history
+                                 else {})
+            trial.actor = spawn_actor(trial.config, trial.checkpoint)
+            trial.state = "RUNNING"
 
         # Open-ended searchers (TPE etc.) suggest forever; num_samples is
         # the experiment budget (reference: same num_samples semantics).
@@ -297,6 +329,7 @@ class Tuner:
                     "iterations": t.iterations,
                     "ckpt_iterations": t.ckpt_iterations,
                     "error": t.error,
+                    "failures": t.failures,
                     "checkpoint": (t.checkpoint.path
                                    if t.checkpoint else None),
                     "from_searcher": t.from_searcher,
@@ -334,9 +367,13 @@ class Tuner:
                         last_result=tr["last_result"],
                         history=tr["history"], error=tr["error"],
                         iterations=tr["iterations"], checkpoint=ckpt,
-                        from_searcher=tr["from_searcher"]))
+                        from_searcher=tr["from_searcher"],
+                        failures=tr.get("failures", 0)))
                 else:
                     t = launch(tr["trial_id"], tr["config"], resume=ckpt)
+                    # max_failures is a per-TRIAL budget; it survives
+                    # experiment restores.
+                    t.failures = tr.get("failures", 0)
                     # Roll back to the checkpoint point: the relaunched
                     # trial replays everything after it, so counters and
                     # history must not double-count those reports.
@@ -387,7 +424,17 @@ class Tuner:
                         decision = STOP
                         break
                 if err:
-                    finish(trial, "ERROR", error=err)
+                    max_f = rc.failure_config.max_failures
+                    if decision == STOP:
+                        # The scheduler already cut this trial at a rung in
+                        # this same poll; its decision stands (a retry
+                        # could never be re-stopped — rungs are judged
+                        # once).
+                        finish(trial, "STOPPED")
+                    elif max_f < 0 or trial.failures < max_f:
+                        retry_trial(trial)
+                    else:
+                        finish(trial, "ERROR", error=err)
                     continue
                 if finished:
                     finish(trial, "TERMINATED")

@@ -560,3 +560,74 @@ class TestBOHB:
         scores = sorted([g[1] for g in good] + [b[1] for b in bad])
         assert scores == [0.0, 10.0]
         assert s._model_ready()
+
+
+class TestTrialFailureRetries:
+    """FailureConfig.max_failures (reference: air/config.py:395): a
+    crashed trial restarts from its latest checkpoint instead of
+    erroring the experiment."""
+
+    def test_trial_retries_from_checkpoint(self, raytpu_local, tmp_path):
+        import raytpu.tune as tune
+        from raytpu.train.config import FailureConfig, RunConfig
+        from raytpu.tune import Tuner
+
+        marker = tmp_path / "crashed_once"
+
+        def objective(config):
+            from raytpu import train
+
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                import json
+                import os as _os
+
+                with open(_os.path.join(ckpt.path, "state.json")) as f:
+                    start = json.load(f)["i"] + 1
+            for i in range(start, 6):
+                import json
+                import os as _os
+                import tempfile as _tf
+
+                d = _tf.mkdtemp()
+                with open(_os.path.join(d, "state.json"), "w") as f:
+                    json.dump({"i": i}, f)
+                train.report({"i": i, "score": i},
+                             checkpoint=train.Checkpoint(d))
+                if i == 3 and not marker.exists():
+                    marker.write_text("x")
+                    raise RuntimeError("transient crash")
+
+        tuner = Tuner(
+            objective, param_space={},
+            tune_config=tune.TuneConfig(num_samples=1, metric="score",
+                                        mode="max"),
+            run_config=RunConfig(
+                name="retry-test", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2)))
+        results = tuner.fit()
+        assert not results.errors, results.errors
+        best = results.get_best_result()
+        # The trial resumed after the crash at i=3 and ran to completion.
+        assert best.metrics["score"] == 5
+        assert marker.exists()
+        assert results._trials[0].failures == 1
+
+    def test_exhausted_retries_error_out(self, raytpu_local, tmp_path):
+        import raytpu.tune as tune
+        from raytpu.train.config import FailureConfig, RunConfig
+        from raytpu.tune import Tuner
+
+        def always_crash(config):
+            raise RuntimeError("permanent")
+
+        tuner = Tuner(
+            always_crash, param_space={},
+            tune_config=tune.TuneConfig(num_samples=1),
+            run_config=RunConfig(
+                name="retry-exhaust", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1)))
+        results = tuner.fit()
+        assert len(results.errors) == 1
+        assert results._trials[0].failures == 1
