@@ -261,7 +261,7 @@ class Tuner:
             trials.append(trial)
             return trial
 
-        def retry_trial(trial: Trial) -> None:
+        def retry_trial(trial: Trial, err: Optional[str] = None) -> None:
             """Crash retry from the latest checkpoint (reference:
             FailureConfig.max_failures): same trial identity, so
             scheduler rung statistics and the searcher's bookkeeping
@@ -273,6 +273,16 @@ class Tuner:
                 except Exception:
                     pass
             trial.failures += 1
+            from raytpu.util.events import record_event
+
+            record_event(
+                "WARNING", "TRIAL_RETRY",
+                f"trial {trial.trial_id} crashed "
+                f"(attempt {trial.failures}/"
+                f"{rc.failure_config.max_failures}); restarting from "
+                f"{'checkpoint' if trial.checkpoint else 'scratch'}: "
+                f"{str(err)[-300:]}",
+                trial_id=trial.trial_id, failures=trial.failures)
             trial.error = None
             it = trial.ckpt_iterations if trial.checkpoint else 0
             trial.iterations = it
@@ -384,7 +394,7 @@ class Tuner:
                     t.history = list(tr["history"])[:it]
                     t.last_result = (t.history[-1] if t.history
                                      else dict(tr["last_result"]))
-                    t.checkpoint = ckpt
+                    # launch() already recorded the resume checkpoint.
                     t.from_searcher = tr["from_searcher"]
             self._restored = None
             suggested = sum(1 for t in trials if t.from_searcher)
@@ -432,7 +442,7 @@ class Tuner:
                         # once).
                         finish(trial, "STOPPED")
                     elif max_f < 0 or trial.failures < max_f:
-                        retry_trial(trial)
+                        retry_trial(trial, err)
                     else:
                         finish(trial, "ERROR", error=err)
                     continue
@@ -465,11 +475,19 @@ class Tuner:
                 time.sleep(0.05)
 
         # Staged-but-unregistered checkpoint snapshots (killed trials,
-        # post-STOP reports) are garbage once the run ends.
+        # post-STOP reports) are garbage once the run ends — EXCEPT ones a
+        # trial still references as its only checkpoint (a PBT clone that
+        # finished before registering its own): deleting those would hand
+        # the caller a Result.checkpoint pointing at nothing.
+        import glob as _glob
         import shutil
 
-        shutil.rmtree(os.path.join(run_dir, ".staged_ckpts"),
-                      ignore_errors=True)
+        referenced = {t.checkpoint.path for t in trials
+                      if t.checkpoint is not None}
+        for staged in _glob.glob(os.path.join(run_dir, ".staged_ckpts",
+                                              "*")):
+            if staged not in referenced:
+                shutil.rmtree(staged, ignore_errors=True)
 
         results = []
         for t in trials:

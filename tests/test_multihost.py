@@ -177,10 +177,27 @@ class TestMultiHostGang:
             if os.path.exists(marker) and os.path.getsize(marker) > 0:
                 break
             time.sleep(0.2)
-        time.sleep(2.5)  # a few 0.5s steps' worth of checkpoints
+        # Kill only after a checkpoint has actually PERSISTED (a blind
+        # sleep flakes under load: the restart would then legitimately
+        # begin at step 0 and the resumed-from-checkpoint assertion
+        # fails).
+        import glob
+
+        # Must match a REGISTERED checkpoint dir, not the bare
+        # "checkpoints" parent the manager creates up front.
+        ckpt_glob = os.path.join(str(tmp_path), "gang-chaos",
+                                 "checkpoints", "checkpoint_*")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if glob.glob(ckpt_glob):
+                break
+            time.sleep(0.2)
+        assert glob.glob(ckpt_glob), \
+            "no checkpoint persisted within 60s"
+        time.sleep(1.0)  # let the in-flight step finish past the ckpt
         c.kill_node(c.nodes[1])
         c.add_node(num_cpus=4)  # replacement host for the restarted gang
-        t.join(timeout=180)
+        t.join(timeout=300)
         assert not t.is_alive(), "fit() hung after host death"
         result = box["result"]
         assert result.error is None, f"gang never recovered: {result.error}"
