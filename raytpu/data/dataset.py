@@ -155,6 +155,18 @@ class Dataset:
 
         return Dataset(source, [], name="union")
 
+    @staticmethod
+    def _global_offsets(in_refs) -> np.ndarray:
+        """Per-block global row offsets (len+1, int64) via one remote
+        count pass — shared by the offset-based exchanges."""
+
+        @raytpu.remote(name="data::count")
+        def count(block):
+            return BlockAccessor(block).num_rows()
+
+        counts = raytpu.get([count.remote(r) for r in in_refs])
+        return np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+
     def _all_to_all(self, num_out: Optional[int], assign_fn, name: str,
                     post_fn=None, prepare_fn=None) -> "Dataset":
         """Two-phase distributed shuffle (reference:
@@ -213,13 +225,7 @@ class Dataset:
         contiguous output ranges."""
 
         def prepare(in_refs, n_out):
-            @raytpu.remote(name="data::repartition-count")
-            def count(block):
-                return BlockAccessor(block).num_rows()
-
-            counts = raytpu.get([count.remote(r) for r in in_refs])
-            offsets = np.concatenate(
-                [[0], np.cumsum(counts)]).astype(np.int64)
+            offsets = self._global_offsets(in_refs)
             total = int(offsets[-1])
             per = max(1, -(-total // n_out))
             return offsets, per
@@ -299,6 +305,85 @@ class Dataset:
         return self._all_to_all(num_blocks, assign, "sort",
                                 post_fn=post, prepare_fn=prepare)
 
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: ``Dataset.random_sample``).
+        The seed is salted per block (like random_shuffle) — one shared
+        seed would draw the SAME mask in every block, correlating the
+        sample across the dataset."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        parent = self
+
+        def source():
+            @raytpu.remote(name="data::sample")
+            def sample(block, idx):
+                rng = np.random.default_rng(
+                    None if seed is None else seed + 7919 * idx)
+                npd = BlockAccessor(block).to_numpy()
+                n = BlockAccessor(block).num_rows()
+                mask = rng.random(n) < fraction
+                return {k: np.asarray(v)[mask] for k, v in npd.items()}
+
+            for i, ref in enumerate(parent._iter_block_refs()):
+                yield sample.remote(ref, i)
+
+        return Dataset(source, [], name=f"{self._name}.sample")
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: ``Dataset.unique``):
+        per-block distincts in remote tasks, merged on the driver —
+        result size is the number of DISTINCT values, not rows."""
+
+        @raytpu.remote(name="data::unique")
+        def distinct(block):
+            return np.unique(np.asarray(
+                BlockAccessor(block).to_numpy()[column]))
+
+        refs = [distinct.remote(r) for r in self._iter_block_refs()]
+        out: set = set()
+        for vals in raytpu.get(refs):
+            out.update(vals.tolist())
+        return sorted(out)
+
+    def split_at_indices(self, indices: Sequence[int]) -> List["Dataset"]:
+        """Split by global row offsets (reference:
+        ``Dataset.split_at_indices``): ``[3, 7]`` -> rows [0,3), [3,7),
+        [7,end) — order preserved, distributed via the offset exchange."""
+        indices = sorted(int(i) for i in indices)
+        if any(i < 0 for i in indices):
+            raise ValueError("indices must be non-negative")
+        n_out = len(indices) + 1
+
+        def prepare(in_refs, n):
+            return (self._global_offsets(in_refs),
+                    np.asarray(indices, np.int64))
+
+        def assign(npd, rows, idx, n, aux):
+            offsets, bounds = aux
+            global_rows = int(offsets[idx]) + np.arange(rows)
+            return np.searchsorted(bounds, global_rows, side="right")
+
+        parts = self._all_to_all(n_out, assign, "split_at_indices",
+                                 prepare_fn=prepare)
+        refs = list(parts._iter_block_refs())
+        if not refs:  # empty upstream: still n_out (empty) datasets
+            return [Dataset(lambda: iter(()), [],
+                            name=f"{self._name}.split_at")
+                    for _ in range(n_out)]
+        return [Dataset(lambda r=ref: iter([r]), [],
+                        name=f"{self._name}.split_at")
+                for ref in refs]
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "numpy"):
+        """First ``batch_size`` rows as one batch (reference:
+        ``Dataset.take_batch`` — raises on an empty dataset)."""
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format):
+            return batch
+        raise ValueError(f"dataset {self._name!r} is empty")
+
     def groupby(self, key: str) -> "GroupedData":
         """Distributed group-by (reference: ``Dataset.groupby`` →
         ``GroupedData``): rows hash-partition to reducers on a
@@ -363,13 +448,7 @@ class Dataset:
         ds = self.random_shuffle(seed=seed) if shuffle else self
 
         def prepare(in_refs, n_out):
-            @raytpu.remote(name="data::tts-count")
-            def count(block):
-                return BlockAccessor(block).num_rows()
-
-            counts = raytpu.get([count.remote(r) for r in in_refs])
-            offsets = np.concatenate(
-                [[0], np.cumsum(counts)]).astype(np.int64)
+            offsets = self._global_offsets(in_refs)
             boundary = int(round(offsets[-1] * (1.0 - test_size)))
             return offsets, boundary
 

@@ -443,3 +443,60 @@ class TestZipSplit:
         assert isinstance(batches[0]["x"], jnp.ndarray)
         assert float(sum(b["x"].sum() for b in batches)) == float(
             np.arange(64).sum())
+
+
+class TestDataParityMethods:
+    def test_random_sample(self, raytpu_local):
+        import raytpu.data as rd
+
+        ds = rd.range(2000, blocks=4)
+        n = ds.random_sample(0.3, seed=0).count()
+        assert 450 < n < 750, n
+        assert ds.random_sample(0.0).count() == 0
+        assert ds.random_sample(1.0).count() == 2000
+        with pytest.raises(ValueError):
+            ds.random_sample(1.5)
+
+    def test_unique(self, raytpu_local):
+        import raytpu.data as rd
+
+        ds = rd.from_items([{"k": i % 5} for i in range(100)], blocks=4)
+        assert ds.unique("k") == [0, 1, 2, 3, 4]
+
+    def test_split_at_indices(self, raytpu_local):
+        import raytpu.data as rd
+
+        parts = rd.range(100, blocks=5).split_at_indices([30, 75])
+        assert [p.count() for p in parts] == [30, 45, 25]
+        # order preserved within each part
+        first = [r["id"] for r in parts[0].take_all()]
+        assert first == list(range(30))
+        last = [r["id"] for r in parts[2].take_all()]
+        assert last == list(range(75, 100))
+
+    def test_take_batch(self, raytpu_local):
+        import raytpu.data as rd
+
+        batch = rd.range(100, blocks=4).take_batch(10)
+        assert list(batch["id"]) == list(range(10))
+        with pytest.raises(ValueError, match="empty"):
+            rd.from_items([], blocks=1).take_batch(5)
+
+    def test_random_sample_decorrelated_across_blocks(self, raytpu_local):
+        import raytpu.data as rd
+
+        ds = rd.range(2000, blocks=4)
+        kept = sorted(r["id"] for r in
+                      ds.random_sample(0.3, seed=0).take_all())
+        # Per-block salting: the kept positions must differ between
+        # blocks (a shared seed keeps identical offsets in every block).
+        per_block = [[i % 500 for i in kept if lo <= i < lo + 500]
+                     for lo in (0, 500, 1000, 1500)]
+        assert not all(b == per_block[0] for b in per_block[1:])
+
+    def test_split_at_indices_empty_dataset(self, raytpu_local):
+        import raytpu.data as rd
+
+        parts = rd.from_items([], blocks=1).split_at_indices([3, 7])
+        assert len(parts) == 3
+        assert [p.count() for p in parts] == [0, 0, 0]
