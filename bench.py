@@ -78,10 +78,16 @@ def main() -> None:
             # r3 sweep), so the interesting region is the "dots" policy —
             # save matmul outputs, recompute elementwise (~few % FLOPs) —
             # with the chunked LM head killing the fp32 [B,T,V] logits
-            # buffer at the bigger batches. (8, full, 0) is the known-fit
-            # r2 fallback. Each candidate costs one compile (~20-40s).
-            candidates = [(16, "dots", 0), (32, "dots", 8192),
-                          (16, "dots", 8192), (8, True, 0)]
+            # buffer at the bigger batches. The KNOWN-FIT r2 config
+            # (8, full) goes first: the attention A/B runs there without
+            # risking an OOM'd A/B, and a failed aggressive candidate
+            # only ever costs its own compile attempt.
+            # Ascending memory within the aggressive region: if both
+            # 16-batch variants fail, 32 certainly would too — so the
+            # early-stop can never skip a config smaller than ones that
+            # already failed.
+            candidates = [(8, True, 0), (16, "dots", 0),
+                          (16, "dots", 8192), (32, "dots", 8192)]
         attn_impls = (["tpu", "reference"] if on_accel
                       else ["reference"])
         if on_accel and _probe_pallas(jnp) != "tpu":
@@ -128,9 +134,16 @@ def main() -> None:
     sweep = []
     best_attn = None
     ab_done = False
+    consecutive_failures = 0
     for ci, (b0, r0, c0) in enumerate(candidates):
         # Attention A/B at the first candidate that fits (recorded either
-        # way); remaining candidates swept with the winning impl.
+        # way); remaining candidates swept with the winning impl. Two
+        # candidates failing in a row ends the sweep — each OOM costs a
+        # full remote compile attempt and the driver's bench has a clock.
+        if consecutive_failures >= 2:
+            sweep.append({"skipped": f"batch={b0} remat={r0} chunk={c0}",
+                          "reason": "2 consecutive candidate failures"})
+            continue
         impls = attn_impls if not ab_done else [best_attn]
         ok = []
         for impl in impls:
@@ -141,6 +154,7 @@ def main() -> None:
                 res = {"batch": b0, "remat": r0, "chunk": c0, "attn": impl,
                        "error": f"{type(e).__name__}: {e}"}
             sweep.append(res)
+        consecutive_failures = 0 if ok else consecutive_failures + 1
         if ok and not ab_done:
             ab_done = True
             best_attn = max(ok, key=lambda r: r["tokens_per_sec"])["attn"]
@@ -150,7 +164,7 @@ def main() -> None:
                           "value": None, "detail": {"sweep": sweep}}))
         sys.exit(1)
 
-    best = max((r for r in sweep if "error" not in r),
+    best = max((r for r in sweep if "tokens_per_sec" in r),
                key=lambda r: r["tokens_per_sec"])
     tokens_per_sec = best["tokens_per_sec"]
     batch = best["batch"]
