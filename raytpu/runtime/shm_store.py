@@ -76,6 +76,8 @@ def _load():
         lib.shm_store_fd.argtypes = [ctypes.c_void_p]
         lib.shm_store_map_size.restype = ctypes.c_uint64
         lib.shm_store_map_size.argtypes = [ctypes.c_void_p]
+        lib.shm_store_set_no_evict.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int]
         _lib = lib
     return _lib
 
@@ -97,11 +99,22 @@ class SharedMemoryStore:
                 f"failed to open shm store {self.name} (capacity={capacity})"
             )
         self._owner = create
+        # The arena is loss-proof by default (C-side no_evict=1): a full
+        # arena FAILS the put — the MemoryStore front spills to disk —
+        # instead of LRU-evicting the ONLY copy of a task result (a silent
+        # eviction leaves a phantom location at the head that drivers poll
+        # until timeout). set_no_evict(False) opts into cache semantics.
         # A Python-side mmap view of the same segment for zero-copy reads.
         fd = lib.shm_store_fd(self._handle)
         self._map = mmap.mmap(fd, lib.shm_store_map_size(self._handle))
         self._mv = memoryview(self._map)
         self._closed = False
+
+    def set_no_evict(self, enable: bool) -> None:
+        """Loss-proof (default) vs cache semantics: with eviction enabled
+        a full arena LRU-discards sealed objects — only safe when every
+        object is re-fetchable elsewhere."""
+        self._lib.shm_store_set_no_evict(self._handle, 1 if enable else 0)
 
     # -- object plane ---------------------------------------------------------
 

@@ -67,6 +67,13 @@ struct Header {
   uint64_t lru_clock;
   uint64_t free_head;  // offset into data region, kNil = none
   uint64_t num_objects;
+  // When set, a full arena FAILS creates instead of LRU-evicting sealed
+  // objects. Eviction is cache semantics; a node's store holds the ONLY
+  // copy of task results — silently discarding one leaves a phantom
+  // location at the head and a driver polling it forever. Overflow is
+  // handled by the caller (spill-to-disk). Shared: every attacher must
+  // honor it.
+  uint64_t no_evict;
 };
 
 struct Store {
@@ -182,6 +189,7 @@ void free_block(Store* s, uint64_t offset, uint64_t size) {
 // Evict unpinned sealed objects, LRU-first, until `needed` bytes could fit.
 // Reference: plasma EvictionPolicy::ChooseObjectsToEvict.
 bool evict_for(Store* s, uint64_t needed) {
+  if (s->hdr->no_evict) return false;
   needed = (needed + 63) & ~63ULL;
   while (true) {
     if (s->hdr->capacity - s->hdr->used_bytes >= needed) {
@@ -276,6 +284,9 @@ void* shm_store_open(const char* name, uint64_t capacity,
     h->used_bytes = 0;
     h->lru_clock = 1;
     h->num_objects = 0;
+    // Loss-proof by default: callers opt INTO cache semantics
+    // (shm_store_set_no_evict(h, 0)) when every object is re-fetchable.
+    h->no_evict = 1;
     memset(reinterpret_cast<uint8_t*>(base) + h->table_offset, 0, table_bytes);
     // One giant free block spanning the data region.
     FreeBlock* fb = reinterpret_cast<FreeBlock*>(
@@ -440,6 +451,14 @@ uint64_t shm_store_num_objects(void* handle) {
 }
 
 int shm_store_fd(void* handle) { return static_cast<Store*>(handle)->fd; }
+
+// Toggle loss-proof mode (see Header::no_evict). Safe from any attacher.
+void shm_store_set_no_evict(void* handle, int enable) {
+  Store* s = static_cast<Store*>(handle);
+  lock(s);
+  s->hdr->no_evict = enable ? 1 : 0;
+  unlock(s);
+}
 
 uint64_t shm_store_map_size(void* handle) {
   return static_cast<Store*>(handle)->map_size;

@@ -17,6 +17,7 @@
 extern "C" {
 void* shm_store_open(const char* name, uint64_t capacity,
                      uint64_t table_slots, int create);
+void shm_store_set_no_evict(void* handle, int enable);
 void shm_store_close(void* handle, int unlink_segment);
 int64_t shm_store_create(void* handle, const uint8_t* key, uint64_t size);
 int shm_store_seal(void* handle, const uint8_t* key);
@@ -78,6 +79,7 @@ void test_store_lifecycle() {
 
 void test_store_eviction_and_reuse() {
   void* s = open_store("/raytpu_test_ev");
+  shm_store_set_no_evict(s, 0);  // cache semantics are opt-in now
   // Fill past capacity with unpinned sealed objects; LRU eviction must
   // keep creates succeeding.
   for (int i = 0; i < 64; i++) {
@@ -91,6 +93,29 @@ void test_store_eviction_and_reuse() {
   assert(shm_store_num_objects(s) <= 16);  // 1MiB / 64KiB
   shm_store_close(s, 1);
   std::printf("store eviction ok\n");
+}
+
+void test_store_no_evict_default() {
+  // Creation default is loss-proof: a full arena fails creates and
+  // nothing sealed is discarded.
+  void* s = open_store("/raytpu_test_ne");
+  int created = 0;
+  for (int i = 0; i < 64; i++) {
+    uint8_t key[kKeySize];
+    make_key(key, i);
+    int64_t off = shm_store_create(s, key, 64 * 1024);
+    if (off < 0) break;
+    assert(shm_store_seal(s, key) == 0);
+    created++;
+  }
+  assert(created >= 8 && created < 64);  // filled, then failed
+  for (int i = 0; i < created; i++) {
+    uint8_t key[kKeySize];
+    make_key(key, i);
+    assert(shm_store_contains(s, key) == 1);  // nothing discarded
+  }
+  shm_store_close(s, 1);
+  std::printf("store no-evict default ok\n");
 }
 
 void test_store_concurrent() {
@@ -177,6 +202,7 @@ void test_score_nodes() {
 int main() {
   test_store_lifecycle();
   test_store_eviction_and_reuse();
+  test_store_no_evict_default();
   test_store_concurrent();
   test_topo_subcube();
   test_topo_concurrent();

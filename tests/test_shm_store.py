@@ -51,11 +51,29 @@ class TestShmStore:
         with pytest.raises(ObjectStoreFullError):
             store.put(oid, serialize(2))
 
-    def test_lru_eviction(self, store):
-        # Fill beyond capacity with unpinned objects: oldest must be evicted.
+    def test_full_arena_fails_put_without_data_loss(self, store):
+        """Default (no_evict) semantics: a full arena FAILS the put —
+        the MemoryStore front spills overflow to disk — and every
+        previously sealed object remains readable. Silent LRU eviction
+        discarded the ONLY copy of task results (the spill-pipeline
+        wedge: phantom head locations polled until timeout)."""
         big = np.zeros(1024 * 1024, dtype=np.uint8)  # 1 MiB each
         oids = []
-        for i in range(30):  # 30 MiB into a 16 MiB store
+        with pytest.raises(ObjectStoreFullError):
+            for i in range(30):  # 30 MiB into a 16 MiB store
+                oid = ObjectID.from_random()
+                store.put(oid, serialize(big))
+                oids.append(oid)
+        assert len(oids) >= 10
+        for oid in oids:  # nothing was discarded
+            assert store.contains(oid)
+
+    def test_lru_eviction_in_cache_mode(self, store):
+        # Cache semantics (opt-in): oldest unpinned objects are evicted.
+        store.set_no_evict(False)
+        big = np.zeros(1024 * 1024, dtype=np.uint8)
+        oids = []
+        for i in range(30):
             oid = ObjectID.from_random()
             store.put(oid, serialize(big))
             oids.append(oid)
@@ -64,6 +82,7 @@ class TestShmStore:
         assert store.used_bytes() <= store.capacity()
 
     def test_pinned_objects_survive_eviction(self, store):
+        store.set_no_evict(False)  # cache mode: eviction allowed
         oid = ObjectID.from_random()
         data = np.arange(262144, dtype=np.uint8)
         store.put(oid, serialize(data))
