@@ -11,10 +11,16 @@ from raytpu.workflow.api import (
     resume_all,
     run,
     run_async,
+    event_exists,
+    post_event,
+    wait_for_event,
 )
 from raytpu.workflow.storage import WorkflowStorage
 
 __all__ = [
+    "post_event",
+    "event_exists",
+    "wait_for_event",
     "WorkflowStorage", "delete", "get_output", "get_status", "init",
     "list_all", "list_steps", "resume", "resume_all", "run", "run_async",
 ]

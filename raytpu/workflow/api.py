@@ -48,6 +48,10 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         raytpu.init()
     storage = _get_storage()
     workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:12]}"
+    if workflow_id.startswith("."):
+        raise ValueError(
+            "workflow ids must not start with '.' (reserved for storage "
+            "internals like .events)")
     if storage.get_status(workflow_id) == "SUCCESSFUL":
         return storage.load_output(workflow_id)
     storage.create_workflow(workflow_id, cloudpickle.dumps(dag),
@@ -79,6 +83,10 @@ def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
         raytpu.init()
     storage = _get_storage()
     workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:12]}"
+    if workflow_id.startswith("."):
+        raise ValueError(
+            "workflow ids must not start with '.' (reserved for storage "
+            "internals like .events)")
     if storage.get_status(workflow_id) != "SUCCESSFUL":
         storage.create_workflow(workflow_id, cloudpickle.dumps(dag),
                                 workflow_input)
@@ -142,6 +150,58 @@ def resume_all(include_running: bool = False) -> List[str]:
             except Exception:
                 pass
     return resumed
+
+
+def post_event(name: str, payload: Any = None) -> None:
+    """Durably deliver an external event (reference: workflow events —
+    ``workflow.wait_for_event`` + event listeners). Any pending
+    ``wait_for_event`` step on this name unblocks with the payload;
+    late waiters see it immediately (events persist)."""
+    _get_storage().post_event(name, payload)
+
+
+def event_exists(name: str) -> bool:
+    return _get_storage().has_event(name)
+
+
+def wait_for_event(name: str, *, poll_interval_s: float = 0.2,
+                   timeout_s: Optional[float] = None):
+    """A DAG node that completes when the named event is posted,
+    returning its payload (reference: ``workflow.wait_for_event``).
+    Durable like any step: a resumed workflow whose wait already
+    completed skips it; one still waiting re-enters the wait."""
+    import raytpu
+
+    root = _get_storage().root
+
+    # num_cpus=0: a pending wait must not hold a CPU slot — N waiting
+    # workflows would otherwise consume every worker and deadlock the
+    # very tasks that could post the event.
+    @raytpu.remote(num_cpus=0, name=f"workflow::wait_event::{name}")
+    def _wait_event(_event_name: str, _root: str,
+                    _poll: float, _timeout):
+        import time as _time
+
+        from raytpu.workflow import api as _api
+        from raytpu.workflow.storage import WorkflowStorage
+
+        # In-process execution shares the module: honor a root set by a
+        # LATER workflow.init() (a wait built before init would bake the
+        # default root). Subprocess workers fall back to the bound hint.
+        storage = _api._storage or WorkflowStorage(_root)
+        deadline = (None if _timeout is None
+                    else _time.monotonic() + _timeout)
+        while True:
+            exists, payload = storage.get_event(_event_name)
+            if exists:
+                return payload
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"workflow event {_event_name!r} not posted within "
+                    f"{_timeout}s")
+            _time.sleep(_poll)
+
+    return _wait_event.bind(name, root, poll_interval_s, timeout_s)
 
 
 def get_status(workflow_id: str) -> Optional[str]:

@@ -43,6 +43,34 @@ class WorkflowStorage:
     def _steps_dir(self, workflow_id: str) -> str:
         return os.path.join(self._wf_dir(workflow_id), "steps")
 
+    def _events_dir(self) -> str:
+        # Dotted: can never collide with a workflow dir (ids starting
+        # with '.' are rejected at run()).
+        return os.path.join(self.root, ".events")
+
+    def _event_path(self, name: str) -> str:
+        # Hex encoding is injective — 'a/b' and 'a_b' must not share a
+        # file (a lossy replace() cross-delivers payloads).
+        return os.path.join(self._events_dir(),
+                            name.encode().hex() + ".pkl")
+
+    # -- durable events (reference: workflow event support) ----------------
+
+    def post_event(self, name: str, payload: Any = None) -> None:
+        self._atomic_write(self._event_path(name),
+                           cloudpickle.dumps(payload))
+
+    def has_event(self, name: str) -> bool:
+        return os.path.exists(self._event_path(name))
+
+    def get_event(self, name: str):
+        """(exists, payload) — durable once posted."""
+        try:
+            with open(self._event_path(name), "rb") as f:
+                return True, cloudpickle.loads(f.read())
+        except FileNotFoundError:
+            return False, None
+
     # -- atomic helpers ----------------------------------------------------
 
     @staticmethod

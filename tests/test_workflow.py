@@ -144,3 +144,104 @@ class TestStorage:
         assert st.load_dag("w") == b"blob"
         st.save_output("w", [1, 2, 3])
         assert st.load_output("w") == [1, 2, 3]
+
+
+class TestWorkflowEvents:
+    """Durable external events (reference: workflow.wait_for_event +
+    event listeners)."""
+
+    def test_wait_unblocks_on_post(self, wf):
+        import threading
+
+        def poster():
+            time.sleep(0.5)
+            wf.post_event("shipment", {"status": "arrived"})
+
+        threading.Thread(target=poster, daemon=True).start()
+        ev = wf.wait_for_event("shipment")
+
+        @raytpu.remote
+        def consume(payload):
+            return payload["status"].upper()
+
+        out = wf.run(consume.bind(ev))
+        assert out == "ARRIVED"
+
+    def test_posted_event_is_durable_for_late_waiters(self, wf):
+        wf.post_event("already", 42)
+        assert wf.event_exists("already")
+
+        @raytpu.remote
+        def plus_one(x):
+            return x + 1
+
+        out = wf.run(plus_one.bind(wf.wait_for_event("already")))
+        assert out == 43
+        # And a SECOND workflow sees it too (events persist).
+        out2 = wf.run(plus_one.bind(wf.wait_for_event("already")))
+        assert out2 == 43
+
+    def test_wait_timeout_fails_workflow(self, wf):
+        @raytpu.remote
+        def identity(x):
+            return x
+
+        with pytest.raises(Exception):
+            wf.run(identity.bind(
+                wf.wait_for_event("never", timeout_s=0.5)))
+
+    def test_resume_reenters_pending_wait(self, wf):
+        """A workflow interrupted while waiting RESUMES into the wait and
+        completes when the event lands: the durable record is created
+        without ever executing (the crash-before-any-step shape), then
+        resume() drives it into the pending wait."""
+        import threading
+
+        import cloudpickle
+
+        from raytpu.workflow.api import _get_storage
+
+        @raytpu.remote
+        def consume(payload):
+            return payload * 10
+
+        wid = "wf-event-resume"
+        dag = consume.bind(wf.wait_for_event("later"))
+        # Durable record only — simulates a process that died before/while
+        # executing (the executor never ran in 'that' process).
+        _get_storage().create_workflow(wid, cloudpickle.dumps(dag), None)
+        assert wf.get_status(wid) == "RUNNING"
+
+        box = {}
+
+        def do_resume():
+            box["out"] = wf.resume(wid)
+
+        t = threading.Thread(target=do_resume, daemon=True)
+        t.start()
+        time.sleep(0.8)
+        assert "out" not in box  # resumed INTO the wait, still pending
+        wf.post_event("later", 7)
+        t.join(timeout=30)
+        assert box.get("out") == 70
+        assert wf.get_status(wid) == "SUCCESSFUL"
+
+    def test_reserved_workflow_id_rejected(self, wf):
+        @raytpu.remote
+        def one():
+            return 1
+
+        with pytest.raises(ValueError, match="reserved"):
+            wf.run(one.bind(), workflow_id=".events")
+
+    def test_slash_vs_underscore_events_distinct(self, wf):
+        wf.post_event("a/b", 1)
+        wf.post_event("a_b", 2)
+        assert wf.event_exists("a/b") and wf.event_exists("a_b")
+
+        @raytpu.remote
+        def identity(x):
+            return x
+
+        assert wf.run(identity.bind(wf.wait_for_event("a/b"))) == 1
+        assert wf.run(identity.bind(wf.wait_for_event("a_b"))) == 2
