@@ -141,6 +141,22 @@ class _GroupState:
 _local = threading.local()
 
 
+def _reset_thread_groups() -> None:
+    """Task-scope reset: execution threads are reused across tasks; a
+    group one task joined must not look initialized to the next task on
+    the same thread (stale rank/coordinator -> wrong reductions)."""
+    if hasattr(_local, "groups"):
+        del _local.groups
+
+
+try:
+    from raytpu.runtime import context as _ctx_mod
+
+    _ctx_mod.register_task_scope_reset(_reset_thread_groups)
+except Exception:  # pragma: no cover — import-order safety
+    pass
+
+
 def _groups() -> Dict[str, _GroupState]:
     if not hasattr(_local, "groups"):
         _local.groups = {}

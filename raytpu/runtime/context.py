@@ -55,3 +55,25 @@ def set_current(ctx: Optional[RuntimeContext]):
 def in_task() -> bool:
     ctx = getattr(_tls, "ctx", None)
     return ctx is not None and ctx.task_id is not None
+
+
+# -- task-scope thread-local resets -----------------------------------------
+# Execution threads are REUSED across tasks (local_backend._SoftThreadPool);
+# modules that key state on the executing thread (e.g. collective group
+# membership) register a reset here so one task's thread-locals never leak
+# into the next task scheduled on the same worker thread.
+_task_scope_resets: list = []
+
+
+def register_task_scope_reset(fn) -> None:
+    _task_scope_resets.append(fn)
+
+
+def reset_task_scope() -> None:
+    """Called by the executor between tasks on a reused thread."""
+    set_current(None)
+    for fn in _task_scope_resets:
+        try:
+            fn()
+        except Exception:
+            pass

@@ -77,6 +77,73 @@ class _PlacementGroup:
     state: str = "created"  # created | removed
 
 
+class _SoftThreadPool:
+    """Grow-on-demand executor for task bodies.
+
+    Thread-per-task semantics at pooled cost: an idle thread is reused,
+    but a submit NEVER queues behind a busy one — a task blocked in
+    raytpu.get must not delay an unrelated dispatch (the deadlock a
+    fixed-size pool would reintroduce). Idle threads expire after
+    ``idle_ttl``; the submit/expire race is linearized under one lock so
+    a reserved work item can never be orphaned."""
+
+    def __init__(self, name: str = "task-exec", idle_ttl: float = 10.0):
+        self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._idle = 0
+        self._name = name
+        self._ttl = idle_ttl
+        self._seq = 0
+
+    def submit(self, fn, *args) -> None:
+        with self._lock:
+            if self._idle > 0:
+                self._idle -= 1
+                self._q.put((fn, args))
+                return
+            self._seq += 1
+            seq = self._seq
+        threading.Thread(target=self._worker, args=(fn, args),
+                         daemon=True, name=f"{self._name}-{seq}").start()
+
+    def _worker(self, fn, args) -> None:
+        from raytpu.runtime import context as ctx_mod
+
+        while True:
+            try:
+                fn(*args)
+            except Exception:  # task errors are handled inside _run_task;
+                # anything reaching here is scheduler-state trouble —
+                # surface it (the old thread-per-task model at least got
+                # the default excepthook traceback).
+                import logging
+                import traceback
+
+                logging.getLogger("raytpu").error(
+                    "task execution thread raised:\n%s",
+                    traceback.format_exc())
+            # Reused threads must not leak one task's thread-locals
+            # (collective group membership etc.) into the next.
+            ctx_mod.reset_task_scope()
+            fn = args = None  # don't pin the finished task while idle
+            with self._lock:
+                self._idle += 1
+            try:
+                fn, args = self._q.get(timeout=self._ttl)
+                continue
+            except queue.Empty:
+                pass
+            with self._lock:
+                # A submit may have reserved us between the timeout and
+                # this lock: drain it rather than orphaning the item.
+                try:
+                    fn, args = self._q.get_nowait()
+                    continue
+                except queue.Empty:
+                    self._idle -= 1
+                    return
+
+
 class _ActorRuntime:
     """One live actor: a dedicated thread draining an ordered queue.
 
@@ -331,6 +398,9 @@ class LocalBackend:
 
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
+        # Thread spawn dominated the task hot path (~half the per-task
+        # cost in profile); reuse execution threads instead.
+        self._exec_threads = _SoftThreadPool()
         self._tasks: Dict[TaskID, _TaskRecord] = {}
         self._waiting_on: Dict[ObjectID, set] = {}  # oid -> task_ids
         self._ready: List[TaskID] = []
@@ -727,10 +797,7 @@ class LocalBackend:
                     # Nothing fits right now; wait for a release.
                     self._cv.wait(timeout=0.05)
             for rec in dispatched:
-                threading.Thread(
-                    target=self._run_task, args=(rec,), daemon=True,
-                    name=f"task-{rec.spec.name[:24]}",
-                ).start()
+                self._exec_threads.submit(self._run_task, rec)
 
     def _run_task(self, rec: _TaskRecord):
         spec = rec.spec

@@ -178,3 +178,26 @@ class TestMeshOps:
         # Resharded: seq now full per shard, heads sharded.
         assert out.shape == (8, 4)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+class TestThreadReuseIsolation:
+    def test_reused_thread_does_not_leak_group_state(self, raytpu_local):
+        """Execution threads are pooled; collective membership is keyed on
+        the thread and must reset between tasks (a stale rank would make
+        the next task skip init and reduce with wrong membership)."""
+        raytpu = raytpu_local
+        from raytpu import collective
+
+        @raytpu.remote
+        def join_group():
+            collective.init_collective_group(1, 0, group_name="leaky")
+            return collective.is_group_initialized("leaky")
+
+        @raytpu.remote
+        def check_group():
+            return collective.is_group_initialized("leaky")
+
+        assert raytpu.get(join_group.remote(), timeout=30) is True
+        # Serial tasks on 1 CPU reuse the same pooled thread.
+        for _ in range(3):
+            assert raytpu.get(check_group.remote(), timeout=30) is False
