@@ -76,4 +76,11 @@ def reset_task_scope() -> None:
         try:
             fn()
         except Exception:
-            pass
+            # A silently-broken reset would reintroduce the cross-task
+            # leak class this mechanism exists to prevent — be loud.
+            import logging
+            import traceback
+
+            logging.getLogger("raytpu").error(
+                "task-scope reset %r failed:\n%s", fn,
+                traceback.format_exc())

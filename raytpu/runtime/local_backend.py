@@ -770,10 +770,24 @@ class LocalBackend:
                 if self._shutdown:
                     return
                 dispatched = []
+                # Requirement-identical skip: once a (resources,
+                # scheduling-target) signature fails to allocate in this
+                # scan, every later task with the SAME signature must fail
+                # too (availability only shrinks mid-scan) — turns the
+                # O(queue) rescans of a deep homogeneous backlog into
+                # O(distinct signatures).
+                failed_sigs: set = set()
                 for tid in list(self._ready):
                     rec = self._tasks.get(tid)
                     if rec is None or rec.state != "ready":
                         self._ready.remove(tid)
+                        continue
+                    sched = rec.spec.scheduling
+                    sig = (tuple(sorted(rec.required.to_dict().items())),
+                           sched.kind,
+                           sched.pg_id.binary() if sched.pg_id else None,
+                           sched.bundle_index)
+                    if sig in failed_sigs:
                         continue
                     try:
                         allocated = self._try_allocate(rec)
@@ -793,6 +807,8 @@ class LocalBackend:
                         dispatched.append(rec)
                     elif rec.state == "done":  # infeasible
                         self._ready.remove(tid)
+                    else:
+                        failed_sigs.add(sig)
                 if not dispatched:
                     # Nothing fits right now; wait for a release.
                     self._cv.wait(timeout=0.05)

@@ -367,6 +367,22 @@ class Worker:
         stores RayTaskError values the same way (``task_manager.cc``
         ``MarkTaskReturnObjectsFailed``).
         """
+        # Execution threads are REUSED (local soft pool; cluster workers'
+        # asyncio default executor): one task's thread-local state
+        # (collective membership etc.) must never leak into the next task
+        # on the same thread. This is the shared execution core, so the
+        # reset covers every executor.
+        try:
+            return self._execute_task_inner(spec, get_fn, actor_instance,
+                                            store_errors)
+        finally:
+            ctx_mod.reset_task_scope()
+
+    def _execute_task_inner(self, spec: TaskSpec,
+                            get_fn: Callable[[ObjectID], SerializedValue],
+                            actor_instance: Any = None,
+                            store_errors: bool = True
+                            ) -> Optional[BaseException]:
         return_ids = spec.return_ids()
         if self.is_cancelled(spec.task_id):
             err = TaskCancelledError(f"task {spec.name} cancelled")
