@@ -137,9 +137,14 @@ std::string Pack(const ValuePtr& v) {
           } else if (n < 256) {
             out->push_back(static_cast<char>(0xd9));
             PutBE(out, n, 1);
-          } else {
+          } else if (n < 65536) {
             out->push_back(static_cast<char>(0xda));
             PutBE(out, n, 2);
+          } else if (n <= 0xFFFFFFFFull) {
+            out->push_back(static_cast<char>(0xdb));  // str32
+            PutBE(out, n, 4);
+          } else {
+            throw std::runtime_error("wire: string exceeds str32 max");
           }
           out->append(v->s);
           break;
@@ -152,9 +157,11 @@ std::string Pack(const ValuePtr& v) {
           } else if (n < 65536) {
             out->push_back(static_cast<char>(0xc5));
             PutBE(out, n, 2);
-          } else {
+          } else if (n <= 0xFFFFFFFFull) {
             out->push_back(static_cast<char>(0xc6));
             PutBE(out, n, 4);
+          } else {
+            throw std::runtime_error("wire: binary exceeds bin32 max");
           }
           out->append(v->s);
           break;
@@ -163,9 +170,14 @@ std::string Pack(const ValuePtr& v) {
           size_t n = v->arr.size();
           if (n < 16) {
             out->push_back(static_cast<char>(0x90 | n));
-          } else {
+          } else if (n < 65536) {
             out->push_back(static_cast<char>(0xdc));
             PutBE(out, n, 2);
+          } else if (n <= 0xFFFFFFFFull) {
+            out->push_back(static_cast<char>(0xdd));  // array32
+            PutBE(out, n, 4);
+          } else {
+            throw std::runtime_error("wire: array exceeds array32 max");
           }
           for (const auto& item : v->arr) Go(item, out);
           break;
@@ -174,9 +186,14 @@ std::string Pack(const ValuePtr& v) {
           size_t n = v->map.size();
           if (n < 16) {
             out->push_back(static_cast<char>(0x80 | n));
-          } else {
+          } else if (n < 65536) {
             out->push_back(static_cast<char>(0xde));
             PutBE(out, n, 2);
+          } else if (n <= 0xFFFFFFFFull) {
+            out->push_back(static_cast<char>(0xdf));  // map32
+            PutBE(out, n, 4);
+          } else {
+            throw std::runtime_error("wire: map exceeds map32 max");
           }
           for (const auto& kv : v->map) {
             Go(kv.first, out);

@@ -9,9 +9,51 @@
 
 #include "raytpu/client.h"
 
+// Offline wire self-test: encoder must emit str32/array32/map32 for
+// oversize values (>= 64 KiB strings / >= 65536 elements) and round-trip
+// them, instead of silently truncating the 16-bit length field.
+static void WireSelfTest() {
+  using raytpu::Value;
+  std::string big(100 * 1024, 'x');
+  big[0] = 'a';
+  big[big.size() - 1] = 'z';
+
+  std::vector<raytpu::ValuePtr> items;
+  items.reserve(70000);
+  for (int i = 0; i < 70000; i++) items.push_back(Value::Int(i & 0x7f));
+
+  std::vector<std::pair<raytpu::ValuePtr, raytpu::ValuePtr>> kvs;
+  kvs.reserve(66000);
+  for (int i = 0; i < 66000; i++) {
+    kvs.emplace_back(Value::Int(i), Value::Int(i & 1));
+  }
+
+  auto root = Value::MapV({
+      {Value::Str("big_str"), Value::Str(big)},
+      {Value::Str("big_bin"), Value::Bin(big)},
+      {Value::Str("big_arr"), Value::Array(std::move(items))},
+      {Value::Str("big_map"), Value::MapV(std::move(kvs))},
+  });
+  std::string frame = raytpu::PackFrame(root);
+  auto back = raytpu::UnpackFrame(frame);
+  assert(back->type == Value::kMap);
+  assert(back->Get("big_str")->s == big);
+  assert(back->Get("big_bin")->s == big);
+  assert(back->Get("big_arr")->arr.size() == 70000);
+  assert(back->Get("big_arr")->arr[69999]->i == (69999 & 0x7f));
+  assert(back->Get("big_map")->map.size() == 66000);
+  std::printf("PASS wire_selftest frame=%zu\n", frame.size());
+}
+
 int main(int argc, char** argv) {
+  if (argc >= 2 && std::string(argv[1]) == "--selftest") {
+    WireSelfTest();
+    std::printf("ALL CPP WIRE SELFTESTS PASSED\n");
+    return 0;
+  }
   if (argc < 3) {
-    std::fprintf(stderr, "usage: %s <host> <port>\n", argv[0]);
+    std::fprintf(stderr, "usage: %s <host> <port> | %s --selftest\n",
+                 argv[0], argv[0]);
     return 2;
   }
   raytpu::Client c(argv[1], std::atoi(argv[2]));
@@ -29,6 +71,17 @@ int main(int argc, char** argv) {
   c.KvDel("cpp::greeting");
   assert(!c.KvGet("cpp::greeting", &val));
   std::printf("PASS kv\n");
+
+  // str32 on the live socket: the Python peer must decode a >=64 KiB
+  // value this encoder produced, and vice versa.
+  std::string big(100 * 1024, 'y');
+  big[7] = 'Q';
+  c.KvPut("cpp::big", big);
+  std::string big_back;
+  assert(c.KvGet("cpp::big", &big_back));
+  assert(big_back == big);
+  c.KvDel("cpp::big");
+  std::printf("PASS kv_big\n");
 
   auto nodes = c.ListNodes();
   assert(nodes->type == raytpu::Value::kArray);

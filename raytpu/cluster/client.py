@@ -45,6 +45,10 @@ from raytpu.runtime.object_ref import ObjectRef
 from raytpu.runtime.serialization import SerializedValue, serialize
 from raytpu.runtime.task_spec import ArgKind, SchedulingKind, TaskSpec
 
+import logging
+
+logger = logging.getLogger(__name__)
+
 
 class _InFlight:
     __slots__ = ("spec", "node_id", "attempts")
@@ -356,8 +360,11 @@ class ClusterBackend:
                     continue
                 peer.call("put_object", oid.hex(), sv.to_bytes(),
                           timeout=None)
-            except Exception:
-                pass  # submission surfaces the real failure if it matters
+            except Exception as e:
+                # The task will fail node-side with a missing-object pull
+                # error; leave a trail pointing at the real cause.
+                logger.warning("push of driver-local arg %s to %s failed: "
+                               "%s", oid.hex()[:12], addr, e)
 
     def _free_loop(self) -> None:
         # Head-mediated free (borrower protocol): the head defers the free

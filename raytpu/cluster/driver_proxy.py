@@ -17,10 +17,15 @@ cluster nodes — the proxy is not an open TCP forwarder.
 
 from __future__ import annotations
 
+import asyncio
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
+from raytpu.core.config import cfg
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+
+_NO_TIMEOUT = "__no_timeout__"  # legacy relay frames carry no timeout field
 
 
 class DriverProxy:
@@ -28,6 +33,14 @@ class DriverProxy:
                  port: int = 0):
         self._head_address = head_address
         self._rpc = RpcServer(host, port)
+        # Upstream calls are blocking (RpcClient.call); running them on the
+        # server's asyncio loop thread would serialize every driver through
+        # one thread and let a single hung upstream wedge the whole proxy
+        # (ADVICE r3). Handlers therefore offload to this pool with a
+        # finite timeout.
+        self._pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="raytpu-proxy-relay")
+        self._relay_timeout = float(cfg.proxy_relay_timeout_s)
         self._lock = threading.Lock()
         self._targets: Dict[str, RpcClient] = {}
         # (target, topic) -> driver peers to push to
@@ -55,6 +68,7 @@ class DriverProxy:
         for c in clients:
             c.close()
         self._rpc.stop()
+        self._pool.shutdown(wait=False)
 
     # -- handlers ----------------------------------------------------------
 
@@ -110,14 +124,38 @@ class DriverProxy:
 
         return fanout
 
-    def _relay_call(self, peer: Peer, target: str, method: str, args: list):
+    async def _relay_call(self, peer: Peer, target: str, method: str,
+                          args: list, timeout: object = _NO_TIMEOUT):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._pool, self._relay_call_blocking, peer, target, method,
+            args, timeout)
+
+    def _relay_call_blocking(self, peer: Peer, target: str, method: str,
+                             args: list, timeout: object):
         self._check_target(target)
         if method == "subscribe":
             self._wire_subscription(peer, target, str(args[0]))
-        return self._target(target).call(method, *args, timeout=None)
+        # The driver's own budget bounds the upstream call. timeout=None
+        # (e.g. a large put_object upload) maps to a long finite backstop
+        # rather than forever, so a hung upstream releases its pool
+        # thread eventually; legacy 4-arg frames get the default cap.
+        if timeout is _NO_TIMEOUT:
+            up: Optional[float] = self._relay_timeout
+        elif timeout is None:
+            up = max(self._relay_timeout, 3600.0)
+        else:
+            up = float(timeout)  # type: ignore[arg-type]
+        return self._target(target).call(method, *args, timeout=up)
 
-    def _relay_notify(self, peer: Peer, target: str, method: str,
-                      args: list) -> None:
+    async def _relay_notify(self, peer: Peer, target: str, method: str,
+                            args: list) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            self._pool, self._relay_notify_blocking, target, method, args)
+
+    def _relay_notify_blocking(self, target: str, method: str,
+                               args: list) -> None:
         self._check_target(target)
         self._target(target).notify(method, *args)
 

@@ -131,8 +131,14 @@ class HeadServer:
         # beat the node's synchronous borrow_added for the same (oid,
         # borrower) in a narrow drop-during-registration race; the add
         # then cancels against the tombstone instead of recording a
-        # borrow that would never be released.
-        self._early_releases: Set[Tuple[str, str]] = set()
+        # borrow that would never be released. Values are creation times:
+        # the matching add lands within one task-completion round-trip,
+        # so anything older than the TTL is a release whose add will
+        # never come — kept entries would otherwise leak and cancel a
+        # future legitimate borrow of the same pair (ADVICE r3).
+        self._early_releases: Dict[Tuple[str, str], float] = {}
+        self._early_release_ttl_s = 60.0
+        self._early_release_cap = 10000
         # Structured-event ring (reference: dashboard event module over
         # RAY_EVENT files); nodes forward their events here.
         self._events = deque(maxlen=2000)
@@ -398,12 +404,24 @@ class HeadServer:
 
     # -- borrower protocol --------------------------------------------------
 
+    def _prune_early_releases(self) -> None:
+        """Caller holds self._lock. Expire stale tombstones and bound the
+        table so unmatched releases can't grow it or cancel a much-later
+        legitimate borrow of the same (oid, borrower) pair."""
+        now = time.monotonic()
+        dead = [k for k, t in self._early_releases.items()
+                if now - t > self._early_release_ttl_s]
+        for k in dead:
+            del self._early_releases[k]
+        while len(self._early_releases) > self._early_release_cap:
+            self._early_releases.pop(next(iter(self._early_releases)))
+
     def _borrow_added(self, peer: Peer, oid_hexes: List[str],
                       borrower: str) -> bool:
         with self._lock:
+            self._prune_early_releases()
             for oh in oid_hexes:
-                if (oh, borrower) in self._early_releases:
-                    self._early_releases.discard((oh, borrower))
+                if self._early_releases.pop((oh, borrower), None) is not None:
                     continue  # released before the add landed
                 self._borrows.setdefault(oh, set()).add(borrower)
         return True
@@ -412,9 +430,10 @@ class HeadServer:
                          borrower: str) -> None:
         free_now = False
         with self._lock:
+            self._prune_early_releases()
             holders = self._borrows.get(oid_hex)
             if holders is None or borrower not in holders:
-                self._early_releases.add((oid_hex, borrower))
+                self._early_releases[(oid_hex, borrower)] = time.monotonic()
             if holders is not None:
                 holders.discard(borrower)
                 if not holders:

@@ -45,8 +45,11 @@ class RelayClient:
 
     def call(self, method: str, *args,
              timeout: Optional[float] = 30.0) -> Any:
+        # The requested timeout rides the frame so the proxy bounds the
+        # upstream call with the CALLER's budget — a long upload with
+        # timeout=None must not be cut off by the proxy's default cap.
         return self._chan._rpc.call("relay_call", self._target, method,
-                                    list(args), timeout=timeout)
+                                    list(args), timeout, timeout=timeout)
 
     def notify(self, method: str, *args) -> None:
         self._chan._rpc.notify("relay_notify", self._target, method,

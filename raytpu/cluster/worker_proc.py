@@ -190,14 +190,25 @@ class _WorkerHost:
         import queue as _q
 
         self._borrowed: set = set()
+        # Guards _borrowed so the hook's check+discard+enqueue and
+        # collect_borrows' add/retract are atomic — without it both sides
+        # can win the discard, the release fires for a borrow that was
+        # never reported, and the head tombstones the NEXT legitimate
+        # borrow for the pair (ADVICE r3). Leaf lock: never take
+        # ReferenceCounter._lock while holding it (the hook already runs
+        # under rc._lock, so the nesting order is rc -> borrow only).
+        self._borrow_lock = threading.Lock()
         self._release_queue: "_q.Queue" = _q.Queue()
         prev_oos = self.worker.reference_counter._on_out_of_scope
 
         def _oos(oid):
             if prev_oos is not None:
                 prev_oos(oid)
-            if oid in self._borrowed:
-                self._borrowed.discard(oid)
+            with self._borrow_lock:
+                queued = oid in self._borrowed
+                if queued:
+                    self._borrowed.discard(oid)
+            if queued:
                 self._release_queue.put(oid)
 
         self.worker.reference_counter._on_out_of_scope = _oos
@@ -285,17 +296,23 @@ class _WorkerHost:
                 continue
             seen.add(oid)
             ref = rc.get(oid)
-            if ref is None or ref.local_ref_count <= 0 \
-                    or oid in self._borrowed:
+            if ref is None or ref.local_ref_count <= 0:
                 continue
-            self._borrowed.add(oid)
+            with self._borrow_lock:
+                if oid in self._borrowed:
+                    continue
+                self._borrowed.add(oid)
             ref = rc.get(oid)
             if ref is None or ref.local_ref_count <= 0:
-                # Dropped mid-registration: retract unless the oos hook
-                # already consumed the membership (queued a release).
-                if oid in self._borrowed:
-                    self._borrowed.discard(oid)
-                    continue
+                # Dropped mid-registration. Exactly one side wins the
+                # discard under the lock: if we do, no release was queued
+                # and the borrow is retracted silently; if the hook did,
+                # the release is queued so the borrow MUST be reported
+                # (the head cancels it via its early-release tombstone).
+                with self._borrow_lock:
+                    if oid in self._borrowed:
+                        self._borrowed.discard(oid)
+                        continue
             out.append(oid.hex())
         return out
 

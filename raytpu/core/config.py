@@ -118,6 +118,9 @@ declare("max_lineage_bytes", 1024 * 1024 * 1024)
 declare("rpc_connect_timeout_s", 10.0)
 declare("rpc_call_timeout_s", 120.0)
 declare("pubsub_batch_ms", 10)
+# Upper bound on one relayed driver-proxy RPC; a hung upstream node fails
+# the one relayed call instead of wedging the proxy (see driver_proxy.py).
+declare("proxy_relay_timeout_s", 120.0)
 
 # Metrics / events.
 declare("metrics_report_interval_ms", 2500)

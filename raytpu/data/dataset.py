@@ -460,6 +460,11 @@ class Dataset:
         both = ds._all_to_all(2, assign, "train_test_split",
                               prepare_fn=prepare)
         refs = list(both._iter_block_refs())
+        if not refs:  # empty upstream: two empty datasets, like split_at
+            return (Dataset(lambda: iter(()), [],
+                            name=f"{self._name}.train"),
+                    Dataset(lambda: iter(()), [],
+                            name=f"{self._name}.test"))
         train_ref, test_ref = refs[0], refs[1]
         return (Dataset(lambda r=train_ref: iter([r]), [],
                         name=f"{self._name}.train"),

@@ -23,6 +23,15 @@ def _build_smoke():
 
 
 class TestCppClient:
+    def test_wire_selftest_oversize_values(self):
+        """Encoder emits str32/array32/map32 for >=64KiB / >=65536-element
+        values instead of truncating the 16-bit length (ADVICE r3)."""
+        _build_smoke()
+        out = subprocess.run([SMOKE, "--selftest"], capture_output=True,
+                             text=True, timeout=60)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "ALL CPP WIRE SELFTESTS PASSED" in out.stdout
+
     def test_cpp_client_against_live_cluster(self, tmp_path):
         _build_smoke()
         import raytpu
@@ -45,7 +54,8 @@ class TestCppClient:
                                  text=True, timeout=60)
             assert out.returncode == 0, (out.stdout, out.stderr)
             assert "ALL CPP CLIENT TESTS PASSED" in out.stdout
-            for probe in ["PASS ping", "PASS kv", "PASS list_nodes",
+            for probe in ["PASS ping", "PASS kv", "PASS kv_big",
+                          "PASS list_nodes",
                           "PASS named_actor ", "PASS named_actor_missing"]:
                 assert probe in out.stdout, out.stdout
         finally:

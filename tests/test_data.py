@@ -500,3 +500,10 @@ class TestDataParityMethods:
         parts = rd.from_items([], blocks=1).split_at_indices([3, 7])
         assert len(parts) == 3
         assert [p.count() for p in parts] == [0, 0, 0]
+
+    def test_train_test_split_empty_dataset(self, raytpu_local):
+        """ADVICE r3: empty upstream used to IndexError on refs[0]."""
+        import raytpu.data as rd
+
+        train, test = rd.from_items([], blocks=1).train_test_split(0.25)
+        assert train.count() == 0 and test.count() == 0

@@ -114,3 +114,108 @@ class TestDriverProxy:
         outside = chan.client_for("127.0.0.1:1")
         with pytest.raises(Exception, match="not a cluster address"):
             outside.call("ping")
+
+
+class TestProxyRelayConcurrency:
+    """ADVICE r3: a blocking/hung upstream call must not serialize the
+    proxy loop — other drivers' relayed frames keep flowing, and a hung
+    call fails with a finite timeout instead of wedging forever."""
+
+    @pytest.fixture
+    def fake_upstream_proxy(self):
+        import asyncio
+
+        from raytpu.cluster.protocol import RpcServer
+
+        upstream = RpcServer()
+
+        def ping(peer):
+            return "pong"
+
+        async def slow(peer, seconds):
+            # async so the *upstream* stays responsive — the serialization
+            # under test is the proxy's, not this fake's.
+            await asyncio.sleep(seconds)
+            return "slept"
+
+        upstream.register("ping", ping)
+        upstream.register("slow", slow)
+        upstream.register("list_nodes", lambda peer: [])
+        addr = upstream.start()
+        proxy = DriverProxy(addr)
+        proxy_addr = proxy.start()
+        yield proxy_addr
+        proxy.stop()
+        upstream.stop()
+
+    def test_slow_relay_does_not_block_other_calls(self,
+                                                   fake_upstream_proxy):
+        import threading
+        import time
+
+        from raytpu.cluster.relay import RelayChannel
+
+        chan = RelayChannel(fake_upstream_proxy)
+        head = chan.client_for(chan.head_address)
+        slow_done = threading.Event()
+
+        def run_slow():
+            head.call("slow", 3.0, timeout=30.0)
+            slow_done.set()
+
+        t = threading.Thread(target=run_slow, daemon=True)
+        t.start()
+        time.sleep(0.2)  # the slow call is in flight on the proxy
+        t0 = time.perf_counter()
+        assert head.call("ping", timeout=5.0) == "pong"
+        elapsed = time.perf_counter() - t0
+        chan.close()
+        assert elapsed < 1.5, (
+            f"ping took {elapsed:.2f}s behind a hung relay call — the "
+            f"proxy loop is serializing upstream calls")
+        # The 3s slow call must still be in flight, proving the ping
+        # genuinely overlapped it rather than running after it finished.
+        assert not slow_done.is_set()
+
+    def test_hung_relay_call_times_out(self):
+        import time
+
+        from raytpu.core.config import cfg as config
+        from raytpu.cluster.protocol import RpcServer
+        from raytpu.cluster.relay import RelayChannel
+
+        import asyncio
+
+        async def hang(peer):
+            await asyncio.sleep(60)
+
+        upstream = RpcServer()
+        upstream.register("ping", lambda peer: "pong")
+        upstream.register("hang", hang)
+        upstream.register("list_nodes", lambda peer: [])
+        addr = upstream.start()
+        old = config.proxy_relay_timeout_s
+        config.set("proxy_relay_timeout_s", 0.5)
+        try:
+            proxy = DriverProxy(addr)
+            proxy_addr = proxy.start()
+            chan = RelayChannel(proxy_addr)
+            head = chan.client_for(chan.head_address)
+            # Driver-requested budget rides the frame and bounds the
+            # upstream call.
+            t0 = time.perf_counter()
+            with pytest.raises(Exception, match="(?i)time"):
+                head.call("hang", timeout=1.0)
+            assert time.perf_counter() - t0 < 5.0
+            # Legacy 4-arg frame (no timeout field): the proxy's default
+            # cap applies instead of hanging forever.
+            t0 = time.perf_counter()
+            with pytest.raises(Exception, match="(?i)time"):
+                chan._rpc.call("relay_call", chan.head_address, "hang",
+                               [], timeout=10.0)
+            assert time.perf_counter() - t0 < 5.0
+            chan.close()
+            proxy.stop()
+        finally:
+            config.set("proxy_relay_timeout_s", old)
+        upstream.stop()
