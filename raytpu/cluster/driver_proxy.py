@@ -32,7 +32,14 @@ class DriverProxy:
     def __init__(self, head_address: str, host: str = "127.0.0.1",
                  port: int = 0):
         self._head_address = head_address
-        self._rpc = RpcServer(host, port)
+        # The proxy is the one cluster surface a REMOTE driver reaches, so
+        # it speaks the strict wire (wire.py contract): a pickle frame
+        # from the network is rejected at decode instead of executing.
+        # Driver payloads that genuinely carry code (task functions,
+        # cloudpickled args) are opaque `bytes` inside relay frames and
+        # deserialize only on cluster nodes, same trust shape as the
+        # reference's ray:// client.
+        self._rpc = RpcServer(host, port, allow_pickle=False)
         # Upstream calls are blocking (RpcClient.call); running them on the
         # server's asyncio loop thread would serialize every driver through
         # one thread and let a single hung upstream wedge the whole proxy
@@ -162,6 +169,12 @@ class DriverProxy:
     def _wire_subscription(self, peer: Peer, target: str,
                            topic: str) -> None:
         key = (target, topic)
+        # Resolve the upstream client BEFORE recording the topic: a fresh
+        # connection re-wires every topic already in _target_topics, so
+        # recording first would make the subscribe below a duplicate
+        # callback (client subscriptions append since the multi-waiter
+        # change) and every push would fan out twice.
+        client = self._target(target)
         with self._lock:
             first = key not in self._subs
             peers = self._subs.setdefault(key, [])
@@ -169,7 +182,7 @@ class DriverProxy:
                 peers.append(peer)
             self._target_topics.setdefault(target, set()).add(topic)
         if first:
-            self._target(target).subscribe(topic, self._make_fanout(key))
+            client.subscribe(topic, self._make_fanout(key))
 
     def _peer_gone(self, peer: Peer) -> None:
         with self._lock:

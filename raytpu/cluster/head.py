@@ -355,6 +355,15 @@ class HeadServer:
             for peers in self._subscribers.values():
                 if peer in peers:
                     peers.remove(peer)
+            # Object waiters registered by the departed peer would leak
+            # (they're only popped when the object is first reported).
+            for oid in list(self._object_waiters):
+                waiters = [p for p in self._object_waiters[oid]
+                           if p is not peer]
+                if waiters:
+                    self._object_waiters[oid] = waiters
+                else:
+                    del self._object_waiters[oid]
 
     def _health_loop(self) -> None:
         while not self._stop.wait(CHECK_PERIOD_S):
@@ -789,7 +798,9 @@ class HeadServer:
                 if nid in self._nodes and self._nodes[nid].alive
             ]
             if not locs and wait:
-                self._object_waiters.setdefault(object_id, []).append(peer)
+                waiters = self._object_waiters.setdefault(object_id, [])
+                if peer not in waiters:
+                    waiters.append(peer)
         return locs
 
     # -- placement groups --------------------------------------------------

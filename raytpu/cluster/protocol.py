@@ -25,7 +25,7 @@ import itertools
 import socket
 import struct
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from raytpu.cluster import wire
 
@@ -41,17 +41,19 @@ class ConnectionLost(RpcError):
     pass
 
 
-def _pack(obj: Any) -> bytes:
-    payload = wire.dumps(obj)
+def _pack(obj: Any, allow_pickle: bool = True) -> bytes:
+    payload = wire.dumps(obj, allow_pickle=allow_pickle)
     return _LEN.pack(len(payload)) + payload
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Any:
+async def _read_frame(reader: asyncio.StreamReader,
+                      allow_pickle: bool = True) -> Any:
     hdr = await reader.readexactly(_LEN.size)
     (n,) = _LEN.unpack(hdr)
     if n > MAX_FRAME:
         raise RpcError(f"frame too large: {n}")
-    return wire.loads(await reader.readexactly(n))
+    return wire.loads(await reader.readexactly(n),
+                      allow_pickle=allow_pickle)
 
 
 class Peer:
@@ -70,20 +72,34 @@ class Peer:
         )
 
     def _send_safe(self, frame: dict) -> None:
-        if not self.closed:
-            try:
-                self._writer.write(_pack(frame))
-            except Exception:
-                self.closed = True
+        if self.closed:
+            return
+        try:
+            payload = _pack(frame, self._server._allow_pickle)
+        except wire.PickleRejected:
+            return  # push not expressible on a strict wire: drop it,
+            # the connection itself is healthy
+        except Exception:
+            self.closed = True
+            return
+        try:
+            self._writer.write(payload)
+        except Exception:
+            self.closed = True
 
 
 class RpcServer:
     """asyncio TCP server on a dedicated thread; handlers may be sync or
     async. Handler signature: ``handler(peer, *args)``."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 allow_pickle: bool = True):
+        # allow_pickle=False is the strict mode for externally reachable
+        # surfaces (wire.py's contract): inbound pickle frames are
+        # rejected at decode, replies degrade to structural encodings.
         self._host = host
         self._port = port
+        self._allow_pickle = allow_pickle
         self._handlers: Dict[str, Callable] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -131,9 +147,13 @@ class RpcServer:
         peer = Peer(self, writer)
         try:
             while True:
-                frame = await _read_frame(reader)
+                frame = await _read_frame(reader, self._allow_pickle)
                 asyncio.ensure_future(self._dispatch(peer, writer, frame))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                wire.WireError):
+            # WireError covers strict-mode pickle rejections: close the
+            # connection quietly instead of spamming the loop's
+            # unhandled-exception handler per bad frame.
             pass
         finally:
             peer.closed = True
@@ -162,7 +182,17 @@ class RpcServer:
             reply = {"i": req_id, "e": e}
         if req_id is not None and not peer.closed:
             try:
-                writer.write(_pack(reply))
+                try:
+                    payload = _pack(reply, self._allow_pickle)
+                except wire.PickleRejected:
+                    # Result not expressible on a strict wire: surface a
+                    # structural error instead of killing the connection.
+                    payload = _pack(
+                        {"i": req_id,
+                         "e": RpcError("result not encodable on this "
+                                       "strict surface")},
+                        self._allow_pickle)
+                writer.write(payload)
                 await writer.drain()
             except Exception:
                 peer.closed = True
@@ -181,7 +211,9 @@ class RpcClient:
     """Blocking, thread-safe client. One socket; a reader thread correlates
     responses and fires subscription callbacks."""
 
-    def __init__(self, address: str, timeout: float = 10.0):
+    def __init__(self, address: str, timeout: float = 10.0,
+                 allow_pickle: bool = True):
+        self._allow_pickle = allow_pickle
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
@@ -190,7 +222,8 @@ class RpcClient:
         self._pending: Dict[int, "_Waiter"] = {}
         self._plock = threading.Lock()
         self._ids = itertools.count(1)
-        self._subs: Dict[str, Callable[[Any], None]] = {}
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
+        self._subs_lock = threading.Lock()
         self._closed = False
         self.address = address
         # Pushes dispatch on their own thread: a subscription callback may
@@ -209,7 +242,24 @@ class RpcClient:
         self._reader.start()
 
     def subscribe(self, topic: str, cb: Callable[[Any], None]) -> None:
-        self._subs[topic] = cb
+        with self._subs_lock:
+            self._subs.setdefault(topic, []).append(cb)
+
+    def unsubscribe(self, topic: str,
+                    cb: Optional[Callable[[Any], None]] = None) -> None:
+        """Remove one callback (or all of a topic's when cb is None)."""
+        with self._subs_lock:
+            if cb is None:
+                self._subs.pop(topic, None)
+                return
+            lst = self._subs.get(topic)
+            if lst is not None:
+                try:
+                    lst.remove(cb)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._subs.pop(topic, None)
 
     def call(self, method: str, *args, timeout: Optional[float] = 30.0) -> Any:
         req_id = next(self._ids)
@@ -230,7 +280,7 @@ class RpcClient:
         self._send({"m": method, "a": args})
 
     def _send(self, frame: dict) -> None:
-        data = _pack(frame)
+        data = _pack(frame, self._allow_pickle)
         with self._wlock:
             if self._closed:
                 raise ConnectionLost(f"connection to {self.address} closed")
@@ -256,7 +306,8 @@ class RpcClient:
                     if not chunk:
                         raise ConnectionError("peer closed")
                     buf += chunk
-                frame = wire.loads(buf[:n])
+                frame = wire.loads(buf[:n],
+                                   allow_pickle=self._allow_pickle)
                 buf = buf[n:]
                 self._on_frame(frame)
         except Exception as e:
@@ -268,8 +319,9 @@ class RpcClient:
             if item is None:
                 return
             topic, data = item
-            cb = self._subs.get(topic)
-            if cb is not None:
+            with self._subs_lock:
+                cbs = list(self._subs.get(topic, ()))
+            for cb in cbs:
                 try:
                     cb(data)
                 except Exception:

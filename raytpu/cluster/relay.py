@@ -61,6 +61,9 @@ class RelayClient:
         # call and fans pushes back).
         self._chan._rpc.subscribe(topic, cb)
 
+    def unsubscribe(self, topic: str) -> None:
+        self._chan._rpc.unsubscribe(topic)
+
     @property
     def closed(self) -> bool:
         return self._chan.closed

@@ -109,6 +109,22 @@ class WorkerBackend:
         except Exception:
             return False
 
+    def wait_any_object_ready(self, refs, timeout=None):
+        """Event-driven stream readiness via the daemon's async
+        wait_objects_any (resolved by its object-arrival hook / head
+        push); returns None on RPC failure so callers fall back to
+        polling."""
+        if any(self.store.contains(r.id) for r in refs):
+            return True
+        server_side = 5.0 if timeout is None else max(0.0, min(
+            float(timeout), 60.0))
+        try:
+            return bool(self._host.node.call(
+                "wait_objects_any", [r.id.hex() for r in refs],
+                server_side, timeout=server_side + 10.0))
+        except Exception:
+            return None
+
     # -- streaming (nested consumption inside a worker) --------------------
 
     def stream_ack(self, task_id: TaskID, consumed: int) -> None:
