@@ -143,6 +143,11 @@ class HeadServer:
         # RAY_EVENT files); nodes forward their events here.
         self._events = deque(maxlen=2000)
         self._object_waiters: Dict[str, List[Peer]] = {}
+        # Push-path demand (reference: push_manager.h): object -> nodes
+        # whose pull loops asked for it before any copy existed. When the
+        # first copy is reported, the producer is told to stream it to
+        # them. Values are registration times for pruning.
+        self._object_node_demand: Dict[str, Dict[str, float]] = {}
         # placement groups: pg_id -> {"bundles": [...], "nodes": [node_id per bundle]}
         self._pgs: Dict[str, dict] = {}
         self._subscribers: Dict[str, List[Peer]] = {}  # topic -> peers
@@ -770,13 +775,26 @@ class HeadServer:
     def _report_object(self, peer: Peer, object_id: str,
                        node_id: str) -> None:
         with self._lock:
+            first_copy = object_id not in self._objects
             self._objects.setdefault(object_id, set()).add(node_id)
             waiters = self._object_waiters.pop(object_id, [])
             entry = self._nodes.get(node_id)
             address = entry.address if entry else None
+            push_targets: List[str] = []
+            if first_copy:
+                demand = self._object_node_demand.pop(object_id, None)
+                for nid in demand or ():
+                    dn = self._nodes.get(nid)
+                    if nid != node_id and dn is not None and dn.alive:
+                        push_targets.append(dn.address)
         for w in waiters:
             w.push(f"object::{object_id}",
                    {"node_id": node_id, "address": address})
+        if push_targets:
+            # `peer` is the producing node's connection: tell it to
+            # stream the fresh object to everyone who demanded it.
+            peer.push("push_requests", {"object_id": object_id,
+                                        "targets": push_targets})
 
     def _forget_object(self, peer: Peer, object_id: str,
                        node_id: str) -> None:
@@ -801,6 +819,19 @@ class HeadServer:
                 waiters = self._object_waiters.setdefault(object_id, [])
                 if peer not in waiters:
                     waiters.append(peer)
+                # Node peers (not drivers) also register push demand.
+                nid = peer.meta.get("node_id")
+                if nid:
+                    now = time.monotonic()
+                    self._object_node_demand.setdefault(
+                        object_id, {})[nid] = now
+                    if len(self._object_node_demand) > 10000:
+                        # Prune demand for objects that never appeared.
+                        for oid in [o for o, d in
+                                    self._object_node_demand.items()
+                                    if all(now - t > 300.0
+                                           for t in d.values())]:
+                            del self._object_node_demand[oid]
         return locs
 
     # -- placement groups --------------------------------------------------

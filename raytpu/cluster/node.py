@@ -500,6 +500,10 @@ class NodeServer:
         h("fetch_object_chunk", self._h_fetch_object_chunk)
         h("has_object", self._h_has_object)
         h("put_object", self._h_put_object)
+        h("push_object_begin", self._h_push_object_begin)
+        h("push_object_chunk", self._h_push_object_chunk)
+        h("push_object_end", self._h_push_object_end)
+        h("push_object_abort", self._h_push_object_abort)
         h("free_object", self._h_free_object)
         h("cache_runtime_env", self._h_cache_runtime_env)
         h("has_runtime_env", self._h_has_runtime_env)
@@ -534,6 +538,15 @@ class NodeServer:
         # (or the head reports a first remote copy).
         self._obj_wait: Dict[str, list] = {}
         self._obj_wait_lock = threading.Lock()
+        # Inbound push assembly (reference: push_manager receiver side):
+        # oid_hex -> [buffer, last_activity, expected_size, bytes_got].
+        # Published to the store only on a complete push_object_end.
+        self._push_rx: Dict[str, list] = {}
+        self._push_rx_lock = threading.Lock()
+        self._push_tx_pool = None  # lazy; bounds concurrent outbound pushes
+        self.push_rx_completed = 0
+        self.push_tx_completed = 0
+        self.pull_rounds = 0
         self.address: Optional[str] = None
         # Per-process log files live under the session dir (reference:
         # /tmp/ray/session_*/logs with one file per worker).
@@ -591,6 +604,9 @@ class NodeServer:
             "register_node", self.node_id.hex(), self.address,
             self.backend.node.total.to_dict(), self.labels,
         )
+        # Producer side of push-based transfer: the head tells us which
+        # nodes demanded an object we just reported local.
+        self._head.subscribe("push_requests", self._on_push_request)
         # Availability snapshots carry a sequence number taken atomically
         # with the snapshot: a preempted heartbeat must not overwrite a
         # fresher resource_update at the head (the head drops lower seqs).
@@ -675,6 +691,8 @@ class NodeServer:
         mon = getattr(self, "_memory_monitor", None)
         if mon is not None:
             mon.stop()
+        if self._push_tx_pool is not None:
+            self._push_tx_pool.shutdown(wait=False)
         try:
             if self._head is not None:
                 self._head.call("drain_node", self.node_id.hex(), timeout=2.0)
@@ -762,6 +780,7 @@ class NodeServer:
                 except Exception:
                     pass
             return  # head still down; next heartbeat retries
+        head.subscribe("push_requests", self._on_push_request)
         old = self._head
         self._head = head
         try:
@@ -803,6 +822,37 @@ class NodeServer:
             self._head.notify("report_object", oid.hex(), self.node_id.hex())
         except Exception:
             pass
+
+    def _on_push_request(self, data: dict) -> None:
+        """Head push: nodes listed in ``targets`` demanded an object that
+        just became local here — stream it to them (reference:
+        push_manager.h eager pushes)."""
+        if not bool(cfg.object_transfer_push_enabled):
+            return
+        oid_hex = data.get("object_id")
+        targets = [a for a in data.get("targets", ())
+                   if a and a != self.address]
+        if not oid_hex or not targets:
+            return
+        if self._push_tx_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._push_tx_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="raytpu-push-tx")
+        self._push_tx_pool.submit(self._push_object_to, oid_hex, targets)
+
+    def _push_object_to(self, oid_hex: str, addresses: List[str]) -> None:
+        from raytpu.cluster.transfer import push_blob
+
+        sv = self.backend.store.try_get(ObjectID.from_hex(oid_hex))
+        if sv is None:
+            return  # freed between report and push request
+        for addr in addresses:
+            try:
+                if push_blob(self._peer_client(addr), oid_hex, sv):
+                    self.push_tx_completed += 1
+            except Exception:
+                pass  # receiver's pull fallback covers it
 
     def _wake_obj_waiters(self, oid_hex: str) -> None:
         with self._obj_wait_lock:
@@ -859,7 +909,25 @@ class NodeServer:
                       deadline_s: Optional[float] = None) -> None:
         """Pull one object into the local store (reference: PullManager).
         ``deadline_s`` bounds speculative pulls (fetch-miss path); arg
-        pulls for queued tasks run until the object appears."""
+        pulls for queued tasks run until the object appears.
+
+        This loop also ARMs the push path: ``locate_object(wait=True)``
+        registers this node's demand at the head, so the producing node
+        is told to stream the object here the moment it exists — when
+        that push wins the race, this loop sees the local copy and exits
+        without pulling a byte. The head's location push doubles as the
+        wakeup (no poll backoff while waiting)."""
+        ev = threading.Event()
+        topic = f"object::{oid.hex()}"
+
+        def _loc_push(_d):
+            ev.set()
+
+        sub_client = self._head  # may be swapped by head reconnection
+        try:
+            sub_client.subscribe(topic, _loc_push)
+        except Exception:
+            sub_client = None
         try:
             delay = 0.01
             last_unavailable = 0.0
@@ -870,9 +938,23 @@ class NodeServer:
                     return
                 if self.backend.store.contains(oid):
                     return
+                with self._push_rx_lock:
+                    ent = self._push_rx.get(oid.hex())
+                    inbound = ent is not None and (
+                        time.monotonic() - ent[1]
+                        <= float(cfg.object_push_rx_ttl_s))
+                    if ent is not None and not inbound:
+                        # Producer died mid-push and nothing else pushed
+                        # since: drop the orphan so pull can proceed.
+                        del self._push_rx[oid.hex()]
+                if inbound:
+                    # A producer is already streaming it here; don't pull
+                    # the same bytes in parallel.
+                    time.sleep(0.02)
+                    continue
                 try:
                     locs = self._head.call("locate_object", oid.hex(),
-                                           timeout=10.0)
+                                           True, timeout=10.0)
                 except ConnectionLost:
                     return
                 for loc in locs or ():
@@ -881,6 +963,7 @@ class NodeServer:
                     try:
                         from raytpu.cluster.transfer import fetch_blob
 
+                        self.pull_rounds += 1
                         blob = fetch_blob(
                             self._peer_client(loc["address"]), oid.hex(),
                             timeout=60.0)
@@ -901,9 +984,15 @@ class NodeServer:
                                               oid.hex())
                         except Exception:
                             pass
-                time.sleep(delay)
+                ev.clear()
+                ev.wait(delay)
                 delay = min(delay * 2, 0.2)
         finally:
+            if sub_client is not None:
+                try:
+                    sub_client.unsubscribe(topic, _loc_push)
+                except Exception:
+                    pass
             with self._fetch_lock:
                 self._fetching.discard(oid)
 
@@ -1045,6 +1134,60 @@ class NodeServer:
     def _h_put_object(self, peer: Peer, oid_hex: str, blob: bytes) -> None:
         self.backend.store.put(ObjectID.from_hex(oid_hex),
                                SerializedValue.from_buffer(blob))
+
+    # -- push-based transfer, receiver side --------------------------------
+
+    def _h_push_object_begin(self, peer: Peer, oid_hex: str,
+                             size: int) -> bool:
+        if self.backend.store.contains(ObjectID.from_hex(oid_hex)):
+            return False
+        ttl = float(cfg.object_push_rx_ttl_s)
+        now = time.monotonic()
+        with self._push_rx_lock:
+            stale = [k for k, ent in self._push_rx.items()
+                     if now - ent[1] > ttl]
+            for k in stale:
+                del self._push_rx[k]
+            if oid_hex in self._push_rx:
+                return False  # another push already inbound
+            self._push_rx[oid_hex] = [bytearray(int(size)), now,
+                                      int(size), 0]
+        return True
+
+    def _h_push_object_chunk(self, peer: Peer, oid_hex: str, offset: int,
+                             data: bytes) -> bool:
+        with self._push_rx_lock:
+            ent = self._push_rx.get(oid_hex)
+            if ent is None:
+                return False
+            buf, _, size, got = ent
+            end = int(offset) + len(data)
+            if end > size:
+                del self._push_rx[oid_hex]
+                return False
+            buf[int(offset):end] = data
+            ent[1] = time.monotonic()
+            ent[3] = got + len(data)
+        return True
+
+    def _h_push_object_end(self, peer: Peer, oid_hex: str) -> bool:
+        with self._push_rx_lock:
+            ent = self._push_rx.pop(oid_hex, None)
+        if ent is None:
+            return False
+        buf, _, size, got = ent
+        if got != size:
+            return False  # incomplete: never published as stored
+        oid = ObjectID.from_hex(oid_hex)
+        if not self.backend.store.contains(oid):
+            self.backend.store.put(
+                oid, SerializedValue.from_buffer(bytes(buf)))
+        self.push_rx_completed += 1
+        return True
+
+    def _h_push_object_abort(self, peer: Peer, oid_hex: str) -> None:
+        with self._push_rx_lock:
+            self._push_rx.pop(oid_hex, None)
 
     def _h_free_object(self, peer: Peer, oid_hex: str) -> None:
         """Owner-directed free (the owner's refcount hit zero)."""
@@ -1413,6 +1556,9 @@ class NodeServer:
                 "store_size": b.store.size(),
                 "actors": [a.hex()[:8] for a in b._actors],
                 "available": b.node.available.to_dict(),
+                "push_rx_completed": self.push_rx_completed,
+                "push_tx_completed": self.push_tx_completed,
+                "pull_rounds": self.pull_rounds,
             }
 
     def _h_node_info(self, peer: Peer) -> dict:

@@ -12,6 +12,14 @@ sender). Wire surface: three RPCs served by every node —
 
 A process-wide semaphore caps concurrent chunk fetches (reference:
 ``max_bytes_in_flight`` in the pull manager).
+
+Push path (reference: ``src/ray/object_manager/push_manager.h:30`` —
+eager producer-to-requester streaming with bounded in-flight chunks):
+:func:`push_blob` drives the receiver's ``push_object_begin`` /
+``push_object_chunk`` / ``push_object_end`` RPCs with a windowed thread
+pool, so a finished task's output flows to the demanding node without
+per-chunk pull round-trips and a producer can offload its output before
+dying.
 """
 
 from __future__ import annotations
@@ -93,3 +101,53 @@ def fetch_blob(client, oid_hex: str, timeout: float = 60.0
         if len(piece) < want:
             return None  # truncated: object changed under us
     return b"".join(parts)
+
+
+def push_blob(client, oid_hex: str, sv: SerializedValue,
+              timeout: float = 60.0) -> bool:
+    """Stream one object's wire bytes TO a peer node.
+
+    Small objects ride the existing ``put_object`` RPC in one frame; large
+    ones stream as bounded-in-flight chunk calls so the receiver never
+    sees a partial object as stored (assembly happens receiver-side and
+    only ``push_object_end`` publishes it). Returns False when the
+    transfer did not complete (the receiver's pull fallback still runs).
+    """
+    chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
+    size = wire_size(sv)
+    if size <= chunk:
+        client.call("put_object", oid_hex, sv.to_bytes(), timeout=timeout)
+        return True
+    if not client.call("push_object_begin", oid_hex, size, timeout=timeout):
+        return True  # receiver already has it (or another push is inbound)
+    window = max(1, min(8, int(cfg.object_transfer_max_concurrency)))
+    from concurrent.futures import ThreadPoolExecutor
+
+    sem = _semaphore()  # same process-wide in-flight cap as the pull path
+
+    def send(off: int) -> bool:
+        want = min(chunk, size - off)
+        # read_range runs in the worker thread under the shared
+        # semaphore: aggregate in-flight chunks across ALL transfers
+        # (push and pull) stay bounded.
+        with sem:
+            return client.call("push_object_chunk", oid_hex, off,
+                               read_range(sv, off, want),
+                               timeout=timeout) is True
+
+    ok = True
+    with ThreadPoolExecutor(max_workers=window,
+                            thread_name_prefix="raytpu-push") as ex:
+        for fut in [ex.submit(send, off) for off in range(0, size, chunk)]:
+            try:
+                if not fut.result():
+                    ok = False
+            except Exception:
+                ok = False
+    if not ok:
+        try:
+            client.notify("push_object_abort", oid_hex)
+        except Exception:
+            pass
+        return False
+    return client.call("push_object_end", oid_hex, timeout=timeout) is True

@@ -94,6 +94,13 @@ declare("object_spilling_threshold", 0.8)
 # object_manager.cc with chunk_size from ray_config_def.h).
 declare("object_transfer_chunk_bytes", 4 * 1024 * 1024)
 declare("object_transfer_max_concurrency", 8)
+# Push-based transfer (reference: push_manager.h bounded-in-flight
+# pushes): a producer streams a demanded object to the requesting node
+# the moment it exists, skipping the pull round-trips.
+declare("object_transfer_push_enabled", True)
+# Incomplete inbound push buffers (producer died mid-push) are dropped
+# after this long.
+declare("object_push_rx_ttl_s", 60.0)
 # 0 = monitor whole-system memory fraction (memory_usage_threshold);
 # >0 = hard byte budget for the node's process tree (tests, cgroups).
 declare("memory_limit_bytes", 0)

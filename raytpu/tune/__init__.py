@@ -20,9 +20,12 @@ from raytpu.tune.search import (
     randint,
     uniform,
 )
+from raytpu.tune.external import AskTellSearcher, OptunaSearcher
 from raytpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 
 __all__ = [
+    "AskTellSearcher",
+    "OptunaSearcher",
     "Tuner",
     "TuneConfig",
     "ResultGrid",

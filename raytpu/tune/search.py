@@ -18,6 +18,13 @@ from typing import Any, Callable, Dict, List, Optional
 @dataclass
 class Domain:
     sampler: Callable[[random.Random], Any]
+    # Structural metadata so external searcher adapters (Optuna etc.) can
+    # translate the space instead of treating it as an opaque closure.
+    kind: str = "custom"
+    low: Optional[float] = None
+    high: Optional[float] = None
+    q: Optional[int] = None
+    options: Optional[List[Any]] = None
 
     def sample(self, rng: random.Random) -> Any:
         return self.sampler(rng)
@@ -25,24 +32,28 @@ class Domain:
 
 def choice(options: List[Any]) -> Domain:
     opts = list(options)
-    return Domain(lambda rng: rng.choice(opts))
+    return Domain(lambda rng: rng.choice(opts), kind="choice", options=opts)
 
 
 def uniform(low: float, high: float) -> Domain:
-    return Domain(lambda rng: rng.uniform(low, high))
+    return Domain(lambda rng: rng.uniform(low, high), kind="uniform",
+                  low=low, high=high)
 
 
 def loguniform(low: float, high: float) -> Domain:
     lo, hi = math.log(low), math.log(high)
-    return Domain(lambda rng: math.exp(rng.uniform(lo, hi)))
+    return Domain(lambda rng: math.exp(rng.uniform(lo, hi)),
+                  kind="loguniform", low=low, high=high)
 
 
 def randint(low: int, high: int) -> Domain:
-    return Domain(lambda rng: rng.randrange(low, high))
+    return Domain(lambda rng: rng.randrange(low, high), kind="randint",
+                  low=low, high=high)
 
 
 def qrandint(low: int, high: int, q: int) -> Domain:
-    return Domain(lambda rng: rng.randrange(low, high, q))
+    return Domain(lambda rng: rng.randrange(low, high, q), kind="qrandint",
+                  low=low, high=high, q=q)
 
 
 @dataclass
