@@ -16,6 +16,7 @@ into the local store, which wakes the dependency manager.
 
 from __future__ import annotations
 
+import os
 import queue as queue_mod
 import threading
 import time
@@ -511,6 +512,7 @@ class NodeServer:
         h("remove_pg_shard", self._h_remove_pg_shard)
         h("node_info", self._h_node_info)
         h("debug_state", self._h_debug_state)
+        h("worker_stacks", self._h_worker_stacks)
         h("ping", lambda peer: "pong")
         # Worker-process plane
         h("register_worker", self._h_register_worker)
@@ -1560,6 +1562,42 @@ class NodeServer:
                 "push_tx_completed": self.push_tx_completed,
                 "pull_rounds": self.pull_rounds,
             }
+
+    def _h_worker_stacks(self, peer: Peer,
+                         worker_id: Optional[str] = None) -> Dict[str, dict]:
+        """Live stack dump of workers on this node (reference:
+        profile_manager.py py-spy dumps from the dashboard). ``worker_id``
+        narrows to one worker; ``"daemon"`` (or None, which includes it)
+        snapshots the node daemon process itself."""
+        from raytpu.util.stack_dump import dump_all_threads
+
+        out: Dict[str, dict] = {}
+        if worker_id in (None, "daemon"):
+            out["daemon"] = {"pid": os.getpid(),
+                             "stack": dump_all_threads(
+                                 header=f"node daemon {self.node_id.hex()}"
+                                        f" pid={os.getpid()}")}
+            if worker_id == "daemon":
+                return out
+        pool = self.worker_pool
+        if pool is None:
+            return out
+        with pool._lock:
+            handles = {wid: h for wid, h in pool._workers.items()
+                       if worker_id is None or wid.startswith(worker_id)}
+        for wid, h in handles.items():
+            client = getattr(h, "client", None)
+            if client is None or client.closed:
+                out[wid] = {"pid": getattr(h, "pid", None),
+                            "error": "worker not connected"}
+                continue
+            try:
+                out[wid] = {"pid": h.pid,
+                            "stack": client.call("stack", timeout=5.0)}
+            except Exception as e:
+                out[wid] = {"pid": h.pid,
+                            "error": f"{type(e).__name__}: {e}"}
+        return out
 
     def _h_node_info(self, peer: Peer) -> dict:
         return {

@@ -508,6 +508,16 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     server.register("stream_close", h_stream_close)
     server.register("kill", h_kill)
     server.register("ping", lambda peer: "pong")
+
+    def h_stack(peer: Peer) -> str:
+        from raytpu.util.stack_dump import dump_all_threads
+
+        return dump_all_threads(
+            header=f"worker {args.worker_id} pid={os.getpid()}")
+
+    # Live profiling (reference: dashboard reporter's py-spy dump): the
+    # RPC loop thread serves this even while task threads are busy.
+    server.register("stack", h_stack)
     addr = server.start()
     host.node.call("register_worker", args.worker_id, addr, os.getpid())
 

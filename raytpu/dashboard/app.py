@@ -13,6 +13,7 @@ Start via ``raytpu dashboard --address tcp://HEAD`` or embed
 
 from __future__ import annotations
 
+import asyncio
 import html
 import json
 import threading
@@ -151,6 +152,19 @@ class DashboardServer:
                                  for k, v in objs.items()]))
         return _PAGE.format(body="".join(parts))
 
+    def _collect_stacks(self, worker: Optional[str],
+                        node_filter: Optional[str]) -> Dict[str, Any]:
+        """Blocking concurrent fan-out to every node's worker_stacks."""
+        import raytpu
+        from raytpu.util.stack_dump import collect_cluster_stacks
+
+        targets = [(n.get("NodeID", ""), n["Address"])
+                   for n in raytpu.nodes()
+                   if n.get("Alive")
+                   and n.get("Labels", {}).get("role") != "driver"]
+        return collect_cluster_stacks(targets, worker=worker,
+                                      node_filter=node_filter)
+
     # -- server ------------------------------------------------------------
 
     async def _start_async(self):
@@ -189,12 +203,24 @@ class DashboardServer:
                 text = "# prometheus_client unavailable\n"
             return web.Response(text=text, content_type="text/plain")
 
+        async def stacks(request):
+            """Live worker stack dumps (reference: dashboard reporter's
+            py-spy profiling endpoint). ?worker=<id prefix|daemon>,
+            ?node=<node id prefix> narrow the dump."""
+            loop = asyncio.get_running_loop()
+            worker = request.query.get("worker") or None
+            node_filter = request.query.get("node") or None
+            result = await loop.run_in_executor(
+                None, self._collect_stacks, worker, node_filter)
+            return web.json_response(result)
+
         app = web.Application()
         app.router.add_get("/", index)
         app.router.add_get("/api/summary", api_summary)
         app.router.add_get("/api/{section}", api_section)
         app.router.add_get("/timeline", timeline)
         app.router.add_get("/metrics", metrics)
+        app.router.add_get("/stacks", stacks)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)

@@ -25,8 +25,20 @@ def make_env(spec, env_config: Optional[dict] = None):
     if isinstance(spec, str):
         if spec in _ENV_REGISTRY:
             return _ENV_REGISTRY[spec](env_config)
-        raise ValueError(f"unknown env {spec!r}; register_env() it first "
-                         f"(built-ins: {sorted(_ENV_REGISTRY)})")
+        # Unregistered names resolve through gymnasium when installed
+        # (reference: RLlib treats any string as a gym id) — this is how
+        # real Atari ("ALE/Pong-v5") plugs in; CatchEnv is the built-in
+        # pixel fallback for images without gymnasium.
+        from raytpu.rllib.env.gym_adapter import (GymnasiumEnv,
+                                                  gymnasium_available)
+
+        if gymnasium_available():
+            return GymnasiumEnv(spec, env_config)
+        raise ValueError(
+            f"unknown env {spec!r}; register_env() it first, or install "
+            f"gymnasium (+ale-py for ALE/* Atari ids) to resolve gym "
+            f"ids directly (built-ins: {sorted(_ENV_REGISTRY)}; built-in "
+            f"pixel fallback: 'Catch-v0')")
     if callable(spec):
         try:
             return spec(env_config)

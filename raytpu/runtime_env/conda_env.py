@@ -1,0 +1,192 @@
+"""conda runtime environments — cached env materialization.
+
+Reference analogue: ``python/ray/_private/runtime_env/conda.py`` — a
+named conda env is activated as-is; a dict spec (environment.yml shape)
+is materialized once into a cache keyed by the spec hash and reused by
+every task/actor with the same spec; install failures surface the solver
+output tail.
+
+TPU-deployment redesign: workers here share the node's interpreter
+(thread/process pool), so "activation" is sys.path injection of the
+env's ``site-packages`` plus exposing ``<prefix>/bin`` on PATH while the
+env is held — the same composition mechanism as the pip plugin — rather
+than re-execing under the env's own python. Pure-python and
+ABI-compatible compiled packages work; a conda env pinned to a different
+python minor version is rejected loudly instead of imported brokenly.
+
+Spec forms (reference-parity):
+  ``{"conda": "envname-or-prefix"}``   — existing env by name or path
+  ``{"conda": {...environment.yml}}``  — materialized + cached by hash
+
+The conda binary is found via ``RAYTPU_CONDA_EXE``, ``CONDA_EXE``, or
+PATH; dict specs require it, named prefixes only need the directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import glob as _glob
+import os
+import shutil
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, Optional, Union
+
+from raytpu.core.errors import RuntimeEnvError
+
+_ENVS_ROOT = os.path.join(os.path.expanduser("~/.raytpu"), "conda_envs")
+_lock = threading.Lock()
+_ready: Dict[str, Dict[str, str]] = {}  # env hash/prefix -> paths
+
+
+def conda_exe() -> Optional[str]:
+    for var in ("RAYTPU_CONDA_EXE", "CONDA_EXE"):
+        exe = os.environ.get(var)
+        if exe and os.path.isfile(exe):
+            return exe
+    return shutil.which("conda")
+
+
+def normalize_spec(spec: Union[str, Dict[str, Any]],
+                   check_gate: bool = True) -> Dict[str, Any]:
+    """Driver-side shape check (``check_gate=False``) vs node-side
+    materialization check — same split as the pip plugin."""
+    if isinstance(spec, str):
+        if not spec:
+            raise RuntimeEnvError("conda env name/prefix must be non-empty")
+        return {"name": spec}
+    if isinstance(spec, dict):
+        if not spec.get("dependencies"):
+            raise RuntimeEnvError(
+                "conda dict spec needs a 'dependencies' list "
+                "(environment.yml shape)")
+        out = {"spec": {
+            "dependencies": list(spec["dependencies"]),
+            "channels": list(spec.get("channels", [])),
+        }}
+        if check_gate and conda_exe() is None:
+            raise RuntimeEnvError(
+                "conda runtime_env requires a conda binary on this node "
+                "(set RAYTPU_CONDA_EXE / CONDA_EXE or put conda on PATH); "
+                "for package installs without conda use the pip plugin")
+        return out
+    raise RuntimeEnvError(
+        "conda runtime_env must be an env name/prefix string or an "
+        "environment.yml-style dict")
+
+
+def _paths_for_prefix(prefix: str) -> Dict[str, str]:
+    sites = sorted(_glob.glob(
+        os.path.join(prefix, "lib", "python*", "site-packages")))
+    if not sites:
+        raise RuntimeEnvError(
+            f"conda env at {prefix!r} has no python site-packages")
+    vi = sys.version_info
+    ours = os.path.join(prefix, "lib", f"python{vi.major}.{vi.minor}",
+                        "site-packages")
+    if ours not in sites:
+        found = os.path.basename(os.path.dirname(sites[0]))
+        raise RuntimeEnvError(
+            f"conda env at {prefix!r} is built for {found}, but workers "
+            f"run python{vi.major}.{vi.minor}; rebuild the env against "
+            f"the node's python (thread-pool workers share the node "
+            f"interpreter)")
+    return {"prefix": prefix, "site_packages": ours,
+            "bin": os.path.join(prefix, "bin")}
+
+
+def _resolve_named(name: str) -> str:
+    """A path is used as-is; a bare name resolves through conda's env
+    directories (reference: conda.py get_conda_env_dir)."""
+    if os.path.sep in name or os.path.isdir(name):
+        prefix = os.path.abspath(name)
+        if not os.path.isdir(prefix):
+            raise RuntimeEnvError(f"conda prefix {name!r} does not exist")
+        return prefix
+    exe = conda_exe()
+    if exe is None:
+        raise RuntimeEnvError(
+            f"cannot resolve conda env name {name!r}: no conda binary "
+            f"(pass the env's full prefix path instead)")
+    r = subprocess.run([exe, "info", "--json"], capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        raise RuntimeEnvError(
+            f"conda info failed: {(r.stderr or r.stdout)[-500:]}")
+    info = json.loads(r.stdout)
+    for envs_dir in info.get("envs_dirs", []):
+        cand = os.path.join(envs_dir, name)
+        if os.path.isdir(cand):
+            return cand
+    for env_path in info.get("envs", []):
+        if os.path.basename(env_path) == name:
+            return env_path
+    raise RuntimeEnvError(
+        f"conda env {name!r} not found in {info.get('envs_dirs')}")
+
+
+def ensure_conda_env(spec: Union[str, Dict[str, Any]]) -> Dict[str, str]:
+    """Materialize (or resolve) the env; returns its paths dict. Cached
+    per spec hash — tasks sharing a spec reuse one env (reference:
+    conda.py URI cache)."""
+    spec = normalize_spec(spec)
+    if "name" in spec:
+        key = "named:" + spec["name"]
+        with _lock:
+            cached = _ready.get(key)
+            if cached and os.path.isdir(cached["prefix"]):
+                return cached
+        paths = _paths_for_prefix(_resolve_named(spec["name"]))
+        with _lock:
+            _ready[key] = paths
+        return paths
+
+    body = json.dumps(spec["spec"], sort_keys=True)
+    key = hashlib.sha1(body.encode()).hexdigest()[:16]
+    with _lock:
+        cached = _ready.get(key)
+        if cached and os.path.isdir(cached["prefix"]):
+            return cached
+    prefix = os.path.join(_ENVS_ROOT, key)
+    marker = os.path.join(prefix, ".raytpu_ready")
+    os.makedirs(_ENVS_ROOT, exist_ok=True)
+    import fcntl
+
+    # Cross-process exclusion, same pattern as pip_env: concurrent
+    # workers must not rmtree a prefix another is mid-create into.
+    with open(os.path.join(_ENVS_ROOT, key + ".lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(marker):
+                exe = conda_exe()
+                if exe is None:
+                    raise RuntimeEnvError(
+                        "conda runtime_env requires a conda binary on "
+                        "this node (RAYTPU_CONDA_EXE / CONDA_EXE / PATH)")
+                shutil.rmtree(prefix, ignore_errors=True)
+                env_yml = os.path.join(_ENVS_ROOT, key + ".yml")
+                with open(env_yml, "w") as f:
+                    yml = {"dependencies": spec["spec"]["dependencies"]}
+                    if spec["spec"]["channels"]:
+                        yml["channels"] = spec["spec"]["channels"]
+                    json.dump(yml, f)  # yaml superset: json is valid yaml
+                r = subprocess.run(
+                    [exe, "env", "create", "--prefix", prefix, "--file",
+                     env_yml, "--quiet", "--json"],
+                    capture_output=True, text=True)
+                if r.returncode != 0:
+                    shutil.rmtree(prefix, ignore_errors=True)
+                    raise RuntimeEnvError(
+                        f"conda env create failed for "
+                        f"{spec['spec']['dependencies']}: "
+                        f"{(r.stderr or r.stdout)[-800:]}")
+                with open(marker, "w") as f:
+                    f.write(body)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    paths = _paths_for_prefix(prefix)
+    with _lock:
+        _ready[key] = paths
+    return paths

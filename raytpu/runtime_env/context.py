@@ -17,12 +17,16 @@ cache. Ours has three plugins:
   ``find_links`` wheel dirs; see :mod:`raytpu.runtime_env.pip_env`);
   its site-packages is path-injected like ``py_modules``.
 
+- ``conda``: an existing env by name/prefix or a cached env built from a
+  dict spec (see :mod:`raytpu.runtime_env.conda_env`); its site-packages
+  is path-injected and its ``bin`` joins PATH while held.
+
 Isolation note: the reference dedicates worker PROCESSES per runtime env;
 our local fabric runs tasks in threads, so ``env_vars`` are process-global
 while held — concurrent tasks with conflicting values of the same key are
-flagged with a warning rather than isolated. ``conda``/``container`` are
-rejected explicitly (no such tooling in this environment) rather than
-silently ignored.
+flagged with a warning rather than isolated. ``container`` is rejected
+explicitly (no such tooling in this environment) rather than silently
+ignored.
 """
 
 from __future__ import annotations
@@ -47,9 +51,14 @@ _env_refs: Dict[str, List] = {}
 # not strip each other's import path)
 _path_refs: Dict[str, int] = {}
 _uri_cache: Dict[str, str] = {}  # uri -> extracted path
+# conda bin dir -> refcount: each held env's bin is its own PATH segment,
+# so two concurrent tasks with DIFFERENT conda envs both resolve their
+# own binaries (a single refcounted PATH value would silently drop the
+# second env's bin).
+_path_env_refs: Dict[str, int] = {}
 
-SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
-REJECTED_KEYS = {"conda", "container"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+REJECTED_KEYS = {"container"}
 
 
 def validate(runtime_env: Optional[dict]) -> None:
@@ -59,17 +68,24 @@ def validate(runtime_env: Optional[dict]) -> None:
     if bad:
         raise ValueError(
             f"runtime_env keys {sorted(bad)} are not supported in this "
-            f"deployment (no package installs); supported: "
+            f"deployment (no container tooling); supported: "
             f"{sorted(SUPPORTED_KEYS)}")
     unknown = set(runtime_env) - SUPPORTED_KEYS
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+    if "pip" in runtime_env and "conda" in runtime_env:
+        raise ValueError("runtime_env cannot combine 'pip' and 'conda' "
+                         "(same rule as the reference)")
     if "pip" in runtime_env:
         from raytpu.runtime_env.pip_env import normalize_spec
 
         # Shape check only: the RAYTPU_ALLOW_PIP policy gate belongs to
         # the node where the env materializes, not the submitting driver.
         normalize_spec(runtime_env["pip"], check_gate=False)
+    if "conda" in runtime_env:
+        from raytpu.runtime_env.conda_env import normalize_spec as _conda_ns
+
+        _conda_ns(runtime_env["conda"], check_gate=False)
 
 
 def package_dir(path: str) -> str:
@@ -142,6 +158,7 @@ class RuntimeEnvContext:
         validate(runtime_env)
         self.env = dict(runtime_env or {})
         self._path_entries: List[str] = []
+        self._bin_entries: List[str] = []
         self._held_keys: List[str] = []
 
     def __enter__(self) -> "RuntimeEnvContext":
@@ -154,6 +171,11 @@ class RuntimeEnvContext:
             from raytpu.runtime_env.pip_env import ensure_pip_env
 
             pip_site = ensure_pip_env(self.env["pip"])
+        conda_paths = None
+        if self.env.get("conda"):
+            from raytpu.runtime_env.conda_env import ensure_conda_env
+
+            conda_paths = ensure_conda_env(self.env["conda"])
         with _lock:
             try:
                 for k, v in env_vars.items():
@@ -182,6 +204,13 @@ class RuntimeEnvContext:
                         self._add_path(target)
                 if pip_site is not None:
                     self._add_path(pip_site)
+                if conda_paths is not None:
+                    self._add_path(conda_paths["site_packages"])
+                    # The env's binaries are reachable while held (conda
+                    # "activation" for subprocesses the task launches).
+                    bin_dir = conda_paths["bin"]
+                    if os.path.isdir(bin_dir):
+                        self._add_bin(bin_dir)
             except BaseException:
                 # Half-entered env must be fully rolled back or the leaked
                 # vars/paths pollute every later task in this process.
@@ -195,6 +224,23 @@ class RuntimeEnvContext:
             sys.path.insert(0, target)
         _path_refs[target] = refs + 1
         self._path_entries.append(target)
+
+    def _add_bin(self, bin_dir: str) -> None:
+        refs = _path_env_refs.get(bin_dir, 0)
+        if refs == 0:
+            os.environ["PATH"] = bin_dir + os.pathsep + \
+                os.environ.get("PATH", "")
+        _path_env_refs[bin_dir] = refs + 1
+        self._bin_entries.append(bin_dir)
+
+    @staticmethod
+    def _strip_bin(bin_dir: str) -> None:
+        parts = os.environ.get("PATH", "").split(os.pathsep)
+        try:
+            parts.remove(bin_dir)
+        except ValueError:
+            return  # user code rewrote PATH; nothing of ours to strip
+        os.environ["PATH"] = os.pathsep.join(parts)
 
     def __exit__(self, *exc) -> bool:
         with _lock:
@@ -225,3 +271,11 @@ class RuntimeEnvContext:
             else:
                 _path_refs[p] = refs
         self._path_entries = []
+        for b in self._bin_entries:
+            refs = _path_env_refs.get(b, 0) - 1
+            if refs <= 0:
+                _path_env_refs.pop(b, None)
+                self._strip_bin(b)
+            else:
+                _path_env_refs[b] = refs
+        self._bin_entries = []

@@ -157,6 +157,38 @@ def _cmd_events(args) -> int:
     return 0
 
 
+def _cmd_stack(args) -> int:
+    """Dump live thread stacks of every worker on every (matching) node
+    (reference: ``ray stack`` + the dashboard's py-spy profiling)."""
+    from raytpu.cluster.protocol import RpcClient
+    from raytpu.util.stack_dump import collect_cluster_stacks
+
+    head = RpcClient(args.address)
+    try:
+        nodes = head.call("list_nodes")
+    finally:
+        head.close()
+    targets = [(n["node_id"], n["address"]) for n in nodes
+               if n.get("alive") and n["labels"].get("role") != "driver"]
+    results = collect_cluster_stacks(targets, worker=args.worker,
+                                     node_filter=args.node)
+    shown = 0
+    for node_id, stacks in results.items():
+        if set(stacks) == {"error"}:
+            print(f"== node {node_id[:12]}: unreachable: "
+                  f"{stacks['error']}")
+            continue
+        for wid, info in stacks.items():
+            print(f"== node {node_id[:12]} worker {wid[:12]} "
+                  f"pid={info.get('pid')}")
+            print(info.get("stack") or f"error: {info.get('error')}")
+            shown += 1
+    if not shown:
+        print("no matching live workers")
+        return 1
+    return 0
+
+
 def _cmd_proxy(args) -> int:
     """Serve the remote-driver proxy (reference: the Ray Client server
     behind ray:// addresses)."""
@@ -274,6 +306,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--label", default=None)
     s.add_argument("--limit", type=int, default=50)
     s.set_defaults(fn=_cmd_events)
+
+    s = sub.add_parser(
+        "stack", help="live stack dump of cluster workers (reference: "
+                      "ray stack / dashboard py-spy)")
+    s.add_argument("--address", required=True, help="head host:port")
+    s.add_argument("--node", default=None, help="node id prefix filter")
+    s.add_argument("worker", nargs="?", default=None,
+                   help="worker id prefix, 'daemon', or empty for all")
+    s.set_defaults(fn=_cmd_stack)
 
     s = sub.add_parser("proxy", help="remote-driver proxy (raytpu://)")
     s.add_argument("--head", required=True, help="head host:port")

@@ -29,11 +29,15 @@ class AskTellSearcher(Searcher):
     def __init__(self, ask: Callable[[], Tuple[Any, Dict[str, Any]]],
                  tell: Callable[[Any, float], None],
                  metric: str, mode: str = "max",
-                 raw_score: bool = False):
+                 raw_score: bool = False,
+                 tell_failure: Optional[Callable[[Any], None]] = None):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
         self._ask = ask
         self._tell = tell
+        # Crashed/metric-less trials: the optimizer must learn the trial
+        # ended (optuna would otherwise consider it running forever).
+        self._tell_failure = tell_failure
         self.metric = metric
         self.mode = mode
         # raw_score: the external optimizer already knows the direction
@@ -52,13 +56,16 @@ class AskTellSearcher(Searcher):
     def on_trial_complete(self, trial_id: str,
                           result: Dict[str, Any]) -> None:
         token = self._tokens.pop(trial_id, None)
-        if token is None or self.metric not in (result or {}):
+        if token is None:
             return
-        score = float(result[self.metric])
-        if self.mode == "min" and not self.raw_score:
-            score = -score
         try:
-            self._tell(token, score)
+            if self.metric in (result or {}):
+                score = float(result[self.metric])
+                if self.mode == "min" and not self.raw_score:
+                    score = -score
+                self._tell(token, score)
+            elif self._tell_failure is not None:
+                self._tell_failure(token)
         except Exception:
             pass  # a broken external model must not fail the run
 
@@ -139,5 +146,12 @@ class OptunaSearcher(AskTellSearcher):
         def tell(trial, score: float):
             self._study.tell(trial, score)
 
+        def tell_failure(trial):
+            # Reference parity: OptunaSearch reports TrialState.FAIL so
+            # the sampler stops treating the trial as running.
+            self._study.tell(trial, None,
+                             state=optuna.trial.TrialState.FAIL)
+
         # raw_score: the study's direction already encodes min/max.
-        super().__init__(ask, tell, metric, mode, raw_score=True)
+        super().__init__(ask, tell, metric, mode, raw_score=True,
+                         tell_failure=tell_failure)

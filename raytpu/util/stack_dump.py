@@ -1,0 +1,77 @@
+"""In-process stack dumps for live workers.
+
+Reference analogue: ``dashboard/modules/reporter/profile_manager.py`` —
+the reference shells out to py-spy to snapshot any worker's stacks from
+the dashboard. py-spy isn't shippable here (zero-egress image), so the
+equivalent capability is in-process: every worker's RPC loop serves a
+``stack`` call that formats ``sys._current_frames()`` for all threads —
+the same information py-spy's ``dump`` mode prints, without ptrace.
+A wedged task thread doesn't block the dump (the RPC loop is a separate
+thread); only a worker hard-hung in native code without releasing the
+GIL is unsnapshotable, which ptrace-based py-spy can still see — dump
+the pid with gdb there.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+
+
+def collect_cluster_stacks(nodes, worker=None, node_filter=None,
+                           timeout: float = 30.0):
+    """Fan ``worker_stacks`` out to every node concurrently (a wedged
+    node costs at most one ``timeout``, not one per node — wedged nodes
+    are exactly what this endpoint debugs).
+
+    ``nodes``: iterable of ``(node_id, address)``. Returns
+    ``{node_id: worker_stacks result or {"error": ...}}``.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from raytpu.cluster.protocol import RpcClient
+
+    targets = [(nid, addr) for nid, addr in nodes
+               if not node_filter or nid.startswith(node_filter)]
+    if not targets:
+        return {}
+
+    def one(target):
+        nid, addr = target
+        try:
+            cli = RpcClient(addr)
+            try:
+                return nid, cli.call("worker_stacks", worker,
+                                     timeout=timeout)
+            finally:
+                cli.close()
+        except Exception as e:
+            return nid, {"error": f"{type(e).__name__}: {e}"}
+
+    with ThreadPoolExecutor(
+            max_workers=min(16, len(targets)),
+            thread_name_prefix="raytpu-stacks") as ex:
+        return dict(ex.map(one, targets))
+
+
+def dump_all_threads(header: str = "") -> str:
+    """Format every thread's current stack, py-spy-dump style."""
+    frames = sys._current_frames()
+    by_id = {t.ident: t for t in threading.enumerate()}
+    parts = []
+    if header:
+        parts.append(header)
+    for tid, frame in sorted(frames.items()):
+        t = by_id.get(tid)
+        name = t.name if t is not None else f"<unknown-{tid}>"
+        flags = []
+        if t is not None and t.daemon:
+            flags.append("daemon")
+        if t is threading.main_thread():
+            flags.append("main")
+        suffix = f" ({', '.join(flags)})" if flags else ""
+        parts.append(
+            f'Thread "{name}" tid={tid}{suffix}:\n'
+            + "".join(traceback.format_stack(frame)).rstrip())
+    return "\n\n".join(parts) + "\n"

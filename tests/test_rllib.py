@@ -636,3 +636,57 @@ class TestCQL:
         # Q, the conservative term must keep it near/below data scale.
         assert r["q_mean"] < 10.0, r
         algo.stop()
+
+
+class TestGymnasiumAdapter:
+    """Gymnasium/ALE adapter (reference: RLlib resolves env ids through
+    gymnasium; rllib/tuned_examples/ppo uses ALE/*-v5). gymnasium ships
+    in this image (no ale-py), so classic-control ids exercise the real
+    adapter; ALE ids raise gymnasium's install hint."""
+
+    def test_make_env_resolves_real_gym_id(self, raytpu_local):
+        from raytpu.rllib.env.envs import make_env
+
+        env = make_env("Acrobot-v1", {})
+        obs, info = env.reset(seed=0)
+        assert obs.dtype == np.float32 and obs.shape == (6,)
+        assert env.action_space.n == 3
+        obs, r, term, trunc, info = env.step(np.int64(1))
+        assert obs.shape == (6,) and isinstance(r, float)
+
+    def test_registered_builtins_take_priority(self, raytpu_local):
+        from raytpu.rllib.env.envs import CartPoleEnv, make_env
+
+        assert isinstance(make_env("CartPole-v1", {}), CartPoleEnv)
+
+    def test_ale_id_without_ale_py_hints_install(self, raytpu_local):
+        from raytpu.rllib.env.envs import make_env
+
+        with pytest.raises(Exception, match="(?i)ale"):
+            make_env("ALE/Pong-v5", {})
+
+    def test_no_gymnasium_error_mentions_fallback(self, raytpu_local,
+                                                  monkeypatch):
+        import raytpu.rllib.env.gym_adapter as ga
+        from raytpu.rllib.env import envs as envs_mod
+
+        monkeypatch.setattr(ga, "gymnasium_available", lambda: False)
+        with pytest.raises(ValueError, match="Catch-v0"):
+            envs_mod.make_env("Whatever-v9", {})
+
+    def test_ppo_smoke_on_adapted_env(self, raytpu_local):
+        from raytpu.rllib import PPOConfig
+
+        config = (PPOConfig().environment(
+                      "Acrobot-v1",
+                      env_config={"env_kwargs": {}})
+                  .env_runners(num_env_runners=0,
+                               num_envs_per_env_runner=2,
+                               rollout_fragment_length=64)
+                  .training(lr=3e-4, num_epochs=1, minibatch_size=64)
+                  .debugging(seed=0))
+        algo = config.build()
+        result = algo.train()
+        assert result["timesteps_total"] == 128
+        assert "episode_return_mean" in result
+        algo.stop()

@@ -12,9 +12,22 @@ from raytpu.runtime_env.context import RuntimeEnvContext
 
 
 class TestValidation:
-    def test_conda_rejected(self):
+    def test_container_rejected(self):
         with pytest.raises(ValueError, match="not supported"):
-            validate({"conda": {"dependencies": ["requests"]}})
+            validate({"container": {"image": "x"}})
+
+    def test_conda_shape_validated_at_submission(self):
+        from raytpu.core.errors import RuntimeEnvError
+
+        # conda is supported now; malformed specs still fail fast at
+        # validate time (the conda-binary gate is node-side).
+        with pytest.raises(RuntimeEnvError, match="dependencies"):
+            validate({"conda": {}})
+        validate({"conda": "someenv"})  # name form: shape-valid
+
+    def test_pip_and_conda_exclusive(self):
+        with pytest.raises(ValueError, match="combine"):
+            validate({"pip": ["x"], "conda": "y"})
 
     def test_pip_spec_validated_at_submission(self):
         from raytpu.core.errors import RuntimeEnvError
@@ -33,7 +46,7 @@ class TestValidation:
         def f():
             return 1
 
-        ref = f.options(runtime_env={"conda": "env"}).remote()
+        ref = f.options(runtime_env={"container": {"image": "x"}}).remote()
         with pytest.raises(raytpu.TaskError, match="not supported"):
             raytpu.get(ref)
 
@@ -232,3 +245,162 @@ class TestPipRuntimeEnv:
         with pytest.raises(RuntimeEnvError, match="pip install failed"):
             ensure_pip_env({"packages": ["no-such-package-xyz"],
                             "find_links": [str(tmp_path)]})
+
+
+class TestCondaRuntimeEnv:
+    """conda envs (raytpu/runtime_env/conda_env.py; reference:
+    python/ray/_private/runtime_env/conda.py). No conda ships in this
+    image, so a stub conda binary materializes envs the way the real one
+    would; the named-prefix form needs no binary at all."""
+
+    @staticmethod
+    def _make_prefix(tmp_path, name, module_body):
+        import sys as _sys
+
+        vi = _sys.version_info
+        prefix = tmp_path / name
+        site = prefix / "lib" / f"python{vi.major}.{vi.minor}" / \
+            "site-packages"
+        site.mkdir(parents=True)
+        (site / "conda_probe_mod.py").write_text(module_body)
+        (prefix / "bin").mkdir()
+        return str(prefix)
+
+    @staticmethod
+    def _stub_conda(tmp_path):
+        """A fake conda: `env create --prefix P --file F` builds a valid
+        prefix containing conda_made.py; every call appends to calls.log."""
+        import sys as _sys
+
+        vi = _sys.version_info
+        stub = tmp_path / "conda"
+        stub.write_text(f"""#!/bin/sh
+echo "$@" >> {tmp_path}/calls.log
+if [ "$1" = "info" ]; then
+  echo '{{"envs_dirs": ["{tmp_path}/envs"], "envs": []}}'
+  exit 0
+fi
+if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+  prefix=$4
+  mkdir -p "$prefix/lib/python{vi.major}.{vi.minor}/site-packages" \
+           "$prefix/bin"
+  echo "TOKEN = 'conda-env-works'" > \
+    "$prefix/lib/python{vi.major}.{vi.minor}/site-packages/conda_made.py"
+  exit 0
+fi
+echo "conda-stub: solver exploded" >&2
+exit 1
+""")
+        stub.chmod(0o755)
+        return str(stub)
+
+    def test_named_prefix_task(self, raytpu_local, tmp_path):
+        raytpu = raytpu_local
+        prefix = self._make_prefix(tmp_path, "env1",
+                                   "VALUE = 'named-prefix-works'\n")
+
+        @raytpu.remote(runtime_env={"conda": prefix})
+        def probe():
+            import conda_probe_mod
+
+            return conda_probe_mod.VALUE
+
+        assert raytpu.get(probe.remote(), timeout=60) == \
+            "named-prefix-works"
+        import sys as _sys
+
+        _sys.modules.pop("conda_probe_mod", None)
+
+    def test_dict_spec_materialized_and_cached(self, tmp_path,
+                                               monkeypatch):
+        from raytpu.runtime_env import conda_env
+
+        monkeypatch.setenv("RAYTPU_CONDA_EXE", self._stub_conda(tmp_path))
+        monkeypatch.setattr(conda_env, "_ENVS_ROOT",
+                            str(tmp_path / "cache"))
+        spec = {"dependencies": ["numpy=1.26"]}
+        p1 = conda_env.ensure_conda_env(spec)
+        assert os.path.isfile(os.path.join(p1["site_packages"],
+                                           "conda_made.py"))
+        calls_before = (tmp_path / "calls.log").read_text().count("create")
+        p2 = conda_env.ensure_conda_env(spec)
+        calls_after = (tmp_path / "calls.log").read_text().count("create")
+        assert p1 == p2
+        assert calls_after == calls_before, "cache hit must not re-create"
+
+    def test_create_failure_surfaces_solver_tail(self, tmp_path,
+                                                 monkeypatch):
+        from raytpu.core.errors import RuntimeEnvError
+        from raytpu.runtime_env import conda_env
+
+        stub = tmp_path / "badconda"
+        stub.write_text("#!/bin/sh\necho 'PackagesNotFoundError: nope' "
+                        ">&2\nexit 1\n")
+        stub.chmod(0o755)
+        monkeypatch.setenv("RAYTPU_CONDA_EXE", str(stub))
+        monkeypatch.setattr(conda_env, "_ENVS_ROOT",
+                            str(tmp_path / "cache2"))
+        with pytest.raises(RuntimeEnvError,
+                           match="PackagesNotFoundError"):
+            conda_env.ensure_conda_env({"dependencies": ["ghost=9.9"]})
+
+    def test_wrong_python_version_rejected(self, tmp_path):
+        from raytpu.core.errors import RuntimeEnvError
+        from raytpu.runtime_env.conda_env import ensure_conda_env
+
+        prefix = tmp_path / "oldenv"
+        (prefix / "lib" / "python2.7" / "site-packages").mkdir(
+            parents=True)
+        with pytest.raises(RuntimeEnvError, match="python2.7"):
+            ensure_conda_env(str(prefix))
+
+    def test_no_conda_binary_gate(self, monkeypatch):
+        from raytpu.core.errors import RuntimeEnvError
+        from raytpu.runtime_env import conda_env
+
+        monkeypatch.delenv("RAYTPU_CONDA_EXE", raising=False)
+        monkeypatch.delenv("CONDA_EXE", raising=False)
+        monkeypatch.setattr(conda_env.shutil, "which", lambda _: None)
+        with pytest.raises(RuntimeEnvError, match="conda binary"):
+            conda_env.normalize_spec({"dependencies": ["x"]})
+        # driver-side shape check passes without the binary
+        conda_env.normalize_spec({"dependencies": ["x"]},
+                                 check_gate=False)
+
+    def test_conda_bin_on_path_during_task(self, raytpu_local, tmp_path):
+        raytpu = raytpu_local
+        prefix = self._make_prefix(tmp_path, "env2", "VALUE = 1\n")
+        tool = os.path.join(prefix, "bin", "conda-tool")
+        with open(tool, "w") as f:
+            f.write("#!/bin/sh\necho tool-ran\n")
+        os.chmod(tool, 0o755)
+
+        @raytpu.remote(runtime_env={"conda": prefix})
+        def run_tool():
+            import subprocess
+
+            return subprocess.run(["conda-tool"], capture_output=True,
+                                  text=True).stdout.strip()
+
+        assert raytpu.get(run_tool.remote(), timeout=60) == "tool-ran"
+
+    def test_two_conda_envs_both_on_path(self, tmp_path):
+        """Concurrent tasks with DIFFERENT conda envs each resolve their
+        own bin dir (regression: a single refcounted PATH value dropped
+        the second env's bin silently)."""
+        p1 = self._make_prefix(tmp_path, "envA", "VALUE = 1\n")
+        p2 = self._make_prefix(tmp_path, "envB", "VALUE = 2\n")
+        c1 = RuntimeEnvContext({"conda": p1})
+        c2 = RuntimeEnvContext({"conda": p2})
+        c1.__enter__()
+        c2.__enter__()
+        try:
+            path = os.environ["PATH"].split(os.pathsep)
+            assert os.path.join(p1, "bin") in path
+            assert os.path.join(p2, "bin") in path
+        finally:
+            c2.__exit__(None, None, None)
+            c1.__exit__(None, None, None)
+        path = os.environ["PATH"].split(os.pathsep)
+        assert os.path.join(p1, "bin") not in path
+        assert os.path.join(p2, "bin") not in path

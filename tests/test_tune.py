@@ -740,3 +740,18 @@ class TestExternalSearchers:
         # every completion was told back to the study with the raw value
         assert len(searcher._study.told) == 5
         assert min(v for _, v in searcher._study.told) == 0.0
+
+    def test_failed_trial_reported_to_external_optimizer(self, tune_env):
+        """A crashed/metric-less trial must release its token and reach
+        the optimizer's failure path (optuna would otherwise consider
+        the trial running forever)."""
+        raytpu, tune, run_config = tune_env
+
+        failed = []
+        s = tune.AskTellSearcher(
+            lambda: ("tok", {"x": 1.0}), lambda t, v: None,
+            metric="loss", mode="min", tell_failure=failed.append)
+        assert s.suggest("t1") == {"x": 1.0}
+        s.on_trial_complete("t1", {})  # no metric: trial errored
+        assert failed == ["tok"]
+        assert s._tokens == {}
