@@ -54,7 +54,14 @@ class StandardAutoscaler:
     def __init__(self, config: AutoscalerConfig, provider: NodeProvider):
         self.config = config
         self.provider = provider
-        self._idle_since: Dict[str, float] = {}
+        # v2 core: the declarative reconciler owns every cloud mutation
+        # and the per-instance state machine (reference:
+        # instance_manager.py:29); this class only computes targets.
+        from raytpu.autoscaler.instance_manager import InstanceManager
+
+        self.instance_manager = InstanceManager(
+            provider, {s.name: s for s in config.node_groups},
+            max_concurrent_requests=config.max_concurrent_launches)
         self._lock = threading.Lock()
 
     # -- demand → desired groups ------------------------------------------
@@ -124,8 +131,10 @@ class StandardAutoscaler:
 
     def update(self, demands: List[ResourceDemand],
                busy_group_ids: Optional[set] = None) -> Dict[str, int]:
-        """One reconcile tick. ``busy_group_ids``: groups currently running
-        workloads (never terminated; reset their idle clocks)."""
+        """One reconcile tick: compute per-type targets from demand, hand
+        them to the instance manager, reconcile. ``busy_group_ids``:
+        groups currently running workloads (never terminated; reset
+        their idle clocks)."""
         busy = busy_group_ids or set()
         self.provider.poll()
         groups = self.provider.non_terminated_groups()
@@ -140,43 +149,18 @@ class StandardAutoscaler:
                     used_counts.get(g.spec.name, 0) + 1
         desired = self.get_desired_groups(demands, used_counts)
 
-        now = time.monotonic()
-        launched: Dict[str, int] = {}
+        # Upscaling-speed bound per type (reference: upscaling_speed).
+        launch_caps = {
+            spec.name: max(5, int(self.config.upscaling_speed *
+                                  max(1, len(by_type.get(spec.name, ())))))
+            for spec in self.config.node_groups
+        }
         with self._lock:
-            # Replace failed groups (failure detection; the reference's
-            # instance manager drives failed instances to re-provision).
-            for g in groups:
-                if g.status == "failed":
-                    self.provider.terminate_node_group(g.group_id)
-            for spec in self.config.node_groups:
-                have = [g for g in by_type.get(spec.name, ())
-                        if g.status in ("pending", "running")]
-                want = desired.get(spec.name, 0)
-                # Scale up.
-                cap = max(5, int(self.config.upscaling_speed *
-                                 max(1, len(have))))
-                for _ in range(min(want - len(have), cap)):
-                    self.provider.create_node_group(spec)
-                    launched[spec.name] = launched.get(spec.name, 0) + 1
-                # Scale down: terminate idle groups beyond the target.
-                if len(have) > want:
-                    for g in list(have):
-                        if len(have) <= want:
-                            break
-                        if g.group_id in busy:
-                            self._idle_since.pop(g.group_id, None)
-                            continue
-                        first_idle = self._idle_since.setdefault(
-                            g.group_id, now)
-                        if now - first_idle >= self.config.idle_timeout_s:
-                            self.provider.terminate_node_group(g.group_id)
-                            self._idle_since.pop(g.group_id, None)
-                            have.remove(g)
-                # Busy groups are by definition not idle.
-                for g in have:
-                    if g.group_id in busy:
-                        self._idle_since.pop(g.group_id, None)
-        return launched
+            self.instance_manager.set_targets(desired)
+            return self.instance_manager.reconcile(
+                busy, idle_timeout_s=self.config.idle_timeout_s,
+                max_launches_per_type=launch_caps,
+                poll=False)  # polled above, before reading group state
 
 
 class AutoscalerMonitor:

@@ -1,0 +1,249 @@
+"""Declarative instance manager — the autoscaler's v2 reconciler core.
+
+Reference analogue: ``python/ray/autoscaler/v2/instance_manager/
+instance_manager.py:29`` — scaling is expressed as *desired state* (how
+many instances of each type should exist) and a reconciler drives the
+cloud toward it through an explicit per-instance state machine with an
+audit trail, instead of imperative launch/terminate calls scattered
+through the scaler. Slice-shaped here: the "instance" is a whole node
+group (one TPU slice), matching the provider layer.
+
+State machine (reference: v2 ``Instance.status`` values)::
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RUNNING -> TERMINATING -> TERMINATED
+                 |             |           |
+                 v             v           v
+         ALLOCATION_FAILED   FAILED     FAILED   (drift: cloud lost it)
+
+Reconcile-on-drift: a RUNNING instance whose cloud group vanishes or
+fails flips to FAILED and the next tick launches a replacement (targets
+are declarative — nothing else needs to notice).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from raytpu.autoscaler.node_provider import (
+    NodeGroup,
+    NodeGroupSpec,
+    NodeProvider,
+)
+
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RUNNING = "RUNNING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+FAILED = "FAILED"
+
+LIVE_STATES = (QUEUED, REQUESTED, ALLOCATED, RUNNING)
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    group_type: str
+    state: str = QUEUED
+    group: Optional[NodeGroup] = None
+    idle_since: Optional[float] = None
+    # (monotonic ts, new_state, reason) — the v2 audit trail.
+    history: List[tuple] = field(default_factory=list)
+
+    def transition(self, state: str, reason: str = "") -> None:
+        self.state = state
+        self.history.append((time.monotonic(), state, reason))
+
+    @property
+    def group_id(self) -> Optional[str]:
+        return self.group.group_id if self.group is not None else None
+
+
+class InstanceManager:
+    """Drives ``provider`` toward per-type targets set with
+    :meth:`set_target`; every cloud mutation happens inside
+    :meth:`reconcile` and is recorded on the instance's history."""
+
+    def __init__(self, provider: NodeProvider,
+                 specs: Dict[str, NodeGroupSpec],
+                 ray_running_fn: Optional[
+                     Callable[[NodeGroup], bool]] = None,
+                 max_concurrent_requests: int = 100):
+        self.provider = provider
+        self.specs = dict(specs)
+        # Hook for "the framework is actually up on the slice" (reference:
+        # RAY_INSTALLING -> RAY_RUNNING); default: allocation == running.
+        self.ray_running_fn = ray_running_fn or (lambda g: True)
+        self.max_concurrent_requests = max_concurrent_requests
+        self._targets: Dict[str, int] = {n: 0 for n in specs}
+        self._instances: Dict[str, Instance] = {}
+        # Terminal instances move here so _instances stays bounded while
+        # a recent audit trail survives (reference: v2 keeps instance
+        # history in storage; a ring suffices for a single head).
+        from collections import deque
+
+        self.retired: "deque[Instance]" = deque(maxlen=200)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # -- declarative surface ------------------------------------------------
+
+    def set_target(self, group_type: str, count: int) -> None:
+        if group_type not in self.specs:
+            raise KeyError(f"unknown node group type {group_type!r}")
+        with self._lock:
+            self._targets[group_type] = max(0, int(count))
+
+    def set_targets(self, targets: Dict[str, int]) -> None:
+        for name, count in targets.items():
+            self.set_target(name, count)
+
+    def instances(self, group_type: Optional[str] = None,
+                  states: Optional[Set[str]] = None) -> List[Instance]:
+        with self._lock:
+            return [i for i in self._instances.values()
+                    if (group_type is None or i.group_type == group_type)
+                    and (states is None or i.state in states)]
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self, busy_group_ids: Optional[Set[str]] = None,
+                  idle_timeout_s: float = 0.0,
+                  max_launches_per_type=None,
+                  poll: bool = True) -> Dict[str, int]:
+        """One tick: sync cloud state, repair drift, launch toward
+        deficits (bounded; ``max_launches_per_type`` may be an int or a
+        per-type dict), retire surplus idle instances. Returns
+        create-call counts per type. ``poll=False`` when the caller just
+        polled the provider (one cloud list per tick, not two)."""
+        busy = busy_group_ids or set()
+        if poll:
+            self.provider.poll()
+        now = time.monotonic()
+        launched: Dict[str, int] = {}
+        with self._lock:
+            self._sync_locked()
+            for name, spec in self.specs.items():
+                live = [i for i in self._instances.values()
+                        if i.group_type == name and i.state in LIVE_STATES]
+                want = self._targets.get(name, 0)
+                # Queue the FULL deficit (declarative: the desired state
+                # exists as QUEUED instances); the launch step below is
+                # what rate-limits cloud requests.
+                for _ in range(max(0, want - len(live))):
+                    inst = Instance(f"i-{next(self._ids)}", name)
+                    inst.transition(QUEUED, "target deficit")
+                    self._instances[inst.instance_id] = inst
+                    live.append(inst)
+                if len(live) > want:
+                    self._retire_locked(live, want, busy, idle_timeout_s,
+                                        now)
+            launched = self._launch_locked(max_launches_per_type)
+            # Terminal instances leave the working set (bounded memory;
+            # reconcile scans stay O(live)).
+            for iid in [iid for iid, i in self._instances.items()
+                        if i.state in (TERMINATED, FAILED,
+                                       ALLOCATION_FAILED)]:
+                self.retired.append(self._instances.pop(iid))
+        return launched
+
+    # -- internals (all hold self._lock) ------------------------------------
+
+    def _sync_locked(self) -> None:
+        """Fold the provider's view into instance states (drift included)."""
+        by_gid = {g.group_id: g for g in
+                  self.provider.non_terminated_groups()}
+        known_gids = {i.group_id for i in self._instances.values()
+                      if i.group_id}
+        # Adopt externally-created groups so reconcile never fights an
+        # operator's manual launches.
+        for gid, g in by_gid.items():
+            if gid not in known_gids and g.spec.name in self.specs:
+                inst = Instance(f"i-{next(self._ids)}", g.spec.name,
+                                group=g)
+                inst.transition(
+                    RUNNING if g.status == "running" else REQUESTED,
+                    "adopted existing group")
+                self._instances[inst.instance_id] = inst
+        for inst in self._instances.values():
+            g = by_gid.get(inst.group_id) if inst.group_id else None
+            if inst.state == REQUESTED:
+                status = (g or inst.group).status
+                if status == "running":
+                    inst.transition(ALLOCATED, "cloud reports running")
+                    if self.ray_running_fn(inst.group):
+                        inst.transition(RUNNING, "framework up")
+                elif status == "failed":
+                    inst.transition(ALLOCATION_FAILED, "provision failed")
+                    self._terminate_locked(inst, "cleanup failed launch")
+            elif inst.state in (ALLOCATED, RUNNING):
+                if g is None or g.status == "failed":
+                    # Drift: the cloud lost a slice we believe is live.
+                    inst.transition(
+                        FAILED, "group vanished" if g is None
+                        else "group failed")
+                    self._terminate_locked(inst, "cleanup drifted group")
+
+    def _retire_locked(self, live: List[Instance], want: int,
+                       busy: Set[str], idle_timeout_s: float,
+                       now: float) -> None:
+        # Cheapest first: queued (no cloud call yet), then requested,
+        # then idle running instances past the timeout.
+        for inst in [i for i in live if i.state == QUEUED]:
+            if len(live) <= want:
+                return
+            inst.transition(TERMINATED, "target shrank before launch")
+            live.remove(inst)
+        for inst in [i for i in live if i.state in (ALLOCATED, RUNNING)]:
+            if len(live) <= want:
+                return
+            if inst.group_id in busy:
+                inst.idle_since = None
+                continue
+            if inst.idle_since is None:
+                inst.idle_since = now
+            if now - inst.idle_since >= idle_timeout_s:
+                inst.transition(TERMINATING, "surplus idle")
+                self._terminate_locked(inst, "surplus idle")
+                live.remove(inst)
+
+    def _launch_locked(self, caps=None) -> Dict[str, int]:
+        launched: Dict[str, int] = {}
+        in_flight = sum(1 for i in self._instances.values()
+                        if i.state == REQUESTED)
+        for inst in [i for i in self._instances.values()
+                     if i.state == QUEUED]:
+            if in_flight >= self.max_concurrent_requests:
+                break
+            if caps is not None:
+                cap = (caps.get(inst.group_type)
+                       if isinstance(caps, dict) else int(caps))
+                if cap is not None and \
+                        launched.get(inst.group_type, 0) >= cap:
+                    continue
+            try:
+                inst.group = self.provider.create_node_group(
+                    self.specs[inst.group_type])
+                inst.transition(REQUESTED, "create requested")
+                in_flight += 1
+                launched[inst.group_type] = \
+                    launched.get(inst.group_type, 0) + 1
+            except Exception as e:
+                inst.transition(ALLOCATION_FAILED, f"create raised: {e}")
+        return launched
+
+    def _terminate_locked(self, inst: Instance, reason: str) -> None:
+        try:
+            if inst.group is not None:
+                self.provider.terminate_node_group(inst.group.group_id)
+        except Exception as e:
+            inst.transition(FAILED, f"terminate raised: {e}")
+            return
+        inst.transition(TERMINATED, reason)
+        inst.idle_since = None

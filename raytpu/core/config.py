@@ -92,6 +92,10 @@ declare("object_store_fallback_directory", "")
 declare("object_spilling_threshold", 0.8)
 # Node-to-node transfer chunking (reference: chunked pull/push,
 # object_manager.cc with chunk_size from ray_config_def.h).
+# Byte budget for one streaming Dataset execution's in-flight blocks
+# (reference: ResourceManager object-store budgets). 0 = auto: 25% of
+# object_store_memory_bytes.
+declare("data_memory_budget_bytes", 0)
 declare("object_transfer_chunk_bytes", 4 * 1024 * 1024)
 declare("object_transfer_max_concurrency", 8)
 # Push-based transfer (reference: push_manager.h bounded-in-flight

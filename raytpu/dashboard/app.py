@@ -155,15 +155,80 @@ class DashboardServer:
     def _collect_stacks(self, worker: Optional[str],
                         node_filter: Optional[str]) -> Dict[str, Any]:
         """Blocking concurrent fan-out to every node's worker_stacks."""
-        import raytpu
         from raytpu.util.stack_dump import collect_cluster_stacks
 
-        targets = [(n.get("NodeID", ""), n["Address"])
-                   for n in raytpu.nodes()
-                   if n.get("Alive")
-                   and n.get("Labels", {}).get("role") != "driver"]
-        return collect_cluster_stacks(targets, worker=worker,
+        return collect_cluster_stacks(self._worker_nodes(), worker=worker,
                                       node_filter=node_filter)
+
+    def _worker_nodes(self):
+        import raytpu
+
+        return [(n.get("NodeID", ""), n["Address"])
+                for n in raytpu.nodes()
+                if n.get("Alive")
+                and n.get("Labels", {}).get("role") != "driver"]
+
+    _LOG_CHUNK = 1 << 20
+    _LOG_MAX_BYTES = 8 << 20  # full-file reads cap here, flagged
+
+    def _list_logs(self) -> Dict[str, Any]:
+        from raytpu.util.stack_dump import fanout_node_call
+
+        return fanout_node_call(self._worker_nodes(), "list_logs",
+                                timeout=10.0)
+
+    def _read_log(self, node_id: str, name: str,
+                  tail: int = 0) -> Optional[str]:
+        from raytpu.cluster.protocol import RpcClient
+
+        for nid, addr in self._worker_nodes():
+            if not nid.startswith(node_id):
+                continue
+            try:
+                cli = RpcClient(addr)
+                try:
+                    if tail > 0:
+                        # True tail: read from the END of the file (the
+                        # listing has the size), not the first chunk.
+                        size = 0
+                        for e in cli.call("list_logs", timeout=10.0):
+                            if e["name"] == name:
+                                size = int(e["size"])
+                        offset = max(0, size - self._LOG_CHUNK)
+                        chunk = cli.call("read_log", name, offset,
+                                         timeout=15.0)
+                        if chunk is None:
+                            return None
+                        lines = chunk.decode("utf-8",
+                                             "replace").splitlines()
+                        if offset > 0 and lines:
+                            lines = lines[1:]  # first line may be cut
+                        return "\n".join(lines[-tail:])
+                    parts = []
+                    offset = 0
+                    truncated = False
+                    while True:
+                        chunk = cli.call("read_log", name, offset,
+                                         timeout=15.0)
+                        if chunk is None:
+                            return None if offset == 0 else "".join(parts)
+                        parts.append(chunk.decode("utf-8", "replace"))
+                        offset += len(chunk)
+                        if len(chunk) < self._LOG_CHUNK:
+                            break
+                        if offset >= self._LOG_MAX_BYTES:
+                            truncated = True
+                            break
+                    text = "".join(parts)
+                    if truncated:
+                        text += (f"\n... [truncated at {offset} bytes; "
+                                 f"use ?tail=N or the raytpu logs CLI]\n")
+                    return text
+                finally:
+                    cli.close()
+            except Exception:
+                return None
+        return None
 
     # -- server ------------------------------------------------------------
 
@@ -203,6 +268,44 @@ class DashboardServer:
                 text = "# prometheus_client unavailable\n"
             return web.Response(text=text, content_type="text/plain")
 
+        async def logs_index(request):
+            """Per-node log file listing (reference: the dashboard's log
+            viewer over each node's session dir)."""
+            loop = asyncio.get_running_loop()
+            listing = await loop.run_in_executor(None, self._list_logs)
+            rows = []
+            for node_id, entries in sorted(listing.items()):
+                if isinstance(entries, dict):  # error
+                    rows.append([node_id[:12],
+                                 html.escape(str(entries.get("error"))),
+                                 ""])
+                    continue
+                for e in entries:
+                    name = html.escape(e["name"])
+                    link = (f'<a href="/logs/{node_id}/{name}">'
+                            f'{name}</a>')
+                    rows.append([node_id[:12], link, e["size"]])
+            body = (f"<h2>Logs ({len(rows)} files)</h2>"
+                    + _table(["node", "file", "bytes"], rows))
+            return web.Response(text=_PAGE.format(body=body),
+                                content_type="text/html")
+
+        async def log_file(request):
+            loop = asyncio.get_running_loop()
+            node_id = request.match_info["node_id"]
+            name = request.match_info["name"]
+            try:
+                tail = int(request.query.get("tail", 0) or 0)
+            except ValueError:
+                return web.Response(status=400,
+                                    text="tail must be an integer")
+            text = await loop.run_in_executor(
+                None, self._read_log, node_id, name, tail)
+            if text is None:
+                return web.Response(status=404,
+                                    text=f"no log {name} on {node_id}")
+            return web.Response(text=text, content_type="text/plain")
+
         async def stacks(request):
             """Live worker stack dumps (reference: dashboard reporter's
             py-spy profiling endpoint). ?worker=<id prefix|daemon>,
@@ -221,6 +324,8 @@ class DashboardServer:
         app.router.add_get("/timeline", timeline)
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/stacks", stacks)
+        app.router.add_get("/logs", logs_index)
+        app.router.add_get("/logs/{node_id}/{name}", log_file)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)

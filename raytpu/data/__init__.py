@@ -2,17 +2,21 @@
 
 from raytpu.data.block import Block, BlockAccessor
 from raytpu.data.dataset import DataIterator, Dataset, GroupedData
-from raytpu.data.executor import ActorPoolStrategy
+from raytpu.data.executor import ActorPoolStrategy, ResourceBudget
 from raytpu.data.read_api import (
     from_arrow,
     from_generator,
     from_items,
+    from_jax,
     from_numpy,
     from_pandas,
+    from_torch,
     range,  # noqa: A004
     range_tensor,
+    read_binary_files,
     read_csv,
     read_json,
+    read_numpy,
     read_parquet,
     read_text,
 )
@@ -22,18 +26,23 @@ __all__ = [
     "DataIterator",
     "GroupedData",
     "ActorPoolStrategy",
+    "ResourceBudget",
     "Block",
     "BlockAccessor",
     "range",
     "range_tensor",
     "from_generator",
     "from_items",
+    "from_jax",
     "from_numpy",
     "from_pandas",
     "from_arrow",
-    "read_parquet",
+    "from_torch",
+    "read_binary_files",
     "read_csv",
     "read_json",
+    "read_numpy",
+    "read_parquet",
     "read_text",
 ]
 

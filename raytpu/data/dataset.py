@@ -477,8 +477,21 @@ class Dataset:
         return run_pipeline(self._source_fn(), self._ops)
 
     def iter_blocks(self) -> Iterator:
-        for ref in self._iter_block_refs():
-            yield raytpu.get(ref)
+        # The consuming loop observes real block sizes and feeds them to
+        # the executor's byte budget (reference: ResourceManager — memory
+        # backpressure, not just a concurrency cap).
+        from raytpu.data.executor import ResourceBudget
+
+        budget = ResourceBudget()
+        self._last_budget = budget  # introspection/tests
+        for ref in run_pipeline(self._source_fn(), self._ops,
+                                budget=budget):
+            block = raytpu.get(ref)
+            try:
+                budget.record_block(BlockAccessor(block).size_bytes())
+            except Exception:
+                pass
+            yield block
 
     def iter_rows(self) -> Iterator[dict]:
         for block in self.iter_blocks():
@@ -638,6 +651,16 @@ class Dataset:
         for i, block in enumerate(self.iter_blocks()):
             BlockAccessor(block).to_pandas().to_json(
                 f"{path}/part-{i:05d}.json", orient="records", lines=True)
+
+    def write_numpy(self, path: str, column: str) -> None:
+        """One ``.npy`` per block of ``column`` (reference:
+        ``Dataset.write_numpy``)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            arr = BlockAccessor(block).to_numpy()[column]
+            np.save(f"{path}/part-{i:05d}.npy", arr)
 
     # -- internals ------------------------------------------------------------
 

@@ -16,7 +16,53 @@ from __future__ import annotations
 from typing import Callable, Iterator, List, Optional
 
 import raytpu
+from raytpu.core.config import cfg
 from raytpu.runtime.object_ref import ObjectRef
+
+
+class ResourceBudget:
+    """Object-store byte budget for one streaming execution.
+
+    Reference analogue: ``_internal/execution/resource_manager.py`` — the
+    reference bounds each execution's object-store footprint, not just
+    its task count. Block sizes aren't known before a task runs, so the
+    consumer feeds observed sizes back (:meth:`record_block`) and the
+    admission check holds ``(in_flight + 1) * avg_block_bytes`` under the
+    budget. Until the first observation the concurrency cap alone
+    governs; at least one block is always admitted (no livelock).
+    """
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if not budget_bytes:
+            budget_bytes = int(cfg.data_memory_budget_bytes) or int(
+                0.25 * float(cfg.object_store_memory_bytes))
+        self.budget_bytes = int(budget_bytes)
+        self.avg_block_bytes: Optional[float] = None
+        self.peak_in_flight = 0
+        # Peak admissions AFTER the first size observation — the
+        # steady-state footprint (cold start is governed by the
+        # concurrency cap alone, so peak_in_flight can reach the window).
+        self.warm_peak_in_flight = 0
+        self.throttle_events = 0
+
+    def record_block(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        if self.avg_block_bytes is None:
+            self.avg_block_bytes = float(nbytes)
+        else:  # EMA: recent blocks dominate (sizes drift along a scan)
+            self.avg_block_bytes += 0.3 * (nbytes - self.avg_block_bytes)
+
+    def admit(self, in_flight: int) -> bool:
+        if in_flight == 0 or self.avg_block_bytes is None:
+            return True
+        ok = (in_flight + 1) * self.avg_block_bytes <= self.budget_bytes
+        if ok:
+            self.warm_peak_in_flight = max(self.warm_peak_in_flight,
+                                           in_flight + 1)
+        else:
+            self.throttle_events += 1
+        return ok
 
 
 class ActorPoolStrategy:
@@ -110,14 +156,17 @@ class _PoolStage:
 
 
 def run_pipeline(source: Iterator, ops: List[OpSpec], *,
-                 max_in_flight: int = 8) -> Iterator[ObjectRef]:
+                 max_in_flight: int = 8,
+                 budget: Optional[ResourceBudget] = None
+                 ) -> Iterator[ObjectRef]:
     """Stream block refs from `source` through `ops`.
 
     `source` yields ObjectRefs of blocks. Returns an iterator of output
     block refs in order. Each stage runs as remote tasks (fused where
-    adjacent) or on an actor pool, with a concurrency cap; stages are
-    chained per-block (pipeline, no barrier — block i can be in stage 2
-    while block j is in stage 0).
+    adjacent) or on an actor pool, with a concurrency cap AND (when the
+    consumer feeds a :class:`ResourceBudget`) an object-store byte
+    budget; stages are chained per-block (pipeline, no barrier — block i
+    can be in stage 2 while block j is in stage 0).
     """
     if not ops:
         yield from source
@@ -148,13 +197,17 @@ def run_pipeline(source: Iterator, ops: List[OpSpec], *,
         source_iter = iter(source)
         exhausted = False
         while pending or not exhausted:
-            while not exhausted and len(pending) < max_in_flight:
+            while not exhausted and len(pending) < max_in_flight and (
+                    budget is None or budget.admit(len(pending))):
                 try:
                     in_ref = next(source_iter)
                 except StopIteration:
                     exhausted = True
                     break
                 pending.append(chain(in_ref))
+                if budget is not None:
+                    budget.peak_in_flight = max(budget.peak_in_flight,
+                                                len(pending))
             if pending:
                 # Ordered streaming: wait on the head (completion order
                 # within the window doesn't matter for memory; order does

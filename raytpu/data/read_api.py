@@ -210,3 +210,90 @@ def read_text(paths) -> Dataset:
             yield read_one.remote(f)
 
     return Dataset(source, [], name="read_text")
+
+
+def read_numpy(paths) -> Dataset:
+    """One block per ``.npy`` file (reference: numpy datasource)."""
+    files = _expand_paths(paths, ".npy")
+
+    @raytpu.remote(name="data::read_numpy")
+    def read_one(path):
+        arr = np.load(path)
+        return {"data": arr}
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_numpy")
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """Whole files as ``bytes`` rows (reference: binary datasource —
+    the image/audio/file-blob workhorse)."""
+    files = _expand_paths(paths, "")
+
+    @raytpu.remote(name="data::read_binary")
+    def read_one(path):
+        with open(path, "rb") as f:
+            data = f.read()
+        row = {"bytes": data}
+        if include_paths:
+            row["path"] = path
+        return block_from_rows([row])
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_binary_files")
+
+
+def from_torch(torch_dataset, *, blocks: int = 8) -> Dataset:
+    """A map-style ``torch.utils.data.Dataset`` as a raytpu Dataset
+    (reference: ``ray.data.from_torch``). Items convert via numpy; rows
+    are ``{"item": value}`` unless the item is a dict."""
+
+    n = len(torch_dataset)
+    blocks = max(1, min(blocks, n or 1))
+
+    def _to_host(v):
+        try:
+            import torch
+
+            if isinstance(v, torch.Tensor):
+                v = v.detach().cpu().numpy()
+        except ImportError:  # pragma: no cover
+            pass
+        if isinstance(v, np.ndarray) and v.ndim == 0:
+            return v.item()
+        return v
+
+    def _to_row(x):
+        if isinstance(x, dict):
+            return {k: _to_host(v) for k, v in x.items()}
+        if isinstance(x, (tuple, list)):
+            if len(x) == 1:
+                return {"item": _to_host(x[0])}
+            return {f"item_{i}": _to_host(v) for i, v in enumerate(x)}
+        return {"item": _to_host(x)}
+
+    def source():
+        import builtins
+
+        per = -(-n // blocks)
+        for i in builtins.range(blocks):
+            lo, hi = i * per, min((i + 1) * per, n)
+            if lo >= n:
+                break
+            rows = [_to_row(torch_dataset[j]) for j in builtins.range(lo, hi)]
+            yield raytpu.put(block_from_rows(rows))
+
+    return Dataset(source, [], name="from_torch")
+
+
+def from_jax(arrays, *, blocks: int = 1) -> Dataset:
+    """Dict of jax arrays -> Dataset (host transfer happens once, at
+    block creation; the TPU-side consumer is ``iter_jax_batches``)."""
+    host = {k: np.asarray(v) for k, v in arrays.items()}
+    return from_numpy(host, blocks=blocks)

@@ -19,14 +19,14 @@ import threading
 import traceback
 
 
-def collect_cluster_stacks(nodes, worker=None, node_filter=None,
-                           timeout: float = 30.0):
-    """Fan ``worker_stacks`` out to every node concurrently (a wedged
-    node costs at most one ``timeout``, not one per node — wedged nodes
-    are exactly what this endpoint debugs).
+def fanout_node_call(nodes, method: str, *args,
+                     node_filter=None, timeout: float = 30.0):
+    """Issue one RPC to every node concurrently (a wedged node costs at
+    most one ``timeout``, not one per node — wedged nodes are exactly
+    what the debugging endpoints built on this exist for).
 
     ``nodes``: iterable of ``(node_id, address)``. Returns
-    ``{node_id: worker_stacks result or {"error": ...}}``.
+    ``{node_id: result or {"error": ...}}``.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -42,8 +42,7 @@ def collect_cluster_stacks(nodes, worker=None, node_filter=None,
         try:
             cli = RpcClient(addr)
             try:
-                return nid, cli.call("worker_stacks", worker,
-                                     timeout=timeout)
+                return nid, cli.call(method, *args, timeout=timeout)
             finally:
                 cli.close()
         except Exception as e:
@@ -51,8 +50,15 @@ def collect_cluster_stacks(nodes, worker=None, node_filter=None,
 
     with ThreadPoolExecutor(
             max_workers=min(16, len(targets)),
-            thread_name_prefix="raytpu-stacks") as ex:
+            thread_name_prefix="raytpu-fanout") as ex:
         return dict(ex.map(one, targets))
+
+
+def collect_cluster_stacks(nodes, worker=None, node_filter=None,
+                           timeout: float = 30.0):
+    """Concurrent cluster-wide ``worker_stacks`` (see fanout_node_call)."""
+    return fanout_node_call(nodes, "worker_stacks", worker,
+                            node_filter=node_filter, timeout=timeout)
 
 
 def dump_all_threads(header: str = "") -> str:
