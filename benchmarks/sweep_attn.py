@@ -1,0 +1,144 @@
+"""Flash-attention block-size sweep (VERDICT r3 next-round #3).
+
+Measures fwd+bwd wall time of :func:`raytpu.ops.flash_attention` at the
+GPT-2 bench shape across pallas tile shapes, one SUBPROCESS per combo
+(the kernel reads RAYTPU_FLASH_BLOCK_Q/K at import), plus the XLA
+reference implementation as the A/B baseline. Prints one JSON line per
+combo and a final summary line; run on the real chip:
+
+    python benchmarks/sweep_attn.py              # full sweep
+    RAYTPU_ATTN_SWEEP_SMOKE=1 ... (tiny, CPU ok)
+
+The same honesty discipline as bench.py: warmup excluded, the clock
+stops on a host fetch of a value depending on every step, steps double
+until a minimum wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+COMBOS = [(128, 128), (256, 128), (128, 256), (256, 256),
+          (512, 128), (128, 512), (512, 512)]
+
+
+def measure_one(impl: str) -> dict:
+    """Runs inside the per-combo subprocess."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    smoke = os.environ.get("RAYTPU_ATTN_SWEEP_SMOKE") == "1"
+    if smoke:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    import importlib
+
+    # raytpu.ops re-exports the flash_attention FUNCTION, which shadows
+    # the submodule on plain attribute imports.
+    fa = importlib.import_module("raytpu.ops.flash_attention")
+
+    if smoke:
+        b, h, t, d = 1, 2, 256, 64
+        min_wall = 0.3
+    else:
+        b, h, t, d = int(os.environ.get("RAYTPU_ATTN_B", 8)), 12, 1024, 64
+        min_wall = 1.0
+    force = impl if impl != "reference" else "reference"
+    if smoke and impl == "tpu":
+        force = "interpret"
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, h, t, d), jnp.bfloat16)
+
+    def loss(q):
+        out = fa.flash_attention(q, q, q, force=force)
+        return jnp.sum(out.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss))
+    g = step(q)
+    np.asarray(jax.device_get(g[0, 0, 0, 0]))  # warmup + compile
+    steps = 3
+    while True:
+        t0 = time.perf_counter()
+        acc = q
+        for _ in range(steps):
+            acc = step(acc).astype(jnp.bfloat16)
+        host = float(np.asarray(jax.device_get(acc[0, 0, 0, 0])))
+        dt = time.perf_counter() - t0
+        if dt >= min_wall:
+            break
+        steps *= 2
+    ms = dt / steps * 1e3
+    return {"impl": impl,
+            "block_q": fa.DEFAULT_BLOCK_Q, "block_k": fa.DEFAULT_BLOCK_K,
+            "fwd_bwd_ms": round(ms, 3), "steps": steps,
+            "shape": [b, h, t, d], "sink": host,
+            "device": str(jax.devices()[0])}
+
+
+def main() -> None:
+    if os.environ.get("_RAYTPU_ATTN_CHILD"):
+        print(json.dumps(measure_one(os.environ["_RAYTPU_ATTN_IMPL"])))
+        return
+
+    results = []
+
+    def child(env_extra, impl):
+        env = dict(os.environ, _RAYTPU_ATTN_CHILD="1",
+                   _RAYTPU_ATTN_IMPL=impl, **env_extra)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=600)
+        except subprocess.TimeoutExpired:
+            out = {"impl": impl, "env": env_extra,
+                   "error": "child timed out after 600s"}
+            results.append(out)
+            print(json.dumps(out), flush=True)
+            return
+        out = None
+        lines = r.stdout.strip().splitlines()
+        if r.returncode == 0 and lines:
+            try:
+                out = json.loads(lines[-1])
+            except json.JSONDecodeError:
+                out = None
+        if not out or "fwd_bwd_ms" not in out:
+            out = {"impl": impl, "env": env_extra,
+                   "error": ((r.stderr or r.stdout)[-400:]
+                             or f"rc={r.returncode}, no output")}
+        results.append(out)
+        print(json.dumps(out), flush=True)
+
+    child({}, "reference")  # XLA baseline at the same shape
+    for bq, bk in COMBOS:
+        child({"RAYTPU_FLASH_BLOCK_Q": str(bq),
+               "RAYTPU_FLASH_BLOCK_K": str(bk)}, "tpu")
+    ok = [r for r in results if "fwd_bwd_ms" in r and r["impl"] == "tpu"]
+    summary = {"metric": "flash_attention_block_sweep"}
+    if ok:
+        best = min(ok, key=lambda r: r["fwd_bwd_ms"])
+        summary.update(best=best,
+                       reference_ms=next(
+                           (r["fwd_bwd_ms"] for r in results
+                            if r["impl"] == "reference"
+                            and "fwd_bwd_ms" in r), None))
+    else:
+        summary["error"] = "no pallas combo succeeded"
+    summary["sweep"] = results
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

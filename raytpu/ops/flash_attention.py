@@ -29,14 +29,28 @@ an ill-defined case (a softmax over zero elements).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+def _env_block(name: str, default: int) -> int:
+    """Malformed/empty/non-positive overrides fall back silently — a bad
+    env var must not break every import of raytpu.ops."""
+    try:
+        v = int(os.environ.get(name) or default)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+# Tile shape of the pallas kernel's grid. Env-overridable so
+# benchmarks/sweep_attn.py can A/B block shapes per process without code
+# edits (the kernel requires seq_len % block == 0; _flash clamps to T).
+DEFAULT_BLOCK_Q = _env_block("RAYTPU_FLASH_BLOCK_Q", 128)
+DEFAULT_BLOCK_K = _env_block("RAYTPU_FLASH_BLOCK_K", 128)
 
 
 def _on_tpu() -> bool:
