@@ -49,6 +49,22 @@ class Client {
   ValuePtr ResolveNamedActor(const std::string& name,
                              const std::string& ns = "default");
 
+  // Cross-language task invocation (reference: the C++ worker API's
+  // Python-function calls via descriptors). Connect this client to a
+  // NODE daemon (address from ListNodes), name a "module:qualname"
+  // function with plain wire-encodable args; returns the return-object
+  // id hexes. FetchResult polls until the value exists and decodes the
+  // SerializedValue envelope (msgpack kind -> Value tree; ndarray kind
+  // -> map {dtype, shape, data}); a stored task error throws with the
+  // remote message.
+  std::vector<std::string> SubmitPyTask(const std::string& fn_ref,
+                                        std::vector<ValuePtr> args,
+                                        int num_returns = 1,
+                                        double num_cpus = 1.0);
+  ValuePtr FetchResult(const std::string& oid_hex,
+                       double timeout_s = 60.0);
+  void FreeObject(const std::string& oid_hex);
+
  private:
   std::string ReadFrame();
   void WriteFrame(const std::string& body);

@@ -5,6 +5,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstring>
 #include <stdexcept>
 
@@ -142,6 +143,97 @@ ValuePtr Client::ListNodes() { return Call("list_nodes"); }
 ValuePtr Client::ResolveNamedActor(const std::string& name,
                                    const std::string& ns) {
   return Call("resolve_named_actor", {Value::Str(name), Value::Str(ns)});
+}
+
+std::vector<std::string> Client::SubmitPyTask(const std::string& fn_ref,
+                                              std::vector<ValuePtr> args,
+                                              int num_returns,
+                                              double num_cpus) {
+  auto r = Call("submit_fn_task",
+                {Value::Str(fn_ref), Value::Array(std::move(args)),
+                 Value::Int(num_returns), Value::Float(num_cpus)});
+  std::vector<std::string> out;
+  for (const auto& v : r->arr) out.push_back(v->s);
+  return out;
+}
+
+namespace {
+
+// SerializedValue envelope (runtime/serialization.py to_bytes):
+// [4-byte LE header len][msgpack header {"t","d",...}][raw buffers].
+ValuePtr DecodeSerializedValue(const std::string& blob) {
+  if (blob.size() < 4) throw std::runtime_error("result: short envelope");
+  uint32_t hlen = static_cast<uint8_t>(blob[0]) |
+                  (static_cast<uint8_t>(blob[1]) << 8) |
+                  (static_cast<uint8_t>(blob[2]) << 16) |
+                  (static_cast<uint8_t>(blob[3]) << 24);
+  if (blob.size() < 4 + hlen) {
+    throw std::runtime_error("result: truncated header");
+  }
+  size_t pos = 0;
+  std::string header = blob.substr(4, hlen);
+  auto meta = Unpack(header, &pos);
+  auto kind = meta->Get("t");
+  if (kind == nullptr) throw std::runtime_error("result: no kind tag");
+  auto d = meta->Get("d");
+  switch (kind->i) {
+    case 0: {  // msgpack-representable: the value rides in the header
+      if (d == nullptr) throw std::runtime_error("result: no payload");
+      return d;
+    }
+    case 2: {  // ndarray: dtype/shape metadata + one raw buffer
+      if (d == nullptr || d->Get("dtype") == nullptr ||
+          d->Get("shape") == nullptr) {
+        throw std::runtime_error("result: malformed ndarray metadata");
+      }
+      return Value::MapV({
+          {Value::Str("dtype"), d->Get("dtype")},
+          {Value::Str("shape"), d->Get("shape")},
+          {Value::Str("data"), Value::Bin(blob.substr(4 + hlen))},
+      });
+    }
+    case 3: {
+      // serialize() puts a plain-text copy of the exception in "s" for
+      // non-Python peers; the pickled payload stays Python-only.
+      auto text = meta->Get("s");
+      throw std::runtime_error(
+          "remote task failed: " +
+          (text != nullptr && text->type == Value::kStr
+               ? text->s
+               : std::string("(no plain-text message in envelope)")));
+    }
+    default:
+      throw std::runtime_error(
+          "result is not cross-language representable (pickled Python "
+          "object; return msgpack-able data or numpy arrays)");
+  }
+}
+
+}  // namespace
+
+ValuePtr Client::FetchResult(const std::string& oid_hex,
+                             double timeout_s) {
+  const auto deadline = std::chrono::steady_clock::now() +
+      std::chrono::milliseconds(static_cast<int64_t>(timeout_s * 1000));
+  // Readiness polls via has_object (local store + cluster directory —
+  // cheap); a fetch_object miss would instead kick the node's cross-node
+  // pull machinery for a result that is about to be produced locally.
+  while (true) {
+    auto ready = Call("has_object", {Value::Str(oid_hex)});
+    if (ready->type == Value::kBool && ready->b) {
+      auto r = Call("fetch_object", {Value::Str(oid_hex)});
+      if (r->type != Value::kNil) return DecodeSerializedValue(r->s);
+    }
+    if (std::chrono::steady_clock::now() >= deadline) {
+      throw std::runtime_error("FetchResult: object " + oid_hex +
+                               " not ready within timeout");
+    }
+    ::usleep(50 * 1000);
+  }
+}
+
+void Client::FreeObject(const std::string& oid_hex) {
+  Call("free_object", {Value::Str(oid_hex)});
 }
 
 }  // namespace raytpu

@@ -104,6 +104,55 @@ int main(int argc, char** argv) {
   assert(missing->type == raytpu::Value::kNil);
   std::printf("PASS named_actor_missing\n");
 
+  // Cross-language task invocation: find a worker node, submit Python
+  // functions by reference, fetch decoded results.
+  std::string node_host;
+  int node_port = 0;
+  for (const auto& n : nodes->arr) {
+    auto labels = n->Get("labels");
+    if (labels != nullptr) {
+      auto role = labels->Get("role");
+      if (role != nullptr && role->s == "driver") continue;
+    }
+    auto addr = n->Get("address");
+    if (addr == nullptr) continue;
+    auto colon = addr->s.rfind(':');
+    node_host = addr->s.substr(0, colon);
+    node_port = std::atoi(addr->s.substr(colon + 1).c_str());
+    break;
+  }
+  assert(node_port != 0);
+  raytpu::Client node(node_host, node_port);
+  auto oids = node.SubmitPyTask(
+      "math:hypot", {raytpu::Value::Float(3.0), raytpu::Value::Float(4.0)});
+  assert(oids.size() == 1);
+  auto result = node.FetchResult(oids[0], 60.0);
+  assert(result->type == raytpu::Value::kFloat && result->f == 5.0);
+  node.FreeObject(oids[0]);
+
+  auto oids2 = node.SubmitPyTask(
+      "builtins:sorted",
+      {raytpu::Value::Array({raytpu::Value::Int(3), raytpu::Value::Int(1),
+                             raytpu::Value::Int(2)})});
+  auto sorted_r = node.FetchResult(oids2[0], 60.0);
+  assert(sorted_r->type == raytpu::Value::kArray);
+  assert(sorted_r->arr.size() == 3 && sorted_r->arr[0]->i == 1 &&
+         sorted_r->arr[2]->i == 3);
+  node.FreeObject(oids2[0]);
+
+  bool threw = false;
+  try {
+    auto bad = node.SubmitPyTask("math:sqrt", {raytpu::Value::Float(-1.0)});
+    node.FetchResult(bad[0], 60.0);
+  } catch (const std::exception& e) {
+    threw = true;
+    // the envelope carries a plain-text copy of the remote exception
+    assert(std::string(e.what()).find("math domain error") !=
+           std::string::npos);
+  }
+  assert(threw);
+  std::printf("PASS cross_lang_tasks\n");
+
   std::printf("ALL CPP CLIENT TESTS PASSED\n");
   return 0;
 }

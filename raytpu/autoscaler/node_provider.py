@@ -138,7 +138,11 @@ def _gcloud(args: List[str]) -> str:
 
 
 class GceTpuSliceProvider(NodeProvider):
-    """Real cloud provider: GCE TPU-VM slices via the gcloud CLI
+    """**Experimental** — exercised only against a fake gcloud runner in
+    CI (this environment has no cloud access); treat the first real
+    `gcloud` run as validation, not the tests.
+
+    Real cloud provider: GCE TPU-VM slices via the gcloud CLI
     (reference analogue: ``python/ray/autoscaler/_private/gcp/node_provider``
     + the v2 instance manager's cloud adapters, reshaped around the slice
     as the provisioning unit — a TPU pod slice is one atomic group of

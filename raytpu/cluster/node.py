@@ -492,6 +492,7 @@ class NodeServer:
         self._rpc = RpcServer(host, 0)
         h = self._rpc.register
         h("submit_task", self._h_submit_task)
+        h("submit_fn_task", self._h_submit_fn_task)
         h("create_actor", self._h_create_actor)
         h("submit_actor_task", self._h_submit_actor_task)
         h("kill_actor", self._h_kill_actor)
@@ -1004,6 +1005,33 @@ class NodeServer:
         spec: TaskSpec = wire.loads(spec_blob)
         self._ensure_args_local(spec)
         self.backend.submit_task(spec)
+
+    def _h_submit_fn_task(self, peer: Peer, fn_ref: str, args: list,
+                          num_returns: int = 1,
+                          num_cpus: float = 1.0) -> List[str]:
+        """Cross-language submission (reference: the C++/Java worker APIs
+        submitting Python tasks via function descriptors): the caller
+        names a ``module:qualname`` function and passes plain
+        wire-encodable args; this daemon builds the TaskSpec (ids derive
+        here — non-Python clients don't reimplement blake2b), submits it
+        through the normal path, and returns the return-object id hexes
+        for has_object/fetch_object polling."""
+        from raytpu.core.ids import TaskID
+        from raytpu.runtime.serialization import serialize
+        from raytpu.runtime.task_spec import ArgKind, TaskArg
+
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=self.backend.worker.job_id,
+            name=f"xlang::{fn_ref}",
+            function_ref=str(fn_ref),
+            args=[TaskArg(ArgKind.INLINE, serialize(a).to_bytes())
+                  for a in args],
+            num_returns=max(1, int(num_returns)),
+            resources={"CPU": float(num_cpus)} if num_cpus else {},
+        )
+        self.backend.submit_task(spec)
+        return [oid.hex() for oid in spec.return_ids()]
 
     def _h_create_actor(self, peer: Peer, spec_blob: bytes) -> None:
         spec: TaskSpec = wire.loads(spec_blob)

@@ -127,14 +127,20 @@ def serialize(value: Any) -> SerializedValue:
         _capture_tls.refs = prev
     kind = _KIND_EXCEPTION if isinstance(value, BaseException) else _KIND_PICKLE
     raw = [pb.raw() for pb in buffers]
-    header = msgpack.packb(
-        {
-            "t": kind,
-            "d": payload,
-            "bl": [b.nbytes for b in raw],
-            "r": captured,
-        }
-    )
+    meta = {
+        "t": kind,
+        "d": payload,
+        "bl": [b.nbytes for b in raw],
+        "r": captured,
+    }
+    if kind == _KIND_EXCEPTION:
+        # Plain-text copy so non-Python clients (cpp/) can surface the
+        # remote failure without unpickling.
+        try:
+            meta["s"] = f"{type(value).__name__}: {value}"[:2000]
+        except Exception:
+            pass
+    header = msgpack.packb(meta)
     return SerializedValue(header, [m if m.contiguous else memoryview(bytes(m)) for m in raw])
 
 

@@ -97,6 +97,11 @@ class TaskSpec:
     attempt: int = 0
     # Concurrency group this actor method executes in ("" = default).
     concurrency_group: str = ""
+    # Cross-language invocation (reference: the C++/Java worker APIs call
+    # Python functions by reference, function_manager.cc cross-language
+    # descriptors): "module:qual.name" resolved by import on the worker
+    # when function_blob is empty. Appended field — wire-schema safe.
+    function_ref: str = ""
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i)

@@ -330,6 +330,29 @@ class Worker:
             self._function_cache[blob] = fn
         return fn
 
+    def load_spec_function(self, spec: TaskSpec) -> Callable:
+        """Pickled payload, or a cross-language ``module:qual.name``
+        reference resolved by import (reference: cross-language function
+        descriptors — C++/Java callers can't cloudpickle Python)."""
+        if spec.function_blob:
+            return self.load_function(spec.function_blob)
+        if spec.function_ref:
+            fn = self._function_cache.get(spec.function_ref)
+            if fn is None:
+                import importlib
+
+                module, _, qual = spec.function_ref.partition(":")
+                if not module or not qual:
+                    raise ValueError(
+                        f"function_ref must be 'module:qualname', got "
+                        f"{spec.function_ref!r}")
+                obj = importlib.import_module(module)
+                for part in qual.split("."):
+                    obj = getattr(obj, part)
+                fn = self._function_cache[spec.function_ref] = obj
+            return fn
+        raise ValueError(f"task {spec.name!r} carries no function")
+
     def resolve_args(self, spec: TaskSpec,
                      get_fn: Callable[[ObjectID], SerializedValue]):
         """Deserialize inline args; fetch + deserialize top-level refs.
@@ -427,7 +450,7 @@ class Worker:
                     method = getattr(actor_instance, spec.method_name)
                     result = method(*args, **kwargs)
             else:
-                fn = self.load_function(spec.function_blob)
+                fn = self.load_spec_function(spec)
                 result = fn(*args, **kwargs)
             if spec.streaming:
                 # Iterate inside the runtime-env/context scope: generator
@@ -478,7 +501,7 @@ class Worker:
         user error — caller stores the error)."""
         from raytpu.runtime_env import RuntimeEnvContext
 
-        cls = self.load_function(spec.function_blob)
+        cls = self.load_spec_function(spec)
         args, kwargs = self.resolve_args(spec, get_fn)
         renv = RuntimeEnvContext(spec.runtime_env)
         old_ctx = ctx_mod.current()

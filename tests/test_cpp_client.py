@@ -56,7 +56,8 @@ class TestCppClient:
             assert "ALL CPP CLIENT TESTS PASSED" in out.stdout
             for probe in ["PASS ping", "PASS kv", "PASS kv_big",
                           "PASS list_nodes",
-                          "PASS named_actor ", "PASS named_actor_missing"]:
+                          "PASS named_actor ", "PASS named_actor_missing",
+                          "PASS cross_lang_tasks"]:
                 assert probe in out.stdout, out.stdout
         finally:
             raytpu.shutdown()
