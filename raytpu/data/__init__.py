@@ -15,10 +15,13 @@ from raytpu.data.read_api import (
     range_tensor,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_webdataset,
 )
 
 __all__ = [
@@ -40,10 +43,13 @@ __all__ = [
     "from_torch",
     "read_binary_files",
     "read_csv",
+    "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
+    "read_webdataset",
 ]
 
 from raytpu.util import usage_stats as _usage_stats

@@ -297,3 +297,99 @@ def from_jax(arrays, *, blocks: int = 1) -> Dataset:
     block creation; the TPU-side consumer is ``iter_jax_batches``)."""
     host = {k: np.asarray(v) for k, v in arrays.items()}
     return from_numpy(host, blocks=blocks)
+
+
+def read_sql(sql: str, connection_factory, *, blocks: int = 1) -> Dataset:
+    """Rows of a SQL query as a Dataset (reference: SQL datasource).
+
+    ``connection_factory`` is a zero-arg callable returning a DBAPI
+    connection (e.g. ``lambda: sqlite3.connect(path)``) — it runs inside
+    the read task, so the connection itself never serializes.
+    """
+
+    @raytpu.remote(name="data::read_sql")
+    def read_all():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()  # DB-API 2.0 (conn.execute is sqlite-only)
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+        finally:
+            conn.close()
+        return block_from_rows(rows) if rows else {}
+
+    def source():
+        yield read_all.remote()
+
+    ds = Dataset(source, [], name="read_sql")
+    return ds.repartition(blocks) if blocks > 1 else ds
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                include_paths: bool = False) -> Dataset:
+    """Image files as float32 arrays via PIL (reference: image
+    datasource). ``size=(w, h)`` resizes; one block per file."""
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+    files = [f for f in _expand_paths(paths, "")
+             if f.lower().endswith(exts)]
+    if not files:
+        raise FileNotFoundError(f"no image files under {paths}")
+
+    @raytpu.remote(name="data::read_images")
+    def read_one(path):
+        from PIL import Image
+
+        img = Image.open(path).convert(mode)
+        if size is not None:
+            img = img.resize(tuple(size))
+        arr = np.asarray(img, dtype=np.float32)[None]  # [1, H, W, C]
+        block = {"image": arr}
+        if include_paths:
+            block["path"] = np.asarray([path])
+        return block
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_images")
+
+
+def read_webdataset(paths) -> Dataset:
+    """WebDataset-style tar shards: files grouped by key (basename
+    before the first dot), one row per key with a column per extension
+    (reference: webdataset datasource). Text-like members decode to
+    str; everything else stays bytes."""
+    files = _expand_paths(paths, ".tar")
+
+    @raytpu.remote(name="data::read_webdataset")
+    def read_shard(path):
+        import tarfile
+
+        samples: Dict[str, dict] = {}
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                key, _, ext = base.partition(".")
+                data = tf.extractfile(member).read()
+                if ext in ("txt", "json", "cls", "csv"):
+                    try:
+                        data = data.decode("utf-8")
+                    except UnicodeDecodeError:
+                        pass
+                samples.setdefault(key, {"__key__": key})[ext] = data
+        # Samples may carry different extension sets; block columns are
+        # the union, absent members become None.
+        all_keys = sorted({k for s in samples.values() for k in s})
+        rows = [{k: samples[key].get(k) for k in all_keys}
+                for key in sorted(samples)]
+        return block_from_rows(rows) if rows else {}
+
+    def source():
+        for f in files:
+            yield read_shard.remote(f)
+
+    return Dataset(source, [], name="read_webdataset")

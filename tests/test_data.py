@@ -594,3 +594,82 @@ class TestNewDatasources:
         assert ds.count() == 32
         batches = list(ds.iter_jax_batches(batch_size=16))
         assert len(batches) == 2
+
+
+class TestMoreDatasources:
+    def test_read_sql(self, raytpu_local, tmp_path):
+        import sqlite3
+
+        import raytpu.data as rd
+
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+        conn.executemany("INSERT INTO items VALUES (?, ?)",
+                         [(i, f"n{i}") for i in range(20)])
+        conn.commit()
+        conn.close()
+        ds = rd.read_sql("SELECT id, name FROM items WHERE id < 10",
+                         lambda: sqlite3.connect(db))
+        rows = sorted(ds.take_all(), key=lambda r: r["id"])
+        assert len(rows) == 10 and rows[3] == {"id": 3, "name": "n3"}
+
+    def test_read_images(self, raytpu_local, tmp_path):
+        from PIL import Image
+
+        import raytpu.data as rd
+
+        for i, color in enumerate([(255, 0, 0), (0, 255, 0)]):
+            Image.new("RGB", (8, 6), color).save(tmp_path / f"im{i}.png")
+        ds = rd.read_images(str(tmp_path / "*.png"), size=(4, 4))
+        blocks = list(ds.iter_blocks())
+        assert len(blocks) == 2
+        img = BlockAccessor(blocks[0]).to_numpy()["image"]
+        assert img.shape == (1, 4, 4, 3) and img.dtype == np.float32
+        assert float(img[0, 0, 0, 0]) == 255.0  # red channel of im0
+
+    def test_read_webdataset(self, raytpu_local, tmp_path):
+        import io
+        import tarfile
+
+        import raytpu.data as rd
+
+        shard = tmp_path / "shard-000.tar"
+        with tarfile.open(shard, "w") as tf:
+            for key, payload in [("s0.txt", b"hello"), ("s0.bin", b"\x01"),
+                                 ("s1.txt", b"world"), ("s1.bin", b"\x02")]:
+                info = tarfile.TarInfo(key)
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+        rows = sorted(rd.read_webdataset(str(shard)).take_all(),
+                      key=lambda r: r["__key__"])
+        assert [r["__key__"] for r in rows] == ["s0", "s1"]
+        assert rows[0]["txt"] == "hello" and rows[1]["bin"] == b"\x02"
+
+    def test_read_webdataset_heterogeneous_keys(self, raytpu_local,
+                                                tmp_path):
+        import io
+        import tarfile
+
+        import raytpu.data as rd
+
+        shard = tmp_path / "het.tar"
+        with tarfile.open(shard, "w") as tf:
+            for key, payload in [("s0.txt", b"only-text"),
+                                 ("s1.txt", b"text"), ("s1.cls", b"7")]:
+                info = tarfile.TarInfo(key)
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+        rows = sorted(rd.read_webdataset(str(shard)).take_all(),
+                      key=lambda r: r["__key__"])
+        assert rows[0]["cls"] is None and rows[1]["cls"] == "7"
+
+    def test_read_images_skips_non_images(self, raytpu_local, tmp_path):
+        from PIL import Image
+
+        import raytpu.data as rd
+
+        Image.new("RGB", (4, 4), (1, 2, 3)).save(tmp_path / "a.png")
+        (tmp_path / "README.md").write_text("not an image")
+        ds = rd.read_images(str(tmp_path))
+        assert len(list(ds.iter_blocks())) == 1
