@@ -98,13 +98,21 @@ def block_from_rows(rows: List[Any]) -> Block:
 def concat_blocks(blocks: List[Block]) -> Block:
     import pyarrow as pa
 
+    # Empty blocks (an empty file/shard read) carry no schema — mixing
+    # one in must not drop the real rows or fail the arrow concat.
+    blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
     if not blocks:
         return pa.table({})
     if isinstance(blocks[0], dict):
         keys = blocks[0].keys()
         return {k: np.concatenate([np.asarray(b[k]) for b in blocks])
                 for k in keys}
-    return pa.concat_tables([BlockAccessor(b).to_arrow() for b in blocks])
+    # promote: blocks from different files may have different column
+    # sets (e.g. webdataset shards with differing extensions) — absent
+    # columns fill with nulls instead of ArrowInvalid.
+    return pa.concat_tables(
+        [BlockAccessor(b).to_arrow() for b in blocks],
+        promote_options="default")
 
 
 def batch_format_view(block: Block, batch_format: str):

@@ -317,7 +317,7 @@ def read_sql(sql: str, connection_factory, *, blocks: int = 1) -> Dataset:
             rows = [dict(zip(cols, r)) for r in cur.fetchall()]
         finally:
             conn.close()
-        return block_from_rows(rows) if rows else {}
+        return block_from_rows(rows)
 
     def source():
         yield read_all.remote()
@@ -329,7 +329,10 @@ def read_sql(sql: str, connection_factory, *, blocks: int = 1) -> Dataset:
 def read_images(paths, *, size=None, mode: str = "RGB",
                 include_paths: bool = False) -> Dataset:
     """Image files as float32 arrays via PIL (reference: image
-    datasource). ``size=(w, h)`` resizes; one block per file."""
+    datasource). ``size=(w, h)`` resizes; one block per file. Without
+    ``size`` the corpus must share dimensions for any batching path
+    (``iter_batches``/``take_batch`` concatenate [1,H,W,C] arrays);
+    mixed-size corpora should pass ``size=``."""
     exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
     files = [f for f in _expand_paths(paths, "")
              if f.lower().endswith(exts)]
@@ -386,7 +389,7 @@ def read_webdataset(paths) -> Dataset:
         all_keys = sorted({k for s in samples.values() for k in s})
         rows = [{k: samples[key].get(k) for k in all_keys}
                 for key in sorted(samples)]
-        return block_from_rows(rows) if rows else {}
+        return block_from_rows(rows)
 
     def source():
         for f in files:

@@ -673,3 +673,43 @@ class TestMoreDatasources:
         (tmp_path / "README.md").write_text("not an image")
         ds = rd.read_images(str(tmp_path))
         assert len(list(ds.iter_blocks())) == 1
+
+    def test_empty_shard_does_not_poison_batches(self, raytpu_local,
+                                                 tmp_path):
+        import io
+        import tarfile
+
+        import raytpu.data as rd
+
+        empty = tmp_path / "empty.tar"
+        with tarfile.open(empty, "w"):
+            pass
+        data = tmp_path / "data.tar"
+        with tarfile.open(data, "w") as tf:
+            for key, payload in [("a.txt", b"x"), ("b.txt", b"y")]:
+                info = tarfile.TarInfo(key)
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+        ds = rd.read_webdataset([str(empty), str(data)])
+        batches = list(ds.iter_batches(batch_size=10,
+                                       batch_format="pyarrow"))
+        assert sum(b.num_rows for b in batches) == 2
+
+    def test_cross_shard_schema_promotion(self, raytpu_local, tmp_path):
+        import io
+        import tarfile
+
+        import raytpu.data as rd
+
+        for i, members in enumerate([[("s0.txt", b"t0")],
+                                     [("s1.txt", b"t1"),
+                                      ("s1.cls", b"9")]]):
+            with tarfile.open(tmp_path / f"p{i}.tar", "w") as tf:
+                for key, payload in members:
+                    info = tarfile.TarInfo(key)
+                    info.size = len(payload)
+                    tf.addfile(info, io.BytesIO(payload))
+        ds = rd.read_webdataset(str(tmp_path / "*.tar"))
+        batch = next(ds.iter_batches(batch_size=10,
+                                     batch_format="pyarrow"))
+        assert batch.num_rows == 2 and "cls" in batch.column_names

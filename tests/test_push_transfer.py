@@ -82,6 +82,42 @@ class TestPushReceiver:
         assert node_client.call("push_object_begin", oid.hex(), len(blob))
 
 
+class TestPushChaos:
+    def test_producer_node_death_mid_stream_falls_back(self):
+        """Producer node dies after its output was replicated to one
+        other node; the consumer (who may have had a push in flight from
+        the dead node) still resolves the object from the survivor —
+        partial pushes never surface, pull fallback covers the gap."""
+        cluster = Cluster()
+        cluster.add_node(num_cpus=2, num_tpus=0, resources={"A": 4.0})
+        cluster.add_node(num_cpus=2, num_tpus=0, resources={"B": 4.0})
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote(resources={"A": 1.0}, max_retries=2)
+            def produce():
+                return np.arange(800_000, dtype=np.float64)  # ~6.4MB
+
+            ref = produce.remote()
+            # Driver get replicates the value into the driver node's
+            # store (a survivor copy).
+            expected = float(raytpu.get(ref, timeout=60).sum())
+
+            # Kill the producer node, then demand the object on B: the
+            # push source is gone; the pull path must find the survivor
+            # (or lineage must re-execute on retries).
+            a_handle = next(h for h in cluster.nodes if h.alive)
+            cluster.kill_node(a_handle)
+
+            @raytpu.remote(resources={"B": 1.0})
+            def consume(arr):
+                return float(arr.sum())
+
+            assert raytpu.get(consume.remote(ref), timeout=120) == expected
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+
 class TestPushEndToEnd:
     def test_output_pushed_to_demanding_node(self):
         """Consumer node registers demand while the producer still runs;
