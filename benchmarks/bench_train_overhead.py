@@ -1,0 +1,147 @@
+"""Train-orchestration overhead — the parity metric behind the
+reference's headline Train claim.
+
+Reference bar: ``doc/source/train/benchmarks.rst:55-84`` — Ray Train is
+within ~2.5% of NATIVE torch DDP on the same workload (the framework's
+orchestration adds almost nothing on top of the training computation).
+The honest analogue here: the SAME jitted MLP train loop (fashion-MNIST
+shape: 784 -> 128 -> 10, batch 128) run (a) bare — plain jax loop in
+this process — and (b) under ``JaxTrainer`` with one gang worker, so the
+delta is exactly our fabric's overhead (gang setup amortized out by
+measuring steady-state epoch time inside the loop, reported via
+``train.report``).
+
+Prints one JSON line:
+  {"metric": "train_orchestration_overhead_pct", "value": ...,
+   "vs_baseline": <value / 2.5>}   (vs_baseline <= 1.0 meets the bar)
+
+Env: RAYTPU_TRAIN_BENCH_STEPS (default 5000), _WORKERS (default 1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_OVERHEAD_PCT = 2.5  # benchmarks.rst parity bar
+
+STEPS = int(os.environ.get("RAYTPU_TRAIN_BENCH_STEPS", 5000))
+WORKERS = int(os.environ.get("RAYTPU_TRAIN_BENCH_WORKERS", 1))
+BATCH, IN_DIM, HIDDEN, OUT_DIM = 128, 784, 128, 10
+
+
+def _make_step():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w1": jax.random.normal(k1, (IN_DIM, HIDDEN)) * 0.02,
+            "b1": jnp.zeros((HIDDEN,)),
+            "w2": jax.random.normal(k2, (HIDDEN, OUT_DIM)) * 0.02,
+            "b2": jnp.zeros((OUT_DIM,)),
+        }
+
+    opt = optax.sgd(1e-2)
+
+    def loss_fn(params, x, y):
+        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        logits = h @ params["w2"] + params["b2"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init, opt, step
+
+
+def _timed_loop(report=None) -> float:
+    """Steady-state seconds for STEPS steps of the fixed workload."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    init, opt, step = _make_step()
+    key = jax.random.PRNGKey(0)
+    params = init(key)
+    opt_state = opt.init(params)
+    x = jax.random.normal(key, (BATCH, IN_DIM))
+    y = jax.random.randint(key, (BATCH,), 0, OUT_DIM)
+    params, opt_state, loss = step(params, opt_state, x, y)  # compile
+    float(np.asarray(loss))
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    float(np.asarray(loss))  # host fetch closes the timed region
+    return time.perf_counter() - t0
+
+
+def _trainer_loop(config):
+    from raytpu.train import report
+
+    # Best-of-two, matching the bare measurement: run-to-run noise on a
+    # shared 1-vCPU box exceeds the effect being measured otherwise.
+    best = min(_timed_loop(), _timed_loop())
+    report({"train_seconds": best})
+
+
+def main() -> None:
+    # Host-plane orchestration measurement: force CPU OUTRIGHT (not
+    # setdefault — the deployment env pins JAX_PLATFORMS=axon, and gang
+    # worker subprocesses inherit it; they'd block on TPU init).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    bare_s = min(_timed_loop(), _timed_loop())  # best of two: less noise
+
+    import raytpu
+    from raytpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    raytpu.init(num_cpus=max(2, WORKERS + 1), ignore_reinit_error=True)
+    result = JaxTrainer(
+        _trainer_loop,
+        scaling_config=ScalingConfig(num_workers=WORKERS),
+        run_config=RunConfig(storage_path="/tmp/raytpu_train_bench"),
+    ).fit()
+    raytpu.shutdown()
+    if result.error is not None:
+        print(json.dumps({"metric": "train_orchestration_overhead_pct",
+                          "value": None,
+                          "error": str(result.error)}))
+        sys.exit(1)
+    fab_s = float(result.metrics["train_seconds"])
+    overhead_pct = (fab_s - bare_s) / bare_s * 100.0
+    print(json.dumps({
+        "metric": "train_orchestration_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "% vs bare jax loop (same jitted steps)",
+        "vs_baseline": round(overhead_pct / REFERENCE_OVERHEAD_PCT, 3),
+        "detail": {"bare_s": round(bare_s, 3),
+                   "fabric_s": round(fab_s, 3),
+                   "steps": STEPS, "workers": WORKERS,
+                   "reference_bar_pct": REFERENCE_OVERHEAD_PCT,
+                   "note": "steady-state step time measured INSIDE the "
+                           "worker loop; gang spawn/rendezvous excluded "
+                           "(the reference bar also excludes setup, "
+                           "benchmarks.rst:58-60)"},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,9 @@ class ResNetConfig:
     num_filters: int = 64
     n_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    # Bottleneck (1x1 -> 3x3 -> 1x1 with 4x expansion) — the block the
+    # 50/101/152 family is defined by; basic blocks otherwise.
+    bottleneck: bool = False
 
     @classmethod
     def resnet18(cls, n_classes: int = 1000):
@@ -24,7 +27,11 @@ class ResNetConfig:
 
     @classmethod
     def resnet50(cls, n_classes: int = 1000):
-        return cls((3, 4, 6, 3), 64, n_classes)
+        return cls((3, 4, 6, 3), 64, n_classes, bottleneck=True)
+
+    @classmethod
+    def resnet101(cls, n_classes: int = 1000):
+        return cls((3, 4, 23, 3), 64, n_classes, bottleneck=True)
 
     @classmethod
     def tiny(cls, n_classes: int = 10):
@@ -54,12 +61,48 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+class BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand (x4) — the ResNet-50/101/152
+    block (He et al. 2016, the variant the reference's ResNet-50 train
+    benchmark uses)."""
+
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        # Zero-init the last BN scale so each block starts as identity
+        # (the standard ResNet-50 training trick).
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1),
+                               (self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
 class ResNet(nn.Module):
     config: ResNetConfig
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         c = self.config
+        block = BottleneckBlock if c.bottleneck else BasicBlock
         x = x.astype(c.dtype)
         x = nn.Conv(c.num_filters, (7, 7), (2, 2), use_bias=False,
                     dtype=c.dtype, name="conv_init")(x)
@@ -69,7 +112,7 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(c.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = BasicBlock(c.num_filters * 2 ** i, strides, c.dtype)(
+                x = block(c.num_filters * 2 ** i, strides, c.dtype)(
                     x, train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(c.n_classes, dtype=jnp.float32, name="head")(x)

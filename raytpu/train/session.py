@@ -43,6 +43,11 @@ class _Session:
         self.reports: List[tuple] = []
         self.latest_checkpoint = None  # resume-from slot (read at startup)
         self.lock = threading.Lock()
+        # Long-poll support: signaled on every report so the trainer's
+        # poll blocks instead of spinning (a 50ms poll loop measurably
+        # taxed the train loop itself on small hosts).
+        self.news = threading.Condition(self.lock)
+        self.closed = False  # loop finished/failed: pollers must not block
         self.dataset_shards = dataset_shards or {}
 
     def report(self, metrics: Dict[str, Any], checkpoint=None):
@@ -68,12 +73,28 @@ class _Session:
             checkpoint = type(checkpoint)(staged)
         with self.lock:
             self.reports.append((dict(metrics), checkpoint))
+            self.news.notify_all()
+
+    def wake(self) -> None:
+        """The loop finished or failed: mark closed and release pollers.
+        The flag is read inside the condition's predicate, so a finish
+        landing between a poller's done-check and its wait cannot strand
+        the poll for the full timeout (lost-wakeup race)."""
+        with self.lock:
+            self.closed = True
+            self.news.notify_all()
 
     def drain(self) -> List[tuple]:
         with self.lock:
             out = self.reports
             self.reports = []
             return out
+
+    def wait_for_news(self, timeout: float) -> None:
+        """Block until a report lands or the loop closes (or timeout)."""
+        with self.lock:
+            self.news.wait_for(
+                lambda: bool(self.reports) or self.closed, timeout)
 
 
 _tls = threading.local()

@@ -147,17 +147,27 @@ class TrainWorker:
             finally:
                 self.done = True
                 session_mod._set_session(None)
+                self.session.wake()  # unblock any in-flight long-poll
 
         self.thread = threading.Thread(target=run, daemon=True)
         self.thread.start()
         return True
 
-    def poll(self):
+    def poll(self, max_wait: float = 0.0):
         """Returns ([(metrics, ckpt_path_or_None), ...], done, error_repr).
+
+        ``max_wait > 0`` long-polls: blocks until a report lands, the
+        loop finishes, or the timeout passes — the trainer drives this
+        at ~0.5s instead of a tight 50ms spin (which measurably stole
+        cycles from the train loop on small hosts and multiplied RPCs
+        on clusters).
 
         `done` is read BEFORE draining: if the loop finishes between the
         drain and the flag read, the final reports are still picked up on
         the trainer's next (guaranteed, because done was False) poll."""
+        if max_wait > 0 and self.session and not self.done \
+                and self.error is None:
+            self.session.wait_for_news(max_wait)
         done = self.done
         pairs = self.session.drain() if self.session else []
         out = [(m, (c.path if c is not None else None)) for m, c in pairs]
@@ -308,7 +318,12 @@ class JaxTrainer(BaseTrainer):
 
             error = None
             while True:
-                polls = raytpu.get([w.poll.remote() for w in workers])
+                # Long-poll rank 0 (it drives metrics/checkpoints); other
+                # ranks answer instantly. No driver-side spin: the worker
+                # wakes us on report/finish (see TrainWorker.poll).
+                polls = raytpu.get(
+                    [w.poll.remote(0.5 if i == 0 else 0.0)
+                     for i, w in enumerate(workers)])
                 for metrics, ckpt_path in polls[0][0]:  # rank 0 drives
                     history.append(metrics)
                     if ckpt_path:
@@ -320,6 +335,9 @@ class JaxTrainer(BaseTrainer):
                     break
                 if all(p[1] for p in polls):
                     break
+                # Pace every round: a loop reporting hundreds of times a
+                # second must not drive a poll round per report — drains
+                # batch. Idle gangs park in the long-poll either way.
                 time.sleep(0.05)
             return Result(
                 metrics=history[-1] if history else {},

@@ -244,3 +244,21 @@ class TestResNetModel:
         variables = model.init(jax.random.PRNGKey(0), x)
         logits = model.apply(variables, x)
         assert logits.shape == (2, 10)
+
+    def test_resnet50_is_the_real_bottleneck_architecture(self):
+        """The 50/101 family is DEFINED by bottleneck blocks; the
+        canonical ResNet-50 has 25.557M parameters — a basic-block
+        (3,4,6,3) stack (ResNet-34 shape) has 21.8M and would silently
+        misrepresent the reference benchmark family."""
+        from raytpu.models.resnet import ResNet, ResNetConfig
+
+        cfg = ResNetConfig.resnet50()
+        assert cfg.bottleneck
+        model = ResNet(cfg)
+        v = model.init(jax.random.PRNGKey(0), jnp.ones((1, 64, 64, 3)))
+        n = sum(p.size for p in jax.tree_util.tree_leaves(v["params"]))
+        assert 25.4e6 < n < 25.7e6, f"{n/1e6:.2f}M params"
+        # train-mode batch stats exist and forward runs
+        out, _ = model.apply(v, jnp.ones((2, 64, 64, 3)), train=True,
+                             mutable=["batch_stats"])
+        assert out.shape == (2, 1000)
