@@ -252,3 +252,159 @@ class GceTpuSliceProvider(NodeProvider):
                     ] or [f"{gid}-host{i}" for i in range(g.spec.hosts)]
                 else:
                     g.host_ids = []
+
+
+class K8sSliceProvider(NodeProvider):
+    """**Experimental** — exercised against a fake kubectl runner in CI
+    (no cluster access in this environment).
+
+    Kubernetes provider (reference analogue: the KubeRay operator's
+    worker-group reconciliation + ``_private/kuberay/node_provider.py``,
+    reshaped around the slice): one node group = one Pod carrying a TPU
+    slice (GKE schedules whole slices onto node pools via
+    ``google.com/tpu`` resources + topology selectors). All cluster
+    calls go through a pluggable ``runner`` (the kubectl CLI by
+    default), so control logic tests need no cluster.
+
+    ``spec.name`` is used as the accelerator selector value (e.g.
+    ``tpu-v5-lite-podslice``); the pod template is minimal on purpose —
+    production deployments supply their own via ``pod_template``.
+    """
+
+    # Succeeded maps to "failed" (a node container exiting is not a
+    # requested termination): the reconciler's cleanup then issues the
+    # kubectl delete — mapping it to "terminated" would skip the delete
+    # (terminate_node_group early-returns) and leak the pod object.
+    _PHASE_MAP = {
+        "Running": "running",
+        "Pending": "pending",
+        "Succeeded": "failed",
+        "Failed": "failed",
+        "Unknown": "failed",
+    }
+
+    def __init__(self, namespace: str = "default",
+                 image: str = "python:3.12-slim",
+                 name_prefix: str = "raytpu",
+                 pod_template: Optional[dict] = None,
+                 runner=None):
+        self.namespace = namespace
+        self.image = image
+        self.name_prefix = name_prefix
+        self.pod_template = pod_template
+        self._run = runner or _kubectl
+        self._lock = threading.Lock()
+        self._groups: Dict[str, NodeGroup] = {}
+        self._ids = itertools.count(1)
+
+    def _pod_manifest(self, gid: str, spec: NodeGroupSpec) -> dict:
+        if self.pod_template is not None:
+            import copy as _copy
+
+            pod = _copy.deepcopy(self.pod_template)
+            meta = pod.setdefault("metadata", {})
+            meta["name"] = gid
+            # poll() lists by this label — a template without it would
+            # never be seen again and the group would pend forever.
+            labels = meta.setdefault("labels", {})
+            labels["app"] = self.name_prefix
+            labels["raytpu-group-type"] = spec.name
+            return pod
+        tpus = int(spec.resources_per_host.get("TPU", 0))
+        limits = {"cpu": str(int(spec.resources_per_host.get("CPU", 1)))}
+        if tpus:
+            limits["google.com/tpu"] = str(tpus)
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": gid,
+                "labels": {"app": self.name_prefix,
+                           "raytpu-group-type": spec.name},
+            },
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "node",
+                    "image": self.image,
+                    "resources": {"limits": limits},
+                }],
+            },
+        }
+        if tpus:
+            pod["spec"]["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-accelerator": spec.name,
+            }
+        return pod
+
+    def create_node_group(self, spec: NodeGroupSpec) -> NodeGroup:
+        import json as _json
+
+        with self._lock:
+            gid = f"{self.name_prefix}-{spec.name}-{next(self._ids)}"
+            group = NodeGroup(gid, spec, status="pending")
+            self._groups[gid] = group
+        try:
+            self._run(["apply", "-n", self.namespace, "-f", "-"],
+                      stdin=_json.dumps(self._pod_manifest(gid, spec)))
+        except Exception:
+            with self._lock:
+                group.status = "failed"
+            raise
+        return group
+
+    def terminate_node_group(self, group_id: str) -> None:
+        with self._lock:
+            g = self._groups.get(group_id)
+            if g is None or g.status == "terminated":
+                return
+        # Terminated only after the delete is accepted (same rationale
+        # as the GCE provider: never silently leak a running slice).
+        self._run(["delete", "pod", group_id, "-n", self.namespace,
+                   "--ignore-not-found", "--wait=false"])
+        with self._lock:
+            g.status = "terminated"
+            g.host_ids = []
+
+    def non_terminated_groups(self) -> List[NodeGroup]:
+        with self._lock:
+            return [g for g in self._groups.values()
+                    if g.status in ("pending", "running")]
+
+    def poll(self) -> None:
+        import json as _json
+
+        out = self._run(["get", "pods", "-n", self.namespace,
+                         "-l", f"app={self.name_prefix}", "-o", "json"])
+        listed = {}
+        for item in _json.loads(out or "{}").get("items", []):
+            listed[item.get("metadata", {}).get("name", "")] = item
+        with self._lock:
+            for gid, g in self._groups.items():
+                if g.status == "terminated":
+                    continue
+                item = listed.get(gid)
+                if item is None:
+                    if g.status != "pending":
+                        g.status = "failed"  # pod vanished under us
+                        g.host_ids = []
+                    continue
+                phase = item.get("status", {}).get("phase", "Unknown")
+                g.status = self._PHASE_MAP.get(phase, "failed")
+                if g.status == "running":
+                    ip = item.get("status", {}).get("podIP")
+                    g.host_ids = [ip] if ip else [f"{gid}-host0"]
+                else:
+                    g.host_ids = []
+
+
+def _kubectl(args: List[str], stdin: Optional[str] = None) -> str:
+    """Default command runner: shells out to the kubectl CLI."""
+    import subprocess
+
+    out = subprocess.run(["kubectl"] + args, capture_output=True,
+                         text=True, timeout=120, input=stdin)
+    if out.returncode != 0:
+        raise RuntimeError(f"kubectl {' '.join(args[:3])}... failed: "
+                           f"{out.stderr.strip()[:500]}")
+    return out.stdout

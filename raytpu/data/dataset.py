@@ -540,6 +540,30 @@ class Dataset:
             else:
                 yield {k: jnp.asarray(v) for k, v in batch.items()}
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False, device=None,
+                           dtypes=None) -> Iterator:
+        """Batches as torch tensors (reference: ``iter_torch_batches``).
+        Migration aid: existing torch training loops consume this
+        unchanged; new TPU code should prefer :meth:`iter_jax_batches`.
+        ``dtypes``: a torch dtype (all columns) or {column: dtype}."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = torch.from_numpy(np.ascontiguousarray(v))
+                want = (dtypes.get(k) if isinstance(dtypes, dict)
+                        else dtypes)
+                if want is not None:
+                    t = t.to(want)
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def take(self, n: int = 20) -> List[dict]:
         out = []
         for row in self.iter_rows():

@@ -393,3 +393,130 @@ class TestInstanceManager:
         im.reconcile()
         assert im.instances(states={im_mod.RUNNING})
         assert provider.create_calls == 2
+
+
+class TestK8sSliceProvider:
+    """Kubernetes provider over a fake kubectl runner (reference:
+    KubeRay worker-group reconciliation)."""
+
+    class _FakeKubectl:
+        def __init__(self):
+            import json as _json
+
+            self._json = _json
+            self.pods = {}  # name -> phase
+            self.calls = []
+
+        def __call__(self, args, stdin=None):
+            self.calls.append(args)
+            if args[0] == "apply":
+                pod = self._json.loads(stdin)
+                self.applied = pod
+                self.pods[pod["metadata"]["name"]] = "Pending"
+                return "pod created"
+            if args[0] == "delete":
+                self.pods.pop(args[2], None)
+                return "pod deleted"
+            if args[0] == "get":
+                items = [{"metadata": {"name": n},
+                          "status": {"phase": p,
+                                     "podIP": f"10.0.0.{i}"}}
+                         for i, (n, p) in enumerate(self.pods.items())]
+                return self._json.dumps({"items": items})
+            raise AssertionError(args)
+
+    def _provider(self):
+        from raytpu.autoscaler.node_provider import (K8sSliceProvider,
+                                                     NodeGroupSpec)
+
+        kubectl = self._FakeKubectl()
+        prov = K8sSliceProvider(runner=kubectl)
+        spec = NodeGroupSpec("tpu-v5-lite-podslice", hosts=1,
+                             resources_per_host={"TPU": 8.0, "CPU": 4.0})
+        return prov, kubectl, spec
+
+    def test_create_poll_terminate(self):
+        prov, kubectl, spec = self._provider()
+        g = prov.create_node_group(spec)
+        assert g.status == "pending"
+        assert kubectl.pods  # manifest applied
+        kubectl.pods[g.group_id] = "Running"
+        prov.poll()
+        assert g.status == "running" and g.host_ids == ["10.0.0.0"]
+        prov.terminate_node_group(g.group_id)
+        assert g.status == "terminated"
+        assert any(a[0] == "delete" for a in kubectl.calls)
+
+    def test_manifest_requests_tpu_and_selector(self):
+        prov, kubectl, spec = self._provider()
+        prov.create_node_group(spec)
+        pod = kubectl.applied  # the manifest actually sent to kubectl
+        limits = pod["spec"]["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == "8"
+        assert pod["spec"]["nodeSelector"][
+            "cloud.google.com/gke-tpu-accelerator"] == spec.name
+        assert pod["metadata"]["labels"]["app"] == prov.name_prefix
+
+    def test_custom_template_gets_poll_label(self):
+        from raytpu.autoscaler.node_provider import (K8sSliceProvider,
+                                                     NodeGroupSpec)
+
+        kubectl = self._FakeKubectl()
+        prov = K8sSliceProvider(
+            runner=kubectl,
+            pod_template={"spec": {"containers": [{"name": "n",
+                                                   "image": "x"}]}})
+        spec = NodeGroupSpec("t", resources_per_host={"CPU": 1.0})
+        g = prov.create_node_group(spec)
+        assert kubectl.applied["metadata"]["labels"]["app"] == "raytpu"
+        kubectl.pods[g.group_id] = "Running"
+        prov.poll()
+        assert g.status == "running"
+
+    def test_succeeded_pod_cleaned_up_not_leaked(self):
+        from raytpu.autoscaler.instance_manager import InstanceManager
+
+        prov, kubectl, spec = self._provider()
+        im = InstanceManager(prov, {spec.name: spec})
+        im.set_target(spec.name, 0)
+        g = prov.create_node_group(spec)
+        im.set_target(spec.name, 1)
+        im.reconcile()  # adopts
+        kubectl.pods[g.group_id] = "Succeeded"
+        im.reconcile()
+        # cleanup deleted the pod object instead of leaking it
+        assert any(a[0] == "delete" and a[2] == g.group_id
+                   for a in kubectl.calls)
+
+    def test_vanished_pod_marks_failed_and_reconciler_replaces(self):
+        from raytpu.autoscaler.instance_manager import (RUNNING,
+                                                        InstanceManager)
+
+        prov, kubectl, spec = self._provider()
+        im = InstanceManager(prov, {spec.name: spec})
+        im.set_target(spec.name, 1)
+        im.reconcile()
+        (gid,) = list(kubectl.pods)
+        kubectl.pods[gid] = "Running"
+        im.reconcile()
+        assert im.instances(states={RUNNING})
+        del kubectl.pods[gid]  # node reclaimed: pod vanishes
+        im.reconcile()
+        # replacement pod applied
+        assert len([a for a in kubectl.calls if a[0] == "apply"]) == 2
+
+    def test_failed_create_marks_failed(self):
+        import pytest
+
+        from raytpu.autoscaler.node_provider import (K8sSliceProvider,
+                                                     NodeGroupSpec)
+
+        def broken(args, stdin=None):
+            raise RuntimeError("forbidden")
+
+        prov = K8sSliceProvider(runner=broken)
+        spec = NodeGroupSpec("x", resources_per_host={"CPU": 1.0})
+        with pytest.raises(RuntimeError):
+            prov.create_node_group(spec)
+        assert prov._groups and list(
+            prov._groups.values())[0].status == "failed"

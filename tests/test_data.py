@@ -713,3 +713,18 @@ class TestMoreDatasources:
         batch = next(ds.iter_batches(batch_size=10,
                                      batch_format="pyarrow"))
         assert batch.num_rows == 2 and "cls" in batch.column_names
+
+    def test_iter_torch_batches(self, raytpu_local):
+        import torch
+
+        import raytpu.data as rd
+
+        ds = rd.from_numpy({"x": np.arange(64, dtype=np.float64)},
+                           blocks=2)
+        batches = list(ds.iter_torch_batches(batch_size=32,
+                                             dtypes=torch.float32))
+        assert len(batches) == 2
+        assert isinstance(batches[0]["x"], torch.Tensor)
+        assert batches[0]["x"].dtype == torch.float32
+        assert float(sum(b["x"].sum() for b in batches)) == float(
+            np.arange(64).sum())
