@@ -392,8 +392,12 @@ class K8sSliceProvider(NodeProvider):
                 phase = item.get("status", {}).get("phase", "Unknown")
                 g.status = self._PHASE_MAP.get(phase, "failed")
                 if g.status == "running":
+                    # len(host_ids) == spec.hosts is the provider-layer
+                    # invariant (the GCE provider pads the same way).
                     ip = item.get("status", {}).get("podIP")
-                    g.host_ids = [ip] if ip else [f"{gid}-host0"]
+                    g.host_ids = [ip or f"{gid}-host0"] + [
+                        f"{gid}-host{i}"
+                        for i in range(1, g.spec.hosts)]
                 else:
                     g.host_ids = []
 

@@ -147,6 +147,10 @@ declare("tpu_visible_chips_env", "TPU_VISIBLE_CHIPS")
 declare("mesh_dcn_axis", "dcn")
 declare("default_remote_chips", 0)
 
+# TorchTrainer compat: gloo process-group op timeout — it bounds every
+# collective for the life of training (reference train default: 30 min).
+declare("torch_pg_timeout_s", 1800.0)
+
 # Memory monitor (reference: memory_monitor.h:52).
 declare("memory_usage_threshold", 0.95)
 declare("memory_monitor_refresh_ms", 250)

@@ -557,10 +557,9 @@ class Dataset:
                 t = torch.from_numpy(np.ascontiguousarray(v))
                 want = (dtypes.get(k) if isinstance(dtypes, dict)
                         else dtypes)
-                if want is not None:
-                    t = t.to(want)
-                if device is not None:
-                    t = t.to(device)
+                if want is not None or device is not None:
+                    # single .to(): one copy, not one per conversion
+                    t = t.to(device=device, dtype=want)
                 out[k] = t
             yield out
 

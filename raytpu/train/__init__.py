@@ -20,11 +20,19 @@ from raytpu.train.session import (
     get_dataset_shard,
     report,
 )
-from raytpu.train.trainer import BaseTrainer, JaxTrainer
+from raytpu.train.torch_trainer import (TorchTrainer, prepare_data_loader,
+                                        prepare_model)
+from raytpu.train.trainer import (BaseTrainer,
+                                  DataParallelTrainer,
+                                  JaxTrainer)
 
 __all__ = [
     "BaseTrainer",
     "JaxTrainer",
+    "DataParallelTrainer",
+    "TorchTrainer",
+    "prepare_model",
+    "prepare_data_loader",
     "ScalingConfig",
     "RunConfig",
     "FailureConfig",

@@ -1,0 +1,68 @@
+"""TorchTrainer — migration-compat trainer for existing torch loops.
+
+Reference analogue: ``python/ray/train/torch/torch_trainer.py`` +
+``torch/train_loop_utils.py`` (``prepare_model``/``prepare_data_loader``).
+The compute plane here is JAX by design (MIGRATION.md), but reference
+users arrive with working ``train_loop_per_worker`` functions written
+against torch — this trainer runs them unchanged: the same gang/PG/
+rendezvous/report/checkpoint machinery as :class:`JaxTrainer`, with the
+process group formed by ``torch.distributed`` (gloo — this image has no
+CUDA/NCCL; the point is API-compatible CPU execution and a mechanical
+migration path to ``JaxTrainer``).
+"""
+
+from __future__ import annotations
+
+from raytpu.train.trainer import JaxTrainer
+
+
+class TorchTrainer(JaxTrainer):
+    distributed_backend = "torch"
+
+
+def prepare_model(model):
+    """DDP-wrap when a multi-worker process group exists (reference:
+    ``ray.train.torch.prepare_model`` — device move + DDP; CPU/gloo
+    here, so only the DDP wrap applies)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across the gang with a DistributedSampler
+    (reference: ``ray.train.torch.prepare_data_loader``). The incoming
+    loader's shuffle intent is preserved (an eval loader built with
+    ``shuffle=False`` stays ordered). Pass-through cases: world size 1,
+    non-map-style datasets, and ``batch_sampler`` loaders (their
+    ``batch_size`` is None — rebuilding would disable batching).
+
+    For shuffling loaders, call ``loader.sampler.set_epoch(epoch)`` at
+    each epoch start (standard DistributedSampler contract) or every
+    epoch reuses one permutation."""
+    import torch.distributed as dist
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    from torch.utils.data import (DataLoader, DistributedSampler,
+                                  RandomSampler)
+
+    ds = data_loader.dataset
+    if not hasattr(ds, "__len__"):
+        return data_loader
+    if data_loader.batch_size is None:
+        return data_loader  # batch_sampler loader: see docstring
+    shuffle = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(ds, num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank(), shuffle=shuffle)
+    return DataLoader(ds, batch_size=data_loader.batch_size,
+                      sampler=sampler,
+                      num_workers=getattr(data_loader, "num_workers", 0),
+                      collate_fn=data_loader.collate_fn,
+                      drop_last=data_loader.drop_last)

@@ -72,7 +72,7 @@ class TrainWorker:
     def setup_distributed(self, coordinator: Optional[str],
                           num_processes: int, process_id: int,
                           rdzv_name: Optional[str] = None,
-                          attempt: int = 0):
+                          attempt: int = 0, backend: str = "jax"):
         """Multi-host rendezvous (reference analogue:
         ``_setup_torch_process_group``, ``torch/config.py:65``).
 
@@ -83,6 +83,17 @@ class TrainWorker:
         runtime (the mesh spans all hosts' devices).
         """
         if coordinator is None or num_processes <= 1:
+            if backend == "torch":
+                if num_processes > 1:
+                    # JAX in-process workers share one runtime, so a None
+                    # coordinator is fine there — torch has no shared
+                    # runtime: an uninitialized process group would train
+                    # N diverging replicas with zero gradient sync.
+                    raise ValueError(
+                        "TorchTrainer with num_workers > 1 requires "
+                        "ScalingConfig(coordinator_address='auto' or "
+                        "'host:port') to form the gloo process group")
+                self._init_torch_pg("127.0.0.1:0", 1, 0)
             return True
         if coordinator == "auto":
             store = raytpu.get_actor(rdzv_name)
@@ -108,6 +119,9 @@ class TrainWorker:
                             "rendezvous: coordinator address never "
                             "published")
                     time.sleep(0.1)
+        if backend == "torch":
+            self._init_torch_pg(coordinator, num_processes, process_id)
+            return True
         import jax
 
         # Honor the spawn-time platform choice: plugin sitecustomize hooks
@@ -126,6 +140,35 @@ class TrainWorker:
             process_id=process_id,
         )
         return True
+
+    @staticmethod
+    def _init_torch_pg(coordinator: str, num_processes: int,
+                       process_id: int) -> None:
+        """Migration-compat gang (reference: _setup_torch_process_group,
+        torch/config.py:65): gloo over the same rendezvous plumbing. The
+        timeout bounds EVERY collective for the life of training, so it
+        defaults to the reference's 1800s (``torch_pg_timeout_s``), not
+        a rendezvous-scale value."""
+        import datetime
+
+        import torch.distributed as dist
+
+        from raytpu.core.config import cfg
+
+        if dist.is_initialized():
+            return
+        if coordinator.endswith(":0"):  # world-size-1 local group
+            import socket
+
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+            s.close()
+        dist.init_process_group(
+            "gloo", init_method=f"tcp://{coordinator}",
+            rank=process_id, world_size=num_processes,
+            timeout=datetime.timedelta(
+                seconds=float(cfg.torch_pg_timeout_s)))
 
     def start(self, train_fn_blob: bytes, config: dict, dataset_shards=None,
               resume_path=None):
@@ -200,6 +243,9 @@ class JaxTrainer(BaseTrainer):
     ``raytpu.train.report`` / ``get_context`` / ``get_dataset_shard`` and
     the mesh helpers in :mod:`raytpu.parallel`.
     """
+
+    # Which process-group flavor setup_distributed forms for the gang.
+    distributed_backend = "jax"
 
     def __init__(self, train_loop_per_worker: Callable[[dict], None], *,
                  train_loop_config: Optional[dict] = None,
@@ -307,7 +353,7 @@ class JaxTrainer(BaseTrainer):
             raytpu.get([
                 w.setup_distributed.remote(
                     sc.coordinator_address, sc.num_workers, i,
-                    rdzv_name, attempt)
+                    rdzv_name, attempt, self.distributed_backend)
                 for i, w in enumerate(workers)])
             resume = (self.resume_from_checkpoint.path
                       if self.resume_from_checkpoint is not None else None)
@@ -385,3 +431,9 @@ def _split_datasets(datasets: Dict[str, Any], n: int):
             for i in range(n):
                 shards[i][key] = ds
     return shards
+
+
+# Reference-parity alias: the reference's trainer hierarchy roots at
+# DataParallelTrainer (python/ray/train/data_parallel_trainer.py);
+# JaxTrainer IS our data-parallel trainer.
+DataParallelTrainer = JaxTrainer
