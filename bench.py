@@ -86,8 +86,12 @@ def main() -> None:
             # 16-batch variants fail, 32 certainly would too — so the
             # early-stop can never skip a config smaller than ones that
             # already failed.
+            # Ascending memory: 16-dots ~9GB, 24-dots ~12-13GB, 32-dots
+            # ~16GB (likely over the 15.75GB HBM) — the 24 rung is the
+            # probable winner if 32 OOMs.
             candidates = [(8, True, 0), (16, "dots", 8192),
-                          (16, "dots", 0), (32, "dots", 8192)]
+                          (16, "dots", 0), (24, "dots", 8192),
+                          (32, "dots", 8192)]
         attn_impls = (["tpu", "reference"] if on_accel
                       else ["reference"])
         if on_accel and _probe_pallas(jnp) != "tpu":
