@@ -129,6 +129,13 @@ class InstanceManager:
         launched: Dict[str, int] = {}
         with self._lock:
             self._sync_locked()
+            # A busy instance's idle clock resets every tick — not only
+            # while a surplus exists — so a stale idle_since from an old
+            # surplus episode can never fast-track a just-idle group past
+            # idle_timeout_s on a later shrink.
+            for inst in self._instances.values():
+                if inst.group_id in busy:
+                    inst.idle_since = None
             for name, spec in self.specs.items():
                 live = [i for i in self._instances.values()
                         if i.group_type == name and i.state in LIVE_STATES]
@@ -199,6 +206,12 @@ class InstanceManager:
             if len(live) <= want:
                 return
             inst.transition(TERMINATED, "target shrank before launch")
+            live.remove(inst)
+        for inst in [i for i in live if i.state == REQUESTED]:
+            if len(live) <= want:
+                return
+            inst.transition(TERMINATING, "target shrank mid-launch")
+            self._terminate_locked(inst, "target shrank mid-launch")
             live.remove(inst)
         for inst in [i for i in live if i.state in (ALLOCATED, RUNNING)]:
             if len(live) <= want:

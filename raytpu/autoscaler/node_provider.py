@@ -296,6 +296,12 @@ class K8sSliceProvider(NodeProvider):
         self._lock = threading.Lock()
         self._groups: Dict[str, NodeGroup] = {}
         self._ids = itertools.count(1)
+        # gid -> consecutive polls where a pending pod was absent from
+        # the listing. One absence is tolerated (apply -> list race);
+        # persistent absence means the pod will never reach Running and
+        # the group must fail rather than pend forever.
+        self._pending_missing: Dict[str, int] = {}
+        self.pending_missing_threshold = 3
 
     def _pod_manifest(self, gid: str, spec: NodeGroupSpec) -> dict:
         if self.pod_template is not None:
@@ -365,6 +371,7 @@ class K8sSliceProvider(NodeProvider):
         with self._lock:
             g.status = "terminated"
             g.host_ids = []
+            self._pending_missing.pop(group_id, None)
 
     def non_terminated_groups(self) -> List[NodeGroup]:
         with self._lock:
@@ -388,7 +395,15 @@ class K8sSliceProvider(NodeProvider):
                     if g.status != "pending":
                         g.status = "failed"  # pod vanished under us
                         g.host_ids = []
+                    else:
+                        n = self._pending_missing.get(gid, 0) + 1
+                        self._pending_missing[gid] = n
+                        if n >= self.pending_missing_threshold:
+                            g.status = "failed"  # never materialized
+                            g.host_ids = []
+                            del self._pending_missing[gid]
                     continue
+                self._pending_missing.pop(gid, None)
                 phase = item.get("status", {}).get("phase", "Unknown")
                 g.status = self._PHASE_MAP.get(phase, "failed")
                 if g.status == "running":

@@ -1180,8 +1180,12 @@ class NodeServer:
                 del self._push_rx[k]
             if oid_hex in self._push_rx:
                 return False  # another push already inbound
+            # [buf, last_activity, size, {offset: length}] — explicit
+            # coverage ranges, not a byte counter: a duplicated or
+            # overlapping chunk must never make "complete" true while
+            # the buffer has zero-filled holes.
             self._push_rx[oid_hex] = [bytearray(int(size)), now,
-                                      int(size), 0]
+                                      int(size), {}]
         return True
 
     def _h_push_object_chunk(self, peer: Peer, oid_hex: str, offset: int,
@@ -1190,14 +1194,15 @@ class NodeServer:
             ent = self._push_rx.get(oid_hex)
             if ent is None:
                 return False
-            buf, _, size, got = ent
-            end = int(offset) + len(data)
-            if end > size:
+            buf, _, size, ranges = ent
+            off = int(offset)
+            end = off + len(data)
+            if off < 0 or end > size:
                 del self._push_rx[oid_hex]
                 return False
-            buf[int(offset):end] = data
+            buf[off:end] = data
+            ranges[off] = len(data)
             ent[1] = time.monotonic()
-            ent[3] = got + len(data)
         return True
 
     def _h_push_object_end(self, peer: Peer, oid_hex: str) -> bool:
@@ -1205,8 +1210,14 @@ class NodeServer:
             ent = self._push_rx.pop(oid_hex, None)
         if ent is None:
             return False
-        buf, _, size, got = ent
-        if got != size:
+        buf, _, size, ranges = ent
+        # Complete means gap-free, overlap-free coverage of [0, size).
+        pos = 0
+        for off in sorted(ranges):
+            if off != pos:
+                return False  # hole or overlap: never published
+            pos = off + ranges[off]
+        if pos != size:
             return False  # incomplete: never published as stored
         oid = ObjectID.from_hex(oid_hex)
         if not self.backend.store.contains(oid):

@@ -55,14 +55,47 @@ def prepare_data_loader(data_loader):
 
     ds = data_loader.dataset
     if not hasattr(ds, "__len__"):
+        _warn_unsharded("an iterable-style dataset (no __len__)")
         return data_loader
     if data_loader.batch_size is None:
-        return data_loader  # batch_sampler loader: see docstring
+        _warn_unsharded("a batch_sampler loader (batch_size is None)")
+        return data_loader
     shuffle = isinstance(data_loader.sampler, RandomSampler)
     sampler = DistributedSampler(ds, num_replicas=dist.get_world_size(),
                                  rank=dist.get_rank(), shuffle=shuffle)
-    return DataLoader(ds, batch_size=data_loader.batch_size,
-                      sampler=sampler,
-                      num_workers=getattr(data_loader, "num_workers", 0),
-                      collate_fn=data_loader.collate_fn,
-                      drop_last=data_loader.drop_last)
+    num_workers = getattr(data_loader, "num_workers", 0)
+    kwargs = dict(
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=num_workers,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+        pin_memory=getattr(data_loader, "pin_memory", False),
+        worker_init_fn=getattr(data_loader, "worker_init_fn", None),
+        generator=getattr(data_loader, "generator", None),
+        timeout=getattr(data_loader, "timeout", 0),
+    )
+    if num_workers > 0:
+        # Only legal to pass with worker processes (DataLoader raises
+        # on prefetch_factor/persistent_workers at num_workers=0).
+        kwargs["persistent_workers"] = getattr(
+            data_loader, "persistent_workers", False)
+        pf = getattr(data_loader, "prefetch_factor", None)
+        if pf is not None:
+            kwargs["prefetch_factor"] = pf
+        kwargs["multiprocessing_context"] = getattr(
+            data_loader, "multiprocessing_context", None)
+    return DataLoader(ds, **kwargs)
+
+
+def _warn_unsharded(why: str) -> None:
+    import warnings
+
+    import torch.distributed as dist
+
+    warnings.warn(
+        f"prepare_data_loader: cannot shard {why} at world size "
+        f"{dist.get_world_size()} — EVERY worker will iterate the FULL "
+        f"dataset (duplicate epochs). Shard inside the dataset itself "
+        f"(e.g. by rank) or switch to a map-style dataset with a "
+        f"batch_size loader.", UserWarning, stacklevel=3)

@@ -364,6 +364,51 @@ class TestInstanceManager:
         im.reconcile(idle_timeout_s=0.0)  # no longer busy
         assert not im.instances(states=set(im_mod.LIVE_STATES))
 
+    def test_stale_idle_clock_cleared_while_busy(self):
+        """A surplus episode starts the idle clock; the group then goes
+        busy with the surplus gone. A later shrink must re-time idleness
+        from scratch, not fast-track past idle_timeout_s on the stale
+        clock (ADVICE r4 #1)."""
+        import time as _time
+
+        from raytpu.autoscaler import instance_manager as im_mod
+
+        im, provider = self._im()
+        im.set_target("v4-8", 1)
+        im.reconcile()
+        im.reconcile()
+        (inst,) = im.instances(states={im_mod.RUNNING})
+        gid = inst.group_id
+        im.set_target("v4-8", 0)
+        im.reconcile(idle_timeout_s=60.0)  # surplus: idle clock starts
+        assert inst.idle_since is not None
+        im.set_target("v4-8", 1)  # surplus gone; group becomes busy
+        im.reconcile(busy_group_ids={gid})
+        assert inst.idle_since is None  # busy tick cleared the clock
+        _time.sleep(0.25)
+        im.set_target("v4-8", 0)  # just went idle
+        im.reconcile(idle_timeout_s=0.2)
+        # Stale clock would read 0.25s idle >= 0.2 and kill it now.
+        assert im.instances(states={im_mod.RUNNING})
+        _time.sleep(0.25)
+        im.reconcile(idle_timeout_s=0.2)  # genuinely idle past timeout
+        assert not im.instances(states=set(im_mod.LIVE_STATES))
+
+    def test_shrink_retires_requested_instances(self):
+        """Shrink while launches are in flight cancels REQUESTED
+        instances (with the cloud terminate) instead of leaving them to
+        allocate against a lower target (ADVICE r4 #1)."""
+        from raytpu.autoscaler import instance_manager as im_mod
+
+        im, provider = self._im(ticks=100)  # never finishes provisioning
+        im.set_target("v4-8", 2)
+        im.reconcile()
+        assert len(im.instances(states={im_mod.REQUESTED})) == 2
+        im.set_target("v4-8", 1)
+        im.reconcile()
+        assert len(im.instances(states={im_mod.REQUESTED})) == 1
+        assert provider.terminate_calls == 1
+
     def test_adopts_externally_created_groups(self):
         from raytpu.autoscaler import instance_manager as im_mod
 
@@ -504,6 +549,34 @@ class TestK8sSliceProvider:
         im.reconcile()
         # replacement pod applied
         assert len([a for a in kubectl.calls if a[0] == "apply"]) == 2
+
+    def test_pending_pod_never_listed_eventually_fails(self):
+        """A pending pod absent from the listing is tolerated briefly
+        (apply->list race) but marked failed after the threshold, so the
+        group cannot pend forever and block replacement (ADVICE r4 #2)."""
+        prov, kubectl, spec = self._provider()
+        g = prov.create_node_group(spec)
+        del kubectl.pods[g.group_id]  # evicted before ever listed
+        for _ in range(prov.pending_missing_threshold - 1):
+            prov.poll()
+            assert g.status == "pending"  # tolerated so far
+        prov.poll()
+        assert g.status == "failed"
+
+    def test_pending_pod_single_missing_poll_tolerated(self):
+        """One missed listing then a successful one: the miss counter
+        resets and the group proceeds normally."""
+        prov, kubectl, spec = self._provider()
+        g = prov.create_node_group(spec)
+        saved = kubectl.pods.pop(g.group_id)
+        prov.poll()
+        assert g.status == "pending"
+        kubectl.pods[g.group_id] = saved  # listing catches up
+        prov.poll()
+        assert g.status == "pending" and not prov._pending_missing
+        kubectl.pods[g.group_id] = "Running"
+        prov.poll()
+        assert g.status == "running"
 
     def test_failed_create_marks_failed(self):
         import pytest

@@ -67,6 +67,41 @@ class TestPushReceiver:
         back = node_client.call("fetch_object", oid.hex(), timeout=30.0)
         assert back == blob
 
+    def test_duplicate_chunk_cannot_mask_a_hole(self, node_client):
+        """A duplicated chunk must not make byte-accounting 'complete'
+        while the buffer still has a zero-filled hole (coverage is
+        tracked as ranges, not a counter)."""
+        oid = ObjectID.for_task_return(TaskID.from_random(), 1)
+        blob = _wire_bytes(np.arange(300_000, dtype=np.float64))
+        step = 256 * 1024
+        offs = list(range(0, len(blob), step))
+        assert len(offs) >= 3
+        assert node_client.call("push_object_begin", oid.hex(), len(blob))
+        # First chunk twice, middle chunk never: total bytes pushed can
+        # equal the object size while [step, 2*step) is a hole.
+        node_client.call("push_object_chunk", oid.hex(), 0, blob[:step])
+        node_client.call("push_object_chunk", oid.hex(), 0, blob[:step])
+        for off in offs[2:]:
+            node_client.call("push_object_chunk", oid.hex(), off,
+                             blob[off:off + step])
+        assert node_client.call("push_object_end", oid.hex()) is False
+        assert node_client.call("fetch_object", oid.hex()) is None
+
+    def test_retried_chunk_is_idempotent(self, node_client):
+        """A chunk resent at the same offset (sender retry) does not
+        corrupt the transfer; the complete object still publishes."""
+        oid = ObjectID.for_task_return(TaskID.from_random(), 1)
+        blob = _wire_bytes(np.arange(300_000, dtype=np.float64))
+        step = 256 * 1024
+        assert node_client.call("push_object_begin", oid.hex(), len(blob))
+        for off in range(0, len(blob), step):
+            node_client.call("push_object_chunk", oid.hex(), off,
+                             blob[off:off + step])
+        node_client.call("push_object_chunk", oid.hex(), 0, blob[:step])
+        assert node_client.call("push_object_end", oid.hex()) is True
+        back = node_client.call("fetch_object", oid.hex(), timeout=30.0)
+        assert back == blob
+
     def test_abandoned_push_buffer_expires(self, node_client, monkeypatch):
         """A begin with no end (producer gone) blocks re-push only until
         the rx TTL; afterwards a fresh push of the same object succeeds."""
