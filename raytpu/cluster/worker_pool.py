@@ -78,6 +78,9 @@ class WorkerHandle:
         self.blocked = False
         self.last_used = time.monotonic()
         self.on_death: Optional[Callable[[str], None]] = None  # actor hook
+        # Container spec from the runtime env (the lease key pins the
+        # image via the renv hash): _spawn wraps the worker command.
+        self.container = None
 
     def crash(self, reason: str) -> None:
         self.dead = True
@@ -181,6 +184,8 @@ class WorkerPool:
                 if live < limit or dedicated:
                     h = self._reserve_locked(key, chips)
                     h.dedicated = dedicated
+                    if renv:
+                        h.container = renv.get("container")
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -189,7 +194,15 @@ class WorkerPool:
                 self._cv.wait(timeout=min(remaining, 0.1))
         # Popen outside the lock: spawns overlap and never stall
         # lease/release/on_register traffic.
-        self._spawn(h)
+        try:
+            self._spawn(h)
+        except Exception as e:
+            # e.g. container engine missing: fail the lease cleanly and
+            # free the reserved slot instead of wedging on ready.wait.
+            h.crash(f"worker spawn failed: {e}")
+            with self._lock:
+                self._workers.pop(h.worker_id.hex(), None)
+            raise WorkerCrashedError(f"worker spawn failed: {e}") from e
         if not h.ready.wait(timeout=float(cfg.worker_register_timeout_seconds)):
             h.crash("worker failed to register in time")
             try:
@@ -268,6 +281,12 @@ class WorkerPool:
             "--job", h.key[0],
             "--node-id", self.node_id_hex,
         ]
+        # Container wrap BEFORE any fd is opened: a failed wrap (e.g. no
+        # engine on the node) must not leak log file handles.
+        if h.container is not None:
+            from raytpu.runtime_env.container import wrap_worker_command
+
+            cmd, env = wrap_worker_command(cmd, env, h.container)
         # Per-process log files (reference: worker-<id>-<pid>.out/.err
         # under the session dir); the node's log monitor tails .out/.err
         # and streams new lines to drivers.
@@ -278,11 +297,14 @@ class WorkerPool:
                 self.log_dir, f"worker-{wid}.out"), "ab", buffering=0)
             stderr = open(os.path.join(
                 self.log_dir, f"worker-{wid}.err"), "ab", buffering=0)
-        h.proc = subprocess.Popen(cmd, env=env, start_new_session=True,
-                                  stdout=stdout, stderr=stderr)
-        if stdout is not None:
-            stdout.close()
-            stderr.close()
+        try:
+            h.proc = subprocess.Popen(cmd, env=env,
+                                      start_new_session=True,
+                                      stdout=stdout, stderr=stderr)
+        finally:
+            if stdout is not None:
+                stdout.close()
+                stderr.close()
 
     def _drop_locked(self, h: WorkerHandle) -> None:
         self._workers.pop(h.worker_id.hex(), None)

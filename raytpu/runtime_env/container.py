@@ -1,0 +1,136 @@
+"""Container runtime env: image-hermetic worker processes.
+
+Reference analogue: ``python/ray/_private/runtime_env/container.py`` —
+the worker command is wrapped in a ``podman run`` exec prefix so the
+worker process executes inside the requested image while sharing the
+host's network/IPC/PID namespaces (the raylet must still reach it, and
+it must still reach the shm object store). Ours composes the same shape
+of prefix for podman **or** docker and applies it at worker spawn
+(:meth:`raytpu.cluster.worker_pool.WorkerPool._spawn`): the pool's lease
+key already includes the runtime-env hash, so container tasks only ever
+reuse workers spawned from the same image.
+
+Spec shape (``runtime_env={"container": ...}``)::
+
+    "image-name"                              # shorthand
+    {"image": "...",                          # required
+     "run_options": ["--privileged", ...],    # extra engine args
+     "mounts": {"/host/path": "/ctr/path"},   # extra -v binds
+     "python": "/usr/bin/python3",            # interpreter inside image
+     "engine": "/usr/bin/podman"}             # explicit engine binary
+
+Engine resolution order: spec ``engine`` > ``RAYTPU_CONTAINER_ENGINE``
+env var > first of ``podman``/``docker`` on PATH. When none is found the
+lease fails with a clear message (graceful rejection — this sandbox has
+no container tooling; CI drives the full path through a fake engine).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_SPEC_KEYS = {"image", "run_options", "mounts", "python", "engine"}
+# Set inside containerized workers: RuntimeEnvContext uses it to tell
+# "container already applied at spawn" from "thread-backend task that
+# nobody containerized" (which must be rejected, not silently ignored).
+CONTAINERIZED_ENV = "RAYTPU_CONTAINERIZED"
+
+
+def normalize_spec(spec) -> dict:
+    if isinstance(spec, str):
+        spec = {"image": spec}
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"container runtime env must be an image name or dict, got "
+            f"{type(spec).__name__}")
+    if not spec.get("image") or not isinstance(spec["image"], str):
+        raise ValueError("container runtime env requires a non-empty "
+                         "'image' string")
+    unknown = set(spec) - _SPEC_KEYS
+    if unknown:
+        raise ValueError(f"unknown container spec keys: {sorted(unknown)}; "
+                         f"supported: {sorted(_SPEC_KEYS)}")
+    run_options = spec.get("run_options") or []
+    if not isinstance(run_options, (list, tuple)) or not all(
+            isinstance(o, str) for o in run_options):
+        raise ValueError("container 'run_options' must be a list of "
+                         "strings")
+    mounts = spec.get("mounts") or {}
+    if not isinstance(mounts, dict):
+        raise ValueError("container 'mounts' must be {host: container}")
+    return {"image": spec["image"], "run_options": list(run_options),
+            "mounts": dict(mounts), "python": spec.get("python"),
+            "engine": spec.get("engine")}
+
+
+def find_engine(spec: Optional[dict] = None) -> str:
+    """Resolve the container engine binary; raises with a clear message
+    when no tooling exists on this node."""
+    explicit = (spec or {}).get("engine") \
+        or os.environ.get("RAYTPU_CONTAINER_ENGINE")
+    if explicit:
+        path = shutil.which(explicit) or (
+            explicit if os.path.isfile(explicit)
+            and os.access(explicit, os.X_OK) else None)
+        if path is None:
+            raise RuntimeError(
+                f"container engine {explicit!r} not found or not "
+                f"executable on this node")
+        return path
+    for name in ("podman", "docker"):
+        path = shutil.which(name)
+        if path:
+            return path
+    raise RuntimeError(
+        "runtime_env 'container' requires podman or docker on the node, "
+        "and neither was found on PATH (set RAYTPU_CONTAINER_ENGINE or "
+        "the spec's 'engine' to an explicit binary)")
+
+
+def _default_mounts() -> Dict[str, str]:
+    """Host paths the worker needs inside the image: the raytpu code
+    tree and the host tmp (session dirs, rendezvous files, spill)."""
+    import raytpu
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(raytpu.__file__)))
+    return {pkg_root: pkg_root, "/tmp": "/tmp"}
+
+
+def wrap_worker_command(cmd: List[str], env: Dict[str, str],
+                        spec) -> Tuple[List[str], Dict[str, str]]:
+    """Compose ``engine run ... image cmd...`` around a worker command.
+
+    Host namespaces are shared (``--network=host --ipc=host --pid=host``:
+    the node daemon reaches the worker's RPC port, and the POSIX shm
+    object store stays visible). The full worker environment is passed
+    explicitly with ``--env`` (docker has no ``--env-host``; explicit is
+    engine-portable and deterministic). Returns (command, env) — env is
+    returned too because the containerized marker is added to it.
+    """
+    spec = normalize_spec(spec)
+    engine = find_engine(spec)
+    env = dict(env)
+    env[CONTAINERIZED_ENV] = "1"
+    prefix = [engine, "run", "--rm",
+              "--network=host", "--ipc=host", "--pid=host"]
+    mounts = _default_mounts()
+    mounts.update(spec["mounts"])
+    for host, ctr in sorted(mounts.items()):
+        prefix += ["-v", f"{host}:{ctr}"]
+    for k in sorted(env):
+        prefix += ["--env", f"{k}={env[k]}"]
+    prefix += spec["run_options"]
+    prefix.append(spec["image"])
+    inner = list(cmd)
+    if spec["python"]:
+        inner[0] = spec["python"]
+    elif inner and inner[0] == sys.executable:
+        # Keep the host interpreter path: the code tree is bind-mounted
+        # at the same location, matching the reference's behavior of
+        # running the same entrypoint inside the image.
+        pass
+    return prefix + inner, env

@@ -21,12 +21,16 @@ cache. Ours has three plugins:
   dict spec (see :mod:`raytpu.runtime_env.conda_env`); its site-packages
   is path-injected and its ``bin`` joins PATH while held.
 
+- ``container``: image-hermetic worker processes — the worker command is
+  wrapped in a podman/docker exec prefix at spawn (see
+  :mod:`raytpu.runtime_env.container`). Cluster mode only: the thread
+  backend cannot containerize a task and rejects the key with a clear
+  error instead of silently ignoring it.
+
 Isolation note: the reference dedicates worker PROCESSES per runtime env;
 our local fabric runs tasks in threads, so ``env_vars`` are process-global
 while held — concurrent tasks with conflicting values of the same key are
-flagged with a warning rather than isolated. ``container`` is rejected
-explicitly (no such tooling in this environment) rather than silently
-ignored.
+flagged with a warning rather than isolated.
 """
 
 from __future__ import annotations
@@ -57,25 +61,29 @@ _uri_cache: Dict[str, str] = {}  # uri -> extracted path
 # second env's bin).
 _path_env_refs: Dict[str, int] = {}
 
-SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
-REJECTED_KEYS = {"container"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+                  "container"}
 
 
 def validate(runtime_env: Optional[dict]) -> None:
     if not runtime_env:
         return
-    bad = set(runtime_env) & REJECTED_KEYS
-    if bad:
-        raise ValueError(
-            f"runtime_env keys {sorted(bad)} are not supported in this "
-            f"deployment (no container tooling); supported: "
-            f"{sorted(SUPPORTED_KEYS)}")
     unknown = set(runtime_env) - SUPPORTED_KEYS
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
     if "pip" in runtime_env and "conda" in runtime_env:
         raise ValueError("runtime_env cannot combine 'pip' and 'conda' "
                          "(same rule as the reference)")
+    if "container" in runtime_env:
+        for other in ("pip", "conda"):
+            if other in runtime_env:
+                raise ValueError(
+                    f"runtime_env cannot combine 'container' with "
+                    f"{other!r}: the image provides the interpreter "
+                    f"environment (same rule as the reference)")
+        from raytpu.runtime_env.container import normalize_spec as _ctr_ns
+
+        _ctr_ns(runtime_env["container"])
     if "pip" in runtime_env:
         from raytpu.runtime_env.pip_env import normalize_spec
 
@@ -162,6 +170,18 @@ class RuntimeEnvContext:
         self._held_keys: List[str] = []
 
     def __enter__(self) -> "RuntimeEnvContext":
+        if self.env.get("container"):
+            from raytpu.runtime_env.container import CONTAINERIZED_ENV
+
+            # Process workers were containerized at spawn (the lease key
+            # pins the image); inside them the key is a no-op. A thread
+            # backend entering it was never containerized: reject.
+            if os.environ.get(CONTAINERIZED_ENV) != "1":
+                raise RuntimeError(
+                    "runtime_env 'container' requires process workers "
+                    "(cluster mode): the local thread backend cannot run "
+                    "a task inside an image. Start a cluster "
+                    "(raytpu.init(address=...)) or drop the key.")
         env_vars = self.env.get("env_vars") or {}
         # Materialize slow resources BEFORE taking the module lock: a pip
         # venv install can run for minutes and must not serialize every

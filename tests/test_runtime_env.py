@@ -12,9 +12,17 @@ from raytpu.runtime_env.context import RuntimeEnvContext
 
 
 class TestValidation:
-    def test_container_rejected(self):
-        with pytest.raises(ValueError, match="not supported"):
-            validate({"container": {"image": "x"}})
+    def test_container_shape_validated(self):
+        validate({"container": {"image": "x"}})  # dict form
+        validate({"container": "someimage:latest"})  # shorthand
+        with pytest.raises(ValueError, match="image"):
+            validate({"container": {}})
+        with pytest.raises(ValueError, match="unknown container"):
+            validate({"container": {"image": "x", "bogus": 1}})
+        with pytest.raises(ValueError, match="combine"):
+            validate({"container": "img", "pip": ["x"]})
+        with pytest.raises(ValueError, match="combine"):
+            validate({"container": "img", "conda": "y"})
 
     def test_conda_shape_validated_at_submission(self):
         from raytpu.core.errors import RuntimeEnvError
@@ -47,7 +55,8 @@ class TestValidation:
             return 1
 
         ref = f.options(runtime_env={"container": {"image": "x"}}).remote()
-        with pytest.raises(raytpu.TaskError, match="not supported"):
+        # Local thread backend cannot containerize: clean task failure.
+        with pytest.raises(raytpu.TaskError, match="process workers"):
             raytpu.get(ref)
 
 
@@ -404,3 +413,133 @@ exit 1
         path = os.environ["PATH"].split(os.pathsep)
         assert os.path.join(p1, "bin") not in path
         assert os.path.join(p2, "bin") not in path
+
+
+class TestContainerRuntimeEnv:
+    """container: image-hermetic workers (VERDICT r4 missing #3;
+    reference: python/ray/_private/runtime_env/container.py). No real
+    podman/docker exists in this sandbox: the exec-prefix composition is
+    unit-tested, and the full spawn path is driven through a fake engine
+    binary that execs the wrapped command on the host."""
+
+    def test_exec_prefix_composition(self, tmp_path):
+        from raytpu.runtime_env.container import wrap_worker_command
+
+        engine = tmp_path / "podman"
+        engine.write_text("#!/bin/sh\n")
+        engine.chmod(0o755)
+        cmd, env = wrap_worker_command(
+            [sys.executable, "-m", "raytpu.cluster.worker_proc", "--x"],
+            {"A": "1", "B": "two"},
+            {"image": "img:v1", "engine": str(engine),
+             "run_options": ["--privileged"],
+             "mounts": {"/data": "/mnt/data"}})
+        assert cmd[0] == str(engine) and cmd[1] == "run"
+        assert "--network=host" in cmd and "--ipc=host" in cmd
+        img_at = cmd.index("img:v1")
+        # run_options immediately before the image; worker cmd after it
+        assert cmd[img_at - 1] == "--privileged"
+        assert cmd[img_at + 1:] == [sys.executable, "-m",
+                                    "raytpu.cluster.worker_proc", "--x"]
+        joined = " ".join(cmd[:img_at])
+        assert "-v /data:/mnt/data" in joined
+        assert "--env A=1" in joined and "--env B=two" in joined
+        assert env["RAYTPU_CONTAINERIZED"] == "1"
+        # the raytpu code tree and /tmp ride along by default
+        import raytpu as _pkg
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        assert f"-v {pkg_root}:{pkg_root}" in joined
+        assert "-v /tmp:/tmp" in joined
+
+    def test_python_override_replaces_interpreter(self, tmp_path):
+        from raytpu.runtime_env.container import wrap_worker_command
+
+        engine = tmp_path / "docker"
+        engine.write_text("#!/bin/sh\n")
+        engine.chmod(0o755)
+        cmd, _ = wrap_worker_command(
+            [sys.executable, "-m", "raytpu.cluster.worker_proc"], {},
+            {"image": "img", "engine": str(engine),
+             "python": "/usr/bin/python3"})
+        tail = cmd[cmd.index("img") + 1:]
+        assert tail[0] == "/usr/bin/python3"
+
+    def test_no_engine_graceful_message(self, monkeypatch):
+        from raytpu.runtime_env.container import find_engine
+
+        monkeypatch.delenv("RAYTPU_CONTAINER_ENGINE", raising=False)
+        monkeypatch.setenv("PATH", "/nonexistent")
+        with pytest.raises(RuntimeError, match="podman or docker"):
+            find_engine({"image": "img"})
+        with pytest.raises(RuntimeError, match="not found"):
+            find_engine({"image": "img", "engine": "/no/such/engine"})
+
+    @pytest.fixture
+    def fake_engine(self, tmp_path):
+        """A 'container engine' that drops every arg up to and including
+        the image, then execs the worker command on the host — the exec
+        prefix must be composed exactly right for this to work."""
+        path = tmp_path / "fake-podman"
+        path.write_text(
+            "#!/bin/sh\n"
+            "while [ $# -gt 0 ]; do\n"
+            "  a=\"$1\"; shift\n"
+            "  if [ \"$a\" = \"raytpu-test-img\" ]; then exec \"$@\"; fi\n"
+            "done\n"
+            "exit 64\n")
+        path.chmod(0o755)
+        return str(path)
+
+    def test_containerized_worker_end_to_end(self, fake_engine):
+        """Cluster task with a container runtime env: the worker spawns
+        through the engine prefix, registers, runs the task with the
+        containerized marker set, and the pool reuses it per-image."""
+        from raytpu.cluster.cluster_utils import Cluster
+
+        cluster = Cluster()
+        cluster.add_node(num_cpus=1, num_tpus=0)
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote
+            def probe():
+                return (os.environ.get("RAYTPU_CONTAINERIZED"),
+                        os.getpid())
+
+            renv = {"container": {"image": "raytpu-test-img",
+                                  "engine": fake_engine}}
+            mark1, pid1 = raytpu.get(
+                probe.options(runtime_env=renv).remote())
+            mark2, pid2 = raytpu.get(
+                probe.options(runtime_env=renv).remote())
+            assert mark1 == "1" and mark2 == "1"
+            assert pid1 == pid2  # same image -> worker reused
+            # a no-env task must NOT land on the containerized worker
+            mark3, pid3 = raytpu.get(probe.remote())
+            assert mark3 is None and pid3 != pid1
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+    def test_missing_engine_fails_task_not_cluster(self):
+        """container env naming a dead engine: the task fails with a
+        clear error; the node and other tasks keep working."""
+        from raytpu.cluster.cluster_utils import Cluster
+
+        cluster = Cluster()
+        cluster.add_node(num_cpus=1, num_tpus=0)
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote
+            def f():
+                return 7
+
+            bad = {"container": {"image": "img",
+                                 "engine": "/no/such/podman"}}
+            with pytest.raises(Exception, match="not found"):
+                raytpu.get(f.options(runtime_env=bad).remote())
+            assert raytpu.get(f.remote()) == 7  # fabric still healthy
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
