@@ -3,19 +3,30 @@ reference's headline Train claim.
 
 Reference bar: ``doc/source/train/benchmarks.rst:55-84`` — Ray Train is
 within ~2.5% of NATIVE torch DDP on the same workload (the framework's
-orchestration adds almost nothing on top of the training computation).
-The honest analogue here: the SAME jitted MLP train loop (fashion-MNIST
-shape: 784 -> 128 -> 10, batch 128) run (a) bare — plain jax loop in
-this process — and (b) under ``JaxTrainer`` with one gang worker, so the
-delta is exactly our fabric's overhead (gang setup amortized out by
-measuring steady-state epoch time inside the loop, reported via
-``train.report``).
+orchestration adds almost nothing on top of the training computation;
+the published setup is a 16-worker gang). The honest analogue here: the
+SAME jitted MLP train loop (fashion-MNIST shape: 784 -> 128 -> 10,
+batch 128) run
+
+(a) bare — N plain processes, compile, meet at a barrier, run the loop
+    (N-way CPU contention included: that is what a gang on this box
+    costs with NO framework in the path), vs
+(b) fabric — an N-worker ``JaxTrainer`` gang running the identical loop
+    with per-epoch ``train.report`` live (the long-poll reporting path
+    under concurrent load) and the gang time taken as the SLOWEST rank
+    (max-allreduce over the host-plane collective), matching how a
+    synchronous data-parallel epoch is actually paced.
+
+Both sides fetch the loss to host at every epoch boundary, and both
+sides gate the timed region on a barrier after compile, so the delta is
+exactly our fabric's orchestration overhead.
 
 Prints one JSON line:
   {"metric": "train_orchestration_overhead_pct", "value": ...,
    "vs_baseline": <value / 2.5>}   (vs_baseline <= 1.0 meets the bar)
 
-Env: RAYTPU_TRAIN_BENCH_STEPS (default 5000), _WORKERS (default 1).
+Env: RAYTPU_TRAIN_BENCH_STEPS (default 5000), _WORKERS (default 2),
+_EPOCHS (default 10), _REPEATS (best-of, default 2).
 """
 
 from __future__ import annotations
@@ -30,8 +41,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REFERENCE_OVERHEAD_PCT = 2.5  # benchmarks.rst parity bar
 
 STEPS = int(os.environ.get("RAYTPU_TRAIN_BENCH_STEPS", 5000))
-WORKERS = int(os.environ.get("RAYTPU_TRAIN_BENCH_WORKERS", 1))
+WORKERS = int(os.environ.get("RAYTPU_TRAIN_BENCH_WORKERS", 2))
+EPOCHS = int(os.environ.get("RAYTPU_TRAIN_BENCH_EPOCHS", 10))
+REPEATS = int(os.environ.get("RAYTPU_TRAIN_BENCH_REPEATS", 2))
 BATCH, IN_DIM, HIDDEN, OUT_DIM = 128, 784, 128, 10
+
+_GROUP = "train-overhead-bench"
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 
 def _make_step():
@@ -66,10 +91,13 @@ def _make_step():
     return init, opt, step
 
 
-def _timed_loop(report=None) -> float:
-    """Steady-state seconds for STEPS steps of the fixed workload."""
+def _timed_loop(report_fn=None, epochs: int = 1, start_gate=None) -> float:
+    """Steady-state seconds for STEPS steps of the fixed workload.
+
+    The loss is fetched to host at every epoch boundary on BOTH sides of
+    the comparison (native loops log per epoch too); only ``report_fn``
+    — the fabric's reporting path — differs between the two."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     init, opt, step = _make_step()
@@ -80,19 +108,90 @@ def _timed_loop(report=None) -> float:
     y = jax.random.randint(key, (BATCH,), 0, OUT_DIM)
     params, opt_state, loss = step(params, opt_state, x, y)  # compile
     float(np.asarray(loss))
+    if start_gate is not None:
+        start_gate()
+    steps_per_epoch = max(1, STEPS // epochs)
     t0 = time.perf_counter()
-    for _ in range(STEPS):
-        params, opt_state, loss = step(params, opt_state, x, y)
-    float(np.asarray(loss))  # host fetch closes the timed region
+    for e in range(epochs):
+        for _ in range(steps_per_epoch):
+            params, opt_state, loss = step(params, opt_state, x, y)
+        loss_host = float(np.asarray(loss))  # epoch-boundary host fetch
+        if report_fn is not None:
+            report_fn({"epoch": e, "loss": loss_host})
     return time.perf_counter() - t0
 
 
-def _trainer_loop(config):
-    from raytpu.train import report
+# -- (a) bare gang: N processes, no framework ----------------------------
 
-    # Best-of-two, matching the bare measurement: run-to-run noise on a
-    # shared 1-vCPU box exceeds the effect being measured otherwise.
-    best = min(_timed_loop(), _timed_loop())
+def _bare_child(barrier, q, epochs, repeats):
+    _force_cpu()
+    best = min(_timed_loop(epochs=epochs, start_gate=barrier.wait)
+               for _ in range(repeats))
+    q.put(best)
+
+
+def _bare_gang_seconds(workers: int) -> float:
+    if workers == 1:
+        return min(_timed_loop(epochs=EPOCHS) for _ in range(REPEATS))
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    barrier = ctx.Barrier(workers)
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_bare_child,
+                         args=(barrier, q, EPOCHS, REPEATS))
+             for _ in range(workers)]
+    for p in procs:
+        p.start()
+    times = []
+    try:
+        import queue as _queue
+
+        deadline = time.monotonic() + 600
+        while len(times) < workers:
+            try:
+                times.append(q.get(timeout=5))
+            except _queue.Empty:
+                # A dead child can never report, and its siblings are
+                # stuck at the barrier forever — fail fast, not in 10min.
+                dead = [p for p in procs if not p.is_alive()
+                        and p.exitcode not in (0, None)]
+                if dead:
+                    raise RuntimeError(
+                        f"bare-gang child died (exitcode "
+                        f"{dead[0].exitcode}) before reporting")
+                if time.monotonic() > deadline:
+                    raise RuntimeError("bare gang timed out")
+    finally:
+        for p in procs:
+            if len(times) < workers:
+                p.terminate()  # never orphan barrier-stuck children
+            p.join(timeout=60)
+    # A synchronous gang's epoch is paced by its slowest member.
+    return max(times)
+
+
+# -- (b) fabric gang: JaxTrainer with live reporting ---------------------
+
+def _trainer_loop(config):
+    import numpy as np
+
+    from raytpu import collective as col
+    from raytpu.train import get_context, report
+
+    ctx = get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    gate = None
+    if world > 1:
+        col.init_collective_group(world, rank, group_name=_GROUP)
+        gate = lambda: col.barrier(_GROUP)  # noqa: E731
+    best = min(
+        _timed_loop(report_fn=report, epochs=config["epochs"],
+                    start_gate=gate)
+        for _ in range(config["repeats"]))
+    if world > 1:
+        best = float(col.allreduce(np.array([best]), group_name=_GROUP,
+                                   op=col.ReduceOp.MAX)[0])
     report({"train_seconds": best})
 
 
@@ -100,15 +199,9 @@ def main() -> None:
     # Host-plane orchestration measurement: force CPU OUTRIGHT (not
     # setdefault — the deployment env pins JAX_PLATFORMS=axon, and gang
     # worker subprocesses inherit it; they'd block on TPU init).
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    _force_cpu()
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
-
-    bare_s = min(_timed_loop(), _timed_loop())  # best of two: less noise
+    bare_s = _bare_gang_seconds(WORKERS)
 
     import raytpu
     from raytpu.train import JaxTrainer, RunConfig, ScalingConfig
@@ -116,6 +209,7 @@ def main() -> None:
     raytpu.init(num_cpus=max(2, WORKERS + 1), ignore_reinit_error=True)
     result = JaxTrainer(
         _trainer_loop,
+        train_loop_config={"epochs": EPOCHS, "repeats": REPEATS},
         scaling_config=ScalingConfig(num_workers=WORKERS),
         run_config=RunConfig(storage_path="/tmp/raytpu_train_bench"),
     ).fit()
@@ -130,15 +224,17 @@ def main() -> None:
     print(json.dumps({
         "metric": "train_orchestration_overhead_pct",
         "value": round(overhead_pct, 2),
-        "unit": "% vs bare jax loop (same jitted steps)",
+        "unit": "% vs bare jax gang (same jitted steps, same contention)",
         "vs_baseline": round(overhead_pct / REFERENCE_OVERHEAD_PCT, 3),
         "detail": {"bare_s": round(bare_s, 3),
                    "fabric_s": round(fab_s, 3),
-                   "steps": STEPS, "workers": WORKERS,
+                   "steps": STEPS, "epochs": EPOCHS,
+                   "workers": WORKERS, "best_of": REPEATS,
                    "reference_bar_pct": REFERENCE_OVERHEAD_PCT,
-                   "note": "steady-state step time measured INSIDE the "
-                           "worker loop; gang spawn/rendezvous excluded "
-                           "(the reference bar also excludes setup, "
+                   "note": "gang time = slowest rank (max-allreduce); "
+                           "per-epoch train.report live on every rank; "
+                           "gang spawn/rendezvous excluded (the "
+                           "reference bar also excludes setup, "
                            "benchmarks.rst:58-60)"},
     }))
 

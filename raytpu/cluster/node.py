@@ -514,6 +514,7 @@ class NodeServer:
         h("node_info", self._h_node_info)
         h("debug_state", self._h_debug_state)
         h("worker_stacks", self._h_worker_stacks)
+        h("worker_profile", self._h_worker_profile)
         h("ping", lambda peer: "pong")
         # Worker-process plane
         h("register_worker", self._h_register_worker)
@@ -1636,6 +1637,62 @@ class NodeServer:
             except Exception as e:
                 out[wid] = {"pid": h.pid,
                             "error": f"{type(e).__name__}: {e}"}
+        return out
+
+    async def _h_worker_profile(self, peer: Peer,
+                                worker_id: Optional[str] = None,
+                                duration_s: float = 2.0,
+                                hz: float = 50.0,
+                                include_idle: bool = True
+                                ) -> Dict[str, dict]:
+        """Sampling CPU profiles of workers on this node (reference:
+        profile_manager.py py-spy flamegraphs). All targeted workers are
+        sampled CONCURRENTLY (one duration_s total, not per worker);
+        ``worker_id`` narrows to one worker, ``"daemon"`` samples the
+        node daemon itself."""
+        import asyncio as _asyncio
+        from concurrent.futures import ThreadPoolExecutor
+
+        from raytpu.util.profiler import sample_for
+
+        loop = _asyncio.get_event_loop()
+        out: Dict[str, dict] = {}
+        jobs = []
+        if worker_id in (None, "daemon"):
+            jobs.append(("daemon", lambda: {
+                "pid": os.getpid(),
+                "profile": sample_for(duration_s, hz, include_idle)}))
+        if worker_id != "daemon" and self.worker_pool is not None:
+            with self.worker_pool._lock:
+                handles = {wid: h for wid, h
+                           in self.worker_pool._workers.items()
+                           if worker_id is None
+                           or wid.startswith(worker_id)}
+            for wid, h in handles.items():
+                client = getattr(h, "client", None)
+                if client is None or client.closed:
+                    out[wid] = {"pid": getattr(h, "pid", None),
+                                "error": "worker not connected"}
+                    continue
+
+                def one(h=h, client=client):
+                    return {"pid": h.pid,
+                            "profile": client.call(
+                                "profile", duration_s, hz, include_idle,
+                                timeout=duration_s + 30.0)}
+                jobs.append((wid, one))
+        if jobs:
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(jobs)),
+                    thread_name_prefix="raytpu-profile") as ex:
+                futs = {wid: loop.run_in_executor(ex, fn)
+                        for wid, fn in jobs}
+                for wid, fut in futs.items():
+                    try:
+                        out[wid] = await fut
+                    except Exception as e:
+                        out[wid] = {"error":
+                                    f"{type(e).__name__}: {e}"}
         return out
 
     def _h_node_info(self, peer: Peer) -> dict:

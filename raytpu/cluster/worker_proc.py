@@ -518,6 +518,16 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     # Live profiling (reference: dashboard reporter's py-spy dump): the
     # RPC loop thread serves this even while task threads are busy.
     server.register("stack", h_stack)
+
+    def h_profile(peer: Peer, duration_s: float = 2.0, hz: float = 50.0,
+                  include_idle: bool = True):
+        from raytpu.util.profiler import sample_for
+
+        # Offloaded: the sampler blocks for duration_s and must not
+        # stall the RPC loop (py-spy analogue: profile_manager.py:79).
+        return _offload(sample_for, duration_s, hz, include_idle)
+
+    server.register("profile", h_profile)
     addr = server.start()
     host.node.call("register_worker", args.worker_id, addr, os.getpid())
 

@@ -160,6 +160,19 @@ class DashboardServer:
         return collect_cluster_stacks(self._worker_nodes(), worker=worker,
                                       node_filter=node_filter)
 
+    def _collect_profile(self, worker: Optional[str],
+                         node_filter: Optional[str],
+                         duration_s: float, hz: float,
+                         include_idle: bool) -> Dict[str, Any]:
+        """Concurrent cluster-wide sampling profile (one duration_s
+        total: every node samples its workers in parallel)."""
+        from raytpu.util.stack_dump import fanout_node_call
+
+        return fanout_node_call(
+            self._worker_nodes(), "worker_profile", worker, duration_s,
+            hz, include_idle, node_filter=node_filter,
+            timeout=duration_s + 60.0)
+
     def _worker_nodes(self):
         import raytpu
 
@@ -317,6 +330,54 @@ class DashboardServer:
                 None, self._collect_stacks, worker, node_filter)
             return web.json_response(result)
 
+        async def profile(request):
+            """On-demand CPU flamegraph of live workers (reference:
+            profile_manager.py py-spy endpoint). Query params:
+            ?worker=<id prefix|daemon>, ?node=<id prefix>,
+            ?duration=<s, default 2>, ?hz=<default 50>,
+            ?idle=1 (keep parked threads), ?format=svg|json|collapsed.
+            """
+            from raytpu.util.profiler import (merge_collapsed,
+                                              flamegraph_svg,
+                                              to_collapsed_text)
+
+            loop = asyncio.get_running_loop()
+            worker = request.query.get("worker") or None
+            node_filter = request.query.get("node") or None
+            try:
+                duration = float(request.query.get("duration", 2.0))
+                hz = float(request.query.get("hz", 50.0))
+            except ValueError:
+                return web.Response(status=400,
+                                    text="duration/hz must be numbers")
+            include_idle = request.query.get("idle", "0") == "1"
+            fmt = request.query.get("format", "svg")
+            result = await loop.run_in_executor(
+                None, self._collect_profile, worker, node_filter,
+                duration, hz, include_idle)
+            if fmt == "json":
+                return web.json_response(result)
+            merged = merge_collapsed(
+                w.get("profile", {}).get("collapsed", {})
+                for node in result.values() if isinstance(node, dict)
+                for w in node.values() if isinstance(w, dict))
+            if fmt == "collapsed":
+                return web.Response(
+                    text=to_collapsed_text(merged),
+                    content_type="text/plain",
+                    headers={"Content-Disposition":
+                             "attachment; filename=profile.collapsed"})
+            n_workers = sum(
+                1 for node in result.values() if isinstance(node, dict)
+                for w in node.values()
+                if isinstance(w, dict) and "profile" in w)
+            svg = flamegraph_svg(
+                merged, title=f"{n_workers} process(es), {duration:g}s "
+                              f"@ {hz:g} Hz"
+                              + (" (idle included)" if include_idle
+                                 else ""))
+            return web.Response(text=svg, content_type="image/svg+xml")
+
         app = web.Application()
         app.router.add_get("/", index)
         app.router.add_get("/api/summary", api_summary)
@@ -324,6 +385,7 @@ class DashboardServer:
         app.router.add_get("/timeline", timeline)
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/stacks", stacks)
+        app.router.add_get("/profile", profile)
         app.router.add_get("/logs", logs_index)
         app.router.add_get("/logs/{node_id}/{name}", log_file)
         self._runner = web.AppRunner(app, access_log=None)

@@ -157,20 +157,27 @@ def _cmd_events(args) -> int:
     return 0
 
 
-def _cmd_stack(args) -> int:
-    """Dump live thread stacks of every worker on every (matching) node
-    (reference: ``ray stack`` + the dashboard's py-spy profiling)."""
+def _cluster_worker_nodes(address: str):
+    """Live non-driver nodes from the head: ``[(node_id, addr), ...]``
+    (shared by every fan-out command so they always agree on targets)."""
     from raytpu.cluster.protocol import RpcClient
-    from raytpu.util.stack_dump import collect_cluster_stacks
 
-    head = RpcClient(args.address)
+    head = RpcClient(address)
     try:
         nodes = head.call("list_nodes")
     finally:
         head.close()
-    targets = [(n["node_id"], n["address"]) for n in nodes
-               if n.get("alive") and n["labels"].get("role") != "driver"]
-    results = collect_cluster_stacks(targets, worker=args.worker,
+    return [(n["node_id"], n["address"]) for n in nodes
+            if n.get("alive") and n["labels"].get("role") != "driver"]
+
+
+def _cmd_stack(args) -> int:
+    """Dump live thread stacks of every worker on every (matching) node
+    (reference: ``ray stack`` + the dashboard's py-spy profiling)."""
+    from raytpu.util.stack_dump import collect_cluster_stacks
+
+    results = collect_cluster_stacks(_cluster_worker_nodes(args.address),
+                                     worker=args.worker,
                                      node_filter=args.node)
     shown = 0
     for node_id, stacks in results.items():
@@ -186,6 +193,54 @@ def _cmd_stack(args) -> int:
     if not shown:
         print("no matching live workers")
         return 1
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    """Sample CPU profiles of live workers and write a flamegraph SVG
+    (reference: ``ray``'s dashboard py-spy flamegraphs;
+    profile_manager.py:79)."""
+    from raytpu.util.profiler import (flamegraph_svg, merge_collapsed,
+                                      to_collapsed_text)
+    from raytpu.util.stack_dump import fanout_node_call
+
+    results = fanout_node_call(
+        _cluster_worker_nodes(args.address), "worker_profile",
+        args.worker, args.duration, args.hz, args.idle,
+        node_filter=args.node, timeout=args.duration + 60.0)
+    profiles = []
+    for node_id, workers in results.items():
+        if set(workers) == {"error"}:
+            print(f"== node {node_id[:12]}: unreachable: "
+                  f"{workers['error']}", file=sys.stderr)
+            continue
+        for wid, info in workers.items():
+            if "profile" in info:
+                p = info["profile"]
+                profiles.append(p["collapsed"])
+                print(f"node {node_id[:12]} {wid[:12]} pid="
+                      f"{info.get('pid')}: {p['samples']} samples",
+                      file=sys.stderr)
+            else:
+                print(f"node {node_id[:12]} {wid[:12]}: "
+                      f"error: {info.get('error')}", file=sys.stderr)
+    if not profiles:
+        print("no profiles collected", file=sys.stderr)
+        return 1
+    merged = merge_collapsed(profiles)
+    if args.out.endswith(".collapsed") or args.out == "-":
+        text = to_collapsed_text(merged)
+        if args.out == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.out, "w") as f:
+                f.write(text)
+    else:
+        with open(args.out, "w") as f:
+            f.write(flamegraph_svg(
+                merged, title=f"{len(profiles)} process(es), "
+                              f"{args.duration:g}s @ {args.hz:g} Hz"))
+        print(f"wrote {args.out}", file=sys.stderr)
     return 0
 
 
@@ -315,6 +370,21 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("worker", nargs="?", default=None,
                    help="worker id prefix, 'daemon', or empty for all")
     s.set_defaults(fn=_cmd_stack)
+
+    s = sub.add_parser(
+        "profile", help="sampling CPU profile of cluster workers -> "
+                        "flamegraph SVG (reference: dashboard py-spy)")
+    s.add_argument("--address", required=True, help="head host:port")
+    s.add_argument("--node", default=None, help="node id prefix filter")
+    s.add_argument("--duration", type=float, default=2.0)
+    s.add_argument("--hz", type=float, default=50.0)
+    s.add_argument("--idle", action="store_true",
+                   help="keep parked threads in the profile")
+    s.add_argument("--out", default="profile.svg",
+                   help="output path (.svg, .collapsed, or '-')")
+    s.add_argument("worker", nargs="?", default=None,
+                   help="worker id prefix, 'daemon', or empty for all")
+    s.set_defaults(fn=_cmd_profile)
 
     s = sub.add_parser("proxy", help="remote-driver proxy (raytpu://)")
     s.add_argument("--head", required=True, help="head host:port")

@@ -60,7 +60,20 @@ def prepare_data_loader(data_loader):
     if data_loader.batch_size is None:
         _warn_unsharded("a batch_sampler loader (batch_size is None)")
         return data_loader
-    shuffle = isinstance(data_loader.sampler, RandomSampler)
+    from torch.utils.data import SequentialSampler
+
+    old_sampler = data_loader.sampler
+    shuffle = isinstance(old_sampler, RandomSampler)
+    if not shuffle and not isinstance(old_sampler, SequentialSampler):
+        import warnings
+
+        warnings.warn(
+            f"prepare_data_loader: replacing custom sampler "
+            f"{type(old_sampler).__name__} with an unshuffled "
+            f"DistributedSampler — its sampling semantics (weighting, "
+            f"ordering) are LOST. Apply the custom logic inside the "
+            f"dataset, or shard manually by rank.", UserWarning,
+            stacklevel=2)
     sampler = DistributedSampler(ds, num_replicas=dist.get_world_size(),
                                  rank=dist.get_rank(), shuffle=shuffle)
     num_workers = getattr(data_loader, "num_workers", 0)

@@ -1,0 +1,191 @@
+"""Sampling CPU profiler + flamegraph rendering.
+
+Reference analogue: ``dashboard/modules/reporter/profile_manager.py`` —
+the reference shells out to py-spy for on-demand CPU flamegraphs of any
+live worker. py-spy isn't shippable in a zero-egress image, so the
+equivalent capability is in-process: a background thread samples
+``sys._current_frames()`` at a fixed rate for a bounded duration and
+aggregates the samples into collapsed stacks (Brendan Gregg's
+``root;child;leaf count`` format — exactly what flamegraph tooling
+consumes). Every worker serves this over its ``profile`` RPC; the node
+fans out; the dashboard renders the merged result as a self-contained
+SVG flamegraph.
+
+What in-process sampling cannot see (and py-spy can): native code that
+holds the GIL without returning to the interpreter. Everything
+Python-visible — including time *waiting* on locks/IO — is captured;
+idle-looking leaf frames can be filtered with ``include_idle=False``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import html
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Leaf code names that mean "this thread is parked, not burning CPU" —
+# a heuristic (py-spy uses native-state instead), documented as such.
+_IDLE_LEAVES = {
+    "wait", "acquire", "select", "poll", "epoll", "accept", "recv",
+    "recv_into", "read", "readline", "sleep", "get", "join",
+    "_wait_for_tstate_lock", "wait_for", "run_forever", "_run_once",
+    "select_poll", "flowcontrol",
+}
+
+
+def sample_for(duration_s: float = 2.0, hz: float = 50.0,
+               include_idle: bool = True) -> dict:
+    """Sample this process's Python stacks for ``duration_s``.
+
+    Returns ``{"collapsed": {stack: count}, "samples": N,
+    "duration_s": ..., "hz": ..., "pid": ...}`` where each ``stack`` is
+    ``thread-name;outermost (file:line);...;leaf (file:line)``.
+    """
+    duration_s = max(0.05, min(float(duration_s), 120.0))
+    hz = max(1.0, min(float(hz), 500.0))
+    interval = 1.0 / hz
+    collapsed: Dict[str, int] = {}
+    samples = 0
+    me = threading.get_ident()
+    deadline = time.monotonic() + duration_s
+    while True:
+        now = time.monotonic()
+        if now >= deadline:
+            break
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in frames.items():
+            if tid == me:
+                continue  # never profile the profiler
+            stack: List[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({os.path.basename(code.co_filename)}:"
+                             f"{f.f_lineno})")
+                f = f.f_back
+            if not stack:
+                continue
+            if not include_idle:
+                leaf_name = frame.f_code.co_name
+                if leaf_name in _IDLE_LEAVES:
+                    continue
+            stack.reverse()  # root first
+            key = ";".join([names.get(tid, f"thread-{tid}")] + stack)
+            collapsed[key] = collapsed.get(key, 0) + 1
+        samples += 1
+        # Fixed-rate pacing; sampling cost eats into the sleep.
+        time.sleep(max(0.0, interval - (time.monotonic() - now)))
+    return {"collapsed": collapsed, "samples": samples,
+            "duration_s": duration_s, "hz": hz, "pid": os.getpid()}
+
+
+def merge_collapsed(profiles) -> Dict[str, int]:
+    """Merge several ``collapsed`` dicts (e.g. one per worker)."""
+    out: Dict[str, int] = {}
+    for p in profiles:
+        for k, v in (p or {}).items():
+            out[k] = out.get(k, 0) + int(v)
+    return out
+
+
+def to_collapsed_text(collapsed: Dict[str, int]) -> str:
+    """The canonical one-line-per-stack text flamegraph.pl consumes."""
+    return "\n".join(f"{k} {v}" for k, v in
+                     sorted(collapsed.items())) + "\n"
+
+
+# -- flamegraph rendering ------------------------------------------------
+
+class _Node:
+    __slots__ = ("name", "value", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self.children: Dict[str, "_Node"] = {}
+
+
+def _build_tree(collapsed: Dict[str, int]) -> _Node:
+    root = _Node("all")
+    for stack, count in collapsed.items():
+        root.value += count
+        node = root
+        for part in stack.split(";"):
+            child = node.children.get(part)
+            if child is None:
+                child = node.children[part] = _Node(part)
+            child.value += count
+            node = child
+    return root
+
+
+def _color(name: str) -> str:
+    """Deterministic warm color per frame (classic flamegraph look)."""
+    h = hashlib.md5(name.encode()).digest()
+    r = 205 + h[0] % 50
+    g = 60 + h[1] % 130
+    b = h[2] % 55
+    return f"rgb({r},{g},{b})"
+
+
+def flamegraph_svg(collapsed: Dict[str, int],
+                   title: str = "CPU flamegraph",
+                   width: int = 1200) -> str:
+    """Self-contained SVG flamegraph (no JS required; hover shows the
+    frame + sample share via native ``<title>`` tooltips)."""
+    root = _build_tree(collapsed)
+    row_h = 17
+    min_w = 0.5  # px; narrower frames are dropped (invisible anyway)
+    rects: List[str] = []
+    max_depth = 0
+
+    def layout(node: _Node, x: float, depth: int, scale: float):
+        nonlocal max_depth
+        max_depth = max(max_depth, depth)
+        cx = x
+        for name, child in sorted(node.children.items(),
+                                  key=lambda kv: -kv[1].value):
+            w = child.value * scale
+            if w < min_w:
+                cx += w
+                continue
+            y = depth * row_h
+            pct = 100.0 * child.value / max(1, root.value)
+            label = html.escape(name)
+            rects.append(
+                f'<g><title>{label} — {child.value} samples '
+                f'({pct:.1f}%)</title>'
+                f'<rect x="{cx:.2f}" y="{y}" width="{w:.2f}" '
+                f'height="{row_h - 1}" fill="{_color(name)}" rx="1"/>'
+                + (f'<text x="{cx + 3:.2f}" y="{y + 12}" '
+                   f'font-size="11" font-family="monospace" '
+                   f'clip-path="inset(0)">'
+                   f'{label[:max(1, int(w / 7))]}</text>'
+                   if w > 25 else "")
+                + "</g>")
+            layout(child, cx, depth + 1, scale)
+            cx += w
+    if root.value > 0:
+        layout(root, 0.0, 0, width / root.value)
+    height = (max_depth + 2) * row_h + 30
+    header = (f'<text x="4" y="16" font-size="13" '
+              f'font-family="sans-serif">{html.escape(title)} — '
+              f'{root.value} samples</text>')
+    body = "".join(f'<g transform="translate(0,24)">{r}</g>'
+                   for r in rects)
+    return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+            f'height="{height}" viewBox="0 0 {width} {height}" '
+            f'style="background:#fff">{header}{body}</svg>')
+
+
+def profile_to_svg(profile: dict, title: Optional[str] = None) -> str:
+    return flamegraph_svg(profile.get("collapsed", {}),
+                          title or f"pid {profile.get('pid', '?')}, "
+                                   f"{profile.get('samples', 0)} samples "
+                                   f"@ {profile.get('hz', 0):g} Hz")

@@ -318,6 +318,21 @@ class TestPrepareDataLoader:
         assert out.num_workers == 0
         assert not out.sampler.shuffle  # eval loader stays ordered
 
+    def test_custom_sampler_replacement_warns(self, world2):
+        import torch
+        from torch.utils.data import (DataLoader, TensorDataset,
+                                      WeightedRandomSampler)
+
+        from raytpu.train.torch_trainer import prepare_data_loader
+
+        ds = TensorDataset(torch.arange(8).float())
+        loader = DataLoader(
+            ds, batch_size=2,
+            sampler=WeightedRandomSampler([1.0] * 8, 8))
+        with pytest.warns(UserWarning, match="WeightedRandomSampler"):
+            out = prepare_data_loader(loader)
+        assert out.sampler.num_replicas == 2  # still sharded
+
     def test_iterable_dataset_warns_and_passes_through(self, world2):
         import torch
         from torch.utils.data import DataLoader, IterableDataset
