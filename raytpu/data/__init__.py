@@ -20,6 +20,7 @@ from raytpu.data.read_api import (
     read_numpy,
     read_parquet,
     read_sql,
+    read_tfrecords,
     read_text,
     read_webdataset,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "read_numpy",
     "read_parquet",
     "read_sql",
+    "read_tfrecords",
     "read_text",
     "read_webdataset",
 ]

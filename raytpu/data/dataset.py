@@ -685,6 +685,23 @@ class Dataset:
             arr = BlockAccessor(block).to_numpy()[column]
             np.save(f"{path}/part-{i:05d}.npy", arr)
 
+    def write_tfrecords(self, path: str) -> None:
+        """One ``.tfrecord`` shard per block, rows encoded as
+        ``tf.train.Example`` protos (reference:
+        ``Dataset.write_tfrecords``; codec in
+        :mod:`raytpu.data.tfrecord` — interoperable with TensorFlow's
+        TFRecordWriter framing)."""
+        import os
+
+        from raytpu.data.tfrecord import encode_example, write_records
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            rows = BlockAccessor(block).to_rows()
+            write_records(
+                f"{path}/part-{i:05d}.tfrecord",
+                [encode_example(r) for r in rows])
+
     # -- internals ------------------------------------------------------------
 
     def _with_op(self, op: OpSpec) -> "Dataset":

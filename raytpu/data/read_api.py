@@ -299,31 +299,119 @@ def from_jax(arrays, *, blocks: int = 1) -> Dataset:
     return from_numpy(host, blocks=blocks)
 
 
-def read_sql(sql: str, connection_factory, *, blocks: int = 1) -> Dataset:
-    """Rows of a SQL query as a Dataset (reference: SQL datasource).
+def read_sql(sql: str, connection_factory, *, blocks: int = 1,
+             partition_column: Optional[str] = None,
+             num_partitions: Optional[int] = None,
+             lower_bound=None, upper_bound=None) -> Dataset:
+    """Rows of a SQL query as a Dataset (reference: SQL datasource,
+    ``python/ray/data/datasource/sql_datasource.py``).
 
     ``connection_factory`` is a zero-arg callable returning a DBAPI
     connection (e.g. ``lambda: sqlite3.connect(path)``) — it runs inside
     the read task, so the connection itself never serializes.
-    """
 
-    @raytpu.remote(name="data::read_sql")
-    def read_all():
+    **Partitioned reads**: with ``partition_column`` +
+    ``num_partitions``, the query runs as N PARALLEL read tasks, each
+    executing a range-predicate sub-query
+
+        ``SELECT * FROM (<sql>) WHERE col >= lo AND col < hi``
+
+    (JDBC/Spark-style pushdown: each partition moves only its own rows).
+    ``lower_bound``/``upper_bound`` set the partition STRIDE only — they
+    never filter: the first partition's lower and the last partition's
+    upper predicate are open-ended (Spark JDBC semantics), and NULL
+    partition-column rows ride the last partition's ``IS NULL`` arm,
+    so every row lands in exactly one partition. When bounds are
+    omitted a MIN/MAX pre-query derives them; the column must be
+    numeric-ish.
+    """
+    if partition_column is None:
+        @raytpu.remote(name="data::read_sql")
+        def read_all():
+            conn = connection_factory()
+            try:
+                # DB-API 2.0 (conn.execute is sqlite-only)
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+            finally:
+                conn.close()
+            return block_from_rows(rows)
+
+        def source():
+            yield read_all.remote()
+
+        ds = Dataset(source, [], name="read_sql")
+        return ds.repartition(blocks) if blocks > 1 else ds
+
+    n = int(num_partitions or blocks or 1)
+    if n < 1:
+        raise ValueError("num_partitions must be >= 1")
+    col = str(partition_column)
+
+    if lower_bound is None or upper_bound is None:
         conn = connection_factory()
         try:
-            cur = conn.cursor()  # DB-API 2.0 (conn.execute is sqlite-only)
-            cur.execute(sql)
+            cur = conn.cursor()
+            cur.execute(f"SELECT MIN({col}), MAX({col}) "  # noqa: S608
+                        f"FROM ({sql}) AS raytpu_bounds")
+            lo_db, hi_db = cur.fetchone()
+        finally:
+            conn.close()
+        if lo_db is None:
+            # Empty result set OR every row has a NULL partition column:
+            # a single unpartitioned read covers both correctly.
+            return read_sql(sql, connection_factory, blocks=1)
+        lower_bound = lo_db if lower_bound is None else lower_bound
+        upper_bound = hi_db if upper_bound is None else upper_bound
+
+    @raytpu.remote(name="data::read_sql_partition")
+    def read_partition(lo, hi, first: bool, last: bool):
+        # JDBC/Spark semantics: bounds set the STRIDE, they never
+        # filter — the first partition's lower and the last partition's
+        # upper predicate are open-ended, and the last also adopts
+        # NULL-column rows, so every row lands in exactly one partition.
+        clauses, params = [], []
+        if not first:
+            clauses.append(f"{col} >= ?")
+            params.append(lo)
+        if not last:
+            clauses.append(f"{col} < ?")
+            params.append(hi)
+        pred = " AND ".join(clauses) if clauses else "1=1"
+        if last:
+            pred = f"({pred}) OR {col} IS NULL"
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(f"SELECT * FROM ({sql}) AS raytpu_part "  # noqa: S608
+                        f"WHERE {pred}", params)
             cols = [d[0] for d in cur.description]
             rows = [dict(zip(cols, r)) for r in cur.fetchall()]
         finally:
             conn.close()
         return block_from_rows(rows)
 
-    def source():
-        yield read_all.remote()
+    integral = isinstance(lower_bound, int) and isinstance(upper_bound, int)
 
-    ds = Dataset(source, [], name="read_sql")
-    return ds.repartition(blocks) if blocks > 1 else ds
+    def _boundary(i: int):
+        # Integer bounds use pure integer arithmetic: float strides lose
+        # precision past 2**53 (e.g. snowflake ids) and would misplace
+        # boundary rows between partitions.
+        if integral:
+            return lower_bound + (upper_bound - lower_bound) * i // n
+        lo_f, hi_f = float(lower_bound), float(upper_bound)
+        return lo_f + (hi_f - lo_f) * i / n
+
+    def source():
+        import builtins
+
+        for i in builtins.range(n):
+            yield read_partition.remote(_boundary(i), _boundary(i + 1),
+                                        i == 0, i == n - 1)
+
+    return Dataset(source, [], name="read_sql")
 
 
 def read_images(paths, *, size=None, mode: str = "RGB",
@@ -396,3 +484,27 @@ def read_webdataset(paths) -> Dataset:
             yield read_shard.remote(f)
 
     return Dataset(source, [], name="read_webdataset")
+
+
+def read_tfrecords(paths, *, raw: bool = False) -> Dataset:
+    """TFRecord files of ``tf.train.Example`` protos as a Dataset, one
+    block per file read in parallel (reference: tfrecords datasource;
+    codec notes in :mod:`raytpu.data.tfrecord`). ``raw=True`` skips the
+    Example parse and yields one ``{"data": bytes}`` row per record."""
+    files = _expand_paths(paths, ".tfrecord")
+
+    @raytpu.remote(name="data::read_tfrecords")
+    def read_file(path):
+        from raytpu.data.tfrecord import decode_example, read_records
+
+        if raw:
+            rows = [{"data": rec} for rec in read_records(path)]
+        else:
+            rows = [decode_example(rec) for rec in read_records(path)]
+        return block_from_rows(rows)
+
+    def source():
+        for f in files:
+            yield read_file.remote(f)
+
+    return Dataset(source, [], name="read_tfrecords")
