@@ -10,17 +10,26 @@ from raytpu.autoscaler.autoscaler import (
     ResourceDemand,
     StandardAutoscaler,
 )
+from raytpu.autoscaler.launcher import (
+    cluster_down,
+    cluster_up,
+    load_cluster_spec,
+    load_cluster_state,
+)
 from raytpu.autoscaler.node_provider import (
     FakeSliceProvider,
     GceTpuSliceProvider,
+    K8sSliceProvider,
     NodeGroup,
     NodeGroupSpec,
     NodeProvider,
 )
+from raytpu.autoscaler.sdk import request_resources
 
 __all__ = [
     "AutoscalerConfig", "AutoscalerMonitor", "FakeSliceProvider",
-    "GceTpuSliceProvider",
+    "GceTpuSliceProvider", "K8sSliceProvider",
     "NodeGroup", "NodeGroupSpec", "NodeProvider", "ResourceDemand",
-    "StandardAutoscaler",
+    "StandardAutoscaler", "cluster_down", "cluster_up",
+    "load_cluster_spec", "load_cluster_state", "request_resources",
 ]

@@ -1,0 +1,235 @@
+"""YAML cluster launcher: ``raytpu up / down``.
+
+Reference analogue: ``python/ray/scripts/scripts.py:1278`` (``ray up``)
++ ``autoscaler/_private/commands.py`` — a YAML cluster spec is turned
+into provider calls that bring up a head and the minimum worker groups,
+and ``down`` tears the same cluster back down. The reference bootstraps
+over SSH; ours drives the slice NodeProviders (GCE/K8s/fake) through
+the same declarative :class:`InstanceManager` the autoscaler uses, so
+``up`` is literally "reconcile until the targets are RUNNING".
+
+Spec shape (YAML)::
+
+    cluster_name: demo
+    provider:
+      type: fake | gce | k8s        # + provider-specific keys:
+      # gce: project, zone, runtime_version
+      # k8s: namespace, image
+    idle_timeout_s: 60              # autoscaler knob (optional)
+    head:
+      group: cpu-head               # which node_groups entry is the head
+    node_groups:
+      cpu-head:
+        resources_per_host: {CPU: 8}
+      v5e-8:
+        hosts: 1
+        resources_per_host: {TPU: 8, CPU: 8}
+        min_workers: 2
+        max_workers: 4
+
+Cluster state (provider config + name) persists under
+``~/.raytpu/clusters/<name>.json`` so ``raytpu down <name>`` works
+without the original YAML.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from raytpu.autoscaler.instance_manager import RUNNING, InstanceManager
+from raytpu.autoscaler.node_provider import NodeGroupSpec, NodeProvider
+
+_STATE_DIR = os.path.join(os.path.expanduser("~/.raytpu"), "clusters")
+
+
+@dataclass
+class ClusterSpec:
+    cluster_name: str
+    provider: Dict[str, object]
+    node_groups: Dict[str, NodeGroupSpec]
+    head_group: Optional[str] = None
+    min_targets: Dict[str, int] = field(default_factory=dict)
+    idle_timeout_s: float = 60.0
+
+
+def load_cluster_spec(path_or_dict) -> ClusterSpec:
+    if isinstance(path_or_dict, dict):
+        raw = path_or_dict
+    else:
+        import yaml
+
+        with open(path_or_dict) as f:
+            raw = yaml.safe_load(f)
+    if not isinstance(raw, dict) or not raw.get("cluster_name"):
+        raise ValueError("cluster spec needs a 'cluster_name'")
+    if not isinstance(raw.get("provider"), dict) \
+            or not raw["provider"].get("type"):
+        raise ValueError("cluster spec needs provider.type")
+    groups_raw = raw.get("node_groups")
+    if not isinstance(groups_raw, dict) or not groups_raw:
+        raise ValueError("cluster spec needs at least one node_groups "
+                         "entry")
+    specs: Dict[str, NodeGroupSpec] = {}
+    targets: Dict[str, int] = {}
+    for name, g in groups_raw.items():
+        g = g or {}
+        unknown = set(g) - {"hosts", "resources_per_host", "topology",
+                            "min_workers", "max_workers"}
+        if unknown:
+            raise ValueError(f"node_groups[{name!r}]: unknown keys "
+                             f"{sorted(unknown)}")
+        specs[name] = NodeGroupSpec(
+            name,
+            hosts=int(g.get("hosts", 1)),
+            resources_per_host={k: float(v) for k, v in
+                                (g.get("resources_per_host") or {}).items()},
+            topology=tuple(g["topology"]) if g.get("topology") else None,
+            min_groups=int(g.get("min_workers", 0)),
+            max_groups=int(g.get("max_workers",
+                                 max(1, int(g.get("min_workers", 0))))),
+        )
+        targets[name] = specs[name].min_groups
+    head_group = (raw.get("head") or {}).get("group")
+    if head_group is not None:
+        if head_group not in specs:
+            raise ValueError(f"head.group {head_group!r} is not a "
+                             f"node_groups entry")
+        targets[head_group] = max(1, targets.get(head_group, 0))
+    return ClusterSpec(
+        cluster_name=str(raw["cluster_name"]),
+        provider=dict(raw["provider"]),
+        node_groups=specs,
+        head_group=head_group,
+        min_targets=targets,
+        idle_timeout_s=float(raw.get("idle_timeout_s", 60.0)),
+    )
+
+
+def make_provider(provider_cfg: Dict[str, object],
+                  runner=None) -> NodeProvider:
+    """Provider factory. ``runner`` injects the fake CLI runner in tests
+    (same pattern the provider unit tests use)."""
+    from raytpu.autoscaler.node_provider import (
+        FakeSliceProvider,
+        GceTpuSliceProvider,
+        K8sSliceProvider,
+    )
+
+    cfg = dict(provider_cfg)
+    ptype = str(cfg.pop("type"))
+    if ptype == "fake":
+        return FakeSliceProvider(
+            provision_ticks=int(cfg.pop("provision_ticks", 1)))
+    if ptype == "gce":
+        kwargs = {k: cfg[k] for k in
+                  ("project", "zone", "runtime_version", "name_prefix")
+                  if k in cfg}
+        return GceTpuSliceProvider(runner=runner, **kwargs)
+    if ptype == "k8s":
+        kwargs = {k: cfg[k] for k in
+                  ("namespace", "image", "name_prefix", "pod_template")
+                  if k in cfg}
+        return K8sSliceProvider(runner=runner, **kwargs)
+    raise ValueError(f"unknown provider type {ptype!r} "
+                     f"(supported: fake, gce, k8s)")
+
+
+def _state_path(name: str) -> str:
+    return os.path.join(_STATE_DIR, f"{name}.json")
+
+
+def _save_state(spec: ClusterSpec) -> None:
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    state = {
+        "cluster_name": spec.cluster_name,
+        "provider": spec.provider,
+        "idle_timeout_s": spec.idle_timeout_s,
+        "node_groups": {
+            n: {"hosts": s.hosts,
+                "resources_per_host": s.resources_per_host,
+                **({"topology": list(s.topology)} if s.topology else {}),
+                "min_workers": s.min_groups,
+                "max_workers": s.max_groups}
+            for n, s in spec.node_groups.items()},
+        "head": {"group": spec.head_group} if spec.head_group else {},
+    }
+    tmp = _state_path(spec.cluster_name) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=2)
+    os.replace(tmp, _state_path(spec.cluster_name))
+
+
+def load_cluster_state(name: str) -> ClusterSpec:
+    path = _state_path(name)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no recorded cluster {name!r} under {_STATE_DIR}; pass the "
+            f"original YAML instead")
+    with open(path) as f:
+        return load_cluster_spec(json.load(f))
+
+
+def cluster_up(spec: ClusterSpec, *, provider: Optional[NodeProvider]
+               = None, runner=None, timeout_s: float = 600.0,
+               poll_interval_s: float = 1.0,
+               on_progress=None) -> Dict[str, object]:
+    """Bring the cluster to its minimum footprint: head group + every
+    group's ``min_workers``, reconciled until RUNNING. Idempotent: the
+    reconciler adopts groups that already exist (re-running ``up`` on a
+    live cluster converges without relaunching)."""
+    provider = provider or make_provider(spec.provider, runner=runner)
+    im = InstanceManager(provider, spec.node_groups)
+    im.set_targets(spec.min_targets)
+    want_total = sum(spec.min_targets.values())
+    deadline = time.monotonic() + timeout_s
+    while True:
+        im.reconcile(idle_timeout_s=spec.idle_timeout_s)
+        running = im.instances(states={RUNNING})
+        if len(running) >= want_total:
+            break
+        if time.monotonic() > deadline:
+            by_state: Dict[str, int] = {}
+            for inst in im.instances():
+                by_state[inst.state] = by_state.get(inst.state, 0) + 1
+            raise TimeoutError(
+                f"cluster {spec.cluster_name!r} did not reach "
+                f"{want_total} running groups in {timeout_s}s "
+                f"(instances: {by_state})")
+        if on_progress is not None:
+            on_progress(len(running), want_total)
+        time.sleep(poll_interval_s)
+    _save_state(spec)
+    groups = [{
+        "group_id": inst.group_id,
+        "type": inst.group_type,
+        "role": ("head" if spec.head_group == inst.group_type
+                 else "worker"),
+        "hosts": list(inst.group.host_ids) if inst.group else [],
+    } for inst in im.instances(states={RUNNING})]
+    return {"cluster_name": spec.cluster_name, "groups": groups,
+            "instance_manager": im, "provider": provider}
+
+
+def cluster_down(spec: ClusterSpec, *, provider: Optional[NodeProvider]
+                 = None, runner=None) -> List[str]:
+    """Terminate every non-terminated group of the cluster's provider
+    scope and drop the recorded state. Returns terminated group ids."""
+    provider = provider or make_provider(spec.provider, runner=runner)
+    provider.poll()
+    terminated: List[str] = []
+    for g in list(provider.non_terminated_groups()):
+        provider.terminate_node_group(g.group_id)
+        terminated.append(g.group_id)
+    try:
+        os.remove(_state_path(spec.cluster_name))
+    except OSError:
+        pass
+    return terminated
+
+
+__all__ = ["ClusterSpec", "load_cluster_spec", "load_cluster_state",
+           "make_provider", "cluster_up", "cluster_down"]

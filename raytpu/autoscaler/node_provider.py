@@ -181,7 +181,10 @@ class GceTpuSliceProvider(NodeProvider):
 
     def create_node_group(self, spec: NodeGroupSpec) -> NodeGroup:
         with self._lock:
+            # Skip ids taken by adopted pre-existing groups.
             gid = f"{self.name_prefix}-{spec.name}-{next(self._ids)}"
+            while gid in self._groups:
+                gid = f"{self.name_prefix}-{spec.name}-{next(self._ids)}"
             group = NodeGroup(gid, spec, status="pending")
             self._groups[gid] = group
         try:
@@ -233,6 +236,18 @@ class GceTpuSliceProvider(NodeProvider):
             name = item.get("name", "").rsplit("/", 1)[-1]
             listed[name] = item
         with self._lock:
+            # Adopt cloud slices this (possibly fresh) provider instance
+            # has never seen — a new process running `down` or a re-run
+            # `up` must discover existing groups, not ignore them.
+            prefix = f"{self.name_prefix}-"
+            for name, item in listed.items():
+                if name in self._groups or not name.startswith(prefix):
+                    continue
+                spec_name = name[len(prefix):].rsplit("-", 1)[0]
+                hosts = len(item.get("networkEndpoints", [])) or 1
+                self._groups[name] = NodeGroup(
+                    name, NodeGroupSpec(spec_name, hosts=hosts),
+                    status="pending")
             for gid, g in self._groups.items():
                 if g.status == "terminated":
                     continue
@@ -347,7 +362,10 @@ class K8sSliceProvider(NodeProvider):
         import json as _json
 
         with self._lock:
+            # Skip ids taken by adopted pre-existing pods.
             gid = f"{self.name_prefix}-{spec.name}-{next(self._ids)}"
+            while gid in self._groups:
+                gid = f"{self.name_prefix}-{spec.name}-{next(self._ids)}"
             group = NodeGroup(gid, spec, status="pending")
             self._groups[gid] = group
         try:
@@ -387,6 +405,16 @@ class K8sSliceProvider(NodeProvider):
         for item in _json.loads(out or "{}").get("items", []):
             listed[item.get("metadata", {}).get("name", "")] = item
         with self._lock:
+            # Adopt labeled pods a fresh provider instance never created
+            # (new-process `down`/re-`up` must see existing groups).
+            for name, item in listed.items():
+                if name in self._groups:
+                    continue
+                labels = item.get("metadata", {}).get("labels", {})
+                spec_name = labels.get("raytpu-group-type") or \
+                    name[len(self.name_prefix) + 1:].rsplit("-", 1)[0]
+                self._groups[name] = NodeGroup(
+                    name, NodeGroupSpec(spec_name), status="pending")
             for gid, g in self._groups.items():
                 if g.status == "terminated":
                     continue

@@ -1,0 +1,43 @@
+"""Autoscaler SDK: programmatic demand hints.
+
+Reference analogue: ``python/ray/autoscaler/sdk.py`` —
+``request_resources(num_cpus=..., bundles=[...])`` tells the autoscaler
+to scale to hold the given shapes immediately, without waiting for
+tasks to queue. Each call replaces the previous request; calling with
+nothing withdraws it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None
+                      ) -> int:
+    """Ask the autoscaler to provision capacity for these bundles now.
+
+    Returns the number of bundles recorded. Cluster mode only (the
+    hint lives on the head, where the autoscaler reads demand); in
+    local mode this is a no-op returning 0 — there is no cloud to
+    scale.
+    """
+    from raytpu.runtime import api
+
+    if api._backend is None:
+        raise RuntimeError("raytpu is not initialized")
+    payload: List[Dict[str, float]] = []
+    if num_cpus:
+        # Reference semantics: N one-CPU bundles, not one N-CPU bundle —
+        # the demand must pack across node shapes, not require a single
+        # host with N cpus.
+        payload.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    for b in bundles or []:
+        payload.append({str(k): float(v) for k, v in b.items()})
+    head = getattr(api._backend, "_head", None)
+    if head is None:
+        return 0  # local backend: nothing to scale
+    return int(head.call("request_resources", payload))
+
+
+__all__ = ["request_resources"]

