@@ -292,7 +292,10 @@ def _ppo_bench(smoke: bool) -> dict:
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmarks", "bench_ppo.py")
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    if env.get("RAYTPU_PPO_BENCH_ON_CHIP") != "1":
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
     if smoke:
         env.setdefault("RAYTPU_PPO_BENCH_ENVS", "8")
         env.setdefault("RAYTPU_PPO_BENCH_FRAGMENT", "16")

