@@ -19,6 +19,7 @@ from raytpu.data.read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_avro,
     read_sql,
     read_tfrecords,
     read_text,
@@ -48,6 +49,7 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_avro",
     "read_sql",
     "read_tfrecords",
     "read_text",

@@ -702,6 +702,30 @@ class Dataset:
                 f"{path}/part-{i:05d}.tfrecord",
                 [encode_example(r) for r in rows])
 
+    def write_avro(self, path: str, *, schema: Optional[dict] = None,
+                   codec: str = "null") -> None:
+        """One ``.avro`` object container file per block (reference:
+        ``Dataset.write_avro``; dependency-free OCF codec in
+        :mod:`raytpu.data.avro`). The record schema is inferred from the
+        rows unless given; ``codec``: ``null`` or ``deflate``."""
+        import os
+
+        from raytpu.data.avro import infer_schema, write_file
+
+        os.makedirs(path, exist_ok=True)
+        # One schema for the whole dataset (external directory readers
+        # expect consistent part schemas): inferred over ALL rows when
+        # not given, so a column that is null-free in one block but
+        # nullable in another still unifies.
+        parts: List[List[dict]] = []
+        for block in self.iter_blocks():
+            parts.append([_plain_row(r)
+                          for r in BlockAccessor(block).to_rows()])
+        sch = schema or infer_schema([r for rows in parts for r in rows])
+        for i, rows in enumerate(parts):
+            write_file(f"{path}/part-{i:05d}.avro", sch, rows,
+                       codec=codec)
+
     # -- internals ------------------------------------------------------------
 
     def _with_op(self, op: OpSpec) -> "Dataset":
@@ -719,6 +743,19 @@ class Dataset:
     def __repr__(self):
         ops = " -> ".join(op.name for op in self._ops) or "source"
         return f"Dataset({self._name}: {ops})"
+
+
+def _plain_row(row: dict) -> dict:
+    """Numpy scalars -> native Python values (avro/json writers need
+    plain types; ndarray cells become lists)."""
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        elif isinstance(v, np.ndarray):
+            v = v.tolist()
+        out[k] = v
+    return out
 
 
 @raytpu.remote(num_cpus=0)

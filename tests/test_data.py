@@ -742,6 +742,32 @@ class TestMoreDatasources:
         assert rows[5]["name"] == b"row5"  # bytes features stay bytes
         assert abs(rows[5]["score"] - 2.5) < 1e-6
 
+    def test_avro_roundtrip(self, raytpu_local, tmp_path):
+        """write_avro -> read_avro round-trip, null + deflate codecs
+        (reference: avro datasource; OCF codec is dependency-free)."""
+        import glob
+
+        import raytpu.data as rd
+
+        items = [{"id": i, "name": f"row{i}", "score": i / 4,
+                  "ok": i % 2 == 0} for i in range(12)]
+        ds = rd.from_items(items, blocks=3)
+        out = str(tmp_path / "av")
+        ds.write_avro(out)
+        assert len(glob.glob(out + "/*.avro")) == 3
+        back = sorted(rd.read_avro(out).take_all(),
+                      key=lambda r: r["id"])
+        assert len(back) == 12
+        assert back[7] == {"id": 7, "name": "row7", "score": 1.75,
+                           "ok": False}
+        # deflate codec + nullable column
+        out2 = str(tmp_path / "av2")
+        rd.from_items([{"k": 1, "opt": None}, {"k": 2, "opt": "x"}],
+                      blocks=1).write_avro(out2, codec="deflate")
+        rows = sorted(rd.read_avro(out2).take_all(),
+                      key=lambda r: r["k"])
+        assert rows == [{"k": 1, "opt": None}, {"k": 2, "opt": "x"}]
+
     def test_read_tfrecords_raw(self, raytpu_local, tmp_path):
         import raytpu.data as rd
         from raytpu.data.tfrecord import write_records
