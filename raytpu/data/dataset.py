@@ -649,15 +649,60 @@ class Dataset:
 
     # -- writes ---------------------------------------------------------------
 
-    def write_parquet(self, path: str) -> None:
+    def write_parquet(self, path: str, *,
+                      partition_cols: Optional[Sequence[str]] = None
+                      ) -> None:
+        """Parquet sink; with ``partition_cols``, hive-style layout —
+        ``path/col=value/.../part-N.parquet`` with the partition columns
+        dropped from the files (reference: ``Dataset.write_parquet``
+        partitioning; readable back via ``read_parquet`` which
+        re-attaches them from the path)."""
         import os
+        import urllib.parse
 
+        import pyarrow.compute as pc
         import pyarrow.parquet as pq
 
         os.makedirs(path, exist_ok=True)
+        if not partition_cols:
+            for i, block in enumerate(self.iter_blocks()):
+                pq.write_table(BlockAccessor(block).to_arrow(),
+                               f"{path}/part-{i:05d}.parquet")
+            return
+        import math
+
+        from raytpu.data.read_api import HIVE_NULL
+
+        _nan = object()  # NaN can't key a set (nan != nan): normalize
+
+        def norm(v):
+            return _nan if isinstance(v, float) and math.isnan(v) else v
+
+        cols = list(partition_cols)
         for i, block in enumerate(self.iter_blocks()):
-            pq.write_table(BlockAccessor(block).to_arrow(),
-                           f"{path}/part-{i:05d}.parquet")
+            table = BlockAccessor(block).to_arrow()
+            missing = [c for c in cols if c not in table.column_names]
+            if missing:
+                raise KeyError(f"partition_cols {missing} not in "
+                               f"columns {table.column_names}")
+            combos = {tuple(norm(row[c]) for c in cols)
+                      for row in table.select(cols).to_pylist()}
+            for combo in sorted(combos, key=repr):
+                mask = None
+                for c, v in zip(cols, combo):
+                    m = (pc.is_null(table[c]) if v is None
+                         else pc.is_nan(table[c]) if v is _nan
+                         else pc.equal(table[c], v))
+                    mask = m if mask is None else pc.and_(mask, m)
+                sub = table.filter(mask).drop_columns(cols)
+                segs = "/".join(
+                    f"{c}=" + (HIVE_NULL if v is None else "nan"
+                               if v is _nan else
+                               urllib.parse.quote(str(v), safe=""))
+                    for c, v in zip(cols, combo))
+                os.makedirs(f"{path}/{segs}", exist_ok=True)
+                pq.write_table(sub,
+                               f"{path}/{segs}/part-{i:05d}.parquet")
 
     def write_csv(self, path: str) -> None:
         import os

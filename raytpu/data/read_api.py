@@ -129,13 +129,20 @@ def from_arrow(table) -> Dataset:
     return Dataset(source, [], name="from_arrow")
 
 
-def _expand_paths(paths, suffix: str) -> List[str]:
+def _expand_paths(paths, suffix: str, recursive: bool = False
+                  ) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            files.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+            if recursive:
+                # Partitioned layouts nest files under col=value/.
+                files.extend(sorted(_glob.glob(
+                    os.path.join(p, f"**/*{suffix}"), recursive=True)))
+            else:
+                files.extend(sorted(_glob.glob(
+                    os.path.join(p, f"*{suffix}"))))
         elif any(ch in p for ch in "*?["):
             files.extend(sorted(_glob.glob(p)))
         else:
@@ -145,22 +152,102 @@ def _expand_paths(paths, suffix: str) -> List[str]:
     return files
 
 
-def read_parquet(paths, *, columns: Optional[Sequence[str]] = None) -> Dataset:
+def read_parquet(paths, *, columns: Optional[Sequence[str]] = None,
+                 partitioning: Optional[str] = "hive") -> Dataset:
     """One remote read task per file — IO parallelism rides the task
-    fabric (reference: parquet datasource)."""
-    files = _expand_paths(paths, ".parquet")
+    fabric (reference: parquet datasource). Hive-partitioned layouts
+    (``root/col=value/.../part.parquet``, e.g. from
+    ``write_parquet(partition_cols=...)`` or Spark) are detected by
+    default: ``col`` comes back as a column parsed from the path
+    (int/float/None/string inferred); ``partitioning=None`` disables.
+    """
+    files = _expand_paths(paths, ".parquet", recursive=True)
+    # Partition values are resolved at PLANNING time, driver-side:
+    # segments are parsed only BELOW the user-passed read roots (a
+    # col=value directory above the dataset must not inject columns),
+    # and one partition schema is typed across ALL files (a dataset
+    # with year=2024 and year=unknown reads year as string everywhere,
+    # never int-in-one-file/str-in-another).
+    part_vals: Dict[str, Dict[str, Any]] = {}
+    if partitioning == "hive":
+        roots = [p for p in ([paths] if isinstance(paths, str)
+                             else list(paths)) if os.path.isdir(p)]
+        raw = {f: _hive_raw_segments(f, roots) for f in files}
+        part_vals = _type_partition_values(raw)
 
     @raytpu.remote(name="data::read_parquet")
-    def read_one(path):
+    def read_one(path, parts):
+        import pyarrow as pa
         import pyarrow.parquet as pq
 
-        return pq.read_table(path, columns=list(columns) if columns else None)
+        file_cols = None
+        if columns:
+            file_cols = [c for c in columns if c not in parts]
+        table = pq.read_table(path, columns=file_cols)
+        for k, v in parts.items():
+            if columns and k not in columns:
+                continue
+            table = table.append_column(
+                k, pa.array([v] * len(table)))
+        return table
 
     def source():
         for f in files:
-            yield read_one.remote(f)
+            yield read_one.remote(f, part_vals.get(f, {}))
 
     return Dataset(source, [], name="read_parquet")
+
+
+HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def _hive_raw_segments(path: str, roots: List[str]) -> Dict[str, str]:
+    """``key=value`` path segments BELOW the matching read root, raw
+    (unquoted string) values; {} when the file is under no known root."""
+    import urllib.parse
+
+    rel = None
+    for root in sorted(roots, key=len, reverse=True):
+        r = root.rstrip(os.sep) + os.sep
+        if path.startswith(r):
+            rel = path[len(r):]
+            break
+    if rel is None:
+        return {}
+    out: Dict[str, str] = {}
+    for seg in rel.split(os.sep)[:-1]:
+        if "=" in seg:
+            k, _, v = seg.partition("=")
+            out[k] = urllib.parse.unquote(v)
+    return out
+
+
+def _type_partition_values(raw: Dict[str, Dict[str, str]]
+                           ) -> Dict[str, Dict[str, Any]]:
+    """One type per partition key across the whole dataset: int if
+    every value parses as int, else float if every value parses, else
+    string. ``__HIVE_DEFAULT_PARTITION__`` decodes to None."""
+    def parses(vals, cast) -> bool:
+        for v in vals:
+            try:
+                cast(v)
+            except ValueError:
+                return False
+        return True
+
+    by_key: Dict[str, List[str]] = {}
+    for parts in raw.values():
+        for k, v in parts.items():
+            if v != HIVE_NULL:
+                by_key.setdefault(k, []).append(v)
+    casts: Dict[str, Any] = {}
+    for k, vals in by_key.items():
+        casts[k] = (int if parses(vals, int)
+                    else float if parses(vals, float) else str)
+    return {f: {k: (None if v == HIVE_NULL
+                    else casts.get(k, str)(v))
+                for k, v in parts.items()}
+            for f, parts in raw.items()}
 
 
 def read_csv(paths, **read_kwargs) -> Dataset:

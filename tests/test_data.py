@@ -742,6 +742,65 @@ class TestMoreDatasources:
         assert rows[5]["name"] == b"row5"  # bytes features stay bytes
         assert abs(rows[5]["score"] - 2.5) < 1e-6
 
+    def test_partitioned_parquet_roundtrip(self, raytpu_local,
+                                           tmp_path):
+        """write_parquet(partition_cols=) -> hive layout; read_parquet
+        re-attaches partition columns parsed from the path (reference:
+        parquet datasource partitioning)."""
+        import glob
+
+        import raytpu.data as rd
+
+        items = [{"year": 2023 + i % 2, "tag": f"t{i % 3}", "v": i}
+                 for i in range(12)]
+        out = str(tmp_path / "pq")
+        rd.from_items(items, blocks=2).write_parquet(
+            out, partition_cols=["year", "tag"])
+        files = glob.glob(out + "/**/*.parquet", recursive=True)
+        assert files and all("year=" in f and "tag=" in f
+                             for f in files)
+        back = sorted(rd.read_parquet(out).take_all(),
+                      key=lambda r: r["v"])
+        assert len(back) == 12
+        assert back[5] == {"year": 2024, "tag": "t2", "v": 5}
+        assert isinstance(back[0]["year"], (int, np.integer))  # inferred
+        # column projection including a partition column
+        proj = rd.read_parquet(out, columns=["v", "year"]).take_all()
+        assert set(proj[0]) == {"v", "year"}
+        # partitioning=None leaves path columns off
+        flat = rd.read_parquet(out, partitioning=None).take_all()
+        assert set(flat[0]) == {"v"}
+
+    def test_partitioned_parquet_nulls_mixed_types_and_root_scope(
+            self, raytpu_local, tmp_path):
+        import math
+
+        import raytpu.data as rd
+
+        # None partition values use the hive sentinel; NaN gets its own
+        # directory; a mixed int/str key types as string EVERYWHERE.
+        items = [{"year": None, "tag": "2024", "v": 0},
+                 {"year": float("nan"), "tag": "unknown", "v": 1},
+                 {"year": 2.5, "tag": "2024", "v": 2}]
+        out = str(tmp_path / "pq2")
+        rd.from_items(items, blocks=1).write_parquet(
+            out, partition_cols=["year", "tag"])
+        back = sorted(rd.read_parquet(out).take_all(),
+                      key=lambda r: r["v"])
+        assert len(back) == 3  # neither the None nor the NaN row lost
+        assert back[0]["year"] is None
+        assert math.isnan(back[1]["year"])
+        assert back[2]["year"] == 2.5
+        assert [r["tag"] for r in back] == ["2024", "unknown", "2024"]
+        assert all(isinstance(r["tag"], str) for r in back)  # unified
+
+        # key=value directories ABOVE the read root never inject
+        # columns (parsing is root-relative).
+        deep = tmp_path / "job=77" / "data"
+        rd.from_items([{"x": 1}], blocks=1).write_parquet(str(deep))
+        rows = rd.read_parquet(str(deep)).take_all()
+        assert rows == [{"x": 1}]
+
     def test_avro_roundtrip(self, raytpu_local, tmp_path):
         """write_avro -> read_avro round-trip, null + deflate codecs
         (reference: avro datasource; OCF codec is dependency-free)."""
