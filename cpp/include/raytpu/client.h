@@ -65,6 +65,22 @@ class Client {
                        double timeout_s = 60.0);
   void FreeObject(const std::string& oid_hex);
 
+  // Cross-language ACTORS (reference: the C++ worker API's Python actor
+  // creation/invocation via class descriptors). CreatePyActor names a
+  // "module:ClassName" with wire-encodable ctor args and returns the
+  // actor id hex; CallPyActor submits a method call and returns the
+  // return-object id hexes (fetch with FetchResult); KillActor tears it
+  // down. Methods on one actor execute in submission order.
+  std::string CreatePyActor(const std::string& class_ref,
+                            std::vector<ValuePtr> args,
+                            const std::string& name = "",
+                            double num_cpus = 0.0, int max_restarts = 0);
+  std::vector<std::string> CallPyActor(const std::string& actor_id_hex,
+                                       const std::string& method,
+                                       std::vector<ValuePtr> args,
+                                       int num_returns = 1);
+  void KillActor(const std::string& actor_id_hex);
+
  private:
   std::string ReadFrame();
   void WriteFrame(const std::string& body);

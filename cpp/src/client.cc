@@ -157,6 +157,35 @@ std::vector<std::string> Client::SubmitPyTask(const std::string& fn_ref,
   return out;
 }
 
+std::string Client::CreatePyActor(const std::string& class_ref,
+                                  std::vector<ValuePtr> args,
+                                  const std::string& name,
+                                  double num_cpus, int max_restarts) {
+  auto r = Call("create_py_actor",
+                {Value::Str(class_ref), Value::Array(std::move(args)),
+                 Value::Str(name), Value::Float(num_cpus),
+                 Value::Int(max_restarts)});
+  if (r->type != Value::kStr) {
+    throw std::runtime_error("create_py_actor: expected actor id hex");
+  }
+  return r->s;
+}
+
+std::vector<std::string> Client::CallPyActor(
+    const std::string& actor_id_hex, const std::string& method,
+    std::vector<ValuePtr> args, int num_returns) {
+  auto r = Call("call_py_actor",
+                {Value::Str(actor_id_hex), Value::Str(method),
+                 Value::Array(std::move(args)), Value::Int(num_returns)});
+  std::vector<std::string> out;
+  for (const auto& v : r->arr) out.push_back(v->s);
+  return out;
+}
+
+void Client::KillActor(const std::string& actor_id_hex) {
+  Call("kill_actor", {Value::Str(actor_id_hex), Value::Bool(true)});
+}
+
 namespace {
 
 // SerializedValue envelope (runtime/serialization.py to_bytes):

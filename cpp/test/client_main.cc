@@ -153,6 +153,29 @@ int main(int argc, char** argv) {
   assert(threw);
   std::printf("PASS cross_lang_tasks\n");
 
+  // Cross-language ACTORS: create a Python actor by class descriptor,
+  // call methods (ordered), read state back, kill it.
+  auto aid = node.CreatePyActor("raytpu.util.xlang:Counter",
+                                {raytpu::Value::Int(10)});
+  assert(!aid.empty());
+  auto c1 = node.CallPyActor(aid, "inc", {raytpu::Value::Int(5)});
+  auto c2 = node.CallPyActor(aid, "inc", {raytpu::Value::Int(1)});
+  auto v1 = node.FetchResult(c1[0], 60.0);
+  auto v2 = node.FetchResult(c2[0], 60.0);
+  assert(v1->type == raytpu::Value::kInt && v1->i == 15);
+  assert(v2->type == raytpu::Value::kInt && v2->i == 16);  // ordered
+  auto got = node.CallPyActor(aid, "get", {});
+  assert(node.FetchResult(got[0], 60.0)->i == 16);
+  auto echoed = node.CallPyActor(
+      aid, "echo",
+      {raytpu::Value::MapV({{raytpu::Value::Str("k"),
+                             raytpu::Value::Int(7)}})});
+  auto echo_r = node.FetchResult(echoed[0], 60.0);
+  assert(echo_r->type == raytpu::Value::kMap &&
+         echo_r->Get("k")->i == 7);
+  node.KillActor(aid);
+  std::printf("PASS cross_lang_actors\n");
+
   std::printf("ALL CPP CLIENT TESTS PASSED\n");
   return 0;
 }

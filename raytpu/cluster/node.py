@@ -438,6 +438,16 @@ class NodeBackend(LocalBackend):
             self._pgs[pg_id] = _PlacementGroup(pg_id, slots, strategy)
 
 
+def _xlang_args(args: list) -> list:
+    """Wire-decoded cross-language args -> INLINE TaskArgs (shared by
+    submit_fn_task / create_py_actor / call_py_actor)."""
+    from raytpu.runtime.serialization import serialize
+    from raytpu.runtime.task_spec import ArgKind, TaskArg
+
+    return [TaskArg(ArgKind.INLINE, serialize(a).to_bytes())
+            for a in args]
+
+
 class NodeServer:
     def __init__(self, head_address: str, *,
                  num_cpus: Optional[float] = None,
@@ -493,6 +503,8 @@ class NodeServer:
         h = self._rpc.register
         h("submit_task", self._h_submit_task)
         h("submit_fn_task", self._h_submit_fn_task)
+        h("create_py_actor", self._h_create_py_actor)
+        h("call_py_actor", self._h_call_py_actor)
         h("create_actor", self._h_create_actor)
         h("submit_actor_task", self._h_submit_actor_task)
         h("kill_actor", self._h_kill_actor)
@@ -1018,20 +1030,72 @@ class NodeServer:
         through the normal path, and returns the return-object id hexes
         for has_object/fetch_object polling."""
         from raytpu.core.ids import TaskID
-        from raytpu.runtime.serialization import serialize
-        from raytpu.runtime.task_spec import ArgKind, TaskArg
 
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=self.backend.worker.job_id,
             name=f"xlang::{fn_ref}",
             function_ref=str(fn_ref),
-            args=[TaskArg(ArgKind.INLINE, serialize(a).to_bytes())
-                  for a in args],
+            args=_xlang_args(args),
             num_returns=max(1, int(num_returns)),
             resources={"CPU": float(num_cpus)} if num_cpus else {},
         )
         self.backend.submit_task(spec)
+        return [oid.hex() for oid in spec.return_ids()]
+
+    def _h_create_py_actor(self, peer: Peer, class_ref: str, args: list,
+                           name: str = "", num_cpus: float = 0.0,
+                           max_restarts: int = 0) -> str:
+        """Cross-language actor creation (reference: the C++/Java worker
+        APIs creating Python actors via class descriptors,
+        ``function_manager.cc``): the caller names a ``module:qualname``
+        class; the spec is built server-side like submit_fn_task.
+        Returns the actor id hex for call_py_actor / kill_actor."""
+        from raytpu.core.ids import ActorID, TaskID
+        from raytpu.runtime.task_spec import ActorCreationSpec
+
+        actor_id = ActorID.from_random()
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            job_id=self.backend.worker.job_id,
+            name=name or f"xlang-actor::{class_ref}",
+            function_ref=str(class_ref),
+            args=_xlang_args(args),
+            num_returns=1,
+            resources={"CPU": float(num_cpus)} if num_cpus else {},
+            actor_creation=ActorCreationSpec(
+                actor_id=actor_id, name=(name or None),
+                max_restarts=int(max_restarts)),
+        )
+        blob = wire.dumps(spec)
+        # Publish the spec SYNCHRONOUSLY before the directory entry goes
+        # live: a driver that resolves the name right after this call
+        # returns must find the spec (the notify inside _h_create_actor
+        # is fire-and-forget and would race; same content, idempotent).
+        self._head.call(
+            "kv_put", f"__actor_spec__::{actor_id.hex()}", blob, True)
+        self._h_create_actor(peer, blob)
+        return actor_id.hex()
+
+    def _h_call_py_actor(self, peer: Peer, actor_id_hex: str,
+                         method: str, args: list,
+                         num_returns: int = 1) -> List[str]:
+        """Cross-language actor method invocation; returns the return
+        object id hexes (poll with has_object, fetch with
+        fetch_object — same contract as submit_fn_task)."""
+        from raytpu.core.ids import ActorID, TaskID
+
+        actor_id = ActorID.from_hex(actor_id_hex)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=self.backend.worker.job_id,
+            name=f"xlang::{actor_id_hex[:8]}.{method}",
+            method_name=str(method),
+            args=_xlang_args(args),
+            num_returns=max(1, int(num_returns)),
+            actor_id=actor_id,
+        )
+        self._h_submit_actor_task(peer, wire.dumps(spec))
         return [oid.hex() for oid in spec.return_ids()]
 
     def _h_create_actor(self, peer: Peer, spec_blob: bytes) -> None:

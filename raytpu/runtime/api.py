@@ -254,13 +254,18 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
-    _, backend = _worker_and_backend()
+    worker, backend = _worker_and_backend()
     actor_id, creation_spec = backend.get_actor_handle_info(name, namespace)
-    import cloudpickle
-
     from raytpu.runtime.actor import method_meta_from_class
 
-    cls = cloudpickle.loads(creation_spec.function_blob)
+    if creation_spec.function_blob:
+        import cloudpickle
+
+        cls = cloudpickle.loads(creation_spec.function_blob)
+    else:
+        # Cross-language actor: the class travels by descriptor, not
+        # pickle (node.py create_py_actor); resolve it by import.
+        cls = worker.load_spec_function(creation_spec)
     return ActorHandle(actor_id, method_meta_from_class(cls))
 
 

@@ -1,0 +1,44 @@
+"""Cross-language demo actors.
+
+Reference analogue: the counter classes the reference's cross-language
+docs/tests invoke from C++/Java workers (``cpp/src/ray/test/``,
+``doc/source/ray-core/cross-language.rst``). Non-Python clients create
+these by descriptor — ``raytpu.util.xlang:Counter`` — and every method
+sticks to wire-encodable values (ints/floats/strings/lists/dicts), the
+contract for crossing the language boundary.
+"""
+
+from __future__ import annotations
+
+
+class Counter:
+    """Minimal stateful actor for cross-language smoke tests."""
+
+    def __init__(self, start: int = 0):
+        self.value = int(start)
+
+    def inc(self, n: int = 1) -> int:
+        self.value += int(n)
+        return self.value
+
+    def get(self) -> int:
+        return self.value
+
+    def echo(self, x):
+        return x
+
+
+class KVStore:
+    """Dict-backed store: cross-language state sharing demo."""
+
+    def __init__(self):
+        self._d = {}
+
+    def put(self, key: str, value) -> None:
+        self._d[key] = value
+
+    def get(self, key: str, default=None):
+        return self._d.get(key, default)
+
+    def keys(self) -> list:
+        return sorted(self._d)

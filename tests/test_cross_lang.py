@@ -88,3 +88,56 @@ class TestFunctionRef:
         finally:
             raytpu.shutdown()
             cluster.shutdown()
+
+
+class TestCrossLangActors:
+    """Actor creation/invocation by class descriptor (reference: the
+    C++/Java worker APIs' Python actor calls)."""
+
+    def test_create_call_kill_via_node_rpc(self):
+        cluster = Cluster()
+        cluster.add_node(num_cpus=2, num_tpus=0)
+        raytpu.init(address=cluster.address)
+        try:
+            cli = RpcClient(_node_addr())
+            try:
+                aid = cli.call("create_py_actor",
+                               "raytpu.util.xlang:Counter", [10],
+                               "", 0.0, 0, timeout=60.0)
+                assert isinstance(aid, str) and len(aid) == 32
+                oids1 = cli.call("call_py_actor", aid, "inc", [5], 1,
+                                 timeout=30.0)
+                oids2 = cli.call("call_py_actor", aid, "inc", [1], 1,
+                                 timeout=30.0)
+                assert _fetch(cli, oids1[0]) == 15
+                assert _fetch(cli, oids2[0]) == 16  # ordered execution
+                echo = cli.call("call_py_actor", aid, "echo",
+                                [{"k": [1, 2]}], 1, timeout=30.0)
+                assert _fetch(cli, echo[0]) == {"k": [1, 2]}
+                cli.call("kill_actor", aid, True, timeout=30.0)
+            finally:
+                cli.close()
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+    def test_named_cross_lang_actor_visible_to_python(self):
+        """A C++-created named actor resolves from Python drivers too
+        (shared directory)."""
+        cluster = Cluster()
+        cluster.add_node(num_cpus=2, num_tpus=0)
+        raytpu.init(address=cluster.address)
+        try:
+            cli = RpcClient(_node_addr())
+            try:
+                cli.call("create_py_actor",
+                         "raytpu.util.xlang:KVStore", [],
+                         "shared-kv", 0.0, 0, timeout=60.0)
+                h = raytpu.get_actor("shared-kv")
+                raytpu.get(h.put.remote("from-py", 1))
+                assert raytpu.get(h.keys.remote()) == ["from-py"]
+            finally:
+                cli.close()
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
