@@ -48,9 +48,63 @@ def _env_block(name: str, default: int) -> int:
 
 # Tile shape of the pallas kernel's grid. Env-overridable so
 # benchmarks/sweep_attn.py can A/B block shapes per process without code
-# edits (the kernel requires seq_len % block == 0; _flash clamps to T).
-DEFAULT_BLOCK_Q = _env_block("RAYTPU_FLASH_BLOCK_Q", 128)
-DEFAULT_BLOCK_K = _env_block("RAYTPU_FLASH_BLOCK_K", 128)
+# edits (_fit_block shrinks them to tile the actual sequence length).
+# 512x512 won the r5 chip sweep (SWEEP_ATTN_r05.json: 2.78ms fwd+bwd at
+# [8,12,1024,64] vs 4.16ms XLA reference, 7.56ms at the old 128x128).
+DEFAULT_BLOCK_Q = _env_block("RAYTPU_FLASH_BLOCK_Q", 512)
+DEFAULT_BLOCK_K = _env_block("RAYTPU_FLASH_BLOCK_K", 512)
+
+
+def _env_dot_mode() -> str:
+    """"input" | "f32", with synonyms; unknown values warn and fall back
+    (a bad env var must not break every import of raytpu.ops)."""
+    raw = (os.environ.get("RAYTPU_FLASH_DOT") or "input").lower()
+    mode = {"input": "input", "bf16": "input",
+            "f32": "f32", "fp32": "f32", "float32": "f32"}.get(raw)
+    if mode is None:
+        import sys
+        print(f"# RAYTPU_FLASH_DOT={raw!r} not recognized "
+              f"(use 'input' or 'f32'); using 'input'", file=sys.stderr)
+        mode = "input"
+    return mode
+
+
+# MXU operand dtype inside the kernels. "input" feeds q/k/v (and p/ds,
+# cast back down) to the MXU in their input dtype with fp32 accumulation
+# via preferred_element_type — the official TPU flash pattern; "f32"
+# upcasts every operand first (r4-and-earlier behavior, ~roundoff-free
+# but slower when inputs are bf16). Env-overridable for the sweep A/B.
+DEFAULT_DOT_MODE = _env_dot_mode()
+
+
+def _fit_block(t: int, want: int, interpret: bool) -> int:
+    """Largest block <= ``want`` that exactly tiles ``t`` (trace-time).
+
+    Keeps arbitrary sequence lengths working under large default tiles
+    (e.g. t=768 with 512 defaults tiles at 384). On hardware the block
+    must also be 8-row sublane-aligned — Mosaic mis-handles odd block
+    heights — so a ``t`` with no aligned divisor (e.g. t=300, t=50, or
+    any prime t > 8) raises; an explicit block override < 64 lowers the
+    economic floor to 8, and interpret mode (CPU tests) accepts any
+    divisor. Callers hitting the error should use force='reference' or
+    pad the sequence.
+    """
+    floor = 64 if want >= 64 else 8  # honor explicit small overrides
+    want = min(want, t)
+    if interpret:
+        def ok(b):  # single-block, or any non-degenerate divisor
+            return b == t or b >= 8
+    else:
+        def ok(b):  # sublane-aligned; full-sequence block also allowed
+            return b % 8 == 0 and (b >= floor or b == t)
+    while want > 1 and (t % want or not ok(want)):
+        want -= 1
+    if not ok(want) or t % want:
+        raise ValueError(
+            f"no sublane-aligned pallas block (>= {floor}, %8 == 0) tiles "
+            f"sequence length {t}; use force='reference', pad the "
+            f"sequence, or raise RAYTPU_FLASH_BLOCK_Q/K")
+    return want
 
 
 def _on_tpu() -> bool:
@@ -104,7 +158,7 @@ _LANES = 128  # VMEM scratch lane width; m/l broadcast across lanes.
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr, *, causal: bool,
                   sm_scale: float, block_q: int, block_k: int, n_kb: int,
-                  off: int):
+                  off: int, dot_mode: str):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     d = q_ref.shape[2]
@@ -126,9 +180,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [Bq, D]
-        kb = k_ref[0].astype(jnp.float32)  # [Bk, D]
-        vb = v_ref[0].astype(jnp.float32)  # [Bk, D]
+        # "input" mode feeds the MXU in the residual dtype (bf16 in, fp32
+        # accumulate) — native MXU speed; "f32" upcasts operands first.
+        mxu = jnp.float32 if dot_mode == "f32" else q_ref.dtype
+        q = q_ref[0].astype(mxu)  # [Bq, D]
+        kb = k_ref[0].astype(mxu)  # [Bk, D]
+        vb = v_ref[0].astype(mxu)  # [Bk, D]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -147,7 +204,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[...] = jnp.broadcast_to(m_new, (block_q, _LANES))
         l_scr[...] = jnp.broadcast_to(l_new, (block_q, _LANES))
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(mxu), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_kb - 1)
@@ -170,18 +227,15 @@ def _flash_forward_pallas(q, k, v, causal: bool, sm_scale: float,
     q3 = q.reshape(bh, t_q, d)
     k3 = k.reshape(bh, t_kv, d)
     v3 = v.reshape(bh, t_kv, d)
-    block_q = min(block_q, t_q)
-    block_k = min(block_k, t_kv)
-    if t_q % block_q or t_kv % block_k:
-        raise ValueError(
-            f"sequence lengths ({t_q}, {t_kv}) must be divisible by blocks "
-            f"({block_q}, {block_k})")
+    block_q = _fit_block(t_q, block_q, interpret)
+    block_k = _fit_block(t_kv, block_k, interpret)
     n_kb = t_kv // block_k
 
     off = t_kv - t_q  # bottom-aligned diagonal (reference tril k=off)
     kernel = functools.partial(
         _flash_kernel, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k, n_kb=n_kb, off=off)
+        block_q=block_q, block_k=block_k, n_kb=n_kb, off=off,
+        dot_mode=DEFAULT_DOT_MODE)
 
     if causal:
         # Clamp the K/V walk to the last causally-live block: iterations
@@ -241,7 +295,8 @@ def _flash_forward_pallas(q, k, v, causal: bool, sm_scale: float,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, causal: bool, sm_scale: float,
-                         block_q: int, block_k: int, n_kb: int, off: int):
+                         block_q: int, block_k: int, n_kb: int, off: int,
+                         dot_mode: str):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     d = q_ref.shape[2]
@@ -258,10 +313,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
+        mxu = jnp.float32 if dot_mode == "f32" else q_ref.dtype
+        q = q_ref[0].astype(mxu)
+        kb = k_ref[0].astype(mxu)
+        vb = v_ref[0].astype(mxu)
+        g = g_ref[0].astype(mxu)
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
@@ -279,7 +335,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dq_scr[...] += jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
+            ds.astype(mxu), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_kb - 1)
@@ -290,7 +346,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                           sm_scale: float, block_q: int, block_k: int,
-                          n_qb: int, off: int):
+                          n_qb: int, off: int, dot_mode: str):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     d = q_ref.shape[2]
@@ -308,10 +364,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
+        mxu = jnp.float32 if dot_mode == "f32" else q_ref.dtype
+        q = q_ref[0].astype(mxu)
+        kb = k_ref[0].astype(mxu)
+        vb = v_ref[0].astype(mxu)
+        g = g_ref[0].astype(mxu)
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
@@ -325,14 +382,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             s = jnp.where(kpos <= qpos + off, s, -1e30)
         p = jnp.exp(s - lse)  # [Bq, Bk]
         dv_scr[...] += jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
+            p.astype(mxu), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             g, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(mxu), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(iq == n_qb - 1)
@@ -349,12 +406,8 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, sm_scale: float,
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
     bh = b * h
-    block_q = min(block_q, t_q)
-    block_k = min(block_k, t_kv)
-    if t_q % block_q or t_kv % block_k:
-        raise ValueError(
-            f"sequence lengths ({t_q}, {t_kv}) must be divisible by blocks "
-            f"({block_q}, {block_k})")
+    block_q = _fit_block(t_q, block_q, interpret)
+    block_k = _fit_block(t_kv, block_k, interpret)
     n_qb = t_q // block_q
     n_kb = t_kv // block_k
 
@@ -412,7 +465,8 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, sm_scale: float,
     dq3 = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, n_kb=n_kb, off=off),
+            block_q=block_q, block_k=block_k, n_kb=n_kb, off=off,
+            dot_mode=DEFAULT_DOT_MODE),
         grid=(bh, n_qb, n_kb),
         in_specs=[
             qspec(lambda ib, iq, ik: (ib, iq, 0)),
@@ -432,7 +486,8 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, sm_scale: float,
     dk3, dv3 = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, n_qb=n_qb, off=off),
+            block_q=block_q, block_k=block_k, n_qb=n_qb, off=off,
+            dot_mode=DEFAULT_DOT_MODE),
         grid=(bh, n_kb, n_qb),
         in_specs=[
             qspec(q_of_kv),

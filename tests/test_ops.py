@@ -73,8 +73,57 @@ class TestFlashAttention:
             np.asarray(got, np.float32), np.asarray(ref, np.float32),
             atol=3e-2, rtol=3e-2)
 
-    def test_bad_block_divisibility(self):
+    def test_block_autofit(self):
+        # 300 and 768 don't divide the 512-tile default; interpret mode
+        # picks the largest fitting divisor instead of erroring.
         q = jnp.ones((1, 1, 300, 64))
+        out = flash_attention(q, q, q, force="interpret")
+        assert out.shape == q.shape
+        q = jnp.ones((1, 1, 768, 64))
+        out = flash_attention(q, q, q, force="interpret")
+        assert out.shape == q.shape
+
+    def test_block_autofit_hardware_alignment(self):
+        from raytpu.ops.flash_attention import _fit_block
+        # Hardware path: the block must be a sublane-aligned (%8)
+        # divisor >= 64; loose fits are interpret-only.
+        assert _fit_block(768, 512, False) == 384
+        assert _fit_block(1024, 512, False) == 512
+        assert _fit_block(300, 512, True) == 300
+        # explicit small override lowers the floor but stays aligned
+        assert _fit_block(1024, 32, False) == 32
+        # aligned full-sequence block below the floor is fine
+        assert _fit_block(32, 512, False) == 32
+        for bad_t in (300, 521, 1022, 50):  # no aligned divisor
+            with pytest.raises(ValueError):
+                _fit_block(bad_t, 512, False)
+
+    def test_bf16_gradients(self):
+        # bf16 residuals exercise the "input" dot mode in the backward
+        # kernels (p/ds fed to the MXU in bf16); fp32-input tests make
+        # those casts no-ops, so without this the production training
+        # precision path would be untested.
+        b, h, t, d = 1, 2, 128, 64
+        key = jax.random.PRNGKey(4)
+        q, k, v = jax.random.normal(key, (3, b, h, t, d), jnp.bfloat16)
+
+        def loss(force, q, k, v):
+            return flash_attention(q, k, v, force=force).astype(
+                jnp.float32).sum()
+
+        g_ref = jax.grad(lambda *a: loss("reference", *a),
+                         argnums=(0, 1, 2))(q, k, v)
+        g_int = jax.grad(lambda *a: loss("interpret", *a),
+                         argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_int, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b_, np.float32),
+                atol=5e-2, rtol=5e-2)
+
+    def test_bad_block_divisibility(self):
+        # A shape the pallas path cannot tile raises even in interpret
+        # mode once t exceeds every divisor (prime > default block).
+        q = jnp.ones((1, 1, 521, 64))
         with pytest.raises(ValueError):
             flash_attention(q, q, q, force="interpret")
 
