@@ -62,6 +62,124 @@ def _probe_backend(timeout_s: float = 90.0) -> dict:
     return info
 
 
+def _fix_platform(smoke: bool) -> None:
+    """Honor the environment's platform choice even when a plugin
+    sitecustomize overrode jax_platforms at interpreter startup (no-op
+    when the env already selects the accelerator)."""
+    import jax
+
+    plat = "cpu" if smoke else os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
+def _base_config(smoke: bool, seq: int):
+    """The single source of truth for the benchmark model config: both
+    main() (which computes FLOPs/MFU from it) and the measurement
+    children (which run it) call this — they must never drift."""
+    import jax.numpy as jnp
+
+    from raytpu.models.gpt2 import GPT2Config
+
+    if smoke:
+        return GPT2Config(vocab_size=512, block_size=128, n_layer=2,
+                          n_head=4, n_embd=128, dtype=jnp.float32,
+                          attn_impl="reference")
+    return GPT2Config(vocab_size=50304, block_size=seq, n_layer=12,
+                      n_head=12, n_embd=768, dtype=jnp.bfloat16)
+
+
+def _measure_child(spec_json: str) -> None:
+    """--measure-one entry: run ONE autotune candidate and print its JSON.
+
+    Runs in a subprocess so a wedged remote compile (the axon relay dies
+    mid-session; bench run 2 of r5 hung 40 minutes on one compile) costs
+    its own bounded candidate slot, never the whole bench.
+    """
+    spec = json.loads(spec_json)
+    smoke = spec["smoke"]
+    if smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _fix_platform(smoke)
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from raytpu.models.gpt2 import GPT2, init_params, make_train_step
+
+    base = _base_config(smoke, spec["seq"])
+    cfg = dataclasses.replace(base, remat=spec["remat"],
+                              attn_impl=spec["attn"],
+                              loss_chunk=spec["chunk"])
+    batch = spec["batch"]
+    steps = spec["steps"]
+    min_wall = spec["min_wall"]
+
+    model = GPT2(cfg)
+    params = init_params(model, cfg, batch=batch)
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(model, opt), donate_argnums=(0, 1))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, cfg.block_size), 0,
+        cfg.vocab_size, jnp.int32)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    _host_sync(np, loss)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    _host_sync(np, loss)
+    # Timed region. `jax.block_until_ready` proved unreliable on the
+    # experimental axon platform (round-1 bench reported 204x device
+    # peak FLOPs — physically impossible), so the clock stops on a
+    # *host fetch* of the final loss: it transitively depends on every
+    # step through the donated params chain. Steps double until wall
+    # time >= min_wall.
+    while True:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        loss_host = _host_sync(np, loss)
+        dt = time.perf_counter() - t0
+        if dt >= min_wall:
+            break
+        steps *= 2
+    toks = batch * cfg.block_size * steps / dt
+    print(json.dumps(
+        {"batch": batch, "remat": spec["remat"], "chunk": spec["chunk"],
+         "attn": spec["attn"],
+         "tokens_per_sec": round(toks, 1), "steps": steps,
+         "wall_s": round(dt, 3), "loss": float(loss_host)}))
+
+
+def _measure_sub(spec: dict, timeout_s: float) -> dict:
+    """Run one candidate via --measure-one with a hard timeout."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--measure-one",
+           json.dumps(spec)]
+    tag = {k: spec[k] for k in ("batch", "remat", "chunk", "attn")}
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {**tag, "error": f"timeout: candidate exceeded "
+                                f"{timeout_s:.0f}s (relay wedged?)"}
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()[-1:]
+        return {**tag, "error": tail[0] if tail
+                else f"candidate rc={out.returncode}"}
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {**tag, "error": "unparseable candidate output"}
+
+
 def main() -> None:
     smoke = os.environ.get("RAYTPU_BENCH_SMOKE") == "1"
     if smoke:
@@ -94,44 +212,29 @@ def main() -> None:
 
     import jax
 
-    # Honor the environment's platform choice even when a plugin
-    # sitecustomize overrode jax_platforms at interpreter startup (no-op
-    # when the env already selects the accelerator).
-    plat = "cpu" if smoke else os.environ.get("JAX_PLATFORMS")
-    if plat:
-        try:
-            jax.config.update("jax_platforms", plat)
-        except Exception:
-            pass
-
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from raytpu.models.gpt2 import GPT2, GPT2Config, init_params, make_train_step
+    _fix_platform(smoke)
 
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
 
-    import dataclasses
-
     if smoke:
-        base = GPT2Config(vocab_size=512, block_size=128, n_layer=2,
-                          n_head=4, n_embd=128, dtype=jnp.float32,
-                          attn_impl="reference")
+        seq = 128
+        base = _base_config(smoke, seq)
         batch = int(os.environ.get("RAYTPU_BENCH_BATCH", 2))
         steps = int(os.environ.get("RAYTPU_BENCH_STEPS", 3))
         min_wall = 0.5
+        cand_timeout = 300.0
         # Same multi-candidate autotune flow as the real bench, tiny model.
         candidates = [(batch, base.remat, 0), (batch * 2, False, 64)]
         attn_impls = ["reference"]
     else:
         seq = int(os.environ.get("RAYTPU_BENCH_SEQ", 1024))
-        base = GPT2Config(vocab_size=50304, block_size=seq, n_layer=12,
-                          n_head=12, n_embd=768, dtype=jnp.bfloat16)
+        base = _base_config(smoke, seq)
         env_batch = os.environ.get("RAYTPU_BENCH_BATCH")
         steps = int(os.environ.get("RAYTPU_BENCH_STEPS", 10))
         min_wall = 1.5
+        cand_timeout = float(
+            os.environ.get("RAYTPU_BENCH_CAND_TIMEOUT", 900))
         if env_batch is not None:
             candidates = [(int(env_batch), base.remat, 0)]
         else:
@@ -157,44 +260,15 @@ def main() -> None:
                           (32, "dots", 8192)]
         attn_impls = (["tpu", "reference"] if on_accel
                       else ["reference"])
-        if on_accel and _probe_pallas(jnp) != "tpu":
+        if on_accel and _probe_pallas() != "tpu":
             attn_impls = ["reference"]
 
     def measure(batch, remat, chunk, attn_impl, steps):
-        cfg = dataclasses.replace(base, remat=remat, attn_impl=attn_impl,
-                                  loss_chunk=chunk)
-        model = GPT2(cfg)
-        params = init_params(model, cfg, batch=batch)
-        opt = optax.adamw(3e-4, weight_decay=0.1)
-        opt_state = opt.init(params)
-        step = jax.jit(make_train_step(model, opt), donate_argnums=(0, 1))
-        tokens = jax.random.randint(
-            jax.random.PRNGKey(0), (batch, cfg.block_size), 0,
-            cfg.vocab_size, jnp.int32)
-        params, opt_state, loss = step(params, opt_state, tokens)
-        _host_sync(np, loss)
-        params, opt_state, loss = step(params, opt_state, tokens)
-        _host_sync(np, loss)
-        # Timed region. `jax.block_until_ready` proved unreliable on the
-        # experimental axon platform (round-1 bench reported 204x device
-        # peak FLOPs — physically impossible), so the clock stops on a
-        # *host fetch* of the final loss: it transitively depends on every
-        # step through the donated params chain. Steps double until wall
-        # time >= min_wall.
-        while True:
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                params, opt_state, loss = step(params, opt_state, tokens)
-            loss_host = _host_sync(np, loss)
-            dt = time.perf_counter() - t0
-            if dt >= min_wall:
-                break
-            steps *= 2
-        toks = batch * cfg.block_size * steps / dt
-        return {"batch": batch, "remat": remat, "chunk": chunk,
-                "attn": attn_impl,
-                "tokens_per_sec": round(toks, 1), "steps": steps,
-                "wall_s": round(dt, 3), "loss": float(loss_host)}
+        return _measure_sub(
+            {"smoke": smoke, "seq": seq, "batch": batch, "remat": remat,
+             "chunk": chunk, "attn": attn_impl, "steps": steps,
+             "min_wall": min_wall},
+            cand_timeout)
 
     # Attention A/B at the first candidate shape (recorded either way),
     # then batch/remat sweep with the winner.
@@ -205,8 +279,9 @@ def main() -> None:
     for ci, (b0, r0, c0) in enumerate(candidates):
         # Attention A/B at the first candidate that fits (recorded either
         # way); remaining candidates swept with the winning impl. Two
-        # candidates failing in a row ends the sweep — each OOM costs a
-        # full remote compile attempt and the driver's bench has a clock.
+        # candidates failing in a row ends the sweep — each OOM or hung
+        # compile costs its own bounded subprocess and the driver's bench
+        # has a clock.
         if consecutive_failures >= 2:
             sweep.append({"skipped": f"batch={b0} remat={r0} chunk={c0}",
                           "reason": "2 consecutive candidate failures"})
@@ -214,12 +289,9 @@ def main() -> None:
         impls = attn_impls if not ab_done else [best_attn]
         ok = []
         for impl in impls:
-            try:
-                res = measure(b0, r0, c0, impl, steps)
+            res = measure(b0, r0, c0, impl, steps)
+            if "tokens_per_sec" in res:
                 ok.append(res)
-            except Exception as e:  # noqa: BLE001 — e.g. OOM
-                res = {"batch": b0, "remat": r0, "chunk": c0, "attn": impl,
-                       "error": f"{type(e).__name__}: {e}"}
             sweep.append(res)
         consecutive_failures = 0 if ok else consecutive_failures + 1
         if ok and not ab_done:
@@ -230,6 +302,8 @@ def main() -> None:
                           "error": "all autotune candidates failed",
                           "value": None, "detail": {"sweep": sweep}}))
         sys.exit(1)
+
+    import dataclasses
 
     best = max((r for r in sweep if "tokens_per_sec" in r),
                key=lambda r: r["tokens_per_sec"])
@@ -314,22 +388,38 @@ def _host_sync(np, x):
     return np.asarray(x)
 
 
-def _probe_pallas(jnp) -> str:
-    """Try compiling the pallas flash kernel on this backend once."""
+def _probe_pallas(timeout_s: float = 300.0) -> str:
+    """Try compiling the pallas flash kernel on this backend, in a
+    bounded subprocess (a wedged relay compile must not hang the bench)."""
+    import subprocess
+
+    code = ("import jax, os\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p:\n"
+            "    try: jax.config.update('jax_platforms', p)\n"
+            "    except Exception: pass\n"
+            "import jax.numpy as jnp\n"
+            "from raytpu.ops.flash_attention import flash_attention\n"
+            "q = jnp.ones((1, 1, 256, 64), jnp.bfloat16)\n"
+            "out = jax.jit(lambda q: flash_attention(q, q, q, "
+            "force='tpu'))(q)\n"
+            "import numpy as np; np.asarray(out)\n"
+            "print('pallas-ok')")
     try:
-        import jax
-
-        from raytpu.ops.flash_attention import flash_attention
-
-        q = jnp.ones((1, 1, 256, 64), jnp.bfloat16)
-        out = jax.jit(
-            lambda q: flash_attention(q, q, q, force="tpu"))(q)
-        jax.block_until_ready(out)
-        return "tpu"
-    except Exception as e:  # noqa: BLE001
-        print(f"# pallas probe failed ({type(e).__name__}); "
-              f"using XLA attention", file=sys.stderr)
-        return "reference"
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+        if "pallas-ok" in out.stdout:
+            return "tpu"
+        tail = (out.stderr or "").strip().splitlines()[-1:]
+        reason = tail[0] if tail else f"rc={out.returncode}, no stderr"
+        print(f"# pallas probe failed ({reason}); using XLA attention",
+              file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"# pallas probe hung >{timeout_s:.0f}s; using XLA "
+              f"attention", file=sys.stderr)
+    return "reference"
 
 
 def _mfu(tokens_per_sec: float, flops_per_token: float, dev) -> float:
@@ -343,4 +433,7 @@ def _mfu(tokens_per_sec: float, flops_per_token: float, dev) -> float:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--measure-one":
+        _measure_child(sys.argv[2])
+    else:
+        main()

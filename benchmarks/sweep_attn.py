@@ -80,10 +80,14 @@ def measure_one(impl: str) -> dict:
             break
         steps *= 2
     ms = dt / steps * 1e3
+    import math
     return {"impl": impl,
             "block_q": fa.DEFAULT_BLOCK_Q, "block_k": fa.DEFAULT_BLOCK_K,
             "fwd_bwd_ms": round(ms, 3), "steps": steps,
-            "shape": [b, h, t, d], "sink": host,
+            # NaN (iterated-gradient sink overflows bf16 for some impls)
+            # is not valid JSON — strict consumers like jq reject it.
+            "shape": [b, h, t, d], "sink": None if math.isnan(host)
+            else host,
             "device": str(jax.devices()[0])}
 
 
