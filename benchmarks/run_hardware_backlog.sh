@@ -1,10 +1,11 @@
 #!/bin/bash
 # The round-5 hardware backlog in one command (VERDICT r4 next #1-#3).
 # Run on a box with the TPU relay UP. Produces, in order:
-#   BENCH_r05_live.json        headline GPT-2 bench (autotune + attn A/B)
-#   SWEEP_ATTN_r05.json        flash-attention tile sweep
-#   SWEEP_GPT2_r05.json        gpt2 config sweep
-#   PPO_r05_chip.json          PPO with the learner compiled on the chip
+#   BENCH_r05_live.json          headline GPT-2 bench (autotune + attn A/B)
+#   SWEEP_ATTN_r05.json          flash-attention tile sweep ("input" dots)
+#   SWEEP_ATTN_DOT_F32_r05.json  MXU dot-mode A/B (f32 dots, winning tiles)
+#   SWEEP_GPT2_r05.json          gpt2 config sweep
+#   PPO_r05_chip.json            PPO with the learner compiled on the chip
 # Each step is independently timeout-bounded; partial progress is kept.
 set -u
 cd "$(dirname "$0")/.."
@@ -21,21 +22,28 @@ if [ "$plat" != "axon" ] && [ "$plat" != "tpu" ]; then
 fi
 echo "== TPU reachable ($plat); running the backlog =="
 
-echo "== 1/4 headline bench =="
+echo "== 1/5 headline bench =="
 timeout 5400 python bench.py > BENCH_r05_live.json 2> bench_r05.err
 tail -1 BENCH_r05_live.json
 
-echo "== 2/4 flash-attention tile sweep =="
+echo "== 2/5 flash-attention tile sweep =="
 timeout 3600 python benchmarks/sweep_attn.py > SWEEP_ATTN_r05.json \
   2> sweep_attn_r05.err
 tail -1 SWEEP_ATTN_r05.json
 
-echo "== 3/4 gpt2 config sweep =="
+echo "== 2b/5 MXU dot-mode A/B at the winning tiles =="
+RAYTPU_FLASH_DOT=f32 RAYTPU_ATTN_SWEEP_COMBOS=512x512,256x256 \
+  RAYTPU_ATTN_SWEEP_SKIP_REF=1 \
+  timeout 1800 python benchmarks/sweep_attn.py \
+  > SWEEP_ATTN_DOT_F32_r05.json 2> sweep_attn_dot_r05.err
+tail -1 SWEEP_ATTN_DOT_F32_r05.json
+
+echo "== 3/5 gpt2 config sweep =="
 timeout 3600 python benchmarks/sweep_gpt2.py > SWEEP_GPT2_r05.json \
   2> sweep_gpt2_r05.err
 tail -1 SWEEP_GPT2_r05.json
 
-echo "== 4/4 PPO learner on chip =="
+echo "== 4/5 PPO learner on chip =="
 RAYTPU_PPO_BENCH_ON_CHIP=1 timeout 3600 python benchmarks/bench_ppo.py \
   > PPO_r05_chip.json 2> ppo_chip_r05.err
 tail -1 PPO_r05_chip.json

@@ -81,7 +81,7 @@ def measure_one(impl: str) -> dict:
         steps *= 2
     ms = dt / steps * 1e3
     import math
-    return {"impl": impl,
+    return {"impl": impl, "dot": fa.DEFAULT_DOT_MODE,
             "block_q": fa.DEFAULT_BLOCK_Q, "block_k": fa.DEFAULT_BLOCK_K,
             "fwd_bwd_ms": round(ms, 3), "steps": steps,
             # NaN (iterated-gradient sink overflows bf16 for some impls)
@@ -125,8 +125,27 @@ def main() -> None:
         results.append(out)
         print(json.dumps(out), flush=True)
 
-    child({}, "reference")  # XLA baseline at the same shape
-    for bq, bk in COMBOS:
+    combos = COMBOS
+    env_combos = os.environ.get("RAYTPU_ATTN_SWEEP_COMBOS")
+    if env_combos:  # e.g. "512x512,256x256" — focused A/B runs
+        combos = []
+        for tok in env_combos.split(","):
+            parts = tok.strip().split("x")
+            if len(parts) == 2 and all(p.strip().isdigit() for p in parts):
+                combos.append((int(parts[0]), int(parts[1])))
+            else:
+                print(f"# skipping malformed combo {tok!r}",
+                      file=sys.stderr)
+        if not combos:
+            print("# RAYTPU_ATTN_SWEEP_COMBOS had no valid QxK entries; "
+                  "using the default sweep", file=sys.stderr)
+            combos = COMBOS
+
+    # Dot mode doesn't affect the XLA reference path, so focused A/B
+    # re-runs can skip re-measuring the identical baseline.
+    if os.environ.get("RAYTPU_ATTN_SWEEP_SKIP_REF") != "1":
+        child({}, "reference")  # XLA baseline at the same shape
+    for bq, bk in combos:
         child({"RAYTPU_FLASH_BLOCK_Q": str(bq),
                "RAYTPU_FLASH_BLOCK_K": str(bk)}, "tpu")
     ok = [r for r in results if "fwd_bwd_ms" in r and r["impl"] == "tpu"]
