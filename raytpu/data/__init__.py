@@ -6,6 +6,7 @@ from raytpu.data.executor import ActorPoolStrategy, ResourceBudget
 from raytpu.data.read_api import (
     from_arrow,
     from_generator,
+    from_huggingface,
     from_items,
     from_jax,
     from_numpy,
@@ -18,6 +19,7 @@ from raytpu.data.read_api import (
     read_images,
     read_json,
     read_numpy,
+    read_orc,
     read_parquet,
     read_avro,
     read_sql,
@@ -37,6 +39,7 @@ __all__ = [
     "range",
     "range_tensor",
     "from_generator",
+    "from_huggingface",
     "from_items",
     "from_jax",
     "from_numpy",
@@ -48,6 +51,7 @@ __all__ = [
     "read_images",
     "read_json",
     "read_numpy",
+    "read_orc",
     "read_parquet",
     "read_avro",
     "read_sql",

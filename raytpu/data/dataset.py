@@ -704,6 +704,18 @@ class Dataset:
                 pq.write_table(sub,
                                f"{path}/{segs}/part-{i:05d}.parquet")
 
+    def write_orc(self, path: str) -> None:
+        """ORC sink, one file per block (reference analogue:
+        ``Dataset.write_orc``; pyarrow.orc codec)."""
+        import os
+
+        from pyarrow import orc
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            orc.write_table(BlockAccessor(block).to_arrow(),
+                            f"{path}/part-{i:05d}.orc")
+
     def write_csv(self, path: str) -> None:
         import os
 

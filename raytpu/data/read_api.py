@@ -613,6 +613,69 @@ def read_tfrecords(paths, *, raw: bool = False) -> Dataset:
     return Dataset(source, [], name="read_tfrecords")
 
 
+def read_orc(paths, *, columns: Optional[Sequence[str]] = None) -> Dataset:
+    """ORC files as a Dataset, one remote read task per file
+    (reference analogue: ``python/ray/data/read_api.py`` ``read_orc``
+    via the ORC datasource; here pyarrow.orc does the codec work and IO
+    parallelism rides the task fabric)."""
+    files = _expand_paths(paths, ".orc")
+
+    @raytpu.remote(name="data::read_orc")
+    def read_one(path):
+        from pyarrow import orc
+
+        return orc.read_table(path, columns=list(columns)
+                              if columns else None)
+
+    def source():
+        for f in files:
+            yield read_one.remote(f)
+
+    return Dataset(source, [], name="read_orc")
+
+
+def from_huggingface(hf_dataset, *, blocks: int = 8) -> Dataset:
+    """A HuggingFace ``datasets.Dataset`` as a Dataset (reference
+    analogue: ``python/ray/data/read_api.py`` ``from_huggingface``).
+
+    The HF dataset is arrow-backed; each block is a contiguous shard
+    converted to an arrow table. ``IterableDataset`` (streaming) is not
+    supported — materialize it first (mirrors the reference's
+    constraint for non-streaming parallelism).
+    """
+    try:
+        import datasets as hf
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("from_huggingface requires the 'datasets' "
+                          "package") from e
+    if isinstance(hf_dataset, hf.IterableDataset):
+        raise TypeError(
+            "from_huggingface needs a materialized datasets.Dataset; "
+            "streaming IterableDataset is unsupported (use "
+            ".take()/.to_list() or load without streaming=True)")
+    if not isinstance(hf_dataset, hf.Dataset):
+        raise TypeError(f"expected datasets.Dataset, got "
+                        f"{type(hf_dataset).__name__}")
+    # A shuffled/filtered/selected HF dataset is a view: an indices
+    # mapping over the unmodified arrow table. Materialize the view
+    # first, or every shard's .data.table would be the FULL original
+    # table (duplicated, wrong-order rows).
+    if getattr(hf_dataset, "_indices", None) is not None:
+        hf_dataset = hf_dataset.flatten_indices()
+    n = len(hf_dataset)
+    blocks = max(1, min(blocks, n) if n else 1)
+
+    def source():
+        import builtins
+
+        for i in builtins.range(blocks):
+            shard = hf_dataset.shard(num_shards=blocks, index=i,
+                                     contiguous=True)
+            yield raytpu.put(shard.data.table.combine_chunks())
+
+    return Dataset(source, [], name="from_huggingface")
+
+
 def read_avro(paths) -> Dataset:
     """Avro object container files as a Dataset, one block per file
     read in parallel (reference: avro datasource; dependency-free OCF

@@ -827,6 +827,47 @@ class TestMoreDatasources:
                       key=lambda r: r["k"])
         assert rows == [{"k": 1, "opt": None}, {"k": 2, "opt": "x"}]
 
+    def test_orc_roundtrip(self, raytpu_local, tmp_path):
+        """write_orc -> read_orc round-trip with column projection
+        (reference: ORC datasource via pyarrow.orc)."""
+        import glob
+
+        import raytpu.data as rd
+
+        items = [{"id": i, "name": f"r{i}", "v": i * 0.5}
+                 for i in range(10)]
+        out = str(tmp_path / "orc")
+        rd.from_items(items, blocks=2).write_orc(out)
+        assert len(glob.glob(out + "/*.orc")) == 2
+        back = sorted(rd.read_orc(out).take_all(), key=lambda r: r["id"])
+        assert back == items
+        proj = rd.read_orc(out, columns=["id"]).take_all()
+        assert all(set(r) == {"id"} for r in proj)
+
+    def test_from_huggingface(self, raytpu_local):
+        """HF arrow-backed dataset in, contiguous shards out
+        (reference: from_huggingface)."""
+        import datasets as hf
+
+        import raytpu.data as rd
+
+        src = hf.Dataset.from_dict(
+            {"id": list(range(20)), "text": [f"t{i}" for i in range(20)]})
+        ds = rd.from_huggingface(src, blocks=4)
+        rows = ds.take_all()
+        assert [r["id"] for r in rows] == list(range(20))  # contiguous
+        # A shuffled/filtered HF dataset is an indices-mapped VIEW over
+        # the full table; blocks must materialize the view, not leak
+        # the whole underlying table per shard.
+        shuf = src.shuffle(seed=0)
+        rows = rd.from_huggingface(shuf, blocks=4).take_all()
+        assert [r["id"] for r in rows] == list(shuf["id"])
+        filt = src.filter(lambda r: r["id"] % 2 == 0)
+        rows = rd.from_huggingface(filt, blocks=2).take_all()
+        assert [r["id"] for r in rows] == list(range(0, 20, 2))
+        with pytest.raises(TypeError):
+            rd.from_huggingface({"not": "a dataset"})
+
     def test_read_tfrecords_raw(self, raytpu_local, tmp_path):
         import raytpu.data as rd
         from raytpu.data.tfrecord import write_records
