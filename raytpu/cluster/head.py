@@ -106,6 +106,97 @@ class NodeEntry:
         }
 
 
+class _HeadMetrics:
+    """Built-in cluster metrics on the head's Prometheus registry.
+
+    Reference analogue: the core runtime metrics the C++ stats layer
+    exports per node (``src/ray/stats/metric_defs.cc`` —
+    ``ray_cluster_active_nodes``, ``ray_actors``, ``ray_tasks`` ...);
+    here the head is the one process that already sees cluster state, so
+    it publishes directly. Never raises: metrics must not take down the
+    control plane.
+    """
+
+    def __init__(self):
+        self.nodes = self.actors = self.pgs = None
+        self.resources = self.available = None
+        self.schedules = self.tasks_done = None
+        # Label values published last refresh, so series for resources
+        # that vanish (node death) are zeroed instead of lying forever.
+        self._published: set = set()
+        try:
+            from raytpu.util.metrics import Counter, Gauge
+
+            self.nodes = Gauge("raytpu_cluster_nodes",
+                               "Cluster nodes by liveness",
+                               tag_keys=("state",))
+            self.actors = Gauge("raytpu_actors",
+                                "Registered (live) actors")
+            self.pgs = Gauge("raytpu_placement_groups",
+                             "Placement groups")
+            self.resources = Gauge(
+                "raytpu_resources_total",
+                "Cluster resource capacity by name",
+                tag_keys=("resource",))
+            self.available = Gauge(
+                "raytpu_resources_available",
+                "Cluster resource availability by name",
+                tag_keys=("resource",))
+            self.schedules = Counter(
+                "raytpu_schedule_requests_total",
+                "Scheduling decisions served by the head")
+            self.tasks_done = Counter(
+                "raytpu_tasks_done_total",
+                "Task completions reported to the head")
+        except Exception:  # pragma: no cover — metrics are best-effort
+            self.nodes = None
+
+    def refresh(self, nodes, actors, pgs) -> None:
+        if self.nodes is None:
+            return
+        try:
+            alive = sum(1 for n in nodes if n.alive)
+            self.nodes.set(alive, {"state": "alive"})
+            self.nodes.set(len(nodes) - alive, {"state": "dead"})
+            self.actors.set(len(actors))
+            self.pgs.set(len(pgs))
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            for n in nodes:
+                if not n.alive:
+                    continue
+                for k, v in n.total.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+            # A resource that vanished (its only node died) must read 0,
+            # not its last value.
+            for k in self._published - set(total):
+                self.resources.set(0.0, {"resource": k})
+                self.available.set(0.0, {"resource": k})
+            self._published = set(total)
+            for k, v in total.items():
+                self.resources.set(v, {"resource": k})
+            for k, v in avail.items():
+                self.available.set(v, {"resource": k})
+        except Exception:  # pragma: no cover
+            pass
+
+    def tick_schedule(self) -> None:
+        self._inc(self.schedules)
+
+    def tick_task_done(self) -> None:
+        self._inc(self.tasks_done)
+
+    @staticmethod
+    def _inc(counter) -> None:
+        if counter is not None:
+            try:
+                counter.inc()
+            except Exception:  # pragma: no cover
+                pass
+
+
 class HeadServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  storage_path: Optional[str] = None):
@@ -158,6 +249,12 @@ class HeadServer:
         # Explicit request_resources() hint (autoscaler sdk); replaced
         # wholesale on each call, merged into _get_demand's output.
         self._requested_resources: List[Dict[str, float]] = []
+        # Built-in runtime metrics (reference: the core metric defs the
+        # per-node metrics agent exports to Prometheus, e.g.
+        # ray_cluster_active_nodes / ray_actors; metric_defs.cc). Gauges
+        # refresh from the health loop; counters tick on the hot paths.
+        self._metrics = _HeadMetrics()
+        self._metrics_port: Optional[int] = None
         self._job_counter = 0
         self._stop = threading.Event()
         h = self._rpc.register
@@ -256,6 +353,17 @@ class HeadServer:
 
     def start(self) -> str:
         addr = self._rpc.start()
+        try:
+            from raytpu.core.config import cfg
+
+            port = int(cfg.head_metrics_port)
+            if port:
+                from raytpu.util.metrics import start_metrics_server
+
+                if start_metrics_server(port):
+                    self._metrics_port = port
+        except Exception:  # metrics are best-effort, never block startup
+            pass
         self._checker = threading.Thread(
             target=self._health_loop, name="head-health", daemon=True
         )
@@ -299,6 +407,11 @@ class HeadServer:
         self._stop.set()
         self._restart_queue.put(None)
         self._rpc.stop()
+        if self._metrics_port is not None:
+            from raytpu.util.metrics import stop_metrics_server
+
+            stop_metrics_server(self._metrics_port)
+            self._metrics_port = None
         if self._store is not None:
             try:
                 self._store.close()
@@ -383,6 +496,8 @@ class HeadServer:
                     if entry.alive and \
                             now - entry.last_heartbeat > HEARTBEAT_TIMEOUT_S:
                         dead.append(entry.node_id)
+                self._metrics.refresh(list(self._nodes.values()),
+                                      self._actors, self._pgs)
             for node_id in dead:
                 self._mark_dead(node_id, reason="heartbeat timeout")
 
@@ -462,6 +577,7 @@ class HeadServer:
 
     def _task_done(self, peer: Peer, task_id_hex: str,
                    node_id: str) -> None:
+        self._metrics.tick_task_done()
         self._publish("tasks", {"event": "done", "task_id": task_id_hex,
                                 "node_id": node_id})
 
@@ -581,6 +697,7 @@ class HeadServer:
         (reference: hybrid_scheduling_policy.h:50): prefer the hinted /
         most-utilized feasible node until utilization crosses the spread
         threshold, then pick the least-utilized feasible node."""
+        self._metrics.tick_schedule()
         with self._lock:
             feasible = []
             for entry in self._nodes.values():

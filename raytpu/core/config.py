@@ -154,3 +154,8 @@ declare("torch_pg_timeout_s", 1800.0)
 # Memory monitor (reference: memory_monitor.h:52).
 declare("memory_usage_threshold", 0.95)
 declare("memory_monitor_refresh_ms", 250)
+
+# Prometheus scrape endpoint on the head (reference: per-node metrics
+# agent port, metrics_agent.py). 0 = disabled; scrape config for it via
+# `raytpu metrics export-config`.
+declare("head_metrics_port", 0)

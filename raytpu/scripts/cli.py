@@ -317,6 +317,17 @@ def _cmd_dashboard(args) -> int:
     return 0
 
 
+def _cmd_metrics(args) -> int:
+    """Export Prometheus scrape config + Grafana dashboard (reference:
+    ``dashboard/modules/metrics`` config generation)."""
+    from raytpu.util.metrics_export import export_config
+
+    targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+    for path in export_config(args.out, targets):
+        print(path)
+    return 0
+
+
 def _cmd_job(args) -> int:
     from raytpu.job.sdk import JobSubmissionClient
 
@@ -446,6 +457,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--host", default="0.0.0.0")
     s.add_argument("--port", type=int, default=10001)
     s.set_defaults(fn=_cmd_proxy)
+
+    s = sub.add_parser(
+        "metrics", help="export Prometheus/Grafana monitoring config")
+    msub = s.add_subparsers(dest="metrics_cmd", required=True)
+    m = msub.add_parser("export-config")
+    m.add_argument("--out", default="./raytpu-monitoring",
+                   help="output directory")
+    m.add_argument("--targets", default="127.0.0.1:8265",
+                   help="comma-separated dashboard host:port targets")
+    m.set_defaults(fn=_cmd_metrics)
 
     s = sub.add_parser("job", help="job submission")
     s.add_argument("--api", default="http://127.0.0.1:8265",

@@ -160,19 +160,33 @@ class Histogram(_Metric):
             return list(self._observations)
 
 
-_server_started = False
+_servers: Dict[int, tuple] = {}  # port -> (wsgi_server, thread)
 _server_lock = threading.Lock()
 
 
 def start_metrics_server(port: int = 8090) -> bool:
     """Expose the Prometheus scrape endpoint (reference: per-node metrics
-    agent → Prometheus exposition)."""
-    global _server_started
+    agent → Prometheus exposition). Idempotent per port; a second caller
+    asking for a DIFFERENT port gets its own endpoint (a restarted head
+    with a new config must not silently reuse the dead one's port)."""
     if _prom is None:
         return False
     with _server_lock:
-        if _server_started:
+        if port in _servers:
             return True
-        _prom.start_http_server(port)
-        _server_started = True
+        _servers[port] = _prom.start_http_server(port)
         return True
+
+
+def stop_metrics_server(port: int) -> None:
+    """Shut down the scrape endpoint on ``port`` (no-op if not running)."""
+    with _server_lock:
+        entry = _servers.pop(port, None)
+    if entry is None:
+        return
+    server, thread = entry
+    try:
+        server.shutdown()
+        thread.join(timeout=5)
+    except Exception:  # pragma: no cover
+        pass

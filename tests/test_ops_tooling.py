@@ -41,6 +41,90 @@ class TestMetrics:
         h.observe(0.5)
         assert h.observations == [0.05, 0.5]
 
+    def test_head_metrics_refresh(self):
+        """Built-in cluster gauges publish head state (reference: core
+        metric defs, metric_defs.cc)."""
+        from raytpu.cluster.head import NodeEntry, _HeadMetrics
+
+        m = _HeadMetrics()
+        n1 = NodeEntry("n1", "addr1", {"num_cpus": 4.0, "TPU": 8.0}, {})
+        n1.available = {"num_cpus": 1.0, "TPU": 8.0}
+        n2 = NodeEntry("n2", "addr2", {"num_cpus": 2.0}, {})
+        n2.alive = False
+        m.refresh([n1, n2], {"a1": {}}, {"pg1": {}})
+        assert m.nodes._values == {("alive",): 1.0, ("dead",): 1.0}
+        assert m.resources._values[("TPU",)] == 8.0
+        assert m.available._values[("num_cpus",)] == 1.0
+        assert m.actors.value == 1.0
+        assert m.pgs.value == 1.0
+        m.tick_schedule()
+        m.tick_task_done()
+        assert m.schedules.value == 1.0
+        assert m.tasks_done.value == 1.0
+        # A resource whose only node died reads 0, not its last value.
+        m.refresh([n2], {}, {})
+        assert m.resources._values[("TPU",)] == 0.0
+        assert m.available._values[("num_cpus",)] == 0.0
+
+    def test_head_metrics_scrape_endpoint(self):
+        """cfg.head_metrics_port exposes the head's Prometheus scrape
+        endpoint (reference: per-node metrics agent port); the built-in
+        gauges appear after one health tick."""
+        import socket
+        import time
+        import urllib.request
+
+        from raytpu.cluster.head import HeadServer
+        from raytpu.core.config import cfg
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        cfg.set("head_metrics_port", port)
+        head = None
+        try:
+            head = HeadServer()
+            head.start()
+
+            class _FakePeer:
+                meta: dict = {}
+
+            head._register_node(_FakePeer(), "n1", "fake:0",
+                                {"num_cpus": 2.0}, {})
+            deadline = time.monotonic() + 10
+            text = ""
+            while time.monotonic() < deadline:
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=5).read().decode()
+                if 'raytpu_cluster_nodes{state="alive"} 1.0' in text:
+                    break
+                time.sleep(0.3)
+            assert 'raytpu_cluster_nodes{state="alive"} 1.0' in text
+            assert 'raytpu_resources_total{resource="num_cpus"}' in text
+        finally:
+            cfg.set("head_metrics_port", 0)
+            if head is not None:
+                head.stop()
+
+    def test_metrics_export_config(self, tmp_path):
+        """prometheus.yml + Grafana JSON generation (reference:
+        dashboard/modules/metrics config generation)."""
+        import json
+
+        from raytpu.util.metrics_export import export_config
+
+        files = export_config(str(tmp_path), ["127.0.0.1:8265"])
+        prom = open(files[0]).read()
+        assert "job_name: raytpu" in prom
+        assert "'127.0.0.1:8265'" in prom
+        dash = json.load(open(files[1]))
+        exprs = [t["expr"] for p in dash["panels"]
+                 for t in p["targets"]]
+        assert "raytpu_cluster_nodes" in exprs
+        assert any("raytpu_tasks_done_total" in e for e in exprs)
+
 
 class TestTracing:
     def test_spans_captured_when_enabled(self):
