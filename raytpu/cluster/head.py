@@ -106,6 +106,14 @@ class NodeEntry:
         }
 
 
+# Resource label values published by the LAST refresh, so series for
+# resources that vanish (node death) are zeroed instead of lying
+# forever. Module-global to match the collectors' lifetime: a head
+# restarted in the same process shares the prometheus collectors, so it
+# must also inherit the set of series needing zeroing.
+_published_resources: set = set()
+
+
 class _HeadMetrics:
     """Built-in cluster metrics on the head's Prometheus registry.
 
@@ -121,9 +129,6 @@ class _HeadMetrics:
         self.nodes = self.actors = self.pgs = None
         self.resources = self.available = None
         self.schedules = self.tasks_done = None
-        # Label values published last refresh, so series for resources
-        # that vanish (node death) are zeroed instead of lying forever.
-        self._published: set = set()
         try:
             from raytpu.util.metrics import Counter, Gauge
 
@@ -171,10 +176,11 @@ class _HeadMetrics:
                     avail[k] = avail.get(k, 0.0) + v
             # A resource that vanished (its only node died) must read 0,
             # not its last value.
-            for k in self._published - set(total):
+            global _published_resources
+            for k in _published_resources - set(total):
                 self.resources.set(0.0, {"resource": k})
                 self.available.set(0.0, {"resource": k})
-            self._published = set(total)
+            _published_resources = set(total)
             for k, v in total.items():
                 self.resources.set(v, {"resource": k})
             for k, v in avail.items():

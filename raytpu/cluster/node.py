@@ -527,6 +527,7 @@ class NodeServer:
         h("debug_state", self._h_debug_state)
         h("worker_stacks", self._h_worker_stacks)
         h("worker_profile", self._h_worker_profile)
+        h("worker_memory_profile", self._h_worker_memory_profile)
         h("ping", lambda peer: "pong")
         # Worker-process plane
         h("register_worker", self._h_register_worker)
@@ -1703,29 +1704,23 @@ class NodeServer:
                             "error": f"{type(e).__name__}: {e}"}
         return out
 
-    async def _h_worker_profile(self, peer: Peer,
-                                worker_id: Optional[str] = None,
-                                duration_s: float = 2.0,
-                                hz: float = 50.0,
-                                include_idle: bool = True
-                                ) -> Dict[str, dict]:
-        """Sampling CPU profiles of workers on this node (reference:
-        profile_manager.py py-spy flamegraphs). All targeted workers are
-        sampled CONCURRENTLY (one duration_s total, not per worker);
-        ``worker_id`` narrows to one worker, ``"daemon"`` samples the
-        node daemon itself."""
+    async def _fanout_worker_profiling(self, worker_id, payload_key,
+                                       rpc_name, rpc_args, local_fn,
+                                       timeout: float) -> Dict[str, dict]:
+        """Shared fan-out for the profiling RPCs (CPU sampling, memory
+        tracing): run ``local_fn`` for the daemon and ``rpc_name`` on
+        every targeted worker CONCURRENTLY (one shared window, not one
+        per worker). ``worker_id`` narrows to one worker; ``"daemon"``
+        targets only the node daemon itself."""
         import asyncio as _asyncio
         from concurrent.futures import ThreadPoolExecutor
-
-        from raytpu.util.profiler import sample_for
 
         loop = _asyncio.get_event_loop()
         out: Dict[str, dict] = {}
         jobs = []
         if worker_id in (None, "daemon"):
             jobs.append(("daemon", lambda: {
-                "pid": os.getpid(),
-                "profile": sample_for(duration_s, hz, include_idle)}))
+                "pid": os.getpid(), payload_key: local_fn()}))
         if worker_id != "daemon" and self.worker_pool is not None:
             with self.worker_pool._lock:
                 handles = {wid: h for wid, h
@@ -1741,9 +1736,8 @@ class NodeServer:
 
                 def one(h=h, client=client):
                     return {"pid": h.pid,
-                            "profile": client.call(
-                                "profile", duration_s, hz, include_idle,
-                                timeout=duration_s + 30.0)}
+                            payload_key: client.call(
+                                rpc_name, *rpc_args, timeout=timeout)}
                 jobs.append((wid, one))
         if jobs:
             with ThreadPoolExecutor(
@@ -1758,6 +1752,40 @@ class NodeServer:
                         out[wid] = {"error":
                                     f"{type(e).__name__}: {e}"}
         return out
+
+    async def _h_worker_profile(self, peer: Peer,
+                                worker_id: Optional[str] = None,
+                                duration_s: float = 2.0,
+                                hz: float = 50.0,
+                                include_idle: bool = True
+                                ) -> Dict[str, dict]:
+        """Sampling CPU profiles of workers on this node (reference:
+        profile_manager.py py-spy flamegraphs)."""
+        from raytpu.util.profiler import sample_for
+
+        return await self._fanout_worker_profiling(
+            worker_id, "profile", "profile",
+            (duration_s, hz, include_idle),
+            lambda: sample_for(duration_s, hz, include_idle),
+            timeout=duration_s + 30.0)
+
+    async def _h_worker_memory_profile(self, peer: Peer,
+                                       worker_id: Optional[str] = None,
+                                       duration_s: float = 2.0,
+                                       trace_frames: int = 16,
+                                       top_n: int = 40,
+                                       stop_after: bool = False
+                                       ) -> Dict[str, dict]:
+        """Allocation memory profiles of workers on this node (reference:
+        profile_manager.py memray attach)."""
+        from raytpu.util.memprofile import memory_profile
+
+        return await self._fanout_worker_profiling(
+            worker_id, "memory", "memory_profile",
+            (duration_s, trace_frames, top_n, stop_after),
+            lambda: memory_profile(duration_s, trace_frames, top_n,
+                                   stop_after),
+            timeout=duration_s + 30.0)
 
     def _h_node_info(self, peer: Peer) -> dict:
         return {

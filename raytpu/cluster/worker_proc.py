@@ -528,6 +528,17 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
         return _offload(sample_for, duration_s, hz, include_idle)
 
     server.register("profile", h_profile)
+
+    def h_memory_profile(peer: Peer, duration_s: float = 2.0,
+                         trace_frames: int = 16, top_n: int = 40,
+                         stop_after: bool = False):
+        from raytpu.util.memprofile import memory_profile
+
+        # Offloaded like h_profile: the window sleeps for duration_s.
+        return _offload(memory_profile, duration_s, trace_frames, top_n,
+                        stop_after)
+
+    server.register("memory_profile", h_memory_profile)
     addr = server.start()
     host.node.call("register_worker", args.worker_id, addr, os.getpid())
 

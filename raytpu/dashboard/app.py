@@ -173,6 +173,19 @@ class DashboardServer:
             hz, include_idle, node_filter=node_filter,
             timeout=duration_s + 60.0)
 
+    def _collect_memprofile(self, worker: Optional[str],
+                            node_filter: Optional[str],
+                            duration_s: float, trace_frames: int,
+                            stop_after: bool) -> Dict[str, Any]:
+        """Concurrent cluster-wide allocation profile (one shared
+        window, same fan-out as _collect_profile)."""
+        from raytpu.util.stack_dump import fanout_node_call
+
+        return fanout_node_call(
+            self._worker_nodes(), "worker_memory_profile", worker,
+            duration_s, trace_frames, 40, stop_after,
+            node_filter=node_filter, timeout=duration_s + 60.0)
+
     def _worker_nodes(self):
         import raytpu
 
@@ -378,6 +391,52 @@ class DashboardServer:
                                  else ""))
             return web.Response(text=svg, content_type="image/svg+xml")
 
+        async def memprofile(request):
+            """On-demand allocation memory flamegraph of live workers
+            (reference: profile_manager.py memray endpoint). Query:
+            ?worker=<id prefix|daemon>, ?node=<id prefix>,
+            ?duration=<s, default 2>, ?frames=<traceback depth, 16>,
+            ?stop=1 (turn tracing off after), ?format=svg|json|table.
+            """
+            from raytpu.util.memprofile import top_table
+            from raytpu.util.profiler import (flamegraph_svg,
+                                              merge_collapsed)
+
+            loop = asyncio.get_running_loop()
+            worker = request.query.get("worker") or None
+            node_filter = request.query.get("node") or None
+            try:
+                duration = float(request.query.get("duration", 2.0))
+                frames = int(request.query.get("frames", 16))
+            except ValueError:
+                return web.Response(
+                    status=400, text="duration/frames must be numbers")
+            stop_after = request.query.get("stop", "0") == "1"
+            fmt = request.query.get("format", "svg")
+            result = await loop.run_in_executor(
+                None, self._collect_memprofile, worker, node_filter,
+                duration, frames, stop_after)
+            worker_mems = [
+                w for node in result.values() if isinstance(node, dict)
+                for w in node.values()
+                if isinstance(w, dict) and "memory" in w]
+            if fmt == "json":
+                return web.json_response(result)
+            if fmt == "table":
+                text = "\n\n".join(top_table(w["memory"])
+                                   for w in worker_mems)
+                return web.Response(text=text or "no profiles",
+                                    content_type="text/plain")
+            merged = merge_collapsed(
+                w["memory"].get("collapsed", {}) for w in worker_mems)
+            total = sum(w["memory"].get("total_kb", 0)
+                        for w in worker_mems)
+            svg = flamegraph_svg(
+                merged, title=f"live python allocations — "
+                              f"{len(worker_mems)} process(es), "
+                              f"{total:,} KiB traced (weights = KiB)")
+            return web.Response(text=svg, content_type="image/svg+xml")
+
         app = web.Application()
         app.router.add_get("/", index)
         app.router.add_get("/api/summary", api_summary)
@@ -386,6 +445,7 @@ class DashboardServer:
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/stacks", stacks)
         app.router.add_get("/profile", profile)
+        app.router.add_get("/memprofile", memprofile)
         app.router.add_get("/logs", logs_index)
         app.router.add_get("/logs/{node_id}/{name}", log_file)
         self._runner = web.AppRunner(app, access_log=None)

@@ -245,6 +245,53 @@ def _cmd_profile(args) -> int:
     return 0
 
 
+def _cmd_memprofile(args) -> int:
+    """Trace live-worker Python allocations and write a memory
+    flamegraph SVG (reference: the dashboard's memray profiles;
+    profile_manager.py:79 — tracemalloc analogue, weights are KiB)."""
+    from raytpu.util.memprofile import top_table
+    from raytpu.util.profiler import flamegraph_svg, merge_collapsed
+    from raytpu.util.stack_dump import fanout_node_call
+
+    results = fanout_node_call(
+        _cluster_worker_nodes(args.address), "worker_memory_profile",
+        args.worker, args.duration, args.frames, 40, args.stop,
+        node_filter=args.node, timeout=args.duration + 60.0)
+    mems = []
+    for node_id, workers in results.items():
+        if set(workers) == {"error"}:
+            print(f"== node {node_id[:12]}: unreachable: "
+                  f"{workers['error']}", file=sys.stderr)
+            continue
+        for wid, info in workers.items():
+            if "memory" in info:
+                m = info["memory"]
+                mems.append(m)
+                print(f"node {node_id[:12]} {wid[:12]} pid="
+                      f"{info.get('pid')}: {m['total_kb']:,} KiB live, "
+                      f"rss {m.get('rss_kb') or 0:,} KiB"
+                      + (" [window-only]" if m.get("window_only")
+                         else ""), file=sys.stderr)
+            else:
+                print(f"node {node_id[:12]} {wid[:12]}: "
+                      f"error: {info.get('error')}", file=sys.stderr)
+    if not mems:
+        print("no memory profiles collected", file=sys.stderr)
+        return 1
+    if args.out == "-":
+        for m in mems:
+            print(top_table(m))
+        return 0
+    merged = merge_collapsed(m.get("collapsed", {}) for m in mems)
+    total = sum(m.get("total_kb", 0) for m in mems)
+    with open(args.out, "w") as f:
+        f.write(flamegraph_svg(
+            merged, title=f"live python allocations — {len(mems)} "
+                          f"process(es), {total:,} KiB (weights = KiB)"))
+    print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
 def _cmd_up(args) -> int:
     """Bring a cluster to its YAML-declared minimum footprint
     (reference: ``ray up``, ``python/ray/scripts/scripts.py:1278``)."""
@@ -441,6 +488,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(fn=_cmd_profile)
 
     s = sub.add_parser(
+        "memprofile", help="allocation memory profile of cluster "
+                           "workers -> flamegraph SVG (reference: "
+                           "dashboard memray)")
+    s.add_argument("--address", required=True, help="head host:port")
+    s.add_argument("--node", default=None, help="node id prefix filter")
+    s.add_argument("--duration", type=float, default=2.0,
+                   help="trace window seconds")
+    s.add_argument("--frames", type=int, default=16,
+                   help="allocation traceback depth")
+    s.add_argument("--stop", action="store_true",
+                   help="stop tracing after (removes overhead, loses "
+                        "the baseline for the next call)")
+    s.add_argument("--out", default="memprofile.svg",
+                   help="output path (.svg or '-' for a text table)")
+    s.add_argument("worker", nargs="?", default=None,
+                   help="worker id prefix, 'daemon', or empty for all")
+    s.set_defaults(fn=_cmd_memprofile)
+
+    s = sub.add_parser(
         "up", help="bring up a cluster from a YAML spec (reference: "
                    "ray up)")
     s.add_argument("config", help="cluster YAML path")
@@ -464,8 +530,11 @@ def build_parser() -> argparse.ArgumentParser:
     m = msub.add_parser("export-config")
     m.add_argument("--out", default="./raytpu-monitoring",
                    help="output directory")
-    m.add_argument("--targets", default="127.0.0.1:8265",
-                   help="comma-separated dashboard host:port targets")
+    m.add_argument("--targets", default="127.0.0.1:8090",
+                   help="comma-separated metrics host:port targets — "
+                        "the HEAD's Prometheus endpoint "
+                        "(head_metrics_port, where the raytpu_* "
+                        "cluster series live), not the dashboard")
     m.set_defaults(fn=_cmd_metrics)
 
     s = sub.add_parser("job", help="job submission")

@@ -187,6 +187,10 @@ def stop_metrics_server(port: int) -> None:
     server, thread = entry
     try:
         server.shutdown()
+        # shutdown() only stops the serve loop; the listening socket
+        # stays bound until closed — a restart on the same port would
+        # otherwise race GC for EADDRINUSE.
+        server.server_close()
         thread.join(timeout=5)
     except Exception:  # pragma: no cover
         pass

@@ -163,6 +163,94 @@ class TestSamplingProfiler:
         assert "t&lt;est" in svg
         assert "80 samples (80.0%)" in svg
 
+    def test_memory_profile_finds_the_allocator(self):
+        from raytpu.util.memprofile import memory_profile, top_table
+
+        hoard = []
+
+        def hoarding_alloc_marker_fn():
+            for _ in range(200):
+                hoard.append(bytearray(64 * 1024))
+
+        # tracemalloc must be ON before the allocation happens for the
+        # traceback to be recorded: first call starts tracing.
+        memory_profile(duration_s=0.0)
+        hoarding_alloc_marker_fn()
+        prof = memory_profile(duration_s=0.0, stop_after=True)
+        try:
+            assert prof["total_kb"] >= 200 * 64 * 0.9  # ~12.5 MiB live
+            hot = {k: v for k, v in prof["collapsed"].items()
+                   if "test_stack_dump" in k}
+            assert hot, list(prof["collapsed"])[:5]
+            # the hoard dominates traced bytes
+            assert sum(hot.values()) >= 0.5 * prof["total_kb"]
+            table = top_table(prof)
+            assert "KiB" in table and "pid" in table
+        finally:
+            hoard.clear()
+
+    def test_memory_profile_window_only_flag(self):
+        import tracemalloc
+
+        from raytpu.util.memprofile import memory_profile
+
+        assert not tracemalloc.is_tracing()
+        prof = memory_profile(duration_s=0.0, stop_after=True)
+        assert prof["window_only"] is True
+        assert not tracemalloc.is_tracing()
+        assert prof["rss_kb"] is None or prof["rss_kb"] > 0
+
+    def test_cluster_memory_profile_rpc(self):
+        """A worker hoarding memory is visible through the node's
+        worker_memory_profile RPC, with per-worker totals."""
+        cluster = Cluster()
+        cluster.add_node(num_cpus=2, num_tpus=0)
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote
+            class Hoarder:
+                def __init__(self):
+                    self._hoard = []
+
+                def hoard_blocks_marker(self, n, kb):
+                    for _ in range(n):
+                        self._hoard.append(bytearray(kb * 1024))
+                    return len(self._hoard)
+
+            h = Hoarder.remote()
+            # Force the actor's worker process to exist BEFORE arming:
+            # tracing only records allocations made while it is on.
+            assert raytpu.get(h.hoard_blocks_marker.remote(0, 0),
+                              timeout=60) == 0
+            node_addr = next(n["Address"] for n in raytpu.nodes()
+                             if n.get("Labels", {}).get("role")
+                             != "driver")
+            # Arm tracing first (window 0), then allocate, then read.
+            cli = RpcClient(node_addr)
+            try:
+                cli.call("worker_memory_profile", None, 0.0, 16, 40,
+                         False, timeout=60.0)
+                assert raytpu.get(
+                    h.hoard_blocks_marker.remote(100, 64),
+                    timeout=60) == 100
+                prof = cli.call("worker_memory_profile", None, 0.0, 16,
+                                40, False, timeout=60.0)
+            finally:
+                cli.close()
+            assert "daemon" in prof
+            workers = {k: v for k, v in prof.items()
+                       if k != "daemon" and "memory" in v}
+            assert workers, prof
+            best = max(w["memory"]["total_kb"] for w in workers.values())
+            assert best >= 100 * 64 * 0.9, prof
+            joined = "\n".join(
+                k for w in workers.values()
+                for k in w["memory"]["collapsed"])
+            assert "alloc;" in joined
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
     def test_cluster_profile_rpc_and_cli(self, tmp_path, capsys):
         """End to end: a busy worker profiled through the node's
         worker_profile RPC and the `raytpu profile` CLI."""
