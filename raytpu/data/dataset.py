@@ -783,6 +783,80 @@ class Dataset:
             write_file(f"{path}/part-{i:05d}.avro", sch, rows,
                        codec=codec)
 
+    def write_sql(self, sql: str, connection_factory: Callable) -> None:
+        """Write rows through a DB-API connection (reference:
+        ``Dataset.write_sql`` / ``sql_datasink.py`` — ``sql`` is the
+        parameterized INSERT, e.g. ``INSERT INTO t VALUES (?, ?)``;
+        rows go in ``executemany`` batches so one bad row can't grow an
+        unbounded buffer)."""
+        MAX_ROWS_PER_WRITE = 128
+        conn = connection_factory()
+        try:
+            cursor = conn.cursor()
+            for block in self.iter_blocks():
+                values = []
+                for row in BlockAccessor(block).to_rows():
+                    values.append(tuple(_plain_row(row).values()))
+                    if len(values) == MAX_ROWS_PER_WRITE:
+                        cursor.executemany(sql, values)
+                        values = []
+                if values:
+                    cursor.executemany(sql, values)
+            conn.commit()
+        finally:
+            conn.close()
+
+    def write_images(self, path: str, column: str, *,
+                     file_format: str = "png",
+                     filename_column: Optional[str] = None) -> None:
+        """One image file per row from an array column (reference:
+        ``Dataset.write_images`` / ``image_datasink.py``). Filenames
+        come from ``filename_column`` when given, else sequential;
+        uint8 HxWxC (or HxW grayscale) arrays are expected — readable
+        back via ``read_images``."""
+        import os
+
+        from PIL import Image
+
+        os.makedirs(path, exist_ok=True)
+        n = 0
+        for block in self.iter_blocks():
+            for row in BlockAccessor(block).to_rows():
+                arr = np.asarray(row[column])
+                if arr.dtype != np.uint8:
+                    # read_images yields float32 0-255; PIL wants uint8.
+                    arr = np.clip(arr, 0, 255).astype(np.uint8)
+                name = (str(row[filename_column]) if filename_column
+                        else f"{n:06d}.{file_format}")
+                Image.fromarray(arr).save(os.path.join(path, name))
+                n += 1
+
+    def write_webdataset(self, path: str) -> None:
+        """One ``.tar`` shard per block in WebDataset layout (reference:
+        ``Dataset.write_webdataset`` / ``webdataset_datasink.py``):
+        each row becomes ``{__key__}.{ext}`` members, one per non-key
+        column; str values encode utf-8, bytes pass through, everything
+        else serializes as its ``str()``. Round-trips through
+        ``read_webdataset``."""
+        import io
+        import os
+        import tarfile
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            with tarfile.open(f"{path}/part-{i:05d}.tar", "w") as tf:
+                for j, row in enumerate(BlockAccessor(block).to_rows()):
+                    row = _plain_row(row)
+                    key = str(row.pop("__key__", f"{i:05d}{j:05d}"))
+                    for ext, value in row.items():
+                        if value is None:
+                            continue
+                        data = (value if isinstance(value, bytes)
+                                else str(value).encode("utf-8"))
+                        info = tarfile.TarInfo(f"{key}.{ext}")
+                        info.size = len(data)
+                        tf.addfile(info, io.BytesIO(data))
+
     # -- internals ------------------------------------------------------------
 
     def _with_op(self, op: OpSpec) -> "Dataset":

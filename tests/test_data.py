@@ -596,6 +596,58 @@ class TestNewDatasources:
         assert len(batches) == 2
 
 
+class TestDatasinks:
+    def test_write_sql_roundtrip(self, raytpu_local, tmp_path):
+        import sqlite3
+
+        import raytpu.data as rd
+
+        db = str(tmp_path / "w.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE out (id INTEGER, name TEXT)")
+        conn.commit()
+        conn.close()
+        ds = rd.from_items([{"id": i, "name": f"n{i}"}
+                            for i in range(300)])  # > one executemany batch
+        ds.write_sql("INSERT INTO out VALUES (?, ?)",
+                     lambda: sqlite3.connect(db))
+        back = rd.read_sql("SELECT id, name FROM out",
+                           lambda: sqlite3.connect(db))
+        rows = sorted(back.take_all(), key=lambda r: r["id"])
+        assert len(rows) == 300 and rows[7] == {"id": 7, "name": "n7"}
+
+    def test_write_images_roundtrip(self, raytpu_local, tmp_path):
+        import numpy as np
+
+        import raytpu.data as rd
+
+        images = np.stack([np.full((8, 8, 3), i, np.uint8)
+                           for i in range(4)])
+        names = np.asarray([f"img{i}.png" for i in range(4)])
+        out = str(tmp_path / "imgs")
+        rd.from_numpy({"image": images, "fname": names}).write_images(
+            out, "image", filename_column="fname")
+        back = rd.read_images(out)
+        got = sorted(back.take_all(), key=lambda r: int(r["image"][0, 0, 0]))
+        assert len(got) == 4
+        assert got[2]["image"].shape == (8, 8, 3)
+        assert (got[2]["image"] == 2).all()
+
+    def test_write_webdataset_roundtrip(self, raytpu_local, tmp_path):
+        import raytpu.data as rd
+
+        rows = [{"__key__": f"s{i:03d}", "txt": f"caption {i}",
+                 "bin": bytes([i, i + 1])} for i in range(6)]
+        out = str(tmp_path / "wds")
+        rd.from_items(rows).write_webdataset(out)
+        back = rd.read_webdataset(out)
+        got = sorted(back.take_all(), key=lambda r: r["__key__"])
+        assert len(got) == 6
+        assert got[0]["__key__"] == "s000"
+        assert got[3]["txt"] == "caption 3"
+        assert got[3]["bin"] == bytes([3, 4])
+
+
 class TestMoreDatasources:
     def test_read_sql(self, raytpu_local, tmp_path):
         import sqlite3
