@@ -26,15 +26,22 @@ spreading — the topology is a scheduling dimension, not an env var.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+from raytpu.util import failpoints
+from raytpu.util.failpoints import DROP, failpoint
 
-HEARTBEAT_TIMEOUT_S = 5.0
-CHECK_PERIOD_S = 1.0
+# Env-overridable so chaos tests (and small dev clusters) can tighten the
+# failure-detection window without patching module state in subprocesses.
+HEARTBEAT_TIMEOUT_S = float(os.environ.get(
+    "RAYTPU_HEARTBEAT_TIMEOUT_S", "5.0"))
+CHECK_PERIOD_S = float(os.environ.get(
+    "RAYTPU_HEALTH_CHECK_PERIOD_S", "1.0"))
 
 
 class GcsStore:
@@ -298,6 +305,12 @@ class HeadServer:
         h("request_resources", self._request_resources)
         h("next_job_id", self._next_job_id)
         h("ping", lambda peer: "pong")
+        # Chaos testing: arm/inspect failpoints on this head or, with
+        # scope="cluster", on every live node daemon too (reference
+        # analogue: Ray's testing-only fault-injection RPCs).
+        h("failpoint_cfg", self._failpoint_cfg)
+        h("failpoint_clear", self._failpoint_clear)
+        h("failpoint_stat", lambda peer, name: failpoints.stat(name))
         self._rpc.on_disconnect(self._peer_gone)
         # Actor-restart machinery (reference: GcsActorManager).
         import queue as _q
@@ -438,6 +451,7 @@ class HeadServer:
     def _register_node(self, peer: Peer, node_id: str, address: str,
                        resources: Dict[str, float],
                        labels: Dict[str, str]) -> dict:
+        failpoint("head.node.register")
         with self._lock:
             entry = NodeEntry(node_id, address, resources, labels)
             entry.peer = peer
@@ -449,6 +463,10 @@ class HeadServer:
 
     def _heartbeat(self, peer: Peer, node_id: str,
                    available: Dict[str, float], seq: int = 0) -> None:
+        # drop => the head never saw this heartbeat; enough consecutive
+        # drops and the health loop declares the node dead.
+        if failpoint("head.heartbeat.handle") is DROP:
+            return
         with self._lock:
             entry = self._nodes.get(node_id)
             if entry is not None:
@@ -474,6 +492,46 @@ class HeadServer:
     def _list_nodes(self, peer: Peer) -> List[dict]:
         with self._lock:
             return [n.snapshot() for n in self._nodes.values()]
+
+    # -- failpoints (chaos testing) ----------------------------------------
+
+    def _failpoint_cfg(self, peer: Peer, name: str, spec: str,
+                       scope: str = "local") -> List[str]:
+        """Arm a failpoint on this head; ``scope="cluster"`` fans the same
+        spec out to every live node daemon so a test can inject faults on
+        remote processes it never spawned. Returns the ids it reached
+        ("head" + node ids)."""
+        failpoints.cfg(name, spec)
+        reached = ["head"]
+        if scope == "cluster":
+            with self._lock:
+                targets = [(n.node_id, n.address)
+                           for n in self._nodes.values() if n.alive]
+            for node_id, address in targets:
+                try:
+                    self._node_client(node_id, address).call(
+                        "failpoint_cfg", name, spec, timeout=5.0)
+                    reached.append(node_id)
+                except Exception:
+                    pass  # a dying node is exactly what chaos runs expect
+        return reached
+
+    def _failpoint_clear(self, peer: Peer,
+                         scope: str = "local") -> List[str]:
+        failpoints.clear()
+        reached = ["head"]
+        if scope == "cluster":
+            with self._lock:
+                targets = [(n.node_id, n.address)
+                           for n in self._nodes.values() if n.alive]
+            for node_id, address in targets:
+                try:
+                    self._node_client(node_id, address).call(
+                        "failpoint_clear", timeout=5.0)
+                    reached.append(node_id)
+                except Exception:
+                    pass
+        return reached
 
     def _peer_gone(self, peer: Peer) -> None:
         node_id = peer.meta.get("node_id")

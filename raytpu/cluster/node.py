@@ -28,6 +28,8 @@ from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
 from raytpu.core.config import cfg
+from raytpu.util import failpoints
+from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.events import record_event
 from raytpu.core.errors import ActorDiedError, TaskError, WorkerCrashedError
 from raytpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
@@ -136,6 +138,7 @@ class _ProcActorRuntime:
         self.ready_event.set()
 
     def _dispatch_one(self, spec: TaskSpec):
+        failpoint("actor.dispatch.pre")
         # Visible in _task_worker while running so stream acks route here.
         with self.backend._lock:
             self.backend._task_worker[spec.task_id] = self.handle
@@ -529,6 +532,13 @@ class NodeServer:
         h("worker_profile", self._h_worker_profile)
         h("worker_memory_profile", self._h_worker_memory_profile)
         h("ping", lambda peer: "pong")
+        # Chaos testing: the head's failpoint_cfg(scope="cluster") fans out
+        # to these, so tests can arm faults on node daemons they never
+        # spawned (workers inherit theirs via RAYTPU_FAILPOINTS instead).
+        h("failpoint_cfg",
+          lambda peer, name, spec: failpoints.cfg(name, spec))
+        h("failpoint_clear", lambda peer: failpoints.clear())
+        h("failpoint_stat", lambda peer, name: failpoints.stat(name))
         # Worker-process plane
         h("register_worker", self._h_register_worker)
         h("task_blocked", self._h_task_blocked)
@@ -743,6 +753,11 @@ class NodeServer:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(HEARTBEAT_PERIOD_S):
             try:
+                # drop => this round's heartbeat is never sent (the head's
+                # timeout path fires exactly as if the network ate it);
+                # delay/raise model slow and severed links.
+                if failpoint("node.heartbeat.emit") is DROP:
+                    continue
                 avail, seq = self._snapshot_avail()
                 self._head.call(
                     "heartbeat", self.node_id.hex(), avail, seq,
@@ -783,6 +798,7 @@ class NodeServer:
         this node under the same node_id, and re-announce live actors and
         held objects so the reloaded directory regains its ephemeral state
         (reference: raylet re-registration after GCS restart, SURVEY A3)."""
+        failpoint("node.reconnect.pre")
         head = None
         try:
             head = RpcClient(self.head_address)
@@ -934,6 +950,7 @@ class NodeServer:
         that push wins the race, this loop sees the local copy and exits
         without pulling a byte. The head's location push doubles as the
         wakeup (no poll backoff while waiting)."""
+        failpoint("node.object.pull")
         ev = threading.Event()
         topic = f"object::{oid.hex()}"
 

@@ -28,6 +28,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from raytpu.cluster import wire
+from raytpu.util.failpoints import DROP, failpoint
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
@@ -170,6 +171,8 @@ class RpcServer:
     async def _dispatch(self, peer: Peer, writer: asyncio.StreamWriter,
                         frame: dict) -> None:
         req_id = frame.get("i")
+        if failpoint("rpc.dispatch.pre") is DROP:
+            return  # swallow the request: caller sees a timeout
         handler = self._handlers.get(frame.get("m"))
         try:
             if handler is None:
@@ -280,6 +283,10 @@ class RpcClient:
         self._send({"m": method, "a": args})
 
     def _send(self, frame: dict) -> None:
+        # drop => the message is silently lost (the call, if any, times
+        # out); raise => surfaces to the caller like a send failure.
+        if failpoint("wire.send.pre") is DROP:
+            return
         data = _pack(frame, self._allow_pickle)
         with self._wlock:
             if self._closed:
@@ -328,6 +335,8 @@ class RpcClient:
                     pass
 
     def _on_frame(self, frame: dict) -> None:
+        if failpoint("wire.recv.pre") is DROP:
+            return  # inbound frame lost: reply/push never delivered
         if "p" in frame:  # pubsub push
             self._push_queue.put((frame["p"], frame["d"]))
             return

@@ -29,6 +29,7 @@ from typing import List, Optional
 
 from raytpu.core.config import cfg
 from raytpu.runtime.serialization import SerializedValue
+from raytpu.util.failpoints import DROP, failpoint
 
 _sem: Optional[threading.Semaphore] = None
 _sem_lock = threading.Lock()
@@ -79,6 +80,11 @@ def fetch_blob(client, oid_hex: str, timeout: float = 60.0
     ``client`` is an RpcClient to the holding node. Returns None when the
     peer no longer holds the object.
     """
+    # drop => behave as if the holder no longer has the object (the
+    # caller re-locates / falls back to lineage); raise models a severed
+    # transfer connection.
+    if failpoint("transfer.fetch.pre") is DROP:
+        return None
     chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
     meta = client.call("fetch_object_meta", oid_hex, timeout=timeout)
     if meta is None:
@@ -113,6 +119,8 @@ def push_blob(client, oid_hex: str, sv: SerializedValue,
     only ``push_object_end`` publishes it). Returns False when the
     transfer did not complete (the receiver's pull fallback still runs).
     """
+    if failpoint("transfer.push.pre") is DROP:
+        return False  # push lost; receiver's pull fallback takes over
     chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
     size = wire_size(sv)
     if size <= chunk:

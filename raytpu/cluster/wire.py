@@ -44,6 +44,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 import cloudpickle
 import msgpack
 
+from raytpu.util.failpoints import failpoint
+
 WIRE_VERSION = 1
 
 _EXT_STRUCT = 1
@@ -296,6 +298,7 @@ _STRICT = _Codec(allow_pickle=False)
 
 def dumps(obj: Any, allow_pickle: bool = True) -> bytes:
     """Encode one wire frame: version byte + msgpack body."""
+    failpoint("wire.encode.pre")
     codec = _TRUSTED if allow_pickle else _STRICT
     try:
         body = codec._pack(obj)
@@ -311,6 +314,7 @@ def dumps(obj: Any, allow_pickle: bool = True) -> bytes:
 
 
 def loads(frame: bytes, allow_pickle: bool = True) -> Any:
+    failpoint("wire.decode.pre")
     if not frame:
         raise WireError("empty wire frame")
     ver = frame[0]

@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from raytpu.cluster.protocol import RpcClient
 from raytpu.core.config import cfg
+from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.events import record_event
 from raytpu.core.errors import WorkerCrashedError
 from raytpu.core.ids import JobID, WorkerID
@@ -120,6 +121,10 @@ class WorkerPool:
     # -- registration (called from the node RPC handler) -------------------
 
     def on_register(self, worker_id_hex: str, address: str, pid: int) -> None:
+        # drop => the registration is lost; the lease waiting on ready
+        # times out exactly like a worker that wedged during startup.
+        if failpoint("worker.register.pre") is DROP:
+            return
         with self._lock:
             h = self._workers.get(worker_id_hex)
         if h is None:
@@ -140,6 +145,7 @@ class WorkerPool:
               timeout: Optional[float] = None) -> WorkerHandle:
         """Pop an idle matching worker or spawn one. Blocks on the soft
         process cap (reference: ``num_workers_soft_limit``)."""
+        failpoint("worker.lease.pre")
         key = (job_id.hex(), runtime_env_hash(renv), tuple(chips))
         if timeout is None:
             timeout = 300.0  # never wedge the dispatcher forever
@@ -265,6 +271,9 @@ class WorkerPool:
     def _spawn(self, h: WorkerHandle) -> None:
         if h.proc is not None:
             return  # popped from idle, already running
+        failpoint("worker.spawn.pre")
+        # os.environ carries RAYTPU_FAILPOINTS, so failpoints armed with
+        # env=True (or inherited by this daemon) reach the worker too.
         env = dict(os.environ)
         env.update(self.base_env)
         env.update(chip_env(h.chips))

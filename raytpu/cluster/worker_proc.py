@@ -34,6 +34,7 @@ import cloudpickle
 from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+from raytpu.util.failpoints import failpoint
 from raytpu.core.errors import ActorDiedError, TaskError
 from raytpu.core.ids import JobID, NodeID, ObjectID, TaskID
 from raytpu.runtime.object_ref import ObjectRef
@@ -333,6 +334,9 @@ class _WorkerHost:
         return out
 
     def execute_plain(self, spec: TaskSpec) -> dict:
+        # kill_process here is the canonical "worker dies mid-task" chaos
+        # scenario: the task was accepted but no result ever comes back.
+        failpoint("worker.task.run")
         # store_errors=False: the daemon owns retry policy — it stores the
         # error into the return slots only once retries are exhausted.
         err = self.worker.execute_task(spec, self.get_serialized,
@@ -366,6 +370,7 @@ class _WorkerHost:
                 "error": None if err is None else _dump_err(spec.name, err)}
 
     def execute_actor_task(self, spec: TaskSpec) -> dict:
+        failpoint("worker.actor_task.run")
         if self.actor_instance is None:
             err: BaseException = ActorDiedError(
                 spec.actor_id.hex() if spec.actor_id else "?",
@@ -540,6 +545,9 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
 
     server.register("memory_profile", h_memory_profile)
     addr = server.start()
+    # kill_process here models a worker dying between exec and register —
+    # the pool's spawn timeout / monitor reaps it.
+    failpoint("worker.register.emit")
     host.node.call("register_worker", args.worker_id, addr, os.getpid())
 
     # Die with the daemon: if the control connection drops, exit.

@@ -793,10 +793,22 @@ class Dataset:
         conn = connection_factory()
         try:
             cursor = conn.cursor()
+            # Bind by the FIRST row's key order, not each dict's insertion
+            # order — blocks produced by different tasks may carry the
+            # same columns in different order, which would silently write
+            # values into the wrong columns.
+            keys: Optional[List[str]] = None
             for block in self.iter_blocks():
                 values = []
                 for row in BlockAccessor(block).to_rows():
-                    values.append(tuple(_plain_row(row).values()))
+                    plain = _plain_row(row)
+                    if keys is None:
+                        keys = list(plain)
+                    elif set(plain) != set(keys):
+                        raise ValueError(
+                            f"write_sql: row columns {sorted(plain)} do not "
+                            f"match first row's columns {sorted(keys)}")
+                    values.append(tuple(plain[k] for k in keys))
                     if len(values) == MAX_ROWS_PER_WRITE:
                         cursor.executemany(sql, values)
                         values = []
@@ -826,9 +838,18 @@ class Dataset:
                 if arr.dtype != np.uint8:
                     # read_images yields float32 0-255; PIL wants uint8.
                     arr = np.clip(arr, 0, 255).astype(np.uint8)
-                name = (str(row[filename_column]) if filename_column
-                        else f"{n:06d}.{file_format}")
-                Image.fromarray(arr).save(os.path.join(path, name))
+                if filename_column:
+                    # Extension-less names give PIL nothing to infer the
+                    # format from; pass it explicitly ("jpg" is the PIL
+                    # format "JPEG").
+                    name = str(row[filename_column])
+                    fmt = {"jpg": "JPEG"}.get(file_format.lower(),
+                                              file_format.upper())
+                    Image.fromarray(arr).save(os.path.join(path, name),
+                                              format=fmt)
+                else:
+                    name = f"{n:06d}.{file_format}"
+                    Image.fromarray(arr).save(os.path.join(path, name))
                 n += 1
 
     def write_webdataset(self, path: str) -> None:

@@ -16,6 +16,7 @@ import cloudpickle
 
 from raytpu.core.config import cfg
 from raytpu.core.ids import ActorID, TaskID
+from raytpu.util.failpoints import failpoint
 from raytpu.runtime.remote_function import (
     build_resources,
     build_scheduling,
@@ -115,6 +116,7 @@ class ActorHandle:
 
     def _invoke(self, method_name: str, args, kwargs, num_returns=1,
                 backpressure: int = 0, concurrency_group: str = ""):
+        failpoint("actor.invoke.pre")
         from raytpu.runtime import api
         from raytpu.runtime.remote_function import streaming_opts
 

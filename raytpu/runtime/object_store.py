@@ -18,6 +18,7 @@ from raytpu.core.config import cfg
 from raytpu.core.errors import GetTimeoutError
 from raytpu.core.ids import ObjectID
 from raytpu.runtime.serialization import SerializedValue
+from raytpu.util.failpoints import failpoint
 
 
 class MemoryStore:
@@ -123,6 +124,7 @@ class MemoryStore:
                         pass
 
     def put(self, oid: ObjectID, value: SerializedValue) -> None:
+        failpoint("object.put.pre")
         big = value.total_bytes() > cfg.max_direct_call_object_size
         stored = False
         if self._shm is not None and big:

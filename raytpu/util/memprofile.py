@@ -91,7 +91,19 @@ def memory_profile(duration_s: float = 2.0, trace_frames: int = 16,
         if len(top) < top_n:
             top.append({"stack": frames, "kb": st.size // 1024,
                         "count": st.count})
-    collapsed = {k: max(1, b // 1024) for k, b in by_bytes.items()}
+    # Sub-KiB sites must not round up to 1 KiB each (2000 tiny sites
+    # would overstate the flamegraph by ~2 MiB): fold them into the
+    # <other> bucket in BYTES, then convert once.
+    other_bytes = by_bytes.pop("alloc;<other>", 0)
+    collapsed: Dict[str, int] = {}
+    for k, b in by_bytes.items():
+        kb = b // 1024
+        if kb == 0:
+            other_bytes += b
+        else:
+            collapsed[k] = kb
+    if other_bytes:
+        collapsed["alloc;<other>"] = max(1, other_bytes // 1024)
     return {"collapsed": collapsed,
             "total_kb": current // 1024,
             "peak_kb": peak // 1024,

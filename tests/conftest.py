@@ -23,6 +23,30 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # No pytest.ini in this repo: markers register here so -m filters
+    # ("not slow" in the tier-1 command) and --strict-markers both work.
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection recovery test (failpoints)")
+    config.addinivalue_line(
+        "markers", "slow: multi-second test, excluded from tier-1")
+
+
+@pytest.fixture(autouse=True)
+def _failpoint_leak_guard():
+    """No chaos test may leak armed failpoints into its neighbors: the
+    registry (and the inheritance env var) must be empty at test exit."""
+    from raytpu.util import failpoints
+
+    yield
+    leaked = failpoints.active()
+    env_leak = os.environ.get(failpoints.ENV_VAR)
+    if leaked or env_leak:
+        failpoints.clear()  # don't cascade the failure into later tests
+        pytest.fail(f"failpoints leaked past test exit: "
+                    f"registry={leaked}, {failpoints.ENV_VAR}={env_leak!r}")
+
+
 @pytest.fixture
 def raytpu_local():
     """A fresh single-process fabric per test (reference fixture analogue:

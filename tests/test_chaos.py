@@ -1,0 +1,544 @@
+"""Deterministic failpoint injection + chaos recovery suite.
+
+Reference analogue: TiKV ``fail-rs`` / etcd ``gofail`` — production code
+threaded with named failpoints that tests arm with action expressions
+(``raytpu/util/failpoints.py``). Every scenario here asserts the
+*specific* recovery event (task retried, actor restarted then died
+cleanly, node declared dead, lineage re-executed, node re-registered)
+using failpoint counters, the head's event ring, or pubsub — never
+sleep-and-hope.
+
+Layout:
+
+- ``TestFailpointRegistry`` — grammar, counts, chaining, deterministic
+  probability, env round-trip, thread safety. Pure in-process.
+- ``TestFailpointRpc`` — arming/clearing failpoints on remote head and
+  node processes through the head's ``failpoint_cfg(scope="cluster")``.
+- ``TestChaosRecovery`` — the kill/drop/delay scenarios from the issue,
+  each driving a real recovery path end to end.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import raytpu
+from raytpu.cluster.cluster_utils import Cluster
+from raytpu.cluster.protocol import RpcClient, RpcServer
+from raytpu.core.errors import ActorDiedError, WorkerCrashedError
+from raytpu.util import failpoints
+from raytpu.util.failpoints import DROP, FailpointError, failpoint
+
+
+class TestFailpointRegistry:
+    def test_unarmed_failpoint_is_noop(self):
+        assert failpoints.active() == {}
+        assert failpoint("never.armed.anywhere") is None
+        assert failpoints.stat("never.armed.anywhere") is None
+
+    def test_bad_specs_rejected_without_arming(self):
+        bad = ["", "bogus", "raise", "raise()", "delay", "delay()",
+               "drop(3)", "kill_process(9)", "raise(NotARealClass)",
+               "1*", "drop->", "delay(nan%)"]
+        for spec in bad:
+            with pytest.raises(FailpointError):
+                failpoints.cfg("t.bad", spec)
+        # validation happens BEFORE the registry mutates
+        assert failpoints.active() == {}
+        with pytest.raises(FailpointError):
+            failpoints.parse_env("noequalsign")
+
+    def test_count_chaining_and_stats(self):
+        try:
+            failpoints.cfg(
+                "t.chain", "2*raise(ConnectionError,boom)->1*drop->delay(0.01)")
+            for _ in range(2):
+                with pytest.raises(ConnectionError, match="boom"):
+                    failpoint("t.chain")
+            assert failpoint("t.chain") is DROP
+            t0 = time.monotonic()
+            assert failpoint("t.chain") is None  # delay term: sleeps
+            assert time.monotonic() - t0 >= 0.01
+            s = failpoints.stat("t.chain")
+            assert s == {"spec": "2*raise(ConnectionError,boom)->1*drop"
+                                 "->delay(0.01)",
+                         "hits": 4, "fires": 4, "exhausted": False}
+            failpoints.off("t.chain")
+            assert failpoints.stat("t.chain") is None
+            assert failpoint("t.chain") is None
+        finally:
+            failpoints.clear()
+
+    def test_single_shot_exhausts(self):
+        try:
+            failpoints.cfg("t.once", "1*drop")
+            assert failpoint("t.once") is DROP
+            assert failpoint("t.once") is None
+            s = failpoints.stat("t.once")
+            assert s["fires"] == 1 and s["hits"] == 2 and s["exhausted"]
+        finally:
+            failpoints.clear()
+
+    def test_off_term_is_armed_but_inert(self):
+        try:
+            failpoints.cfg("t.off", "1*drop->off")
+            assert failpoint("t.off") is DROP
+            for _ in range(5):
+                assert failpoint("t.off") is None
+            s = failpoints.stat("t.off")
+            assert s["hits"] == 6 and s["fires"] == 1
+            assert not s["exhausted"]  # the off term holds forever
+        finally:
+            failpoints.clear()
+
+    def test_raise_resolves_raytpu_error_names(self):
+        try:
+            failpoints.cfg("t.err", "1*raise(WorkerCrashedError,gone)")
+            with pytest.raises(WorkerCrashedError, match="gone"):
+                failpoint("t.err")
+        finally:
+            failpoints.clear()
+
+    def test_probability_is_deterministic(self, monkeypatch):
+        def draw(n=64):
+            failpoints.cfg("t.prob", "50%drop")  # (re)arm resets the RNG
+            return [failpoint("t.prob") is DROP for _ in range(n)]
+
+        try:
+            pat1 = draw()
+            pat2 = draw()
+            assert pat1 == pat2  # same seed, same stream
+            assert any(pat1) and not all(pat1)  # it IS probabilistic
+            # probability gate never consumes counts: all evaluations hit
+            s = failpoints.stat("t.prob")
+            assert s["hits"] == 64 and s["fires"] == sum(pat2)
+            monkeypatch.setenv(failpoints.SEED_ENV_VAR, "12345")
+            assert draw() != pat1  # a new seed is a new stream
+        finally:
+            failpoints.clear()
+
+    def test_env_export_and_load_roundtrip(self):
+        try:
+            failpoints.cfg("t.env.a", "drop", env=True)
+            failpoints.cfg("t.env.b", "2*delay(0.5)", env=True)
+            raw = os.environ[failpoints.ENV_VAR]
+            assert raw == "t.env.a=drop;t.env.b=2*delay(0.5)"
+            assert failpoints.parse_env(raw) == {
+                "t.env.a": "drop", "t.env.b": "2*delay(0.5)"}
+            failpoints.off("t.env.a", env=True)
+            assert os.environ[failpoints.ENV_VAR] == "t.env.b=2*delay(0.5)"
+            # what a freshly spawned subprocess would do at import:
+            failpoints.clear(env=False)
+            assert failpoints.load_env("t.load=1*drop") == ["t.load"]
+            assert failpoint("t.load") is DROP
+        finally:
+            failpoints.clear()
+        assert failpoints.ENV_VAR not in os.environ
+
+    def test_concurrent_single_shot_fires_exactly_once(self):
+        try:
+            failpoints.cfg("t.race", "1*raise(ConnectionError)")
+            n_threads, n_iter = 8, 50
+            hits = []
+            barrier = threading.Barrier(n_threads)
+
+            def hammer():
+                barrier.wait()
+                for _ in range(n_iter):
+                    try:
+                        failpoint("t.race")
+                    except ConnectionError:
+                        hits.append(1)
+
+            threads = [threading.Thread(target=hammer)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(hits) == 1, "count-gated term fired more than once"
+            s = failpoints.stat("t.race")
+            assert s["fires"] == 1 and s["hits"] == n_threads * n_iter
+            assert s["exhausted"]
+        finally:
+            failpoints.clear()
+
+    def test_wait_fired_synchronizes_on_injection(self):
+        try:
+            failpoints.cfg("t.sync", "1*drop")
+            assert not failpoints.wait_fired("t.sync", timeout=0.05)
+            th = threading.Timer(0.05, lambda: failpoint("t.sync"))
+            th.start()
+            try:
+                assert failpoints.wait_fired("t.sync", timeout=5.0)
+            finally:
+                th.join()
+        finally:
+            failpoints.clear()
+
+
+@pytest.mark.chaos
+class TestFailpointRpc:
+    def test_head_arms_and_clears_cluster_wide(self):
+        """``failpoint_cfg(scope="cluster")`` on the head arms the same
+        failpoint on every node daemon; ``failpoint_stat`` reads remote
+        counters; ``failpoint_clear`` scrubs everything."""
+        cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1})
+        cluster.wait_for_nodes(1)
+        head = RpcClient(cluster.address)
+        node_cli = None
+        try:
+            node = next(n for n in head.call("list_nodes")
+                        if n["labels"].get("role") != "driver")
+            reached = head.call("failpoint_cfg", "t.remote", "3*drop",
+                                "cluster")
+            assert "head" in reached and node["node_id"] in reached
+            assert head.call("failpoint_stat", "t.remote")["spec"] == "3*drop"
+            node_cli = RpcClient(node["address"])
+            s = node_cli.call("failpoint_stat", "t.remote")
+            assert s["spec"] == "3*drop" and s["hits"] == 0
+            # local scope touches only the process you called
+            node_cli.call("failpoint_cfg", "t.local", "drop")
+            assert head.call("failpoint_stat", "t.local") is None
+            head.call("failpoint_clear", "cluster")
+            assert head.call("failpoint_stat", "t.remote") is None
+            assert node_cli.call("failpoint_stat", "t.remote") is None
+            assert node_cli.call("failpoint_stat", "t.local") is None
+        finally:
+            if node_cli is not None:
+                node_cli.close()
+            head.close()
+            cluster.shutdown()
+            failpoints.clear()
+
+
+@pytest.mark.chaos
+class TestChaosRecovery:
+    # -- wire faults ------------------------------------------------------
+
+    def test_wire_delay_and_raise_then_recover(self):
+        """Delayed sends slow calls without breaking them; an injected
+        send failure surfaces to exactly one caller and the client stays
+        usable afterwards."""
+        srv = RpcServer()
+        srv.register("echo", lambda peer, x: x)
+        addr = srv.start()
+        cli = RpcClient(addr)
+        try:
+            failpoints.cfg("wire.send.pre", "3*delay(0.05)")
+            t0 = time.monotonic()
+            for i in range(3):
+                assert cli.call("echo", i, timeout=10.0) == i
+            assert time.monotonic() - t0 >= 0.15
+            s = failpoints.stat("wire.send.pre")
+            assert s["fires"] == 3 and s["exhausted"]
+
+            failpoints.cfg("wire.send.pre", "1*raise(ConnectionError,cut)")
+            with pytest.raises(ConnectionError, match="cut"):
+                cli.call("echo", 99, timeout=10.0)
+            # the fault was injected client-side; the socket never died
+            assert cli.call("echo", 100, timeout=10.0) == 100
+        finally:
+            failpoints.clear()
+            cli.close()
+            srv.stop()
+
+    def test_rpc_request_drop_times_out_then_recovers(self):
+        """A dropped request frame looks like a lost packet: the call
+        times out; the next attempt goes through untouched."""
+        srv = RpcServer()
+        srv.register("echo", lambda peer, x: x)
+        addr = srv.start()
+        cli = RpcClient(addr)
+        try:
+            failpoints.cfg("rpc.dispatch.pre", "1*drop")
+            with pytest.raises(TimeoutError):
+                cli.call("echo", 1, timeout=0.4)
+            s = failpoints.stat("rpc.dispatch.pre")
+            assert s["fires"] == 1 and s["exhausted"]
+            assert cli.call("echo", 2, timeout=10.0) == 2  # retry lands
+        finally:
+            failpoints.clear()
+            cli.close()
+            srv.stop()
+
+    # -- head health ------------------------------------------------------
+
+    def test_heartbeat_drops_kill_node_and_stale_one_stays_dead(
+            self, monkeypatch):
+        """Drop every heartbeat at the head: the health loop declares the
+        node dead and publishes the removal; a late heartbeat from the
+        declared-dead node must NOT resurrect it (and the scheduler must
+        refuse to place work there). A bounded number of drops inside
+        the timeout window is tolerated."""
+        import raytpu.cluster.head as head_mod
+
+        monkeypatch.setattr(head_mod, "HEARTBEAT_TIMEOUT_S", 0.6)
+        monkeypatch.setattr(head_mod, "CHECK_PERIOD_S", 0.1)
+        head = head_mod.HeadServer(port=0)
+        addr = head.start()
+        cli = RpcClient(addr)
+        removed = threading.Event()
+        removal = {}
+
+        def on_nodes(data):
+            if data.get("event") == "removed":
+                removal.update(data)
+                removed.set()
+
+        try:
+            cli.subscribe("nodes", on_nodes)
+            cli.call("subscribe", "nodes")  # local cb + server-side fanout
+            cli.call("register_node", "nodeX", "127.0.0.1:1",
+                     {"CPU": 4.0}, {})
+            # Tolerated partial loss: 2 dropped beats < timeout window.
+            failpoints.cfg("head.heartbeat.handle", "2*drop->off")
+            for seq in range(1, 5):
+                cli.call("heartbeat", "nodeX", {"CPU": 4.0}, seq)
+                time.sleep(0.1)
+            assert failpoints.stat("head.heartbeat.handle")["fires"] == 2
+            alive = {n["node_id"]: n["alive"] for n in cli.call("list_nodes")}
+            assert alive["nodeX"] is True, "partial drops must be tolerated"
+
+            # Total loss: every beat eaten until the health loop fires.
+            failpoints.cfg("head.heartbeat.handle", "drop")
+            deadline = time.monotonic() + 10
+            seq = 10
+            while not removed.is_set() and time.monotonic() < deadline:
+                cli.call("heartbeat", "nodeX", {"CPU": 4.0}, seq)
+                seq += 1
+                time.sleep(0.05)
+            assert removed.is_set(), "node never declared dead"
+            assert removal["node_id"] == "nodeX"
+            assert removal["reason"] == "heartbeat timeout"
+            assert failpoints.stat("head.heartbeat.handle")["fires"] >= 3
+
+            # The partition heals; a late (stale-seq) heartbeat arrives.
+            failpoints.off("head.heartbeat.handle")
+            cli.call("heartbeat", "nodeX", {"CPU": 4.0}, 1)
+            snap = {n["node_id"]: n for n in cli.call("list_nodes")}
+            assert snap["nodeX"]["alive"] is False, \
+                "a late heartbeat resurrected a dead node"
+            assert cli.call("schedule", {"CPU": 1.0}, None, 0.5,
+                            "00" * 8) is None
+        finally:
+            failpoints.clear()
+            cli.close()
+            head.stop()
+
+    # -- worker / task plane ----------------------------------------------
+
+    @pytest.mark.slow
+    def test_worker_kill_mid_task_retries(self):
+        """SIGKILL the worker on its first task (armed before the cluster
+        spawns, inherited via RAYTPU_FAILPOINTS): the node reports the
+        crash, the owner resubmits, and once the node-side env is
+        scrubbed a fresh worker completes the task."""
+        failpoints.cfg("worker.task.run", "1*kill_process", env=True)
+        cluster = Cluster()
+        failpoints.clear()  # driver side is clean; children captured env
+        node_cli = None
+        try:
+            cluster.add_node(num_cpus=1, num_tpus=0)
+            cluster.wait_for_nodes(1)
+            raytpu.init(address=cluster.address)
+
+            @raytpu.remote(max_retries=8)
+            def double(x):
+                return x * 2
+
+            ref = double.remote(21)
+            # Deterministic sync point: the head's event ring shows the
+            # injected crash before we disarm anything.
+            head = RpcClient(cluster.address)
+            crash_labels = {"WORKER_CRASHED", "WORKER_KILLED"}
+            deadline = time.monotonic() + 60
+            crashed = []
+            while time.monotonic() < deadline:
+                crashed = [e for e in head.call("list_events", "ERROR")
+                           if e.get("label") in crash_labels]
+                if crashed:
+                    break
+                time.sleep(0.05)
+            assert crashed, "armed worker never crashed"
+            # Scrub the node daemon's env so the NEXT spawned worker is
+            # clean (workers already spawned armed burn one retry each).
+            node = next(n for n in head.call("list_nodes")
+                        if n["labels"].get("role") != "driver")
+            node_cli = RpcClient(node["address"])
+            node_cli.call("failpoint_clear")
+            head.close()
+            assert raytpu.get(ref, timeout=90) == 42
+        finally:
+            if node_cli is not None:
+                node_cli.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+            failpoints.clear()
+
+    @pytest.mark.slow
+    def test_actor_worker_kill_restarts_then_dies_cleanly(self):
+        """Every actor-task execution SIGKILLs its worker. A
+        ``max_restarts=1`` actor survives exactly one kill (head publishes
+        restarting -> restarted), dies for good on the second, and later
+        calls fail with a clean ActorDiedError."""
+        failpoints.cfg("worker.actor_task.run", "kill_process", env=True)
+        cluster = Cluster()
+        failpoints.clear()
+        head = None
+        try:
+            cluster.add_node(num_cpus=1, num_tpus=0)
+            cluster.wait_for_nodes(1)
+            raytpu.init(address=cluster.address)
+            head = RpcClient(cluster.address)
+            events = []
+            seen = {"restarted": threading.Event(),
+                    "dead": threading.Event()}
+
+            def on_actors(data):
+                events.append(data.get("event"))
+                ev = seen.get(data.get("event"))
+                if ev is not None:
+                    ev.set()
+
+            head.subscribe("actors", on_actors)
+            head.call("subscribe", "actors")
+
+            @raytpu.remote(max_restarts=1)
+            class Victim:
+                def poke(self):
+                    return "alive"
+
+            a = Victim.remote()  # creation path is unarmed: succeeds
+            with pytest.raises(Exception):
+                raytpu.get(a.poke.remote(), timeout=60)
+            assert seen["restarted"].wait(60), \
+                "head never restarted the actor after the first kill"
+            # Second incarnation is up; the next poke kills it too and
+            # max_restarts is spent.
+            deadline = time.monotonic() + 60
+            while not seen["dead"].is_set():
+                assert time.monotonic() < deadline, \
+                    "actor never declared dead after exhausting restarts"
+                try:
+                    raytpu.get(a.poke.remote(), timeout=10)
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert events.index("restarting") < events.index("restarted") \
+                < events.index("dead")
+            # Terminal state: a clean ActorDiedError, not a hang/timeout.
+            with pytest.raises(ActorDiedError):
+                raytpu.get(a.poke.remote(), timeout=30)
+        finally:
+            if head is not None:
+                head.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+            failpoints.clear()
+
+    # -- object plane ------------------------------------------------------
+
+    @pytest.mark.slow
+    def test_replica_drop_triggers_lineage_reexecution(self, tmp_path):
+        """Drop the only replica of a finished task's output (node-side
+        free + head directory forget): the owner's ``get`` finds no
+        locations and re-executes the creating task via lineage."""
+        cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1})
+        cluster.wait_for_nodes(1)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{cluster.address}")
+        marker = str(tmp_path / "runs.txt")
+        head = RpcClient(cluster.address)
+        node_cli = None
+        try:
+            @raytpu.remote
+            def produce(x):
+                with open(marker, "a") as f:
+                    f.write("run\n")
+                return x * 7
+
+            ref = produce.remote(6)
+            # Completion observed via the head's directory — no driver get,
+            # so the node holds the ONLY copy.
+            deadline = time.monotonic() + 30
+            locs = []
+            while time.monotonic() < deadline:
+                locs = head.call("locate_object", ref.id.hex()) or []
+                if locs:
+                    break
+                time.sleep(0.05)
+            assert locs, "task output never reported"
+            node_cli = RpcClient(locs[0]["address"])
+            node_cli.call("free_object", ref.id.hex())
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if not head.call("locate_object", ref.id.hex()):
+                    break
+                time.sleep(0.05)
+            assert not head.call("locate_object", ref.id.hex()), \
+                "replica still registered after free"
+            assert raytpu.get(ref, timeout=90) == 42
+            with open(marker) as f:
+                runs = f.readlines()
+            assert len(runs) >= 2, "task was not re-executed via lineage"
+        finally:
+            if node_cli is not None:
+                node_cli.close()
+            head.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+            failpoints.clear()
+
+    # -- control plane -----------------------------------------------------
+
+    @pytest.mark.slow
+    def test_head_bounce_nodes_reregister(self, tmp_path):
+        """Kill and restart the head at the same address (persistent GCS
+        storage): the node's heartbeat loop notices, runs the reconnect
+        path (counted by an armed inert failpoint), re-registers under the
+        SAME node id, and the cluster schedules work again."""
+        cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1},
+                          head_storage=str(tmp_path / "gcs.db"))
+        cluster.wait_for_nodes(1)
+        head = RpcClient(cluster.address)
+        node = next(n for n in head.call("list_nodes")
+                    if n["labels"].get("role") != "driver")
+        head.close()
+        node_cli = RpcClient(node["address"])
+        try:
+            # Inert counter: proves recovery went through _reconnect_head.
+            node_cli.call("failpoint_cfg", "node.reconnect.pre", "off")
+            cluster.restart_head()
+            head = RpcClient(cluster.address)
+            deadline = time.monotonic() + 60
+            back = None
+            while time.monotonic() < deadline:
+                nodes = {n["node_id"]: n for n in head.call("list_nodes")}
+                back = nodes.get(node["node_id"])
+                if back is not None and back["alive"]:
+                    break
+                time.sleep(0.1)
+            assert back is not None and back["alive"], \
+                "node never re-registered with the bounced head"
+            s = node_cli.call("failpoint_stat", "node.reconnect.pre")
+            assert s is not None and s["hits"] >= 1, \
+                "re-registration did not go through the reconnect path"
+            node_cli.call("failpoint_clear")
+            head.close()
+            # The data plane works again end to end.
+            raytpu.shutdown()
+            raytpu.init(address=cluster.address)
+
+            @raytpu.remote
+            def triple(x):
+                return x * 3
+
+            assert raytpu.get(triple.remote(4), timeout=60) == 12
+        finally:
+            node_cli.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+            failpoints.clear()

@@ -633,6 +633,68 @@ class TestDatasinks:
         assert got[2]["image"].shape == (8, 8, 3)
         assert (got[2]["image"] == 2).all()
 
+    def test_write_sql_mixed_key_order(self, raytpu_local, tmp_path):
+        """Rows whose dicts carry the same columns in different order
+        must still land in the right columns (binding follows the FIRST
+        row's key order, not each dict's insertion order)."""
+        import sqlite3
+
+        import raytpu.data as rd
+
+        db = str(tmp_path / "mixed.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE out (id INTEGER, name TEXT)")
+        conn.commit()
+        conn.close()
+        rows = [{"id": 0, "name": "n0"}, {"name": "n1", "id": 1},
+                {"id": 2, "name": "n2"}, {"name": "n3", "id": 3}]
+        rd.from_items(rows).write_sql("INSERT INTO out VALUES (?, ?)",
+                                      lambda: sqlite3.connect(db))
+        back = rd.read_sql("SELECT id, name FROM out",
+                           lambda: sqlite3.connect(db))
+        got = sorted(back.take_all(), key=lambda r: r["id"])
+        assert got == [{"id": i, "name": f"n{i}"} for i in range(4)]
+
+    def test_write_sql_mismatched_keys_raise(self, raytpu_local, tmp_path):
+        import sqlite3
+
+        import pytest
+
+        import raytpu.data as rd
+
+        db = str(tmp_path / "bad.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE out (id INTEGER, name TEXT)")
+        conn.commit()
+        conn.close()
+        rows = [{"id": 0, "name": "n0"}, {"id": 1, "nome": "typo"}]
+        with pytest.raises(Exception, match="do not match"):
+            rd.from_items(rows).write_sql(
+                "INSERT INTO out VALUES (?, ?)",
+                lambda: sqlite3.connect(db))
+
+    def test_write_images_extensionless_names(self, raytpu_local, tmp_path):
+        """filename_column values without an extension give PIL nothing
+        to infer the format from — file_format must be passed through."""
+        import numpy as np
+
+        import raytpu.data as rd
+
+        images = np.stack([np.full((4, 4, 3), i, np.uint8)
+                           for i in range(3)])
+        names = np.asarray([f"frame_{i}" for i in range(3)])  # no ".png"
+        out = str(tmp_path / "raw_imgs")
+        rd.from_numpy({"image": images, "fname": names}).write_images(
+            out, "image", file_format="png", filename_column="fname")
+        import os
+
+        from PIL import Image
+
+        files = sorted(os.listdir(out))
+        assert files == ["frame_0", "frame_1", "frame_2"]
+        img = Image.open(os.path.join(out, "frame_2"))
+        assert img.format == "PNG" and img.size == (4, 4)
+
     def test_write_webdataset_roundtrip(self, raytpu_local, tmp_path):
         import raytpu.data as rd
 

@@ -200,6 +200,39 @@ class TestSamplingProfiler:
         assert not tracemalloc.is_tracing()
         assert prof["rss_kb"] is None or prof["rss_kb"] > 0
 
+    def test_memory_profile_collapsed_total_matches(self):
+        """Sub-KiB sites must fold into <other> in bytes, not round up to
+        1 KiB each — the collapsed-stack total has to track total_kb
+        within flooring error, even with thousands of tiny allocations."""
+        from raytpu.util.memprofile import memory_profile
+
+        # 300 DISTINCT sub-KiB allocation sites (each exec'd function has
+        # its own synthetic filename, hence its own traceback).
+        funcs = []
+        for i in range(300):
+            ns: dict = {}
+            exec(compile("def f(out):\n    out.append(bytes(100))\n",
+                         f"<fp_site_{i}>", "exec"), ns)
+            funcs.append(ns["f"])
+        memory_profile(duration_s=0.0)  # start tracing
+        hoard: list = []
+        for f in funcs:
+            f(hoard)
+        hoard.append(bytearray(4 * 1024 * 1024))
+        prof = memory_profile(duration_s=0.0, stop_after=True)
+        try:
+            collapsed_total = sum(prof["collapsed"].values())
+            # Sub-KiB sites folded in bytes can only round ONE bucket up;
+            # per-site max(1, ...) rounding would overstate by ~300 KiB.
+            assert collapsed_total <= prof["total_kb"] + 2, (
+                collapsed_total, prof["total_kb"])
+            # Retained sites floor, so the undercount is bounded too.
+            assert collapsed_total >= prof["total_kb"] \
+                - (len(prof["collapsed"]) + 2), (
+                collapsed_total, prof["total_kb"], len(prof["collapsed"]))
+        finally:
+            hoard.clear()
+
     def test_cluster_memory_profile_rpc(self):
         """A worker hoarding memory is visible through the node's
         worker_memory_profile RPC, with per-worker totals."""
