@@ -25,6 +25,7 @@ import cloudpickle
 
 from raytpu.cluster import wire
 
+from raytpu.cluster import constants as tuning
 from raytpu.cluster.node import NodeServer
 from raytpu.cluster.protocol import ConnectionLost, RpcClient
 from raytpu.core.errors import (
@@ -33,6 +34,13 @@ from raytpu.core.errors import (
     PlacementGroupError,
     WorkerCrashedError,
 )
+from raytpu.util.errors import (
+    CircuitOpenError,
+    NodeVanishedError,
+    PlacementInfeasibleError,
+    RpcTimeoutError,
+)
+from raytpu.util.resilience import Deadline, RetryPolicy, breaker_for
 from raytpu.core.ids import (
     ActorID,
     JobID,
@@ -376,13 +384,14 @@ class ClusterBackend:
             if oid is None or self._shutdown_flag:
                 return
             try:
-                self._head.call("request_free", oid.hex(), timeout=5.0)
+                self._head.call("request_free", oid.hex(),
+                                timeout=tuning.CONTROL_CALL_TIMEOUT_S)
             except Exception:
                 pass
 
     def _pending_loop(self) -> None:
         while not self._shutdown_flag:
-            time.sleep(0.2)
+            time.sleep(tuning.PENDING_POLL_PERIOD_S)
             with self._lock:
                 pending, self._pending = self._pending, []
             for spec in pending:
@@ -403,8 +412,9 @@ class ClusterBackend:
             oids = rec.spec.return_ids()
             try:
                 done = all(self.store.contains(oid) or
-                           bool(self._head.call("locate_object", oid.hex(),
-                                                timeout=5.0))
+                           bool(self._head.call(
+                               "locate_object", oid.hex(),
+                               timeout=tuning.CONTROL_CALL_TIMEOUT_S))
                            for oid in oids)
             except Exception:
                 continue
@@ -426,17 +436,29 @@ class ClusterBackend:
 
     def create_actor(self, spec: TaskSpec) -> None:
         ac = spec.actor_creation
-        # _pick_node honors placement-group scheduling (bundle -> node);
-        # a bare schedule call here would strand PG-placed actors on
-        # arbitrary nodes whose bundle shard they cannot reserve.
-        node_id = self._pick_node(spec)
-        if node_id is None:
-            raise ValueError(
-                f"no feasible node for actor {ac.name or ac.actor_id.hex()} "
-                f"requiring {spec.resources}")
-        addr = self._node_addr(node_id)
-        if addr is None:
-            raise ValueError("scheduled node vanished; retry")
+
+        def _place() -> Tuple[str, str]:
+            # _pick_node honors placement-group scheduling (bundle ->
+            # node); a bare schedule call here would strand PG-placed
+            # actors on arbitrary nodes whose bundle shard they cannot
+            # reserve.
+            node_id = self._pick_node(spec)
+            if node_id is None:
+                raise ValueError(
+                    f"no feasible node for actor "
+                    f"{ac.name or ac.actor_id.hex()} "
+                    f"requiring {spec.resources}")
+            addr = self._node_addr(node_id)
+            if addr is None:
+                # Scheduler raced with failure detection: typed and
+                # retryable, so the policy below re-picks a live node
+                # (the old signal was ValueError("...; retry") that
+                # nothing actually retried).
+                raise NodeVanishedError(node_id)
+            return node_id, addr
+
+        node_id, addr = RetryPolicy(seed=0).run(
+            _place, what=f"place actor {ac.actor_id.hex()[:12]}")
         with self._lock:
             self._actor_nodes[ac.actor_id] = node_id
             self._my_actors[ac.actor_id] = bool(ac.lifetime_detached)
@@ -463,7 +485,7 @@ class ClusterBackend:
             # Resolve via the head; if the head is mid-restart, wait for
             # the new incarnation instead of failing (reference: client
             # submissions buffer while GCS restarts an actor).
-            deadline = time.monotonic() + 30.0
+            deadline = Deadline.after(tuning.ACTOR_RESOLVE_TIMEOUT_S)
             while True:
                 info = self._head.call("resolve_actor", spec.actor_id.hex())
                 if info is not None and info.get("state") == "alive":
@@ -474,12 +496,13 @@ class ClusterBackend:
                     self._fail_refs(spec, ActorDiedError(
                         spec.actor_id.hex(), dead or "actor not found"))
                     return refs
-                if time.monotonic() >= deadline:
+                if deadline.expired:
                     self._fail_refs(spec, ActorDiedError(
                         spec.actor_id.hex(),
-                        "actor stuck restarting for 30s"))
+                        f"actor stuck restarting for "
+                        f"{tuning.ACTOR_RESOLVE_TIMEOUT_S:g}s"))
                     return refs
-                time.sleep(0.1)
+                time.sleep(tuning.RESTART_POLL_PERIOD_S)
             node_id = info["node_id"]
             with self._lock:
                 self._actor_nodes[spec.actor_id] = node_id
@@ -564,7 +587,8 @@ class ClusterBackend:
         # stream was fully drained there is nothing to GC.
         try:
             elem = ObjectID.for_task_return(task_id, count + 1)
-            locs = self._head.call("locate_object", elem.hex(), timeout=5.0)
+            locs = self._head.call("locate_object", elem.hex(),
+                                   timeout=tuning.CONTROL_CALL_TIMEOUT_S)
             for loc in locs or ():
                 try:
                     self._peer(loc["address"]).notify(
@@ -596,8 +620,8 @@ class ClusterBackend:
 
     def get_object(self, ref: ObjectRef,
                    timeout: Optional[float] = None) -> SerializedValue:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 0.005
+        deadline = None if timeout is None else Deadline.after(timeout)
+        delay = tuning.OBJECT_POLL_MIN_S
         empty_since: Optional[float] = None
         while True:
             sv = self.store.try_get(ref.id)
@@ -610,13 +634,27 @@ class ClusterBackend:
             for loc in locs or ():
                 if loc["address"] == self._serve_address:
                     continue
+                # One dead replica holder must not cost every getter a
+                # full fetch timeout per poll: the per-peer breaker
+                # fails the source over to other copies instantly.
+                src = breaker_for(loc["address"])
+                try:
+                    src.allow()
+                except CircuitOpenError:
+                    continue
                 try:
                     from raytpu.cluster.transfer import fetch_blob
 
                     blob = fetch_blob(self._peer(loc["address"]),
-                                      ref.id.hex(), timeout=60.0)
-                except Exception:
+                                      ref.id.hex())
+                except (ConnectionLost, RpcTimeoutError, ConnectionError,
+                        OSError):
+                    src.record_failure()
                     continue
+                except Exception:
+                    src.record_success()  # peer answered; fetch just failed
+                    continue
+                src.record_success()
                 if blob is not None:
                     sv = SerializedValue.from_buffer(blob)
                     self.store.put(ref.id, sv)
@@ -638,11 +676,11 @@ class ClusterBackend:
                         self._reconstruct(ref.id)
             else:
                 empty_since = None
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and deadline.expired:
                 raise GetTimeoutError(
                     f"object {ref.id.hex()} not ready within {timeout}s")
             time.sleep(delay)
-            delay = min(delay * 2, 0.1)
+            delay = min(delay * 2, tuning.OBJECT_POLL_MAX_S)
 
     def object_ready(self, ref: ObjectRef) -> bool:
         if self.store.contains(ref.id):
@@ -730,8 +768,9 @@ class ClusterBackend:
 
     def _safe_located(self, oid: ObjectID) -> bool:
         try:
-            return bool(self._head.call("locate_object", oid.hex(),
-                                        timeout=5.0))
+            return bool(self._head.call(
+                "locate_object", oid.hex(),
+                timeout=tuning.CONTROL_CALL_TIMEOUT_S))
         except Exception:
             return False
 
@@ -834,17 +873,16 @@ class ClusterBackend:
         # The head's availability view lags heartbeats (and is optimistically
         # debited by recent schedules), so transient infeasibility is normal;
         # PGs are pending-until-placeable (reference: GCS PG state machine).
-        deadline = time.monotonic() + 15.0
+        deadline = Deadline.after(tuning.PG_CREATE_TIMEOUT_S)
         while True:
             try:
                 result = self._head.call("create_pg", pg_id.hex(), bundles,
                                          strategy)
                 break
-            except ValueError as e:
-                if "infeasible" not in str(e) or \
-                        time.monotonic() >= deadline:
+            except PlacementInfeasibleError:
+                if deadline.expired:
                     raise
-                time.sleep(0.25)
+                time.sleep(tuning.PG_POLL_PERIOD_S)
         placement: List[str] = result["nodes"]
         # Tell each node to reserve its shard under this pg id.
         by_node: Dict[str, List[Tuple[int, Dict[str, float]]]] = {}

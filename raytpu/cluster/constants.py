@@ -1,0 +1,103 @@
+"""Cluster-layer timing knobs: every timeout, poll period, and backoff
+parameter the cluster layer uses, in one place, env-overridable.
+
+Reference analogue: Ray's ``RAY_*`` timing env vars
+(``ray_config_def.h`` — e.g. ``RAY_health_check_timeout_ms``,
+``RAY_grpc_client_keepalive_timeout_ms``). PR 1 started the pattern for
+heartbeats (``RAYTPU_HEARTBEAT_TIMEOUT_S`` in ``head.py``); this module
+finishes it — a numeric ``timeout=`` literal or bare ``time.sleep(0.5)``
+in ``raytpu/cluster/`` is now a lint failure (see
+``tests/test_resilience.py::TestNoHardcodedTimeouts``), because scattered
+magic timeouts are how one slow peer becomes an undebuggable gray
+failure: nobody can say which knob to turn, and no two sites agree.
+
+Naming: ``RAYTPU_<CONSTANT_NAME>`` env var overrides each value. Periods
+end in ``_PERIOD_S``, budgets in ``_TIMEOUT_S``, backoff bounds in
+``_DELAY_S``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _f(name: str, default: float) -> float:
+    return float(os.environ.get(f"RAYTPU_{name}", str(default)))
+
+
+def _i(name: str, default: int) -> int:
+    return int(os.environ.get(f"RAYTPU_{name}", str(default)))
+
+
+# -- RPC substrate -----------------------------------------------------------
+
+# Default reply budget for RpcClient.call when the caller passes none.
+RPC_CALL_TIMEOUT_S = _f("RPC_CALL_TIMEOUT_S", 30.0)
+# TCP connect budget for a new RpcClient / RelayChannel.
+RPC_CONNECT_TIMEOUT_S = _f("RPC_CONNECT_TIMEOUT_S", 10.0)
+# RpcServer.start() waits this long for the loop thread to bind.
+SERVER_START_TIMEOUT_S = _f("SERVER_START_TIMEOUT_S", 10.0)
+# RpcServer.stop() waits this long for the loop thread to exit.
+SERVER_STOP_TIMEOUT_S = _f("SERVER_STOP_TIMEOUT_S", 5.0)
+
+# -- control-plane calls -----------------------------------------------------
+
+# Small metadata RPCs (heartbeat, register, locate, free, failpoint
+# arming): short budget — if one of these is slow the peer is sick.
+CONTROL_CALL_TIMEOUT_S = _f("CONTROL_CALL_TIMEOUT_S", 5.0)
+# locate_object from a node resolving a task argument.
+LOCATE_TIMEOUT_S = _f("LOCATE_TIMEOUT_S", 10.0)
+# Node drain (graceful stop) per-node budget.
+DRAIN_TIMEOUT_S = _f("DRAIN_TIMEOUT_S", 2.0)
+
+# -- data plane --------------------------------------------------------------
+
+# Whole-object chunked transfer budget (fetch_blob / push_blob).
+FETCH_TIMEOUT_S = _f("FETCH_TIMEOUT_S", 60.0)
+# Object fetch from inside a worker process (smaller objects, hotter path).
+WORKER_FETCH_TIMEOUT_S = _f("WORKER_FETCH_TIMEOUT_S", 30.0)
+# Cap on one blocking wait_objects_any poll (server-side hold).
+WAIT_POLL_CAP_S = _f("WAIT_POLL_CAP_S", 300.0)
+
+# -- actors / placement ------------------------------------------------------
+
+# Budget for resolving an actor's node (restart in flight).
+ACTOR_RESOLVE_TIMEOUT_S = _f("ACTOR_RESOLVE_TIMEOUT_S", 30.0)
+# create_actor RPC (spawns a worker: slow path).
+CREATE_ACTOR_TIMEOUT_S = _f("CREATE_ACTOR_TIMEOUT_S", 120.0)
+# Placement-group creation end-to-end budget.
+PG_CREATE_TIMEOUT_S = _f("PG_CREATE_TIMEOUT_S", 15.0)
+
+# -- workers -----------------------------------------------------------------
+
+# WorkerPool.lease: budget for a free worker to appear.
+WORKER_LEASE_TIMEOUT_S = _f("WORKER_LEASE_TIMEOUT_S", 300.0)
+# Graceful worker shutdown before SIGKILL.
+WORKER_KILL_TIMEOUT_S = _f("WORKER_KILL_TIMEOUT_S", 2.0)
+
+# -- poll periods ------------------------------------------------------------
+
+# Driver-side pending-task scan.
+PENDING_POLL_PERIOD_S = _f("PENDING_POLL_PERIOD_S", 0.2)
+# Actor-restart wait poll (driver and node routing).
+RESTART_POLL_PERIOD_S = _f("RESTART_POLL_PERIOD_S", 0.1)
+# Placement-group readiness poll.
+PG_POLL_PERIOD_S = _f("PG_POLL_PERIOD_S", 0.25)
+# Worker-pool monitor thread scan.
+MONITOR_POLL_PERIOD_S = _f("MONITOR_POLL_PERIOD_S", 0.05)
+# Object-arrival poll floor/ceiling for driver get_object.
+OBJECT_POLL_MIN_S = _f("OBJECT_POLL_MIN_S", 0.005)
+OBJECT_POLL_MAX_S = _f("OBJECT_POLL_MAX_S", 0.1)
+# Node-side wait for an already-inbound push to land before pulling.
+PUSH_WAIT_POLL_PERIOD_S = _f("PUSH_WAIT_POLL_PERIOD_S", 0.02)
+
+# -- node → head reconnect ---------------------------------------------------
+
+# Exponential backoff bounds for a node whose head is unreachable
+# (replaces the tight reconnect-every-heartbeat loop).
+RECONNECT_BASE_DELAY_S = _f("RECONNECT_BASE_DELAY_S", 0.2)
+RECONNECT_MAX_DELAY_S = _f("RECONNECT_MAX_DELAY_S", 5.0)
+# While the head is unreachable, a node buffers at most this many
+# control-plane notifications (object/actor announcements) to replay
+# after re-registering; older entries are dropped oldest-first.
+HEAD_NOTIFY_BUFFER_MAX = _i("HEAD_NOTIFY_BUFFER_MAX", 1024)

@@ -32,9 +32,12 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import failpoints
+from raytpu.util.errors import PlacementInfeasibleError
 from raytpu.util.failpoints import DROP, failpoint
+from raytpu.util.resilience import breaker_for
 
 # Env-overridable so chaos tests (and small dev clusters) can tighten the
 # failure-detection window without patching module state in subprocesses.
@@ -510,7 +513,9 @@ class HeadServer:
             for node_id, address in targets:
                 try:
                     self._node_client(node_id, address).call(
-                        "failpoint_cfg", name, spec, timeout=5.0)
+                        "failpoint_cfg", name, spec,
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                        breaker=breaker_for(address))
                     reached.append(node_id)
                 except Exception:
                     pass  # a dying node is exactly what chaos runs expect
@@ -527,7 +532,9 @@ class HeadServer:
             for node_id, address in targets:
                 try:
                     self._node_client(node_id, address).call(
-                        "failpoint_clear", timeout=5.0)
+                        "failpoint_clear",
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                        breaker=breaker_for(address))
                     reached.append(node_id)
                 except Exception:
                     pass
@@ -704,7 +711,19 @@ class HeadServer:
     def _node_client(self, node_id: str, address: str):
         client = self._node_clients.get(node_id)
         if client is None or client.closed:
-            client = RpcClient(address)
+            # Per-peer breaker gates the reconnect: fan-out paths (free
+            # notifies, failpoint arming, actor restarts) skip a peer
+            # whose breaker is open instead of burning a TCP connect
+            # timeout each — callers already tolerate per-node failure,
+            # so an open breaker degrades to partial fan-out.
+            breaker = breaker_for(address)
+            breaker.allow()  # raises CircuitOpenError while open
+            try:
+                client = RpcClient(address)
+            except Exception:
+                breaker.record_failure()
+                raise
+            breaker.record_success()
             self._node_clients[node_id] = client
         return client
 
@@ -909,23 +928,25 @@ class HeadServer:
             if info is None or info["state"] != "restarting" or blob is None:
                 continue
             placed = False
-            deadline = time.monotonic() + 30.0
+            deadline = time.monotonic() + tuning.ACTOR_RESOLVE_TIMEOUT_S
             while time.monotonic() < deadline and not self._stop.is_set():
                 node_id = self._schedule(None, info.get("resources", {}))
                 if node_id is None:
-                    time.sleep(0.5)
+                    time.sleep(tuning.PENDING_POLL_PERIOD_S)
                     continue
                 with self._lock:
                     entry = self._nodes.get(node_id)
                     address = entry.address if entry and entry.alive else None
                 if address is None:
-                    time.sleep(0.2)
+                    time.sleep(tuning.RESTART_POLL_PERIOD_S)
                     continue
                 try:
                     client = self._node_client(node_id, address)
-                    client.call("create_actor", blob, timeout=120.0)
+                    client.call("create_actor", blob,
+                                timeout=tuning.CREATE_ACTOR_TIMEOUT_S,
+                                breaker=breaker_for(address))
                 except Exception:
-                    time.sleep(0.5)
+                    time.sleep(tuning.PENDING_POLL_PERIOD_S)
                     continue
                 # The node's create_actor re-registers the actor (state
                 # flips to alive there).
@@ -1064,7 +1085,7 @@ class HeadServer:
                         break
                 if placement and placement[0] is None:
                     if strategy == "STRICT_PACK":
-                        raise ValueError(
+                        raise PlacementInfeasibleError(
                             "STRICT_PACK infeasible: no single node fits "
                             "all bundles")
                     # PACK fallback: greedy pack-then-spill.
@@ -1076,7 +1097,7 @@ class HeadServer:
                                 chosen = node
                                 break
                         if chosen is None:
-                            raise ValueError(
+                            raise PlacementInfeasibleError(
                                 f"PACK infeasible for bundle {i}: {b}")
                         take(chosen, b, scratch)
                         placement[i] = chosen.node_id
@@ -1092,7 +1113,7 @@ class HeadServer:
                     ]
                     chosen = (fresh or reused or [None])[0]
                     if chosen is None:
-                        raise ValueError(
+                        raise PlacementInfeasibleError(
                             f"{strategy} infeasible for bundle {i}: {b}")
                     take(chosen, b, scratch)
                     used.add(chosen.node_id)

@@ -26,6 +26,7 @@ import cloudpickle
 
 from raytpu.cluster import wire
 
+from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
 from raytpu.core.config import cfg
 from raytpu.util import failpoints
@@ -37,8 +38,11 @@ from raytpu.runtime.local_backend import LocalBackend, _Bundle, _PlacementGroup
 from raytpu.runtime.serialization import SerializedValue
 from raytpu.runtime.task_spec import SchedulingKind, TaskSpec
 from raytpu.core.resources import ResourceSet
+from raytpu.util.errors import PlacementInfeasibleError
+from raytpu.util.resilience import RetryPolicy
 
-HEARTBEAT_PERIOD_S = 1.0
+HEARTBEAT_PERIOD_S = float(os.environ.get(
+    "RAYTPU_HEARTBEAT_PERIOD_S", "1.0"))
 
 
 class _ProcActorRuntime:
@@ -424,7 +428,7 @@ class NodeBackend(LocalBackend):
             total = total + b.resources
         with self._lock:
             if not total.is_subset_of(self.node.available):
-                raise ValueError(
+                raise PlacementInfeasibleError(
                     f"pg shard infeasible: needs {total.to_dict()}, "
                     f"available {self.node.available.to_dict()}")
             self.node.allocate(total)
@@ -558,6 +562,14 @@ class NodeServer:
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
         self._stop = threading.Event()
+        # Head-unreachable buffering: fire-and-forget control notifies
+        # queue here (bounded, oldest dropped) and replay after the
+        # reconnect path re-registers this node.
+        from collections import deque as _deque
+
+        self._notify_buffer = _deque(
+            maxlen=max(1, tuning.HEAD_NOTIFY_BUFFER_MAX))
+        self._notify_buffer_lock = threading.Lock()
         self._fetching: set = set()
         self._fetch_lock = threading.Lock()
         # oid_hex -> [(loop, future), ...]: workers blocked in
@@ -722,7 +734,8 @@ class NodeServer:
             self._push_tx_pool.shutdown(wait=False)
         try:
             if self._head is not None:
-                self._head.call("drain_node", self.node_id.hex(), timeout=2.0)
+                self._head.call("drain_node", self.node_id.hex(),
+                                timeout=tuning.DRAIN_TIMEOUT_S)
         except Exception:
             pass
         self.backend.shutdown()
@@ -751,7 +764,12 @@ class NodeServer:
             return self.backend.node.available.to_dict(), self._avail_seq
 
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(HEARTBEAT_PERIOD_S):
+        # Reconnect attempts back off exponentially while the head stays
+        # unreachable (a bounced head must not be greeted by every node
+        # re-dialing at full heartbeat rate), and snap back to the plain
+        # heartbeat period on the first success.
+        backoff = 0.0
+        while not self._stop.wait(HEARTBEAT_PERIOD_S + backoff):
             try:
                 # drop => this round's heartbeat is never sent (the head's
                 # timeout path fires exactly as if the network ate it);
@@ -761,12 +779,18 @@ class NodeServer:
                 avail, seq = self._snapshot_avail()
                 self._head.call(
                     "heartbeat", self.node_id.hex(), avail, seq,
-                    timeout=5.0,
+                    timeout=tuning.CONTROL_CALL_TIMEOUT_S,
                 )
+                backoff = 0.0
             except Exception:
                 if self._stop.is_set():
                     return
-                self._reconnect_head()
+                if self._reconnect_head():
+                    backoff = 0.0
+                else:
+                    backoff = min(tuning.RECONNECT_MAX_DELAY_S,
+                                  max(tuning.RECONNECT_BASE_DELAY_S,
+                                      backoff * 2.0))
 
     def _resource_sync_loop(self) -> None:
         """Streaming resource view (reference: RaySyncer,
@@ -793,18 +817,21 @@ class NodeServer:
                 # Heartbeat loop owns reconnection; just retry later.
                 last = None
 
-    def _reconnect_head(self) -> None:
+    def _reconnect_head(self) -> bool:
         """Head bounce recovery: dial the (restarted) head, re-register
         this node under the same node_id, and re-announce live actors and
         held objects so the reloaded directory regains its ephemeral state
-        (reference: raylet re-registration after GCS restart, SURVEY A3)."""
+        (reference: raylet re-registration after GCS restart, SURVEY A3).
+        Returns True on success so the heartbeat loop can reset its
+        reconnect backoff."""
         failpoint("node.reconnect.pre")
         head = None
         try:
             head = RpcClient(self.head_address)
             head.call(
                 "register_node", self.node_id.hex(), self.address,
-                self.backend.node.total.to_dict(), self.labels, timeout=5.0,
+                self.backend.node.total.to_dict(), self.labels,
+                timeout=tuning.CONTROL_CALL_TIMEOUT_S,
             )
         except Exception:
             if head is not None:  # connected but registration failed
@@ -812,7 +839,7 @@ class NodeServer:
                     head.close()
                 except Exception:
                     pass
-            return  # head still down; next heartbeat retries
+            return False  # head still down; heartbeat loop backs off
         head.subscribe("push_requests", self._on_push_request)
         old = self._head
         self._head = head
@@ -844,17 +871,47 @@ class NodeServer:
                 head.notify("report_object", oid.hex(), self.node_id.hex())
             except Exception:
                 break
+        # Replay control-plane notifications buffered while the head was
+        # unreachable (task_done, borrow_released, ...). All of them are
+        # idempotent at the head; object reports were already re-announced
+        # above but a duplicate merely re-adds an existing directory entry.
+        while True:
+            with self._notify_buffer_lock:
+                if not self._notify_buffer:
+                    break
+                method, args = self._notify_buffer.popleft()
+            try:
+                head.notify(method, *args)
+            except Exception:
+                # Head went away again; put the in-flight one back and
+                # keep the rest buffered for the next reconnect.
+                with self._notify_buffer_lock:
+                    self._notify_buffer.appendleft((method, args))
+                break
+        return True
 
     # -- head reporting ----------------------------------------------------
 
+    def _head_notify(self, method: str, *args) -> None:
+        """Fire-and-forget to the head with bounded buffering: while the
+        head is unreachable, notifications queue (oldest dropped beyond
+        ``HEAD_NOTIFY_BUFFER_MAX``) and replay after re-registration —
+        instead of being silently swallowed by the old per-site
+        ``except Exception: pass``."""
+        head = self._head
+        try:
+            if head is None or head.closed:
+                raise ConnectionLost("head connection closed")
+            head.notify(method, *args)
+        except Exception:
+            with self._notify_buffer_lock:
+                self._notify_buffer.append((method, args))
+
     def _report_object(self, oid: ObjectID) -> None:
         self._wake_obj_waiters(oid.hex())
-        if self._head is None or self._head.closed:
+        if self._head is None:
             return
-        try:
-            self._head.notify("report_object", oid.hex(), self.node_id.hex())
-        except Exception:
-            pass
+        self._head_notify("report_object", oid.hex(), self.node_id.hex())
 
     def _on_push_request(self, data: dict) -> None:
         """Head push: nodes listed in ``targets`` demanded an object that
@@ -899,13 +956,10 @@ class NodeServer:
 
     def _report_actor_dead(self, actor_id: ActorID, reason: str,
                            no_restart: bool = True) -> None:
-        if self._head is None or self._head.closed:
-            return
-        try:
-            self._head.notify("actor_dead", actor_id.hex(), reason,
-                              no_restart)
-        except Exception:
-            pass
+        # Buffered: a missed actor_dead means the head keeps routing
+        # tasks to a corpse until the next heartbeat-timeout sweep.
+        self._head_notify("actor_dead", actor_id.hex(), reason,
+                          no_restart)
 
     # -- cross-node object fetch ------------------------------------------
 
@@ -984,11 +1038,12 @@ class NodeServer:
                 if inbound:
                     # A producer is already streaming it here; don't pull
                     # the same bytes in parallel.
-                    time.sleep(0.02)
+                    time.sleep(tuning.PUSH_WAIT_POLL_PERIOD_S)
                     continue
                 try:
                     locs = self._head.call("locate_object", oid.hex(),
-                                           True, timeout=10.0)
+                                           True,
+                                           timeout=tuning.LOCATE_TIMEOUT_S)
                 except ConnectionLost:
                     return
                 for loc in locs or ():
@@ -1000,7 +1055,7 @@ class NodeServer:
                         self.pull_rounds += 1
                         blob = fetch_blob(
                             self._peer_client(loc["address"]), oid.hex(),
-                            timeout=60.0)
+                            timeout=tuning.FETCH_TIMEOUT_S)
                     except Exception:
                         continue
                     if blob is not None:
@@ -1149,20 +1204,20 @@ class NodeServer:
 
     def _route_remote_actor_task(self, spec: TaskSpec,
                                  spec_blob: bytes) -> None:
-        deadline = time.monotonic() + 30.0
+        deadline = time.monotonic() + tuning.ACTOR_RESOLVE_TIMEOUT_S
         reason = "actor not found"
         while time.monotonic() < deadline and not self._stop.is_set():
             try:
                 info = self._head.call("resolve_actor", spec.actor_id.hex())
             except Exception:
-                time.sleep(0.5)
+                time.sleep(tuning.PENDING_POLL_PERIOD_S)
                 continue
             if info is None:
                 reason = "actor not found or dead"
                 break
             addr = info.get("address")
             if info.get("state") == "restarting" or addr is None:
-                time.sleep(0.2)
+                time.sleep(tuning.RESTART_POLL_PERIOD_S)
                 continue
             if addr == self.address:
                 self._ensure_args_local(spec)
@@ -1173,7 +1228,7 @@ class NodeServer:
                 return
             except Exception as e:
                 reason = f"actor node unreachable: {e}"
-                time.sleep(0.5)
+                time.sleep(tuning.PENDING_POLL_PERIOD_S)
         self.backend.worker._store_error(
             spec.return_ids(), spec,
             ActorDiedError(spec.actor_id.hex(), reason))
@@ -1239,8 +1294,9 @@ class NodeServer:
         if self.backend.store.contains(ObjectID.from_hex(oid_hex)):
             return True
         try:
-            return bool(self._head.call("locate_object", oid_hex,
-                                        timeout=5.0))
+            return bool(self._head.call(
+                "locate_object", oid_hex,
+                timeout=tuning.CONTROL_CALL_TIMEOUT_S))
         except Exception:
             return False
 
@@ -1317,11 +1373,7 @@ class NodeServer:
         """Owner-directed free (the owner's refcount hit zero)."""
         oid = ObjectID.from_hex(oid_hex)
         self.backend.store.delete([oid])
-        try:
-            self._head.notify("forget_object", oid.hex(),
-                              self.node_id.hex())
-        except Exception:
-            pass
+        self._head_notify("forget_object", oid.hex(), self.node_id.hex())
 
     def _h_cache_runtime_env(self, peer: Peer, uri: str,
                              blob: bytes) -> None:
@@ -1351,11 +1403,7 @@ class NodeServer:
         self.backend.remove_placement_group(PlacementGroupID(pg_id_bin))
 
     def _report_task_done(self, task_id_hex: str) -> None:
-        try:
-            self._head.notify("task_done", task_id_hex,
-                              self.node_id.hex())
-        except Exception:
-            pass
+        self._head_notify("task_done", task_id_hex, self.node_id.hex())
 
     def _report_borrows(self, oid_hexes, worker_id_hex: str) -> None:
         """Synchronous head report on the task-completion path (the
@@ -1366,21 +1414,21 @@ class NodeServer:
         with self._borrow_lock:
             self._worker_borrows.setdefault(
                 worker_id_hex, set()).update(oid_hexes)
-        last = None
-        for attempt in range(3):
-            try:
-                self._head.call("borrow_added", list(oid_hexes), key,
-                                timeout=10.0)
-                return
-            except Exception as e:
-                last = e
-                time.sleep(0.2 * (attempt + 1))
-        import logging
+        try:
+            RetryPolicy(max_attempts=3,
+                        base_delay_s=tuning.RECONNECT_BASE_DELAY_S,
+                        seed=0).run(
+                lambda: self._head.call(
+                    "borrow_added", list(oid_hexes), key,
+                    timeout=tuning.LOCATE_TIMEOUT_S),
+                what="borrow_added report")
+        except Exception as last:
+            import logging
 
-        logging.getLogger("raytpu.cluster").error(
-            "borrow_added report failed for %s (borrower %s): %s — the "
-            "owner may free these objects while the worker still holds "
-            "them", [o[:8] for o in oid_hexes], key, last)
+            logging.getLogger("raytpu.cluster").error(
+                "borrow_added report failed for %s (borrower %s): %s — "
+                "the owner may free these objects while the worker still "
+                "holds them", [o[:8] for o in oid_hexes], key, last)
 
     def _h_borrow_released(self, peer: Peer, oid_hex: str,
                            worker_id_hex: str) -> None:
@@ -1388,11 +1436,8 @@ class NodeServer:
             held = self._worker_borrows.get(worker_id_hex)
             if held is not None:
                 held.discard(oid_hex)
-        try:
-            self._head.notify("borrow_released", oid_hex,
-                              f"{self.node_id.hex()}:{worker_id_hex}")
-        except Exception:
-            pass
+        self._head_notify("borrow_released", oid_hex,
+                          f"{self.node_id.hex()}:{worker_id_hex}")
 
     def _worker_gone(self, worker_id_hex: str) -> None:
         """Pool callback on worker death/drop: its borrows are gone."""
@@ -1401,10 +1446,7 @@ class NodeServer:
                 oids = self._worker_borrows.pop(worker_id_hex, set())
             key = f"{self.node_id.hex()}:{worker_id_hex}"
             for oh in oids:
-                try:
-                    self._head.notify("borrow_released", oh, key)
-                except Exception:
-                    pass
+                self._head_notify("borrow_released", oh, key)
         threading.Thread(target=run, daemon=True).start()
 
     def _h_register_worker(self, peer: Peer, worker_id_hex: str,
@@ -1469,8 +1511,9 @@ class NodeServer:
                     found = False
                     for oh in oid_hexes:
                         try:
-                            if head.call("locate_object", oh, True,
-                                         timeout=5.0):
+                            if head.call(
+                                    "locate_object", oh, True,
+                                    timeout=tuning.CONTROL_CALL_TIMEOUT_S):
                                 found = True
                         except Exception:
                             pass
@@ -1479,8 +1522,9 @@ class NodeServer:
                 if await loop.run_in_executor(None, _locate):
                     return True
             try:
-                await asyncio.wait_for(asyncio.shield(fut),
-                                       min(float(timeout), 300.0))
+                await asyncio.wait_for(
+                    asyncio.shield(fut),
+                    min(float(timeout), tuning.WAIT_POLL_CAP_S))
                 return True
             except asyncio.TimeoutError:
                 return False
@@ -1509,11 +1553,8 @@ class NodeServer:
             if not self.backend.store.contains(oid):
                 break
             self.backend.store.delete([oid])
-            try:
-                self._head.notify("forget_object", oid.hex(),
-                                  self.node_id.hex())
-            except Exception:
-                pass
+            self._head_notify("forget_object", oid.hex(),
+                              self.node_id.hex())
             i += 1
 
     def _route_stream(self, method: str, task_id_hex: str,
@@ -1542,7 +1583,8 @@ class NodeServer:
         # something).
         try:
             elem = ObjectID.for_task_return(tid, max(count, 1))
-            locs = self._head.call("locate_object", elem.hex(), timeout=5.0)
+            locs = self._head.call("locate_object", elem.hex(),
+                                   timeout=tuning.CONTROL_CALL_TIMEOUT_S)
             for loc in locs or ():
                 if loc["address"] != self.address:
                     self._peer_client(loc["address"]).notify(
@@ -1715,7 +1757,9 @@ class NodeServer:
                 continue
             try:
                 out[wid] = {"pid": h.pid,
-                            "stack": client.call("stack", timeout=5.0)}
+                            "stack": client.call(
+                                "stack",
+                                timeout=tuning.CONTROL_CALL_TIMEOUT_S)}
             except Exception as e:
                 out[wid] = {"pid": h.pid,
                             "error": f"{type(e).__name__}: {e}"}

@@ -25,13 +25,26 @@ import itertools
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from raytpu.cluster import wire
+from raytpu.cluster import constants as tuning
+from raytpu.util.errors import DeadlineExceeded, RpcTimeoutError
 from raytpu.util.failpoints import DROP, failpoint
+from raytpu.util.resilience import (
+    Deadline,
+    current_deadline,
+    reset_current_deadline,
+    set_current_deadline,
+)
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
+
+# Distinguishes "caller said nothing" (-> configured default) from an
+# explicit timeout=None (wait forever, e.g. long uploads via the relay).
+_UNSET = object()
 
 
 class RpcError(Exception):
@@ -120,7 +133,7 @@ class RpcServer:
             target=self._run, name="raytpu-rpc-server", daemon=True
         )
         self._thread.start()
-        if not self._started.wait(timeout=10):
+        if not self._started.wait(timeout=tuning.SERVER_START_TIMEOUT_S):
             raise RpcError("rpc server failed to start")
         return self.address
 
@@ -174,15 +187,32 @@ class RpcServer:
         if failpoint("rpc.dispatch.pre") is DROP:
             return  # swallow the request: caller sees a timeout
         handler = self._handlers.get(frame.get("m"))
+        # A "d" field is the caller's remaining budget (seconds). Each
+        # dispatch runs in its own task (contextvars copy at task
+        # creation), so the contextvar can't bleed between concurrent
+        # requests on one connection. Handlers fanning out downstream
+        # read it via resilience.current_deadline().
+        dl_wire = frame.get("d")
+        deadline = (Deadline.from_wire(dl_wire)
+                    if isinstance(dl_wire, (int, float)) else None)
+        token = set_current_deadline(deadline) \
+            if deadline is not None else None
         try:
             if handler is None:
                 raise RpcError(f"no handler for {frame.get('m')!r}")
+            if deadline is not None:
+                # Budget already spent in flight: reply without paying
+                # for the handler — the caller gave up regardless.
+                deadline.check(f"rpc {frame.get('m')!r} (server)")
             result = handler(peer, *frame.get("a", ()))
             if asyncio.iscoroutine(result):
                 result = await result
             reply = {"i": req_id, "r": result}
         except BaseException as e:  # noqa: BLE001 — errors cross the wire
             reply = {"i": req_id, "e": e}
+        finally:
+            if token is not None:
+                reset_current_deadline(token)
         if req_id is not None and not peer.closed:
             try:
                 try:
@@ -207,15 +237,18 @@ class RpcServer:
             except RuntimeError:
                 pass
             if self._thread is not None:
-                self._thread.join(timeout=5)
+                self._thread.join(timeout=tuning.SERVER_STOP_TIMEOUT_S)
 
 
 class RpcClient:
     """Blocking, thread-safe client. One socket; a reader thread correlates
     responses and fires subscription callbacks."""
 
-    def __init__(self, address: str, timeout: float = 10.0,
+    def __init__(self, address: str,
+                 timeout: Optional[float] = None,
                  allow_pickle: bool = True):
+        if timeout is None:
+            timeout = tuning.RPC_CONNECT_TIMEOUT_S
         self._allow_pickle = allow_pickle
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
@@ -264,16 +297,75 @@ class RpcClient:
                 if not lst:
                     self._subs.pop(topic, None)
 
-    def call(self, method: str, *args, timeout: Optional[float] = 30.0) -> Any:
+    def call(self, method: str, *args, timeout: Any = _UNSET,
+             policy: Any = None, deadline: Optional[Deadline] = None,
+             breaker: Any = None) -> Any:
+        """One RPC round trip.
+
+        ``timeout`` — reply budget (default ``tuning.RPC_CALL_TIMEOUT_S``;
+        explicit ``None`` waits forever). ``deadline`` — a
+        :class:`~raytpu.util.resilience.Deadline` that bounds the timeout
+        AND rides the frame so the server (and anything it calls) sees
+        the shrunken budget; defaults to the ambient handler deadline
+        when called from inside an RPC handler. ``policy`` — a
+        :class:`~raytpu.util.resilience.RetryPolicy` re-attempting
+        retryable failures. ``breaker`` — a
+        :class:`~raytpu.util.resilience.CircuitBreaker` consulted before
+        the socket is touched and fed with the transport outcome.
+        """
+        if timeout is _UNSET:
+            timeout = tuning.RPC_CALL_TIMEOUT_S
+        if deadline is None:
+            deadline = current_deadline()
+        if policy is None:
+            return self._call_once(method, args, timeout, deadline, breaker)
+        return policy.run(
+            lambda: self._call_once(method, args, timeout, deadline,
+                                    breaker),
+            deadline=deadline,
+            what=f"rpc {method!r} to {self.address}")
+
+    def _call_once(self, method: str, args: tuple,
+                   timeout: Optional[float], deadline: Optional[Deadline],
+                   breaker: Any) -> Any:
+        if deadline is not None:
+            # Spent budget fails HERE — before the breaker, before the
+            # socket: a dead peer's connect/read path is never burned
+            # for a call whose caller has already given up.
+            deadline.check(f"rpc {method!r} to {self.address}")
+            timeout = deadline.bound(timeout)
+        if breaker is not None:
+            breaker.allow()  # raises CircuitOpenError when open
         req_id = next(self._ids)
-        waiter = _Waiter()
+        waiter = _Waiter(method, self.address)
         with self._plock:
             if self._closed:
+                if breaker is not None:
+                    breaker.record_failure()
                 raise ConnectionLost(f"connection to {self.address} closed")
             self._pending[req_id] = waiter
+        frame = {"m": method, "a": args, "i": req_id}
+        if deadline is not None:
+            frame["d"] = deadline.to_wire()
         try:
-            self._send({"m": method, "a": args, "i": req_id})
-            return waiter.wait(timeout)
+            self._send(frame)
+            result = waiter.wait(timeout)
+        except (ConnectionLost, RpcTimeoutError, ConnectionError,
+                OSError) as e:
+            # Transport-level: the peer never answered. Everything else
+            # (application errors decoded off a reply frame) proves the
+            # peer alive and counts as breaker success below.
+            if breaker is not None:
+                breaker.record_failure()
+            raise e
+        except BaseException:
+            if breaker is not None:
+                breaker.record_success()
+            raise
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return result
         finally:
             with self._plock:
                 self._pending.pop(req_id, None)
@@ -370,7 +462,9 @@ class RpcClient:
 
 
 class _Waiter:
-    def __init__(self):
+    def __init__(self, method: str = "?", address: str = "?"):
+        self._method = method
+        self._address = address
         self._ev = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -384,8 +478,14 @@ class _Waiter:
         self._ev.set()
 
     def wait(self, timeout: Optional[float]):
+        start = time.monotonic()
         if not self._ev.wait(timeout):
-            raise TimeoutError("rpc call timed out")
+            # Timeout context in the exception, not just the message: a
+            # stack trace must name the slow hop (which method, which
+            # peer, how long) — "rpc call timed out" names nothing.
+            raise RpcTimeoutError(self._method, self._address,
+                                  timeout_s=timeout,
+                                  elapsed_s=time.monotonic() - start)
         if self._error is not None:
             raise self._error
         return self._result

@@ -12,13 +12,16 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
-from raytpu.cluster.protocol import RpcClient
+from raytpu.cluster import constants as tuning
+from raytpu.cluster.protocol import RpcClient, _UNSET
+from raytpu.util.resilience import Deadline, current_deadline
 
 
 class RelayChannel:
     """One physical connection to the proxy, shared by all RelayClients."""
 
-    def __init__(self, proxy_address: str, timeout: float = 10.0):
+    def __init__(self, proxy_address: str,
+                 timeout: Optional[float] = None):
         self._rpc = RpcClient(proxy_address, timeout=timeout)
         info = self._rpc.call("proxy_info")
         self.head_address: str = info["head"]
@@ -43,13 +46,26 @@ class RelayClient:
         self._target = target
         self.address = target
 
-    def call(self, method: str, *args,
-             timeout: Optional[float] = 30.0) -> Any:
+    def call(self, method: str, *args, timeout: Any = _UNSET,
+             policy: Any = None, deadline: Optional[Deadline] = None,
+             breaker: Any = None) -> Any:
         # The requested timeout rides the frame so the proxy bounds the
         # upstream call with the CALLER's budget — a long upload with
         # timeout=None must not be cut off by the proxy's default cap.
+        # A deadline shrinks that budget the same way (the in-frame
+        # timeout argument IS the deadline's remaining budget at this
+        # hop, so it keeps shrinking client → proxy → upstream).
+        if timeout is _UNSET:
+            timeout = tuning.RPC_CALL_TIMEOUT_S
+        if deadline is None:
+            deadline = current_deadline()
+        if deadline is not None:
+            deadline.check(f"relay {method!r} to {self._target}")
+            timeout = deadline.bound(timeout)
         return self._chan._rpc.call("relay_call", self._target, method,
-                                    list(args), timeout, timeout=timeout)
+                                    list(args), timeout, timeout=timeout,
+                                    policy=policy, deadline=deadline,
+                                    breaker=breaker)
 
     def notify(self, method: str, *args) -> None:
         self._chan._rpc.notify("relay_notify", self._target, method,

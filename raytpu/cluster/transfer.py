@@ -28,8 +28,10 @@ import threading
 from typing import List, Optional
 
 from raytpu.core.config import cfg
+from raytpu.cluster import constants as tuning
 from raytpu.runtime.serialization import SerializedValue
 from raytpu.util.failpoints import DROP, failpoint
+from raytpu.util.resilience import Deadline
 
 _sem: Optional[threading.Semaphore] = None
 _sem_lock = threading.Lock()
@@ -73,25 +75,31 @@ def read_range(sv: SerializedValue, offset: int, length: int) -> bytes:
     return bytes(out)
 
 
-def fetch_blob(client, oid_hex: str, timeout: float = 60.0
-               ) -> Optional[bytes]:
+def fetch_blob(client, oid_hex: str, timeout: Optional[float] = None,
+               deadline: Optional[Deadline] = None) -> Optional[bytes]:
     """Pull one object's wire bytes from a peer, chunked when large.
 
     ``client`` is an RpcClient to the holding node. Returns None when the
-    peer no longer holds the object.
+    peer no longer holds the object. ``timeout`` bounds each chunk RPC;
+    ``deadline`` bounds the whole transfer (every chunk call checks and
+    shrinks to the remaining budget).
     """
     # drop => behave as if the holder no longer has the object (the
     # caller re-locates / falls back to lineage); raise models a severed
     # transfer connection.
     if failpoint("transfer.fetch.pre") is DROP:
         return None
+    if timeout is None:
+        timeout = tuning.FETCH_TIMEOUT_S
     chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
-    meta = client.call("fetch_object_meta", oid_hex, timeout=timeout)
+    meta = client.call("fetch_object_meta", oid_hex, timeout=timeout,
+                       deadline=deadline)
     if meta is None:
         return None
     size = int(meta["size"])
     if size <= chunk:
-        return client.call("fetch_object", oid_hex, timeout=timeout)
+        return client.call("fetch_object", oid_hex, timeout=timeout,
+                           deadline=deadline)
     parts: List[bytes] = []
     off = 0
     sem = _semaphore()
@@ -99,7 +107,7 @@ def fetch_blob(client, oid_hex: str, timeout: float = 60.0
         want = min(chunk, size - off)
         with sem:
             piece = client.call("fetch_object_chunk", oid_hex, off, want,
-                                timeout=timeout)
+                                timeout=timeout, deadline=deadline)
         if piece is None:
             return None  # holder dropped it mid-transfer; caller re-locates
         parts.append(piece)
@@ -110,7 +118,8 @@ def fetch_blob(client, oid_hex: str, timeout: float = 60.0
 
 
 def push_blob(client, oid_hex: str, sv: SerializedValue,
-              timeout: float = 60.0) -> bool:
+              timeout: Optional[float] = None,
+              deadline: Optional[Deadline] = None) -> bool:
     """Stream one object's wire bytes TO a peer node.
 
     Small objects ride the existing ``put_object`` RPC in one frame; large
@@ -121,12 +130,16 @@ def push_blob(client, oid_hex: str, sv: SerializedValue,
     """
     if failpoint("transfer.push.pre") is DROP:
         return False  # push lost; receiver's pull fallback takes over
+    if timeout is None:
+        timeout = tuning.FETCH_TIMEOUT_S
     chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
     size = wire_size(sv)
     if size <= chunk:
-        client.call("put_object", oid_hex, sv.to_bytes(), timeout=timeout)
+        client.call("put_object", oid_hex, sv.to_bytes(), timeout=timeout,
+                    deadline=deadline)
         return True
-    if not client.call("push_object_begin", oid_hex, size, timeout=timeout):
+    if not client.call("push_object_begin", oid_hex, size, timeout=timeout,
+                       deadline=deadline):
         return True  # receiver already has it (or another push is inbound)
     window = max(1, min(8, int(cfg.object_transfer_max_concurrency)))
     from concurrent.futures import ThreadPoolExecutor
@@ -141,7 +154,7 @@ def push_blob(client, oid_hex: str, sv: SerializedValue,
         with sem:
             return client.call("push_object_chunk", oid_hex, off,
                                read_range(sv, off, want),
-                               timeout=timeout) is True
+                               timeout=timeout, deadline=deadline) is True
 
     ok = True
     with ThreadPoolExecutor(max_workers=window,
@@ -158,4 +171,5 @@ def push_blob(client, oid_hex: str, sv: SerializedValue,
         except Exception:
             pass
         return False
-    return client.call("push_object_end", oid_hex, timeout=timeout) is True
+    return client.call("push_object_end", oid_hex, timeout=timeout,
+                       deadline=deadline) is True

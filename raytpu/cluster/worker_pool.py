@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import RpcClient
 from raytpu.core.config import cfg
 from raytpu.util.failpoints import DROP, failpoint
@@ -148,7 +149,8 @@ class WorkerPool:
         failpoint("worker.lease.pre")
         key = (job_id.hex(), runtime_env_hash(renv), tuple(chips))
         if timeout is None:
-            timeout = 300.0  # never wedge the dispatcher forever
+            # Never wedge the dispatcher forever.
+            timeout = tuning.WORKER_LEASE_TIMEOUT_S
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:
@@ -247,7 +249,8 @@ class WorkerPool:
                          worker_id=h.worker_id.hex(), reason=reason)
         try:
             if h.client is not None and not h.client.closed:
-                h.client.call("kill", reason, timeout=2.0)
+                h.client.call("kill", reason,
+                              timeout=tuning.WORKER_KILL_TIMEOUT_S)
         except Exception:
             pass
         try:
@@ -330,7 +333,7 @@ class WorkerPool:
 
     def _monitor_loop(self) -> None:
         while not self._stopped:
-            time.sleep(0.05)
+            time.sleep(tuning.MONITOR_POLL_PERIOD_S)
             dead: List[WorkerHandle] = []
             idle_kill: List[WorkerHandle] = []
             now = time.monotonic()
@@ -383,7 +386,7 @@ class WorkerPool:
             if h.proc is None:
                 continue
             try:
-                h.proc.wait(timeout=2)
+                h.proc.wait(timeout=tuning.WORKER_KILL_TIMEOUT_S)
             except Exception:
                 try:
                     h.proc.kill()

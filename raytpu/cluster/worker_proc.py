@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from raytpu.cluster import constants as tuning
 from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
@@ -105,8 +106,9 @@ class WorkerBackend:
         if self.store.contains(ref.id):
             return True
         try:
-            return bool(self._host.node.call("has_object", ref.id.hex(),
-                                             timeout=5.0))
+            return bool(self._host.node.call(
+                "has_object", ref.id.hex(),
+                timeout=tuning.CONTROL_CALL_TIMEOUT_S))
         except Exception:
             return False
 
@@ -254,12 +256,13 @@ class _WorkerHost:
                        timeout: Optional[float] = None) -> SerializedValue:
         """Local/shm store first; miss → pull from the daemon."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 0.002
+        delay = tuning.OBJECT_POLL_MIN_S
         while True:
             sv = self.store.try_get(oid)
             if sv is not None:
                 return sv
-            blob = self.node.call("fetch_object", oid.hex(), timeout=30.0)
+            blob = self.node.call("fetch_object", oid.hex(),
+                                  timeout=tuning.WORKER_FETCH_TIMEOUT_S)
             if blob is not None:
                 return SerializedValue.from_buffer(blob)
             if deadline is not None and time.monotonic() >= deadline:
@@ -267,7 +270,7 @@ class _WorkerHost:
 
                 raise GetTimeoutError(f"object {oid.hex()} not ready")
             time.sleep(delay)
-            delay = min(delay * 2, 0.1)
+            delay = min(delay * 2, tuning.OBJECT_POLL_MAX_S)
 
     def collect_results(self, spec: TaskSpec) -> List[Tuple[bytes, Optional[bytes]]]:
         """Gather return values: ``(oid, None)`` = sealed in shared memory
@@ -552,12 +555,13 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
 
     # Die with the daemon: if the control connection drops, exit.
     while not host.node.closed:
-        time.sleep(0.5)
+        time.sleep(tuning.PENDING_POLL_PERIOD_S)
     os._exit(0)
 
 
 def _delayed_exit() -> None:  # pragma: no cover
-    time.sleep(0.05)  # let the kill reply flush
+    # Let the kill reply flush before the hard exit.
+    time.sleep(tuning.MONITOR_POLL_PERIOD_S)
     os._exit(0)
 
 

@@ -1,0 +1,142 @@
+"""Typed error taxonomy for the resilience layer.
+
+Reference analogue: gRPC status codes (``UNAVAILABLE`` vs
+``DEADLINE_EXCEEDED`` vs ``FAILED_PRECONDITION``) and Ray's
+``RpcError``/``GetTimeoutError`` split: retry decisions must key off
+*types*, never off string-matching an exception message. Every
+hand-rolled ``except ValueError: if "retry" in str(e)`` site in the
+cluster layer migrates onto this module.
+
+The taxonomy has two roots under :class:`~raytpu.core.errors.RayTpuError`:
+
+- :class:`RetryableError` — transient; a :class:`~raytpu.util.resilience.
+  RetryPolicy` may re-attempt the operation.
+- :class:`FatalError` — re-attempting cannot help (budget exhausted,
+  breaker open, precondition failed); policies re-raise immediately.
+
+Errors raised by lower layers (``ConnectionError``, ``OSError``,
+``TimeoutError``) predate the taxonomy; :func:`is_retryable` classifies
+them so policies work over the whole exception population. Everything
+here is wire-encodable by :mod:`raytpu.cluster.wire` (the ``raytpu``
+module prefix is on the strict-surface allowlist), so typed errors
+survive the hop back to a remote caller.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raytpu.core.errors import RayTpuError
+
+
+class RetryableError(RayTpuError):
+    """Transient failure: the operation may succeed if re-attempted."""
+
+
+class FatalError(RayTpuError):
+    """Permanent failure: retrying cannot change the outcome."""
+
+
+class NodeVanishedError(RetryableError):
+    """A node selected by the scheduler disappeared before the operation
+    reached it (raced with failure detection). Retrying re-schedules on
+    a surviving node. Replaces the string-matched
+    ``ValueError("scheduled node vanished; retry")`` signal."""
+
+    def __init__(self, node_id_hex: str = "", detail: str = ""):
+        self.node_id_hex = node_id_hex
+        msg = f"scheduled node {node_id_hex or '?'} vanished"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class PlacementInfeasibleError(RetryableError):
+    """A placement request does not fit the head's *current* availability
+    view — which lags heartbeats and is optimistically debited, so
+    transient infeasibility is normal and retried under a bounded
+    deadline (PG creation). Replaces the string-matched ``"infeasible"``
+    ValueError signal."""
+
+
+class DeadlineExceeded(FatalError, TimeoutError):
+    """The caller's remaining time budget is spent. Raised *locally*,
+    before touching the socket, when a propagated deadline expires —
+    never worth retrying under the same deadline."""
+
+    def __init__(self, what: str = "operation",
+                 budget_s: Optional[float] = None,
+                 overrun_s: Optional[float] = None):
+        self.what = what
+        self.budget_s = budget_s
+        self.overrun_s = overrun_s
+        msg = f"deadline exceeded for {what}"
+        if budget_s is not None:
+            msg += f" (budget {budget_s:.3f}s"
+            if overrun_s is not None:
+                msg += f", overran by {overrun_s:.3f}s"
+            msg += ")"
+        super().__init__(msg)
+
+
+class CircuitOpenError(FatalError):
+    """The per-peer circuit breaker is open: the peer has failed
+    consecutively past threshold and the cooldown has not elapsed.
+    Fail-fast — callers degrade (partial results, alternate replica)
+    instead of queueing behind a dead socket."""
+
+    def __init__(self, peer: str, open_for_s: Optional[float] = None):
+        self.peer = peer
+        self.open_for_s = open_for_s
+        msg = f"circuit breaker open for peer {peer}"
+        if open_for_s is not None:
+            msg += f" (retry allowed in {open_for_s:.3f}s)"
+        super().__init__(msg)
+
+
+class RpcTimeoutError(RetryableError, TimeoutError):
+    """An RPC reply did not arrive within the configured timeout.
+    Carries full call context (method, peer, timeout, elapsed) so a
+    stack trace names the slow hop instead of 'rpc call timed out'."""
+
+    def __init__(self, method: str = "?", peer: str = "?",
+                 timeout_s: Optional[float] = None,
+                 elapsed_s: Optional[float] = None):
+        self.method = method
+        self.peer = peer
+        self.timeout_s = timeout_s
+        self.elapsed_s = elapsed_s
+        msg = f"rpc {method!r} to {peer} timed out"
+        if timeout_s is not None:
+            msg += f" after {timeout_s:.3f}s"
+        if elapsed_s is not None:
+            msg += f" (elapsed {elapsed_s:.3f}s)"
+        super().__init__(msg)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Classify an exception for retry policies.
+
+    Taxonomy types answer for themselves; pre-taxonomy types are
+    classified by kind: connection-level failures and plain timeouts are
+    transient (the peer may come back / the next attempt may be faster),
+    while everything else — application errors — means the operation
+    itself is wrong and retrying would just repeat it.
+
+    Order matters: :class:`DeadlineExceeded` subclasses ``TimeoutError``
+    but is fatal (same budget, same outcome), so ``FatalError`` is
+    checked first.
+    """
+    if isinstance(exc, FatalError):
+        return False
+    if isinstance(exc, RetryableError):
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, OSError):
+        return True
+    # ConnectionLost (protocol.py) subclasses RpcError/Exception only;
+    # match it structurally to avoid an import cycle with protocol.py.
+    if type(exc).__name__ == "ConnectionLost":
+        return True
+    return False

@@ -1,0 +1,387 @@
+"""Unified resilience layer: retry policies, circuit breakers, deadlines.
+
+Reference analogues: gRPC's retry policy + deadline propagation model
+(deadlines shrink monotonically as a call crosses hops; a server sees
+the *caller's* remaining budget, not a fresh one) and the circuit
+breaker of Nygard's *Release It!* as implemented in Hystrix/resilience4j
+(closed → open on consecutive failures, half-open probe after cooldown).
+Ray's equivalent machinery is scattered through ``core_worker`` retry
+loops; here it is one policy surface the whole cluster layer shares.
+
+Three primitives:
+
+- :class:`RetryPolicy` — bounded attempts, exponential backoff with
+  *deterministically seeded* jitter (chaos tests pin the exact delay
+  sequence), retryability decided by the typed taxonomy in
+  :mod:`raytpu.util.errors` (never by string-matching messages).
+- :class:`CircuitBreaker` — per-peer failure accounting. One dead peer
+  must cost each caller O(1) probes, not O(attempts); the breaker turns
+  repeated connect-and-burn into an instant local
+  :class:`~raytpu.util.errors.CircuitOpenError`.
+- :class:`Deadline` — an absolute time budget that rides RPC frame
+  metadata (wire format: *remaining seconds* as a float, because peer
+  clocks are not synchronized) and shrinks across hops. Expiry raises
+  :class:`~raytpu.util.errors.DeadlineExceeded` locally, before the
+  socket is touched.
+
+Clocks and sleeps are injectable so every behavior is testable without
+wall-clock waits.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from raytpu.util.errors import (
+    CircuitOpenError,
+    DeadlineExceeded,
+    is_retryable,
+)
+
+# Env-overridable defaults (RAYTPU_* convention, matching the heartbeat
+# constants in cluster/head.py and the timeout registry in
+# cluster/constants.py — kept here because util/ must not import cluster/).
+RETRY_MAX_ATTEMPTS = int(os.environ.get("RAYTPU_RETRY_MAX_ATTEMPTS", "3"))
+RETRY_BASE_DELAY_S = float(os.environ.get("RAYTPU_RETRY_BASE_DELAY_S", "0.05"))
+RETRY_MAX_DELAY_S = float(os.environ.get("RAYTPU_RETRY_MAX_DELAY_S", "2.0"))
+BREAKER_FAILURE_THRESHOLD = int(
+    os.environ.get("RAYTPU_BREAKER_FAILURE_THRESHOLD", "5"))
+BREAKER_RESET_TIMEOUT_S = float(
+    os.environ.get("RAYTPU_BREAKER_RESET_TIMEOUT_S", "5.0"))
+
+
+# -- deadlines ---------------------------------------------------------------
+
+
+class Deadline:
+    """Absolute expiry against a monotonic clock.
+
+    Created once at the outermost caller (``Deadline.after(total)``) and
+    passed *down* — every layer that consumes time shrinks what the next
+    layer sees. Serialization is relative (:meth:`to_wire` → remaining
+    seconds) so the budget survives hops between machines whose clocks
+    disagree.
+    """
+
+    __slots__ = ("_expires_at", "_clock")
+
+    def __init__(self, expires_at: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self._expires_at = expires_at
+        self._clock = clock
+
+    @classmethod
+    def after(cls, seconds: float,
+              clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(clock() + float(seconds), clock=clock)
+
+    def remaining(self) -> float:
+        """Seconds left; negative once expired (callers that report
+        overrun want the sign)."""
+        return self._expires_at - self._clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "operation") -> None:
+        """Raise :class:`DeadlineExceeded` if the budget is spent."""
+        rem = self.remaining()
+        if rem <= 0.0:
+            raise DeadlineExceeded(what, overrun_s=-rem)
+
+    def bound(self, timeout: Optional[float]) -> float:
+        """Shrink a per-call timeout to fit the remaining budget.
+
+        ``timeout=None`` (wait forever) becomes the remaining budget —
+        a deadlined call is never unbounded. Floor of 0: a spent budget
+        yields an immediate timeout rather than a negative wait.
+        """
+        rem = max(0.0, self.remaining())
+        if timeout is None:
+            return rem
+        return min(float(timeout), rem)
+
+    def to_wire(self) -> float:
+        """Frame metadata: remaining seconds (relative — peer clocks are
+        not synchronized, so absolute times cannot cross the wire)."""
+        return self.remaining()
+
+    @classmethod
+    def from_wire(cls, remaining_s: float) -> "Deadline":
+        return cls.after(float(remaining_s))
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+# Server-side propagation: RpcServer._dispatch decodes the frame's "d"
+# field into a Deadline and sets it here for the duration of the handler.
+# Each dispatch runs in its own asyncio task (contextvars are copied at
+# task creation), so concurrent requests on one connection can't race.
+_current_deadline: "contextvars.ContextVar[Optional[Deadline]]" = \
+    contextvars.ContextVar("raytpu_deadline", default=None)
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The deadline of the RPC being handled, if the caller sent one.
+    Handlers that fan out downstream pass this along so the budget keeps
+    shrinking hop by hop (client → head → relay → node)."""
+    return _current_deadline.get()
+
+
+def set_current_deadline(d: Optional[Deadline]) -> "contextvars.Token":
+    return _current_deadline.set(d)
+
+
+def reset_current_deadline(token: "contextvars.Token") -> None:
+    _current_deadline.reset(token)
+
+
+# -- retry policy ------------------------------------------------------------
+
+
+class RetryPolicy:
+    """Bounded retry with exponential backoff and seeded jitter.
+
+    ``delay(k) = min(max_delay_s, base_delay_s * multiplier**k)
+    * (1 + jitter * u_k)`` where ``u_k`` is the k-th draw from
+    ``random.Random(seed)`` — fix the seed and the whole delay sequence
+    is pinned, which is what lets chaos tests assert exact backoff
+    without tolerance windows.
+
+    ``retryable`` defaults to the taxonomy classifier
+    (:func:`raytpu.util.errors.is_retryable`); ``sleep`` is injectable
+    so tests record delays instead of serving them.
+    """
+
+    def __init__(self, max_attempts: int = RETRY_MAX_ATTEMPTS,
+                 base_delay_s: float = RETRY_BASE_DELAY_S,
+                 max_delay_s: float = RETRY_MAX_DELAY_S,
+                 multiplier: float = 2.0,
+                 jitter: float = 0.5,
+                 seed: Optional[int] = None,
+                 retryable: Callable[[BaseException], bool] = is_retryable,
+                 sleep: Callable[[float], None] = time.sleep):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.seed = seed
+        self.retryable = retryable
+        self._sleep = sleep
+
+    def delays(self) -> list:
+        """The full backoff schedule (``max_attempts - 1`` entries),
+        deterministic for a fixed seed."""
+        rng = random.Random(self.seed)
+        out = []
+        for k in range(self.max_attempts - 1):
+            base = min(self.max_delay_s,
+                       self.base_delay_s * (self.multiplier ** k))
+            out.append(base * (1.0 + self.jitter * rng.random()))
+        return out
+
+    def run(self, fn: Callable[[], Any], *,
+            deadline: Optional[Deadline] = None,
+            what: str = "operation",
+            on_retry: Optional[Callable[[int, BaseException, float],
+                                        None]] = None) -> Any:
+        """Call ``fn`` up to ``max_attempts`` times.
+
+        Non-retryable errors and the final attempt's error propagate
+        unchanged. A deadline bounds the whole loop: expiry is checked
+        before each attempt, and a backoff that would sleep past the
+        deadline re-raises instead of burning budget in bed.
+        """
+        rng = random.Random(self.seed)
+        attempt = 0
+        while True:
+            if deadline is not None:
+                deadline.check(what)
+            attempt += 1
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 — classifier decides
+                if attempt >= self.max_attempts or not self.retryable(e):
+                    raise
+                base = min(self.max_delay_s,
+                           self.base_delay_s
+                           * (self.multiplier ** (attempt - 1)))
+                delay = base * (1.0 + self.jitter * rng.random())
+                if deadline is not None and deadline.remaining() <= delay:
+                    raise  # sleeping would outlive the budget
+                if on_retry is not None:
+                    try:
+                        on_retry(attempt, e, delay)
+                    except Exception:
+                        pass
+                self._sleep(delay)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_metrics_lock = threading.Lock()
+_metrics: Dict[str, Any] = {}
+
+
+def _metric(kind: str, name: str, desc: str, tag_keys):
+    """Lazy, best-effort metric creation (the breaker must work — and
+    stay silent — even if the metrics registry objects to anything)."""
+    with _metrics_lock:
+        m = _metrics.get(name)
+        if m is None:
+            try:
+                from raytpu.util import metrics as _m
+
+                cls = _m.Counter if kind == "counter" else _m.Gauge
+                m = cls(name, desc, tag_keys=tag_keys)
+            except Exception:
+                m = False  # cache the failure; never retry per-call
+            _metrics[name] = m
+    return m or None
+
+
+class CircuitBreaker:
+    """Per-peer consecutive-failure breaker (closed → open → half-open).
+
+    Only *transport-level* outcomes feed the state machine: the owner
+    records a failure when the peer was unreachable or silent, and a
+    success when a reply arrived — even an application error is proof
+    the peer is alive. ``clock`` is injectable so the open→half-open
+    cooldown is testable without waiting it out.
+    """
+
+    def __init__(self, peer: str = "",
+                 failure_threshold: int = BREAKER_FAILURE_THRESHOLD,
+                 reset_timeout_s: float = BREAKER_RESET_TIMEOUT_S,
+                 half_open_max_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.peer = peer
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.half_open_max_probes = half_open_max_probes
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probes = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _maybe_half_open_locked(self) -> None:
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.reset_timeout_s):
+            self._state = HALF_OPEN
+            self._probes = 0
+            self._note_transition(HALF_OPEN)
+
+    def allow(self) -> None:
+        """Gate one call. Raises :class:`CircuitOpenError` when the
+        breaker is open (or half-open with its probe quota in flight);
+        otherwise returns, reserving a probe slot if half-open."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return
+            if self._state == HALF_OPEN:
+                if self._probes < self.half_open_max_probes:
+                    self._probes += 1
+                    return
+                remaining = None
+            else:
+                remaining = max(
+                    0.0, self.reset_timeout_s
+                    - (self._clock() - self._opened_at))
+        self._count("raytpu_breaker_rejected",
+                    "calls rejected by an open circuit breaker")
+        raise CircuitOpenError(self.peer, open_for_s=remaining)
+
+    def record_success(self) -> None:
+        """A reply arrived (even an application error): peer is alive."""
+        with self._lock:
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self._note_transition(CLOSED)
+            self._failures = 0
+            self._probes = 0
+
+    def record_failure(self) -> None:
+        """The peer was unreachable or silent for one call."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == HALF_OPEN:
+                # The probe failed: back to a full cooldown.
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._probes = 0
+                self._note_transition(OPEN)
+                return
+            self._failures += 1
+            if self._state == CLOSED and \
+                    self._failures >= self.failure_threshold:
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._note_transition(OPEN)
+
+    # Called with the lock held: metric emission must never raise.
+    def _note_transition(self, new_state: str) -> None:
+        self._count("raytpu_breaker_transitions",
+                    "circuit breaker state transitions",
+                    extra={"state": new_state})
+
+    def _count(self, name: str, desc: str, extra=None) -> None:
+        try:
+            tags = {"peer": self.peer or "?"}
+            keys = ("peer",)
+            if extra:
+                tags.update(extra)
+                keys = ("peer", "state")
+            m = _metric("counter", name, desc, keys)
+            if m is not None:
+                m.inc(1.0, tags=tags)
+        except Exception:
+            pass
+
+
+# Per-peer registry: every component talking to the same address shares
+# one failure account, so N callers against a dead peer collectively make
+# O(threshold) probes — not N * attempts.
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(peer: str, **kwargs) -> CircuitBreaker:
+    """The process-wide breaker for ``peer`` (created on first use;
+    ``kwargs`` only apply then)."""
+    with _breakers_lock:
+        b = _breakers.get(peer)
+        if b is None:
+            b = CircuitBreaker(peer=peer, **kwargs)
+            _breakers[peer] = b
+        return b
+
+
+def reset_breakers() -> None:
+    """Drop every registered breaker (test isolation)."""
+    with _breakers_lock:
+        _breakers.clear()
