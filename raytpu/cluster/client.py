@@ -40,6 +40,7 @@ from raytpu.util.errors import (
     PlacementInfeasibleError,
     RpcTimeoutError,
 )
+from raytpu.util import tracing
 from raytpu.util.resilience import Deadline, RetryPolicy, breaker_for
 from raytpu.core.ids import (
     ActorID,
@@ -216,7 +217,13 @@ class ClusterBackend:
                 for oid in spec.return_ids()]
         self._pin_args(spec)
         self._record_lineage(spec)
-        self._route_task(spec)
+        # Trace root of a plain f.remote(): the head's schedule RPC and
+        # the node-bound submit_task frame both parent under this span.
+        with tracing.span("task.submit") as attrs:
+            if tracing.enabled():
+                attrs["task"] = spec.task_id.hex()[:16]
+                attrs["name"] = spec.name
+            self._route_task(spec)
         return refs
 
     def _record_lineage(self, spec: TaskSpec) -> None:
@@ -620,6 +627,15 @@ class ClusterBackend:
 
     def get_object(self, ref: ObjectRef,
                    timeout: Optional[float] = None) -> SerializedValue:
+        # One span for the whole locate/fetch/poll loop: in a timeline,
+        # "time spent waiting in raytpu.get" is the question being asked.
+        with tracing.span("object.get") as attrs:
+            if tracing.enabled():
+                attrs["oid"] = ref.id.hex()
+            return self._get_object_impl(ref, timeout)
+
+    def _get_object_impl(self, ref: ObjectRef,
+                         timeout: Optional[float] = None) -> SerializedValue:
         deadline = None if timeout is None else Deadline.after(timeout)
         delay = tuning.OBJECT_POLL_MIN_S
         empty_since: Optional[float] = None
@@ -980,6 +996,14 @@ class ClusterBackend:
 
     def task_events(self) -> List[dict]:
         return list(self._driver_backend.task_events())
+
+    def trace_dump(self) -> List[dict]:
+        """Every cluster process's span ring buffer, via the head's
+        fan-out (head → nodes → workers). The driver's own buffer is NOT
+        in here — :func:`raytpu.util.tracing.cluster_timeline` appends
+        it locally."""
+        out = self._head.call("trace_dump", "cluster")
+        return out if isinstance(out, list) else []
 
     # -- kv (used by job submission / function shipping) -------------------
 

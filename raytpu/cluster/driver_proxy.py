@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from raytpu.core.config import cfg
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util.resilience import current_deadline
+from raytpu.util.tracing import current_trace
 
 _NO_TIMEOUT = "__no_timeout__"  # legacy relay frames carry no timeout field
 
@@ -136,16 +137,19 @@ class DriverProxy:
                           args: list, timeout: object = _NO_TIMEOUT):
         loop = asyncio.get_running_loop()
         # run_in_executor does NOT copy contextvars: the driver's deadline
-        # (decoded into the dispatch task's context by RpcServer) must be
-        # captured here, on the loop thread, and handed through explicitly
-        # or it would die at this hop instead of riding to the upstream.
+        # and trace context (decoded into the dispatch task's context by
+        # RpcServer) must be captured here, on the loop thread, and handed
+        # through explicitly or they would die at this hop instead of
+        # riding to the upstream.
         deadline = current_deadline()
+        trace = current_trace()
         return await loop.run_in_executor(
             self._pool, self._relay_call_blocking, peer, target, method,
-            args, timeout, deadline)
+            args, timeout, deadline, trace)
 
     def _relay_call_blocking(self, peer: Peer, target: str, method: str,
-                             args: list, timeout: object, deadline=None):
+                             args: list, timeout: object, deadline=None,
+                             trace=None):
         self._check_target(target)
         if method == "subscribe":
             self._wire_subscription(peer, target, str(args[0]))
@@ -160,7 +164,7 @@ class DriverProxy:
         else:
             up = float(timeout)  # type: ignore[arg-type]
         return self._target(target).call(method, *args, timeout=up,
-                                         deadline=deadline)
+                                         deadline=deadline, trace=trace)
 
     async def _relay_notify(self, peer: Peer, target: str, method: str,
                             args: list) -> None:

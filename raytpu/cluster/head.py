@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import failpoints
+from raytpu.util import tracing
 from raytpu.util.errors import PlacementInfeasibleError
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.resilience import breaker_for
@@ -314,6 +315,9 @@ class HeadServer:
         h("failpoint_cfg", self._failpoint_cfg)
         h("failpoint_clear", self._failpoint_clear)
         h("failpoint_stat", lambda peer, name: failpoints.stat(name))
+        # Distributed tracing: collect every process's span ring buffer
+        # (head + nodes + their workers) in one fan-out.
+        h("trace_dump", self._trace_dump)
         self._rpc.on_disconnect(self._peer_gone)
         # Actor-restart machinery (reference: GcsActorManager).
         import queue as _q
@@ -375,6 +379,7 @@ class HeadServer:
 
     def start(self) -> str:
         addr = self._rpc.start()
+        tracing.set_process_identity("head")
         try:
             from raytpu.core.config import cfg
 
@@ -539,6 +544,30 @@ class HeadServer:
                 except Exception:
                     pass
         return reached
+
+    # -- tracing -----------------------------------------------------------
+
+    def _trace_dump(self, peer: Peer, scope: str = "cluster") -> List[dict]:
+        """This head's span buffer; ``scope="cluster"`` (the default) fans
+        out to every live node daemon — each of which collects its pool
+        workers — in the same shape as ``failpoint_cfg``. An unreachable
+        node just misses the timeline."""
+        dumps: List[dict] = [tracing.dump()]
+        if scope == "cluster":
+            with self._lock:
+                targets = [(n.node_id, n.address)
+                           for n in self._nodes.values() if n.alive]
+            for node_id, address in targets:
+                try:
+                    got = self._node_client(node_id, address).call(
+                        "trace_dump",
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                        breaker=breaker_for(address))
+                    if isinstance(got, list):
+                        dumps.extend(d for d in got if isinstance(d, dict))
+                except Exception:
+                    pass
+        return dumps
 
     def _peer_gone(self, peer: Peer) -> None:
         node_id = peer.meta.get("node_id")
@@ -780,6 +809,18 @@ class HeadServer:
         (reference: hybrid_scheduling_policy.h:50): prefer the hinted /
         most-utilized feasible node until utilization crosses the spread
         threshold, then pick the least-utilized feasible node."""
+        # The decision span links a driver's submit span to the chosen
+        # node's execution span; the outcome rides as an attribute.
+        with tracing.span("sched.decide") as attrs:
+            node_id = self._schedule_impl(peer, resources, node_hint,
+                                          spread_threshold, req_id)
+            attrs["node"] = node_id
+            return node_id
+
+    def _schedule_impl(self, peer: Peer, resources: Dict[str, float],
+                       node_hint: Optional[str] = None,
+                       spread_threshold: float = 0.5,
+                       req_id: Optional[str] = None) -> Optional[str]:
         self._metrics.tick_schedule()
         with self._lock:
             feasible = []

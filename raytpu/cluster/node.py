@@ -30,6 +30,7 @@ from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
 from raytpu.core.config import cfg
 from raytpu.util import failpoints
+from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.events import record_event
 from raytpu.core.errors import ActorDiedError, TaskError, WorkerCrashedError
@@ -142,6 +143,14 @@ class _ProcActorRuntime:
         self.ready_event.set()
 
     def _dispatch_one(self, spec: TaskSpec):
+        # Re-anchor the submitter's trace context: dispatch runs on the
+        # actor's pump thread, far from the submit RPC's contextvars, so
+        # the "actor_task" frame below parents under the caller's span.
+        tc = self.backend._pop_task_trace(spec.task_id)
+        return tracing.run_with_trace(tc, "actor.task.execute",
+                                      self._dispatch_one_impl, spec)
+
+    def _dispatch_one_impl(self, spec: TaskSpec):
         failpoint("actor.dispatch.pre")
         # Visible in _task_worker while running so stream acks route here.
         with self.backend._lock:
@@ -234,6 +243,10 @@ class NodeBackend(LocalBackend):
         self.on_object_local = None   # cb(oid) -> None (report location)
         self.on_actor_dead = None     # cb(actor_id, reason)
         self.report_borrows = None    # cb(oid_hexes, worker_id_hex)
+        # task_id -> TraceContext captured at submit time: execution is
+        # queue-decoupled from the submit RPC, so its contextvar anchor
+        # dies with the dispatch task; this bounded map bridges the gap.
+        self._task_traces: Dict[TaskID, "tracing.TraceContext"] = {}
         # Worker-process pool (attached by NodeServer after its RPC server
         # is up); None = in-daemon thread execution (round-1 behavior,
         # still used by serve-only driver nodes).
@@ -341,6 +354,14 @@ class NodeBackend(LocalBackend):
                 self.store.put(oid, SerializedValue.from_buffer(blob))
 
     def _execute_plain(self, rec):
+        # Execution happens on a dispatcher thread, decoupled from the
+        # submit RPC that carried the trace context; re-anchor the stashed
+        # context so the worker "execute" frame continues the chain.
+        tc = self._pop_task_trace(rec.spec.task_id)
+        return tracing.run_with_trace(tc, "task.execute",
+                                      self._execute_plain_impl, rec)
+
+    def _execute_plain_impl(self, rec):
         if self.worker_pool is None:
             return super()._execute_plain(rec)
         spec = rec.spec
@@ -386,6 +407,21 @@ class NodeBackend(LocalBackend):
         if reply["error"] is not None:
             return cloudpickle.loads(reply["error"])
         return None
+
+    def _stash_task_trace(self, task_id: TaskID) -> None:
+        """Capture the ambient trace context for a task about to be
+        queued (called from the submit RPC's dispatch context)."""
+        tc = tracing.current_trace()
+        if tc is None:
+            return
+        with self._lock:
+            self._task_traces[task_id] = tc
+            while len(self._task_traces) > 4096:  # bounded like the spans
+                self._task_traces.pop(next(iter(self._task_traces)))
+
+    def _pop_task_trace(self, task_id: TaskID):
+        with self._lock:
+            return self._task_traces.pop(task_id, None)
 
     def _make_actor_runtime(self, spec: TaskSpec):
         if self.worker_pool is None:
@@ -543,6 +579,9 @@ class NodeServer:
           lambda peer, name, spec: failpoints.cfg(name, spec))
         h("failpoint_clear", lambda peer: failpoints.clear())
         h("failpoint_stat", lambda peer, name: failpoints.stat(name))
+        # Distributed tracing: this daemon's span buffer plus every pool
+        # worker's (the head's trace_dump fans out here).
+        h("trace_dump", self._h_trace_dump)
         # Worker-process plane
         h("register_worker", self._h_register_worker)
         h("task_blocked", self._h_task_blocked)
@@ -611,6 +650,11 @@ class NodeServer:
             _api._backend = self.backend
             _api._worker = self.backend.worker
         self.address = self._rpc.start()
+        # Serve-only nodes run inside the driver process: its timeline
+        # track should say so instead of masquerading as a node daemon.
+        tracing.set_process_identity(
+            "driver" if self.labels.get("role") == "driver" else "node",
+            self.node_id.hex()[:12])
         if self._worker_processes:
             from raytpu.cluster.worker_pool import WorkerPool
 
@@ -994,6 +1038,15 @@ class NodeServer:
 
     def _fetch_object(self, oid: ObjectID,
                       deadline_s: Optional[float] = None) -> None:
+        # The pull loop gets its own span (it runs on a dedicated thread,
+        # so there is no ambient context to parent under).
+        with tracing.span("object.pull") as attrs:
+            if tracing.enabled():
+                attrs["oid"] = oid.hex()
+            self._fetch_object_impl(oid, deadline_s)
+
+    def _fetch_object_impl(self, oid: ObjectID,
+                           deadline_s: Optional[float] = None) -> None:
         """Pull one object into the local store (reference: PullManager).
         ``deadline_s`` bounds speculative pulls (fetch-miss path); arg
         pulls for queued tasks run until the object appears.
@@ -1089,6 +1142,7 @@ class NodeServer:
 
     def _h_submit_task(self, peer: Peer, spec_blob: bytes) -> None:
         spec: TaskSpec = wire.loads(spec_blob)
+        self.backend._stash_task_trace(spec.task_id)
         self._ensure_args_local(spec)
         self.backend.submit_task(spec)
 
@@ -1199,6 +1253,7 @@ class NodeServer:
                 target=self._route_remote_actor_task,
                 args=(spec, spec_blob), daemon=True).start()
             return
+        self.backend._stash_task_trace(spec.task_id)
         self._ensure_args_local(spec)
         self.backend.submit_actor_task(spec)
 
@@ -1764,6 +1819,29 @@ class NodeServer:
                 out[wid] = {"pid": h.pid,
                             "error": f"{type(e).__name__}: {e}"}
         return out
+
+    def _h_trace_dump(self, peer: Peer) -> List[dict]:
+        """This daemon's span buffer plus each live pool worker's (the
+        node-level leg of the head's cluster fan-out; same per-worker
+        error-swallowing shape as worker_stacks)."""
+        dumps: List[dict] = [tracing.dump()]
+        pool = self.worker_pool
+        if pool is None:
+            return dumps
+        with pool._lock:
+            handles = dict(pool._workers)
+        for wid, h in handles.items():
+            client = getattr(h, "client", None)
+            if client is None or client.closed:
+                continue
+            try:
+                got = client.call("trace_dump",
+                                  timeout=tuning.CONTROL_CALL_TIMEOUT_S)
+                if isinstance(got, dict):
+                    dumps.append(got)
+            except Exception:
+                pass  # a dying worker just misses the timeline
+        return dumps
 
     async def _fanout_worker_profiling(self, worker_id, payload_key,
                                        rpc_name, rpc_args, local_fn,

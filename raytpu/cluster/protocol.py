@@ -32,6 +32,7 @@ from raytpu.cluster import wire
 from raytpu.cluster import constants as tuning
 from raytpu.util.errors import DeadlineExceeded, RpcTimeoutError
 from raytpu.util.failpoints import DROP, failpoint
+from raytpu.util import tracing
 from raytpu.util.resilience import (
     Deadline,
     current_deadline,
@@ -197,6 +198,15 @@ class RpcServer:
                     if isinstance(dl_wire, (int, float)) else None)
         token = set_current_deadline(deadline) \
             if deadline is not None else None
+        # A "tc" field is the caller's trace context. Like the deadline,
+        # it anchors into this dispatch task's contextvars, so handler
+        # fan-out (and the server span below) parents under the caller's
+        # span even with tracing locally disabled.
+        tc_wire = frame.get("tc")
+        tctx = (tracing.TraceContext.from_wire(tc_wire)
+                if isinstance(tc_wire, (list, tuple)) else None)
+        ttoken = tracing.set_current_trace(tctx) \
+            if tctx is not None else None
         try:
             if handler is None:
                 raise RpcError(f"no handler for {frame.get('m')!r}")
@@ -204,15 +214,20 @@ class RpcServer:
                 # Budget already spent in flight: reply without paying
                 # for the handler — the caller gave up regardless.
                 deadline.check(f"rpc {frame.get('m')!r} (server)")
-            result = handler(peer, *frame.get("a", ()))
-            if asyncio.iscoroutine(result):
-                result = await result
+            # Every registered handler runs inside this one span site
+            # (the span lint in tests/test_tracing.py pins that).
+            with tracing.span("rpc.server." + str(frame.get("m"))):
+                result = handler(peer, *frame.get("a", ()))
+                if asyncio.iscoroutine(result):
+                    result = await result
             reply = {"i": req_id, "r": result}
         except BaseException as e:  # noqa: BLE001 — errors cross the wire
             reply = {"i": req_id, "e": e}
         finally:
             if token is not None:
                 reset_current_deadline(token)
+            if ttoken is not None:
+                tracing.reset_current_trace(ttoken)
         if req_id is not None and not peer.closed:
             try:
                 try:
@@ -238,6 +253,21 @@ class RpcServer:
                 pass
             if self._thread is not None:
                 self._thread.join(timeout=tuning.SERVER_STOP_TIMEOUT_S)
+
+
+def _observe_rpc_latency(method: str, peer: str, seconds: float) -> None:
+    """Best-effort per-method/per-peer latency sample (only reached with
+    tracing enabled — the disabled path never pays for this)."""
+    try:
+        from raytpu.util.resilience import _metric
+
+        m = _metric("histogram", "raytpu_rpc_client_latency_seconds",
+                    "client-observed RPC round-trip latency",
+                    ("method", "peer"))
+        if m is not None:
+            m.observe(seconds, tags={"method": method, "peer": peer})
+    except Exception:
+        pass
 
 
 class RpcClient:
@@ -299,7 +329,7 @@ class RpcClient:
 
     def call(self, method: str, *args, timeout: Any = _UNSET,
              policy: Any = None, deadline: Optional[Deadline] = None,
-             breaker: Any = None) -> Any:
+             breaker: Any = None, trace: Any = None) -> Any:
         """One RPC round trip.
 
         ``timeout`` — reply budget (default ``tuning.RPC_CALL_TIMEOUT_S``;
@@ -312,22 +342,26 @@ class RpcClient:
         retryable failures. ``breaker`` — a
         :class:`~raytpu.util.resilience.CircuitBreaker` consulted before
         the socket is touched and fed with the transport outcome.
+        ``trace`` — a :class:`~raytpu.util.tracing.TraceContext` to parent
+        under, for callers that crossed an executor hop (contextvars do
+        not survive ``run_in_executor``); defaults to the ambient one.
         """
         if timeout is _UNSET:
             timeout = tuning.RPC_CALL_TIMEOUT_S
         if deadline is None:
             deadline = current_deadline()
         if policy is None:
-            return self._call_once(method, args, timeout, deadline, breaker)
+            return self._call_once(method, args, timeout, deadline,
+                                   breaker, trace)
         return policy.run(
             lambda: self._call_once(method, args, timeout, deadline,
-                                    breaker),
+                                    breaker, trace),
             deadline=deadline,
             what=f"rpc {method!r} to {self.address}")
 
     def _call_once(self, method: str, args: tuple,
                    timeout: Optional[float], deadline: Optional[Deadline],
-                   breaker: Any) -> Any:
+                   breaker: Any, trace: Any = None) -> Any:
         if deadline is not None:
             # Spent budget fails HERE — before the breaker, before the
             # socket: a dead peer's connect/read path is never burned
@@ -347,6 +381,33 @@ class RpcClient:
         frame = {"m": method, "a": args, "i": req_id}
         if deadline is not None:
             frame["d"] = deadline.to_wire()
+        tc = trace if trace is not None else tracing.current_trace()
+        if not tracing.enabled():
+            # Untraced hop in a traced request: forward the inbound
+            # context unchanged so the chain isn't severed downstream.
+            if tc is not None:
+                frame["tc"] = tc.to_wire()
+            return self._transact(frame, req_id, waiter, timeout, breaker)
+        ttoken = tracing.set_current_trace(tc) if tc is not None else None
+        try:
+            with tracing.span("rpc.client." + method) as tattrs:
+                tattrs["peer"] = self.address
+                cur = tracing.current_trace()
+                if cur is not None:
+                    frame["tc"] = cur.to_wire()
+                t0 = time.perf_counter()
+                try:
+                    return self._transact(frame, req_id, waiter, timeout,
+                                          breaker)
+                finally:
+                    _observe_rpc_latency(method, self.address,
+                                         time.perf_counter() - t0)
+        finally:
+            if ttoken is not None:
+                tracing.reset_current_trace(ttoken)
+
+    def _transact(self, frame: dict, req_id: int, waiter: "_Waiter",
+                  timeout: Optional[float], breaker: Any) -> Any:
         try:
             self._send(frame)
             result = waiter.wait(timeout)

@@ -14,6 +14,7 @@ from typing import Any, Callable, Optional
 
 from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import RpcClient, _UNSET
+from raytpu.util import tracing
 from raytpu.util.resilience import Deadline, current_deadline
 
 
@@ -62,10 +63,17 @@ class RelayClient:
         if deadline is not None:
             deadline.check(f"relay {method!r} to {self._target}")
             timeout = deadline.bound(timeout)
-        return self._chan._rpc.call("relay_call", self._target, method,
-                                    list(args), timeout, timeout=timeout,
-                                    policy=policy, deadline=deadline,
-                                    breaker=breaker)
+        # The physical frame is always "relay_call"; a relay span records
+        # the LOGICAL method so timelines name the real operation. The
+        # trace context itself rides the physical client's frame as usual
+        # (the proxy re-anchors and hands it to the upstream hop).
+        with tracing.span("rpc.relay." + method) as attrs:
+            if tracing.enabled():
+                attrs["target"] = self._target
+            return self._chan._rpc.call("relay_call", self._target, method,
+                                        list(args), timeout, timeout=timeout,
+                                        policy=policy, deadline=deadline,
+                                        breaker=breaker)
 
     def notify(self, method: str, *args) -> None:
         self._chan._rpc.notify("relay_notify", self._target, method,

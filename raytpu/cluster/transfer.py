@@ -30,6 +30,7 @@ from typing import List, Optional
 from raytpu.core.config import cfg
 from raytpu.cluster import constants as tuning
 from raytpu.runtime.serialization import SerializedValue
+from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.resilience import Deadline
 
@@ -84,6 +85,15 @@ def fetch_blob(client, oid_hex: str, timeout: Optional[float] = None,
     ``deadline`` bounds the whole transfer (every chunk call checks and
     shrinks to the remaining budget).
     """
+    with tracing.span("object.transfer.pull") as attrs:
+        if tracing.enabled():
+            attrs["oid"] = oid_hex
+            attrs["peer"] = getattr(client, "address", "")
+        return _fetch_blob_impl(client, oid_hex, timeout, deadline)
+
+
+def _fetch_blob_impl(client, oid_hex: str, timeout: Optional[float],
+                     deadline: Optional[Deadline]) -> Optional[bytes]:
     # drop => behave as if the holder no longer has the object (the
     # caller re-locates / falls back to lineage); raise models a severed
     # transfer connection.
@@ -128,6 +138,16 @@ def push_blob(client, oid_hex: str, sv: SerializedValue,
     only ``push_object_end`` publishes it). Returns False when the
     transfer did not complete (the receiver's pull fallback still runs).
     """
+    with tracing.span("object.transfer.push") as attrs:
+        if tracing.enabled():
+            attrs["oid"] = oid_hex
+            attrs["peer"] = getattr(client, "address", "")
+        return _push_blob_impl(client, oid_hex, sv, timeout, deadline)
+
+
+def _push_blob_impl(client, oid_hex: str, sv: SerializedValue,
+                    timeout: Optional[float],
+                    deadline: Optional[Deadline]) -> bool:
     if failpoint("transfer.push.pre") is DROP:
         return False  # push lost; receiver's pull fallback takes over
     if timeout is None:

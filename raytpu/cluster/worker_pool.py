@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import RpcClient
 from raytpu.core.config import cfg
+from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.events import record_event
 from raytpu.core.errors import WorkerCrashedError
@@ -146,6 +147,17 @@ class WorkerPool:
               timeout: Optional[float] = None) -> WorkerHandle:
         """Pop an idle matching worker or spawn one. Blocks on the soft
         process cap (reference: ``num_workers_soft_limit``)."""
+        # The lease span separates "waiting for a worker" (cap waits,
+        # cold spawns) from the task's own execution in a timeline.
+        with tracing.span("worker.lease") as attrs:
+            h = self._lease_impl(job_id, renv, chips, dedicated=dedicated,
+                                 timeout=timeout)
+            attrs["worker"] = h.worker_id.hex()[:12]
+            return h
+
+    def _lease_impl(self, job_id: JobID, renv: Optional[dict],
+                    chips: Tuple[int, ...], *, dedicated: bool = False,
+                    timeout: Optional[float] = None) -> WorkerHandle:
         failpoint("worker.lease.pre")
         key = (job_id.hex(), runtime_env_hash(renv), tuple(chips))
         if timeout is None:

@@ -35,6 +35,7 @@ from raytpu.cluster import constants as tuning
 from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+from raytpu.util import tracing
 from raytpu.util.failpoints import failpoint
 from raytpu.core.errors import ActorDiedError, TaskError
 from raytpu.core.ids import JobID, NodeID, ObjectID, TaskID
@@ -463,6 +464,7 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     ap.add_argument("--job", required=True)
     ap.add_argument("--node-id", required=True)
     args = ap.parse_args()
+    tracing.set_process_identity("worker", args.worker_id[:12])
 
     host = _WorkerHost(
         args.node, args.shm or None,
@@ -488,16 +490,25 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
             None, fn, *a)
 
     def h_execute(peer: Peer, blob: bytes):
-        return _offload(host.execute_plain, wire.loads(blob))
+        # run_in_executor drops contextvars: capture the dispatch task's
+        # trace context HERE and re-anchor it on the executor thread so
+        # the execution span parents under the daemon's task.execute.
+        tc = tracing.current_trace()
+        return _offload(tracing.run_with_trace, tc, "worker.task.run",
+                        host.execute_plain, wire.loads(blob))
 
     def h_create_actor(peer: Peer, blob: bytes):
-        return _offload(host.create_actor, wire.loads(blob))
+        tc = tracing.current_trace()
+        return _offload(tracing.run_with_trace, tc, "worker.actor.create",
+                        host.create_actor, wire.loads(blob))
 
     def h_actor_task(peer: Peer, blob: bytes):
         spec = wire.loads(blob)
         if host._actor_loop is not None:
             return host.actor_task_via_loop(spec)
-        return _offload(host.execute_actor_task, spec)
+        tc = tracing.current_trace()
+        return _offload(tracing.run_with_trace, tc, "worker.actor_task.run",
+                        host.execute_actor_task, spec)
 
     def h_kill(peer: Peer, reason: str = ""):
         threading.Thread(target=_delayed_exit, daemon=True).start()
@@ -516,6 +527,9 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     server.register("stream_close", h_stream_close)
     server.register("kill", h_kill)
     server.register("ping", lambda peer: "pong")
+    # Distributed tracing: the node daemon's trace_dump fan-in collects
+    # this worker's span buffer (arming rides RAYTPU_TRACING in the env).
+    server.register("trace_dump", lambda peer: tracing.dump())
 
     def h_stack(peer: Peer) -> str:
         from raytpu.util.stack_dump import dump_all_threads

@@ -285,6 +285,20 @@ class DashboardServer:
                 headers={"Content-Disposition":
                          "attachment; filename=timeline.json"})
 
+        async def api_trace(request):
+            """Cluster-wide chrome trace: fans out ``trace_dump`` through
+            the connected backend (driver -> head -> nodes -> workers) and
+            merges every process's span buffer into one timeline."""
+            from raytpu.util.tracing import cluster_timeline
+
+            loop = asyncio.get_running_loop()
+            events = await loop.run_in_executor(None, cluster_timeline)
+            return web.Response(
+                text=json.dumps(events),
+                content_type="application/json",
+                headers={"Content-Disposition":
+                         "attachment; filename=trace.json"})
+
         async def metrics(request):
             try:
                 import prometheus_client
@@ -440,6 +454,9 @@ class DashboardServer:
         app = web.Application()
         app.router.add_get("/", index)
         app.router.add_get("/api/summary", api_summary)
+        # /api/trace must register before the /api/{section} wildcard or
+        # the section handler would 404 it as an unknown snapshot key.
+        app.router.add_get("/api/trace", api_trace)
         app.router.add_get("/api/{section}", api_section)
         app.router.add_get("/timeline", timeline)
         app.router.add_get("/metrics", metrics)

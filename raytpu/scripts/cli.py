@@ -83,6 +83,16 @@ def _cmd_timeline(args) -> int:
     return 0
 
 
+def _cmd_trace(args) -> int:
+    import raytpu
+    from raytpu.util.tracing import cluster_timeline
+
+    raytpu.init(address=args.address, ignore_reinit_error=True)
+    events = cluster_timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+    return 0
+
+
 def _cmd_memory(args) -> int:
     import raytpu
     from raytpu.state import object_summary
@@ -431,6 +441,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--address", default=None)
     s.add_argument("--output", default="timeline.json")
     s.set_defaults(fn=_cmd_timeline)
+
+    s = sub.add_parser("trace",
+                       help="pull cluster-wide spans as a chrome trace")
+    s.add_argument("--address", default=None)
+    s.add_argument("--output", default="trace.json")
+    s.set_defaults(fn=_cmd_trace)
 
     s = sub.add_parser("memory", help="object store summary")
     s.add_argument("--address", default=None)

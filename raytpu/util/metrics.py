@@ -123,9 +123,20 @@ class Gauge(_Metric):
 
     @property
     def value(self) -> float:
+        """The untagged value when one was set; otherwise the most
+        recently introduced tag set's value (legacy behavior, only
+        deterministic for single-tag-set gauges)."""
         with self._lock:
+            if () in self._values:
+                return self._values[()]
             vals = list(self._values.values())
             return vals[-1] if vals else 0.0
+
+    @property
+    def values(self) -> Dict[Tuple, float]:
+        """Per-tag-tuple snapshot (keys ordered by ``tag_keys``)."""
+        with self._lock:
+            return dict(self._values)
 
 
 class Histogram(_Metric):
@@ -137,6 +148,7 @@ class Histogram(_Metric):
         self._boundaries = tuple(boundaries or _DEFAULT_BUCKETS)
         super().__init__(name, description, tag_keys)
         self._observations: List[float] = []
+        self._by_key: Dict[Tuple, List[float]] = {}
 
     def _signature(self) -> tuple:
         return (type(self).__name__, self._tag_keys, self._boundaries)
@@ -151,13 +163,22 @@ class Histogram(_Metric):
         key = self._tag_tuple(tags)
         with self._lock:
             self._observations.append(value)
+            self._by_key.setdefault(key, []).append(value)
         if self._prom is not None:
             (self._prom.labels(*key) if key else self._prom).observe(value)
 
     @property
     def observations(self) -> List[float]:
+        """All observations in arrival order (tag-blind, backward
+        compatible); per-tag series live in :attr:`observations_by_tag`."""
         with self._lock:
             return list(self._observations)
+
+    @property
+    def observations_by_tag(self) -> Dict[Tuple, List[float]]:
+        """Observations keyed by tag tuple (ordered by ``tag_keys``)."""
+        with self._lock:
+            return {k: list(v) for k, v in self._by_key.items()}
 
 
 _servers: Dict[int, tuple] = {}  # port -> (wsgi_server, thread)

@@ -224,6 +224,16 @@ class RetryPolicy:
                         on_retry(attempt, e, delay)
                     except Exception:
                         pass
+                try:
+                    # Exception class name keeps tag cardinality bounded
+                    # (vs. str(e), which embeds addresses/ids).
+                    m = _metric("counter", "raytpu_retries_total",
+                                "retry attempts across resilience "
+                                "policies", ("error",))
+                    if m is not None:
+                        m.inc(1.0, tags={"error": type(e).__name__})
+                except Exception:
+                    pass
                 self._sleep(delay)
 
 
@@ -246,7 +256,8 @@ def _metric(kind: str, name: str, desc: str, tag_keys):
             try:
                 from raytpu.util import metrics as _m
 
-                cls = _m.Counter if kind == "counter" else _m.Gauge
+                cls = {"counter": _m.Counter, "gauge": _m.Gauge,
+                       "histogram": _m.Histogram}[kind]
                 m = cls(name, desc, tag_keys=tag_keys)
             except Exception:
                 m = False  # cache the failure; never retry per-call

@@ -1,9 +1,25 @@
-"""Tracing & profiling.
+"""Distributed tracing & profiling.
 
 Reference analogue (SURVEY.md §5 tracing): (a) span wrapping of task/actor
 calls (``python/ray/util/tracing/tracing_helper.py:34``, OpenTelemetry);
 (b) chrome-trace timeline from buffered profile events (``ray timeline``,
 ``python/ray/_private/state.py:917``); (c) on-demand worker profiling.
+
+Cross-process model (Dapper): a :class:`TraceContext` — trace id, span id,
+parent span id, sampled flag — rides every RPC frame as a ``"tc"`` field
+next to the deadline's ``"d"`` (see :mod:`raytpu.cluster.protocol`) and is
+re-anchored server-side into a contextvar, so a driver's submit span is
+the ancestor of the head's scheduling span and the worker's execution
+span. Each process records closed spans into a bounded ring buffer;
+``trace_dump`` RPCs fan the buffers back (head → nodes → workers) and
+:func:`assemble_timeline` merges them into one chrome-trace/Perfetto JSON
+with per-process tracks and flow arrows on cross-process parent edges.
+
+Cost model mirrors :mod:`raytpu.util.failpoints`: with tracing disabled a
+span site is one module-flag check plus returning a shared no-op context
+manager — nothing allocates, no contextvar is read (pinned by the
+micro-bench in tests/test_tracing.py). Arming is inherited by child
+processes via ``RAYTPU_TRACING`` / ``RAYTPU_TRACE_SAMPLE`` env vars.
 
 TPU-first: device-side profiling is ``jax.profiler`` (XLA traces viewable
 in TensorBoard/Perfetto include per-op HBM/MXU utilization), host-side is
@@ -15,27 +31,138 @@ dumps chrome-trace JSON of task events.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import functools
 import json
+import os
+import random
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-_spans: List[dict] = []
+ENV_VAR = "RAYTPU_TRACING"
+SAMPLE_ENV_VAR = "RAYTPU_TRACE_SAMPLE"
+BUFFER_ENV_VAR = "RAYTPU_TRACE_BUFFER"
+
+
+def _env_truthy(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0", "false", "False")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+_BUFFER = max(16, int(_env_float(BUFFER_ENV_VAR, 4096)))
+_spans: "deque[dict]" = deque(maxlen=_BUFFER)
 _spans_lock = threading.Lock()
-_enabled = False
+_enabled = _env_truthy(ENV_VAR)
+_sample_rate = _env_float(SAMPLE_ENV_VAR, 1.0)
+# [kind, ident] — e.g. ["head", ""], ["worker", "ab12cd34"]. Mutated in
+# place so dump() sees updates without rebinding.
+_identity: List[str] = ["proc", ""]
 
 
-def enable_tracing() -> None:
-    """Turn on span capture for traced functions (reference: tracing
-    startup hook enables the OpenTelemetry proxy)."""
-    global _enabled
+class TraceContext:
+    """Immutable Dapper-style context: which trace, which span, whose
+    child, and whether anything records. On the wire only
+    ``[trace_id, span_id, sampled]`` travels — the receiver's parent IS
+    the sender's span id, so ``parent_span_id`` never needs to ride."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: Optional[str] = None,
+                 sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+        self.sampled = sampled
+
+    @classmethod
+    def root(cls, sampled: bool = True) -> "TraceContext":
+        return cls(os.urandom(16).hex(), os.urandom(8).hex(), None, sampled)
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, os.urandom(8).hex(),
+                            self.span_id, self.sampled)
+
+    def to_wire(self) -> list:
+        # Primitives only: must encode on strict (allow_pickle=False)
+        # surfaces like the driver proxy.
+        return [self.trace_id, self.span_id, 1 if self.sampled else 0]
+
+    @classmethod
+    def from_wire(cls, w: Any) -> Optional["TraceContext"]:
+        try:
+            trace_id, span_id, sampled = w[0], w[1], bool(w[2])
+        except (TypeError, IndexError, KeyError):
+            return None
+        if not isinstance(trace_id, str) or not isinstance(span_id, str):
+            return None
+        return cls(trace_id, span_id, None, sampled)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"TraceContext({self.trace_id[:8]}…/{self.span_id}"
+                f" parent={self.parent_span_id} sampled={self.sampled})")
+
+
+_current: "contextvars.ContextVar[Optional[TraceContext]]" = \
+    contextvars.ContextVar("raytpu_trace", default=None)
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The ambient trace context (None outside any span/handler)."""
+    return _current.get()
+
+
+def set_current_trace(ctx: Optional[TraceContext]):
+    """Anchor ``ctx`` as the ambient context; returns a reset token."""
+    return _current.set(ctx)
+
+
+def reset_current_trace(token) -> None:
+    _current.reset(token)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable_tracing(sample_rate: Optional[float] = None,
+                   env: bool = False) -> None:
+    """Turn on span capture (reference: tracing startup hook enables the
+    OpenTelemetry proxy). ``sample_rate`` bounds ROOT creation: 0.0 means
+    new roots are created unsampled (contexts still propagate, nothing
+    records). ``env=True`` exports the arming so child processes — cluster
+    daemons, pool workers — inherit it (failpoints' ``cfg(env=True)``
+    pattern)."""
+    global _enabled, _sample_rate
+    if sample_rate is not None:
+        _sample_rate = float(sample_rate)
     _enabled = True
+    if env:
+        os.environ[ENV_VAR] = "1"
+        os.environ[SAMPLE_ENV_VAR] = repr(_sample_rate)
 
 
-def disable_tracing() -> None:
+def disable_tracing(env: bool = False) -> None:
     global _enabled
     _enabled = False
+    if env:
+        os.environ.pop(ENV_VAR, None)
+        os.environ.pop(SAMPLE_ENV_VAR, None)
+
+
+def set_process_identity(kind: str, ident: str = "") -> None:
+    """Name this process for cluster timelines (head / node:<id> /
+    worker:<id> / driver)."""
+    _identity[0] = str(kind)
+    _identity[1] = str(ident)
 
 
 def get_spans() -> List[dict]:
@@ -48,28 +175,92 @@ def clear_spans() -> None:
         _spans.clear()
 
 
-@contextlib.contextmanager
+def dump() -> dict:
+    """This process's span buffer plus identity — the payload of the
+    ``trace_dump`` RPC every daemon registers."""
+    return {"identity": list(_identity), "pid": os.getpid(),
+            "spans": get_spans()}
+
+
+_NOOP_ATTRS: Dict[str, Any] = {}
+
+
+class _NoopSpan:
+    """Shared disabled-path context manager: zero allocation per site."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> Dict[str, Any]:
+        # Sites may write attributes into the yielded dict; a shared one
+        # is fine because nothing ever reads it. Bounded by the set of
+        # distinct attribute keys, not by call count.
+        return _NOOP_ATTRS
+
+    def __exit__(self, et, ev, tb) -> bool:
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """Recording context manager. Entering derives a child context from
+    the ambient one (or starts a new root, subject to the sample rate)
+    and anchors it; exiting restores the parent and — only when sampled —
+    appends one record to the ring buffer."""
+
+    __slots__ = ("name", "attrs", "_ctx", "_token", "_start", "_t0")
+
+    def __init__(self, name: str, attributes: Optional[Dict] = None):
+        self.name = name
+        self.attrs: Dict[str, Any] = dict(attributes) if attributes else {}
+
+    def __enter__(self) -> Dict[str, Any]:
+        parent = _current.get()
+        if parent is not None:
+            self._ctx = parent.child()
+        else:
+            sampled = _sample_rate >= 1.0 or random.random() < _sample_rate
+            self._ctx = TraceContext.root(sampled=sampled)
+        self._token = _current.set(self._ctx)
+        self._start = time.time()
+        self._t0 = time.perf_counter()
+        return self.attrs
+
+    def __exit__(self, et, ev, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        _current.reset(self._token)
+        ctx = self._ctx
+        if ctx.sampled:
+            with _spans_lock:
+                _spans.append({
+                    "name": self.name,
+                    "trace_id": ctx.trace_id,
+                    "span_id": ctx.span_id,
+                    "parent_span_id": ctx.parent_span_id,
+                    "start": self._start,
+                    "duration_s": dur,
+                    "pid": os.getpid(),
+                    "tid": threading.get_native_id(),
+                    "attributes": self.attrs,
+                    "error": repr(ev) if ev is not None else None,
+                })
+        return False
+
+
 def span(name: str, attributes: Optional[Dict[str, Any]] = None):
-    """Record one span (no-op unless tracing is enabled)."""
+    """One traced region. Disabled cost is this flag check plus a shared
+    no-op context manager; enabled, it parents into the ambient
+    :class:`TraceContext` and records into the ring buffer. Yields the
+    (mutable) attributes dict so sites can attach results post-hoc::
+
+        with tracing.span("sched.decide") as attrs:
+            node = pick()
+            attrs["node"] = node
+    """
     if not _enabled:
-        yield
-        return
-    start = time.time()
-    err = None
-    try:
-        yield
-    except BaseException as e:
-        err = repr(e)
-        raise
-    finally:
-        with _spans_lock:
-            _spans.append({
-                "name": name,
-                "start": start,
-                "duration_s": time.time() - start,
-                "attributes": dict(attributes or {}),
-                "error": err,
-            })
+        return _NOOP_SPAN
+    return _Span(name, attributes)
 
 
 def traced(name: Optional[str] = None) -> Callable:
@@ -86,6 +277,41 @@ def traced(name: Optional[str] = None) -> Callable:
         return inner
 
     return wrap
+
+
+def run_with_trace(tc: Optional[TraceContext], name: str,
+                   fn: Callable, *args, **kwargs):
+    """Re-anchor ``tc`` around ``fn`` on THIS thread and run it inside a
+    span. The bridge for every hop that loses contextvars: executor
+    offloads (``run_in_executor`` does not copy context) and
+    queue-decoupled execution (a task enqueued by one RPC and executed
+    later by a dispatcher thread)."""
+    token = _current.set(tc) if tc is not None else None
+    try:
+        with span(name):
+            return fn(*args, **kwargs)
+    finally:
+        if token is not None:
+            _current.reset(token)
+
+
+def _span_event(s: dict, pid: Optional[int] = None) -> dict:
+    args = dict(s.get("attributes") or {})
+    for k in ("trace_id", "span_id", "parent_span_id"):
+        if s.get(k):
+            args[k] = s[k]
+    if s.get("error"):
+        args["error"] = s["error"]
+    return {
+        "name": s["name"],
+        "cat": "span",
+        "ph": "X",
+        "ts": s["start"] * 1e6,
+        "dur": s["duration_s"] * 1e6,
+        "pid": s.get("pid", 0) if pid is None else pid,
+        "tid": s.get("tid", 0),
+        "args": args,
+    }
 
 
 @contextlib.contextmanager
@@ -105,23 +331,95 @@ def profile(logdir: str, *, host_tracer_level: int = 2):
 
 def timeline(filename: Optional[str] = None) -> List[dict]:
     """Chrome-trace events from the backend's task-event buffer plus any
-    recorded spans (reference: ``ray timeline``)."""
+    locally recorded spans (reference: ``ray timeline``). Spans carry
+    their real pid/tid so a multi-threaded local timeline lays out on
+    distinct tracks. For the whole cluster, see
+    :func:`cluster_timeline`."""
     import raytpu
 
     events = raytpu.timeline()
     trace = list(events) if isinstance(events, list) else []
     for s in get_spans():
-        trace.append({
-            "name": s["name"],
-            "cat": "span",
-            "ph": "X",
-            "ts": s["start"] * 1e6,
-            "dur": s["duration_s"] * 1e6,
-            "pid": 0,
-            "tid": 0,
-            "args": s["attributes"],
-        })
+        trace.append(_span_event(s))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def assemble_timeline(dumps: List[dict],
+                      filename: Optional[str] = None) -> List[dict]:
+    """Merge per-process trace dumps (:func:`dump` payloads) into one
+    chrome-trace JSON. Each dump becomes one ``pid`` track named by its
+    identity via a ``process_name`` metadata event; spans whose parent
+    lives in a DIFFERENT process get a flow-event pair (``ph:"s"`` at the
+    parent, ``ph:"f", bp:"e"`` at the child) so Perfetto draws the
+    cross-process arrow."""
+    events: List[dict] = []
+    # span_id -> (track pid, record)
+    index: Dict[str, Tuple[int, dict]] = {}
+    for i, d in enumerate(dumps or []):
+        if not isinstance(d, dict):
+            continue
+        ident = list(d.get("identity") or ("proc", ""))
+        label = str(ident[0]) if ident else "proc"
+        if len(ident) > 1 and ident[1]:
+            label += f":{ident[1]}"
+        label += f" (pid {d.get('pid', '?')})"
+        track = i + 1
+        events.append({"name": "process_name", "ph": "M", "pid": track,
+                       "tid": 0, "args": {"name": label}})
+        for s in d.get("spans") or []:
+            events.append(_span_event(s, pid=track))
+            sid = s.get("span_id")
+            if sid:
+                index[sid] = (track, s)
+    for sid, (track, s) in index.items():
+        parent = s.get("parent_span_id")
+        if not parent or parent not in index:
+            continue
+        ptrack, ps = index[parent]
+        if ptrack == track:
+            continue  # local nesting draws itself; arrows are for hops
+        events.append({
+            "name": "trace", "cat": "flow", "ph": "s", "id": sid,
+            "pid": ptrack, "tid": ps.get("tid", 0),
+            "ts": ps["start"] * 1e6,
+        })
+        events.append({
+            "name": "trace", "cat": "flow", "ph": "f", "bp": "e",
+            "id": sid, "pid": track, "tid": s.get("tid", 0),
+            "ts": s["start"] * 1e6,
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+def cluster_timeline(filename: Optional[str] = None) -> List[dict]:
+    """Pull every process's span buffer through the connected backend's
+    ``trace_dump`` fan-out (driver → head → nodes → workers) and
+    assemble one cluster-wide chrome trace. Falls back to just the local
+    process when not connected to a cluster."""
+    dumps: List[dict] = []
+    try:
+        from raytpu.runtime import api as _api
+
+        backend = _api._backend_or_none()
+    except Exception:  # pragma: no cover - api import never fails in-tree
+        backend = None
+    if backend is not None and hasattr(backend, "trace_dump"):
+        try:
+            dumps = list(backend.trace_dump() or [])
+        except Exception:
+            dumps = []
+    # The head's fan-out can reach this very process (a connected driver
+    # runs a serve-only node daemon): drop that copy in favor of the
+    # local buffer, which is strictly fresher, or the driver would get
+    # two identical tracks.
+    me = os.getpid()
+    dumps = [d for d in dumps
+             if not (isinstance(d, dict) and d.get("pid") == me)]
+    dumps.append(dump())  # this (driver) process
+    return assemble_timeline(dumps, filename)

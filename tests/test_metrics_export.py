@@ -1,0 +1,122 @@
+"""Prometheus / Grafana config generation (raytpu/util/metrics_export.py).
+
+Pins the contract between the generated monitoring artifacts and the
+metrics the head actually publishes: every series a Grafana panel
+queries must be registered by ``_HeadMetrics``, the scrape config must
+round-trip its targets, and the exposition endpoint must release its
+port on stop (a restarted head reusing the port must not hit
+EADDRINUSE against its predecessor's lingering socket).
+"""
+
+import json
+import re
+import socket
+
+import pytest
+
+from raytpu.util import metrics_export
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestPrometheusConfig:
+    def test_targets_round_trip(self):
+        targets = ["10.0.0.1:8265", "10.0.0.2:8265", "head.local:9999"]
+        text = metrics_export.prometheus_config(targets)
+        listed = re.findall(r"- '([^']+)'", text)
+        assert listed == targets
+        assert f"scrape_interval: {metrics_export.SCRAPE_INTERVAL_S}s" \
+            in text
+        assert "metrics_path: /metrics" in text
+
+    def test_empty_targets_still_valid(self):
+        text = metrics_export.prometheus_config([])
+        assert "job_name: raytpu" in text
+        assert re.findall(r"- '([^']+)'", text) == []
+
+
+class TestGrafanaDashboard:
+    def test_panel_exprs_reference_only_registered_series(self):
+        from raytpu.cluster.head import _HeadMetrics
+
+        hm = _HeadMetrics()
+        registered = set()
+        for attr in ("nodes", "actors", "pgs", "resources", "available",
+                     "schedules", "tasks_done"):
+            m = getattr(hm, attr)
+            assert m is not None, f"_HeadMetrics.{attr} failed to build"
+            registered.add(m.info["name"])
+
+        dash = metrics_export.grafana_dashboard()
+        referenced = set()
+        for panel in dash["panels"]:
+            for target in panel["targets"]:
+                referenced.update(
+                    re.findall(r"raytpu_[a-z0-9_]+", target["expr"]))
+        assert referenced, "dashboard must query at least one series"
+        unknown = referenced - registered
+        assert not unknown, (
+            f"grafana panels query unregistered series {sorted(unknown)}; "
+            f"head publishes only {sorted(registered)}")
+
+    def test_dashboard_is_json_serializable_with_panels(self):
+        dash = metrics_export.grafana_dashboard()
+        reparsed = json.loads(json.dumps(dash))
+        assert reparsed["uid"] == "raytpu-cluster"
+        ids = [p["id"] for p in reparsed["panels"]]
+        assert len(ids) == len(set(ids)) >= 5
+
+
+class TestExportConfig:
+    def test_writes_both_files(self, tmp_path):
+        out = tmp_path / "monitoring"
+        targets = ["127.0.0.1:8265"]
+        paths = metrics_export.export_config(str(out), targets)
+        assert len(paths) == 2
+        prom = out / "prometheus.yml"
+        graf = out / "grafana_raytpu.json"
+        assert prom.exists() and graf.exists()
+        assert "127.0.0.1:8265" in prom.read_text()
+        dash = json.loads(graf.read_text())
+        assert dash["title"] == "raytpu cluster"
+
+
+class TestMetricsServerLifecycle:
+    def test_stop_releases_port_for_restart(self):
+        from raytpu.util import metrics
+
+        if metrics._prom is None:
+            pytest.skip("prometheus_client not installed")
+        port = _free_port()
+        assert metrics.start_metrics_server(port)
+        try:
+            # Scrape endpoint is actually serving.
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5):
+                pass
+            # Idempotent per port.
+            assert metrics.start_metrics_server(port)
+        finally:
+            metrics.stop_metrics_server(port)
+        # The listening socket was CLOSED, not just shut down: binding
+        # the same port again must succeed immediately.
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("127.0.0.1", port))
+        finally:
+            s.close()
+        # And the server can come back on that port.
+        assert metrics.start_metrics_server(port)
+        metrics.stop_metrics_server(port)
+
+    def test_stop_unknown_port_is_noop(self):
+        from raytpu.util import metrics
+
+        metrics.stop_metrics_server(_free_port())  # must not raise
