@@ -1,0 +1,55 @@
+"""raytpu.inference — TPU-native LLM inference engine.
+
+Reference analogues: vLLM's PagedAttention (SOSP '23) for KV-cache
+memory management and Orca (OSDI '22) for iteration-level (continuous)
+batching; Ray's Serve layer provides the replica/streaming transport
+(``raytpu.serve``).
+
+TPU twist running through every module: *static shapes everywhere*.
+Prefill pads prompts to a small set of length buckets and decode pads
+the batch to a fixed batch bucket, so XLA compiles ONE program per
+bucket — never one per batch composition (recompiles cost tens of
+seconds on TPU; padding costs microseconds — the same trade
+``serve/batching.py``'s ``pad_batch_to_max`` already makes for
+request batching).
+
+Layout:
+
+- :mod:`raytpu.inference.kv_cache` — paged KV cache: fixed-size pages
+  preallocated as ``[num_pages, page_size, kv_heads, head_dim]`` JAX
+  arrays (one per layer), per-sequence block tables, allocate /
+  extend / free, utilization accounting. Decode never reallocates.
+- :mod:`raytpu.inference.scheduler` — Orca-style continuous-batching
+  scheduler: admits waiting requests by KV-page budget each iteration,
+  merges fresh prefills with in-flight decodes, preempts-to-recompute
+  the youngest sequence under page pressure.
+- :mod:`raytpu.inference.sampling` — greedy / temperature / top-k
+  sampling with a *per-request* RNG, so sampled outputs are invariant
+  to batch composition.
+- :mod:`raytpu.inference.engine` — :class:`InferenceEngine`: bucketed
+  static-shape prefill + a single jit-compiled decode step, stop
+  conditions, ``raytpu_infer_*`` metrics and ``infer.*`` tracing spans.
+- :mod:`raytpu.inference.serving` — ``LLMDeployment``: a serve replica
+  running the engine loop, streaming tokens through the existing
+  ``ObjectRefGenerator`` path.
+"""
+
+from raytpu.inference.kv_cache import PagedKVCache
+from raytpu.inference.sampling import SamplingParams
+from raytpu.inference.scheduler import Scheduler, Sequence
+from raytpu.inference.engine import InferenceEngine, StepOutput
+
+__all__ = [
+    "InferenceEngine", "LLMDeployment", "PagedKVCache", "SamplingParams",
+    "Scheduler", "Sequence", "StepOutput",
+]
+
+
+def __getattr__(name):
+    # Lazy: serving pulls in raytpu.serve (controller/replica machinery);
+    # engine-only users (benchmarks, tests) shouldn't pay for it.
+    if name == "LLMDeployment":
+        from raytpu.inference.serving import LLMDeployment
+
+        return LLMDeployment
+    raise AttributeError(name)

@@ -1,0 +1,343 @@
+"""InferenceEngine: bucketed static-shape prefill + jitted decode step.
+
+The TPU compile-once discipline, concretely:
+
+- **Prefill** pads each prompt to the smallest length *bucket* (powers
+  of two up to ``max_model_len``) and runs one sequence at a time, so
+  XLA sees one program per bucket regardless of prompt length.
+- **Decode** pads the batch to the smallest batch *bucket* (powers of
+  two up to ``max_num_seqs``). Tokens/positions/slots/block tables are
+  data, not shapes, so changing batch *composition* never recompiles —
+  only the first time a bucket size appears. Dummy rows point at the
+  scratch page (page 0) with ``context_len=1`` so padding attends to
+  one masked-garbage slot and pollutes nothing.
+
+Both jitted callables are constructed exactly once, in
+``_build_prefill_fn`` / ``_build_decode_fn`` — the per-iteration loop
+(:meth:`InferenceEngine.step`) only *calls* them. A lint test pins
+this: ``jax.jit`` may appear in ``_build_*`` constructors only. The
+compile counters increment inside the traced function body, which
+Python executes only during tracing — i.e. exactly once per XLA
+compile — giving tests and the bench an honest recompile count.
+
+Sampling runs on the host with per-request RNGs (see
+:mod:`raytpu.inference.sampling`), so batched output == solo output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence as SequenceT
+
+import numpy as np
+
+from raytpu.inference.kv_cache import PagedKVCache
+from raytpu.inference.sampling import SamplingParams, sample_token
+from raytpu.inference.scheduler import Scheduler, Sequence
+from raytpu.util import tracing
+from raytpu.util.metrics import Counter, Gauge
+
+_running_gauge = Gauge("raytpu_infer_running_requests",
+                       "Sequences currently decoding")
+_waiting_gauge = Gauge("raytpu_infer_waiting_requests",
+                       "Requests queued for admission")
+_kv_util_gauge = Gauge("raytpu_infer_kv_page_utilization",
+                       "Fraction of KV pages in use")
+_prefill_tps_gauge = Gauge("raytpu_infer_prefill_tokens_per_s",
+                           "Prefill throughput of the last engine step")
+_decode_tps_gauge = Gauge("raytpu_infer_decode_tokens_per_s",
+                          "Decode throughput of the last engine step")
+_prefill_tokens_total = Counter("raytpu_infer_prefill_tokens_total",
+                                "Prompt tokens prefilled")
+_decode_tokens_total = Counter("raytpu_infer_decode_tokens_total",
+                               "Tokens decoded")
+
+
+@dataclasses.dataclass(frozen=True)
+class StepOutput:
+    """One newly sampled token for one request."""
+
+    request_id: str
+    token_id: int
+    finished: bool = False
+    finish_reason: Optional[str] = None
+
+
+def _pow2_buckets(lo: int, hi: int) -> List[int]:
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return out
+
+
+def _bucket_for(n: int, buckets: SequenceT[int]) -> int:
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+class InferenceEngine:
+    """Continuous-batching decode loop over a paged KV cache.
+
+    Drive it with :meth:`add_request` + :meth:`step` (one scheduler
+    iteration per call — the serve replica's loop), or use
+    :meth:`generate` to run a closed batch to completion.
+    """
+
+    def __init__(self, model_config, params, *, page_size: int = 16,
+                 num_pages: Optional[int] = None, max_num_seqs: int = 8,
+                 max_model_len: Optional[int] = None,
+                 prefill_buckets: Optional[SequenceT[int]] = None,
+                 decode_buckets: Optional[SequenceT[int]] = None):
+        import jax
+
+        from raytpu.models.gpt2 import GPT2Config
+        from raytpu.models.llama import LlamaConfig
+
+        if isinstance(model_config, LlamaConfig):
+            from raytpu.models.llama import llama_decode, llama_prefill
+
+            self._prefill_fwd, self._decode_fwd = llama_prefill, llama_decode
+            kv_heads = model_config.n_kv_head
+            head_dim = model_config.head_dim
+        elif isinstance(model_config, GPT2Config):
+            from raytpu.models.gpt2 import gpt2_decode, gpt2_prefill
+
+            self._prefill_fwd, self._decode_fwd = gpt2_prefill, gpt2_decode
+            kv_heads = model_config.n_head
+            head_dim = model_config.n_embd // model_config.n_head
+        else:
+            raise TypeError(f"unsupported model config: {model_config!r}")
+
+        self._config = model_config
+        self._params = params
+        self.max_model_len = min(max_model_len or model_config.block_size,
+                                 model_config.block_size)
+        self.page_size = page_size
+        # Static per-sequence page capacity: every decode gathers
+        # [B, P*page_size] — P is a SHAPE, so it must not depend on
+        # which sequences happen to be in the batch.
+        self.max_pages_per_seq = -(-self.max_model_len // page_size)
+        if num_pages is None:
+            num_pages = max_num_seqs * self.max_pages_per_seq + 1
+        self.cache = PagedKVCache(
+            model_config.n_layer, num_pages, page_size, kv_heads, head_dim,
+            dtype=model_config.dtype)
+        self.scheduler = Scheduler(self.cache, max_num_seqs=max_num_seqs,
+                                   max_model_len=self.max_model_len)
+        self.prefill_buckets = sorted(prefill_buckets or _pow2_buckets(
+            min(16, self.max_model_len), self.max_model_len))
+        self.decode_buckets = sorted(decode_buckets or _pow2_buckets(
+            1, max_num_seqs))
+        self._prefill_compiles: Dict[int, int] = {}
+        self._decode_compiles: Dict[int, int] = {}
+        self._decode_batch_hist: List[int] = []
+        self._prefill_tokens = 0
+        self._decode_tokens = 0
+        self._jnp = jax.numpy
+        self._prefill_fn = self._build_prefill_fn(jax)
+        self._decode_fn = self._build_decode_fn(jax)
+
+    # ---- compiled steps (the ONLY jax.jit call sites) ---------------
+
+    def _build_prefill_fn(self, jax):
+        cfg, fwd = self._config, self._prefill_fwd
+        compiles = self._prefill_compiles
+
+        def _prefill(params, ks, vs, tokens, dests):
+            # Trace-time only: counts XLA compiles per length bucket.
+            bucket = tokens.shape[1]
+            compiles[bucket] = compiles.get(bucket, 0) + 1
+            logits, new_k, new_v = fwd(cfg, params, tokens)
+            flat = ks[0].shape[0] * ks[0].shape[1]
+            ks2, vs2 = [], []
+            for kc, vc, nk, nv in zip(ks, vs, new_k, new_v):
+                ks2.append(kc.reshape((flat,) + kc.shape[2:]).at[dests].set(
+                    nk[0].astype(kc.dtype)).reshape(kc.shape))
+                vs2.append(vc.reshape((flat,) + vc.shape[2:]).at[dests].set(
+                    nv[0].astype(vc.dtype)).reshape(vc.shape))
+            return logits[0], ks2, vs2
+
+        return jax.jit(_prefill)
+
+    def _build_decode_fn(self, jax):
+        cfg, fwd = self._config, self._decode_fwd
+        compiles = self._decode_compiles
+
+        def _decode(params, ks, vs, tokens, positions, dests, block_tables,
+                    context_lens):
+            bucket = tokens.shape[0]
+            compiles[bucket] = compiles.get(bucket, 0) + 1
+            return fwd(cfg, params, tokens, positions, dests, block_tables,
+                       context_lens, ks, vs)
+
+        return jax.jit(_decode)
+
+    # ---- request lifecycle ------------------------------------------
+
+    def add_request(self, request_id: str, prompt: SequenceT[int],
+                    sampling: Optional[SamplingParams] = None) -> Sequence:
+        sampling = sampling or SamplingParams()
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if len(prompt) >= self.max_model_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_model_len "
+                f"{self.max_model_len} leaves no room to generate")
+        if self.cache.pages_for(len(prompt) + 1) > self.cache.total_pages:
+            raise ValueError("prompt exceeds total KV-page capacity")
+        seq = Sequence(request_id=request_id, prompt=prompt,
+                       sampling=sampling)
+        self.scheduler.add(seq)
+        return seq
+
+    def abort(self, request_id: str) -> bool:
+        return self.scheduler.abort(request_id)
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished()
+
+    # ---- the iteration ----------------------------------------------
+
+    def step(self) -> List[StepOutput]:
+        """One scheduler iteration: run every admitted prefill, then one
+        padded decode step over all running sequences; sample on host;
+        retire finished sequences (freeing their pages)."""
+        out: List[StepOutput] = []
+        plan = self.scheduler.schedule()
+        t0 = time.perf_counter()
+        prefilled = 0
+        for seq in plan.prefills:
+            prefilled += self._run_prefill(seq, out)
+        t1 = time.perf_counter()
+        decoded = 0
+        if plan.decodes:
+            decoded = self._run_decode(plan.decodes, out)
+        t2 = time.perf_counter()
+
+        if prefilled:
+            self._prefill_tokens += prefilled
+            _prefill_tokens_total.inc(prefilled)
+            _prefill_tps_gauge.set(prefilled / max(t1 - t0, 1e-9))
+        if decoded:
+            self._decode_tokens += decoded
+            _decode_tokens_total.inc(decoded)
+            _decode_tps_gauge.set(decoded / max(t2 - t1, 1e-9))
+        _running_gauge.set(len(self.scheduler.running))
+        _waiting_gauge.set(len(self.scheduler.waiting))
+        _kv_util_gauge.set(self.cache.utilization())
+        return out
+
+    def _run_prefill(self, seq: Sequence, out: List[StepOutput]) -> int:
+        jnp = self._jnp
+        plen = seq.prefill_len
+        bucket = _bucket_for(plen, self.prefill_buckets)
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, :plen] = seq.tokens[:plen]
+        dests = self.cache.prefill_dests(seq.request_id, plen, bucket)
+        with tracing.span("infer.prefill", {
+                "request_id": seq.request_id, "len": plen,
+                "bucket": bucket}):
+            logits, ks, vs = self._prefill_fn(
+                self._params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(dests))
+            self.cache.k, self.cache.v = ks, vs
+        seq.cached_len = plen
+        if not seq.generated:
+            # Fresh prompt: its last logit samples the first new token.
+            # A preemption-resume prefill must NOT resample — the tail
+            # token was already emitted; the next decode rewrites its KV.
+            token = sample_token(np.asarray(logits[plen - 1]),
+                                 seq.sampling, seq.rng)
+            self._emit(seq, token, out)
+        return plen
+
+    def _run_decode(self, seqs: List[Sequence],
+                    out: List[StepOutput]) -> int:
+        jnp = self._jnp
+        b = len(seqs)
+        bucket = _bucket_for(b, self.decode_buckets)
+        P = self.max_pages_per_seq
+        tokens = np.zeros(bucket, dtype=np.int32)
+        positions = np.zeros(bucket, dtype=np.int32)
+        dests = np.zeros(bucket, dtype=np.int32)  # page-0 slot 0 = scratch
+        context_lens = np.ones(bucket, dtype=np.int32)
+        for i, seq in enumerate(seqs):
+            pos = seq.cached_len
+            tokens[i] = seq.tokens[-1]
+            positions[i] = pos
+            dests[i] = self.cache.slot(seq.request_id, pos)
+            context_lens[i] = pos + 1
+        tables = self.cache.table_array(
+            [s.request_id for s in seqs], P, batch=bucket)
+        with tracing.span("infer.decode", {"batch": b, "bucket": bucket}):
+            logits, ks, vs = self._decode_fn(
+                self._params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(dests), jnp.asarray(tables),
+                jnp.asarray(context_lens))
+            self.cache.k, self.cache.v = ks, vs
+        logits_np = np.asarray(logits)
+        for i, seq in enumerate(seqs):
+            seq.cached_len += 1
+            token = sample_token(logits_np[i], seq.sampling, seq.rng)
+            self._emit(seq, token, out)
+        self._decode_batch_hist.append(b)
+        return b
+
+    def _emit(self, seq: Sequence, token: int,
+              out: List[StepOutput]) -> None:
+        seq.generated.append(token)
+        reason = None
+        if token in seq.sampling.stop_token_ids:
+            reason = "stop"
+        elif len(seq.generated) >= seq.sampling.max_new_tokens:
+            reason = "length"
+        elif seq.num_tokens >= self.max_model_len:
+            reason = "length"
+        if reason is not None:
+            self.scheduler.finish(seq, reason)
+        out.append(StepOutput(request_id=seq.request_id, token_id=token,
+                              finished=reason is not None,
+                              finish_reason=reason))
+
+    # ---- convenience + introspection --------------------------------
+
+    def generate(self, prompts: SequenceT[SequenceT[int]],
+                 sampling: Optional[SamplingParams] = None,
+                 ) -> List[List[int]]:
+        """Run a closed batch of prompts to completion; returns the
+        generated token ids per prompt (continuously batched under the
+        hood, but output-identical to one-at-a-time decoding)."""
+        ids = [f"gen-{i}" for i in range(len(prompts))]
+        for rid, prompt in zip(ids, prompts):
+            self.add_request(rid, prompt, sampling)
+        results: Dict[str, List[int]] = {rid: [] for rid in ids}
+        while self.has_unfinished():
+            for o in self.step():
+                if o.request_id in results:
+                    results[o.request_id].append(o.token_id)
+        return [results[rid] for rid in ids]
+
+    def stats(self) -> dict:
+        # Bucket keys as strings: the dict crosses the wire from serve
+        # replicas and msgpack (strict_map_key) rejects int map keys.
+        return {
+            "prefill_compiles": {str(k): v for k, v
+                                 in self._prefill_compiles.items()},
+            "decode_compiles": {str(k): v for k, v
+                                in self._decode_compiles.items()},
+            "decode_batch_hist": list(self._decode_batch_hist),
+            "num_preemptions": self.scheduler.num_preemptions,
+            "running": len(self.scheduler.running),
+            "waiting": len(self.scheduler.waiting),
+            "kv_utilization": self.cache.utilization(),
+            "prefill_tokens": self._prefill_tokens,
+            "decode_tokens": self._decode_tokens,
+        }

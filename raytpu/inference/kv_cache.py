@@ -1,0 +1,166 @@
+"""Paged KV cache (reference analogue: vLLM's PagedAttention, SOSP '23).
+
+The cache for every layer is ONE preallocated JAX array shaped
+``[num_pages, page_size, kv_heads, head_dim]`` (one for K, one for V).
+Sequences own pages through a *block table* — an ordered list of page
+ids — so a sequence's logical position ``p`` lives at flat slot
+``table[p // page_size] * page_size + p % page_size``. Growing a
+sequence by one token allocates at most one page; freeing returns the
+pages to a stack. Nothing is ever reallocated or compacted, which is
+the property the TPU decode step needs: the jitted program sees the
+same cache buffers every iteration and only the (tiny, host-built)
+block tables change.
+
+Page 0 is reserved as *scratch*: it is never handed to a sequence, and
+every padded slot in a bucketed prefill or dummy row in a padded decode
+batch writes there. Garbage lands only in page 0, so real pages are
+never polluted by static-shape padding.
+
+Host-side bookkeeping (block tables, free list) is plain Python — it's
+O(pages touched) per step and never traced.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class PagedKVCache:
+    """Fixed-page KV pool with per-sequence block tables.
+
+    Args:
+        num_layers: number of transformer layers (one K and one V array
+            per layer).
+        num_pages: total pages INCLUDING the reserved scratch page 0;
+            usable capacity is ``num_pages - 1`` pages.
+        page_size: tokens per page.
+        num_kv_heads: KV heads per token (``n_kv_head`` for GQA Llama,
+            ``n_head`` for MHA GPT-2).
+        head_dim: per-head feature dim.
+        dtype: cache array dtype (the model's activation dtype).
+    """
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, dtype=None):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is scratch)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        import jax.numpy as jnp
+
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype or jnp.float32
+        shape = (num_pages, page_size, num_kv_heads, head_dim)
+        self.k: List = [jnp.zeros(shape, self.dtype) for _ in range(num_layers)]
+        self.v: List = [jnp.zeros(shape, self.dtype) for _ in range(num_layers)]
+        # LIFO free list over pages 1..num_pages-1 (0 is scratch).
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._tables: Dict[str, List[int]] = {}
+
+    # ---- accounting -------------------------------------------------
+
+    def pages_for(self, num_tokens: int) -> int:
+        """Pages needed to hold ``num_tokens`` tokens."""
+        return max(0, math.ceil(num_tokens / self.page_size))
+
+    @property
+    def total_pages(self) -> int:
+        """Usable pages (excludes scratch)."""
+        return self.num_pages - 1
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def used_pages(self) -> int:
+        return self.total_pages - len(self._free)
+
+    def utilization(self) -> float:
+        """Fraction of usable pages currently owned by sequences."""
+        return self.used_pages() / self.total_pages
+
+    def num_sequences(self) -> int:
+        return len(self._tables)
+
+    # ---- allocation -------------------------------------------------
+
+    def allocate(self, seq_id: str, num_tokens: int) -> bool:
+        """Reserve pages for a new sequence of ``num_tokens`` tokens.
+
+        All-or-nothing: returns False (allocating nothing) if the free
+        list cannot cover the request. Raises if ``seq_id`` already has
+        a table — callers must :meth:`free` before re-allocating.
+        """
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        need = self.pages_for(max(1, num_tokens))
+        if need > len(self._free):
+            return False
+        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        return True
+
+    def extend(self, seq_id: str, num_tokens_total: int) -> bool:
+        """Grow ``seq_id``'s allocation to cover ``num_tokens_total``
+        tokens. All-or-nothing; True when capacity is already enough."""
+        table = self._tables.get(seq_id)
+        if table is None:
+            raise KeyError(f"sequence {seq_id!r} has no allocation")
+        need = self.pages_for(num_tokens_total) - len(table)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        table.extend(self._free.pop() for _ in range(need))
+        return True
+
+    def free(self, seq_id: str) -> None:
+        """Return a sequence's pages to the pool (idempotent)."""
+        table = self._tables.pop(seq_id, None)
+        if table:
+            # LIFO reuse keeps the hot working set in a few pages.
+            self._free.extend(reversed(table))
+
+    # ---- addressing -------------------------------------------------
+
+    def block_table(self, seq_id: str) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def slot(self, seq_id: str, pos: int) -> int:
+        """Flat slot index (into ``[num_pages*page_size]``) of logical
+        token position ``pos`` of sequence ``seq_id``."""
+        table = self._tables[seq_id]
+        page = pos // self.page_size
+        if page >= len(table):
+            raise IndexError(
+                f"pos {pos} beyond allocation of {seq_id!r} "
+                f"({len(table)} pages x {self.page_size})")
+        return table[page] * self.page_size + pos % self.page_size
+
+    def table_array(self, seq_ids: Sequence[str], max_pages: int,
+                    batch: Optional[int] = None) -> np.ndarray:
+        """Stacked block tables ``[batch, max_pages]`` int32, padded
+        with 0 (scratch) — rows past ``len(seq_ids)`` are dummy rows."""
+        b = batch if batch is not None else len(seq_ids)
+        out = np.zeros((b, max_pages), dtype=np.int32)
+        for i, sid in enumerate(seq_ids):
+            table = self._tables[sid]
+            out[i, :len(table)] = table
+        return out
+
+    def prefill_dests(self, seq_id: str, length: int,
+                      bucket: int) -> np.ndarray:
+        """Flat destination slots ``[bucket]`` int32 for writing a
+        prefill of ``length`` real tokens padded to ``bucket``. Padding
+        slots cycle through page 0 so bucketed garbage stays in scratch."""
+        out = np.empty(bucket, dtype=np.int32)
+        for i in range(min(length, bucket)):
+            out[i] = self.slot(seq_id, i)
+        for i in range(length, bucket):
+            out[i] = i % self.page_size  # page 0 slots
+        return out

@@ -1,0 +1,133 @@
+"""LLMDeployment: the inference engine behind a serve replica.
+
+Wire path (all existing machinery): client calls
+``handle.generate.remote_streaming(prompt, ...)`` → router
+``assign_request_streaming`` → replica ``handle_request_streaming``
+drains the sync generator below on its executor → each yielded token
+id travels back through the worker's object stream →
+``ObjectRefGenerator`` → ``DeploymentResponseGenerator`` on the
+client, which sees tokens *while the sequence still decodes*.
+
+The engine is stepped by whichever request thread is currently waiting
+for a token (caller-driven, no background loop): a thread holding the
+engine lock runs ``engine.step()`` and fans the produced tokens out to
+every request's buffer, so N concurrent streams cost one continuously
+batched decode per iteration, not N. Cancellation rides generator
+close: the client's ``close()`` (or GC of an abandoned stream)
+delivers GeneratorExit to :meth:`LLMDeployment.generate`'s frame,
+whose ``finally`` aborts the request — freeing its KV pages.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from collections import deque
+from typing import Dict, Optional
+
+from raytpu.inference.engine import InferenceEngine
+from raytpu.inference.sampling import SamplingParams
+from raytpu.serve.deployment import deployment
+
+
+@deployment
+class LLMDeployment:
+    """Serve a decoder LM with continuous batching + streaming tokens.
+
+    Args:
+        model: "llama" or "gpt2".
+        model_config: a ``LlamaConfig``/``GPT2Config`` (or kwargs dict
+            for one). Defaults to the family's ``tiny()`` config in
+            fp32/reference-attention mode (CPU-runnable).
+        engine_options: kwargs forwarded to :class:`InferenceEngine`
+            (page_size, num_pages, max_num_seqs, ...).
+        seed: parameter-init seed — two replicas (or a test building a
+            reference model) with the same seed hold identical weights.
+    """
+
+    def __init__(self, model: str = "llama", model_config=None,
+                 engine_options: Optional[dict] = None, seed: int = 0):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        if model == "llama":
+            from raytpu.models.llama import Llama, LlamaConfig, init_params
+
+            cfg_cls, model_cls, init = LlamaConfig, Llama, init_params
+        elif model == "gpt2":
+            from raytpu.models.gpt2 import GPT2, GPT2Config, init_params
+
+            cfg_cls, model_cls, init = GPT2Config, GPT2, init_params
+        else:
+            raise ValueError(f"unknown model family: {model!r}")
+        if model_config is None:
+            model_config = dataclasses.replace(
+                cfg_cls.tiny(), dtype=jnp.float32, attn_impl="reference",
+                remat=False)
+        elif isinstance(model_config, dict):
+            model_config = cfg_cls(**model_config)
+        params = init(model_cls(model_config), model_config, seed=seed,
+                      batch=1)
+        self._engine = InferenceEngine(model_config, params,
+                                       **(engine_options or {}))
+        # One lock serializes engine mutation; the thread that holds it
+        # while buffers are dry runs the next engine step for everyone.
+        self._lock = threading.Lock()
+        self._buffers: Dict[str, deque] = {}
+        self._finished: Dict[str, str] = {}
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 stop_token_ids=()):
+        """Sync generator of token ids for one request; safe to call
+        from many requests concurrently — they share decode steps."""
+        sampling = SamplingParams(
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, seed=seed, stop_token_ids=tuple(stop_token_ids))
+        request_id = uuid.uuid4().hex
+        with self._lock:
+            self._engine.add_request(request_id, prompt, sampling)
+            self._buffers[request_id] = deque()
+        try:
+            while True:
+                token = self._next_token(request_id)
+                if token is None:
+                    return
+                yield token
+        finally:
+            with self._lock:
+                self._engine.abort(request_id)  # no-op if finished
+                self._buffers.pop(request_id, None)
+                self._finished.pop(request_id, None)
+
+    def _next_token(self, request_id: str) -> Optional[int]:
+        while True:
+            with self._lock:
+                buf = self._buffers.get(request_id)
+                if buf is None:
+                    return None
+                if buf:
+                    return buf.popleft()
+                if request_id in self._finished:
+                    return None
+                # Our turn to advance the world one iteration.
+                outs = self._engine.step()
+                for out in outs:
+                    b = self._buffers.get(out.request_id)
+                    if b is not None:
+                        b.append(out.token_id)
+                    if out.finished:
+                        self._finished[out.request_id] = out.finish_reason
+                if not outs and not self._engine.has_unfinished():
+                    # Request left the engine without a finish marker
+                    # (out-of-band abort): end the stream, don't spin.
+                    return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return self._engine.stats()
+
+    def abort(self, request_id: str) -> bool:
+        with self._lock:
+            return self._engine.abort(request_id)

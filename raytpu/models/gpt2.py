@@ -63,26 +63,76 @@ class GPT2Config:
 
 
 class CausalSelfAttention(nn.Module):
+    """MHA with training (``__call__``), cache-emitting ``prefill``, and
+    paged single-token ``decode_step`` entry points — setup()-style so
+    all three share the c_attn/c_proj params (attribute names keep the
+    param tree identical to the old compact version). No rope: GPT-2's
+    positions live in ``wpe``, so decode just embeds at the absolute
+    position and attends; KV heads == query heads."""
+
     config: GPT2Config
 
-    @nn.compact
+    def setup(self):
+        c = self.config
+        self.c_attn = nn.Dense(3 * c.n_embd, dtype=c.dtype)
+        self.c_proj = nn.Dense(c.n_embd, dtype=c.dtype)
+        if c.dropout > 0:
+            self.drop = nn.Dropout(c.dropout)
+
     def __call__(self, x, deterministic: bool = True):
+        y, _, _ = self.prefill(x)
+        if self.config.dropout > 0:
+            y = self.drop(y, deterministic=deterministic)
+        return y
+
+    def prefill(self, x):
+        """[B, T, E] -> (out, k [B, T, H, D], v [B, T, H, D]); k/v are
+        the cache-resident halves for positions 0..T-1 (no dropout —
+        inference path)."""
         c = self.config
         b, t, e = x.shape
         h = c.n_head
-        qkv = nn.Dense(3 * e, dtype=c.dtype, name="c_attn")(x)
+        qkv = self.c_attn(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        k_cache = k.reshape(b, t, h, e // h)
+        v_cache = v.reshape(b, t, h, e // h)
         q = q.reshape(b, t, h, e // h).transpose(0, 2, 1, 3)
-        k = k.reshape(b, t, h, e // h).transpose(0, 2, 1, 3)
-        v = v.reshape(b, t, h, e // h).transpose(0, 2, 1, 3)
+        k = k_cache.transpose(0, 2, 1, 3)
+        v = v_cache.transpose(0, 2, 1, 3)
         from raytpu.ops.flash_attention import flash_attention
 
         y = flash_attention(q, k, v, causal=True, force=c.attn_impl)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, e)
-        y = nn.Dense(e, dtype=c.dtype, name="c_proj")(y)
-        if c.dropout > 0:
-            y = nn.Dropout(c.dropout)(y, deterministic=deterministic)
-        return y
+        return self.c_proj(y), k_cache, v_cache
+
+    def decode_step(self, x, k_pages, v_pages, dests, block_tables,
+                    context_lens):
+        """One-token paged-cache attention; same contract as
+        :meth:`raytpu.models.llama.LlamaAttention.decode_step` minus
+        rope (``positions`` is consumed upstream by the wpe lookup)."""
+        c = self.config
+        b, e = x.shape
+        h = c.n_head
+        d = e // h
+        qkv = self.c_attn(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, h, d)
+        n_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+        flat = (n_pages * page_size, h, d)
+        k_pages = k_pages.reshape(flat).at[dests].set(
+            k.reshape(b, h, d).astype(k_pages.dtype)).reshape(k_pages.shape)
+        v_pages = v_pages.reshape(flat).at[dests].set(
+            v.reshape(b, h, d).astype(v_pages.dtype)).reshape(v_pages.shape)
+        ks = k_pages[block_tables].reshape(b, -1, h, d)
+        vs = v_pages[block_tables].reshape(b, -1, h, d)
+        s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                       ks.astype(jnp.float32)) * (d ** -0.5)
+        visible = jnp.arange(ks.shape[1])[None, :] < context_lens[:, None]
+        s = jnp.where(visible[:, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhl,blhd->bhd", p, vs.astype(jnp.float32))
+        y = o.astype(c.dtype).reshape(b, e)
+        return self.c_proj(y), k_pages, v_pages
 
 
 class MLP(nn.Module):
@@ -234,3 +284,76 @@ def init_params(model: GPT2, config: GPT2Config, seed: int = 0,
                 batch: int = 2):
     tokens = jnp.zeros((batch, config.block_size), jnp.int32)
     return model.init(jax.random.PRNGKey(seed), tokens)["params"]
+
+
+# ---------------------------------------------------------------------------
+# Inference forward paths (used by raytpu.inference.engine) — pure
+# functions over the trained param tree, layers looped in Python (the
+# engine jits the whole step; see raytpu.models.llama for the pattern).
+# ---------------------------------------------------------------------------
+
+def layer_params(params, i: int):
+    """Layer ``i`` params from either layout: scanned (stacked under
+    "h" with a leading layer axis) or unrolled ("h_{i}")."""
+    if "h" in params:
+        return jax.tree_util.tree_map(lambda p: p[i], params["h"])
+    return params[f"h_{i}"]
+
+
+def _tied_logits(c: GPT2Config, params, x):
+    wte = params["wte"]["embedding"].astype(c.dtype)
+    contract = ((x.ndim - 1,), (1,))
+    return jax.lax.dot_general(x, wte, (contract, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _block_apply(c: GPT2Config, lp, x, attn_fn):
+    attn = CausalSelfAttention(c)
+    mlp = MLP(c)
+    ln = nn.LayerNorm(dtype=c.dtype)
+    h = ln.apply({"params": lp["ln_1"]}, x)
+    y, k, v = attn_fn(attn, lp["attn"], h)
+    x = x + y
+    h = ln.apply({"params": lp["ln_2"]}, x)
+    x = x + mlp.apply({"params": lp["mlp"]}, h)
+    return x, k, v
+
+
+def gpt2_prefill(config: GPT2Config, params, tokens):
+    """Prefill forward: ``tokens`` [B, T] -> (fp32 logits [B, T, V],
+    per-layer K [B, T, H, D] list, per-layer V list)."""
+    c = config
+    b, t = tokens.shape
+    x = params["wte"]["embedding"].astype(c.dtype)[tokens] + \
+        params["wpe"]["embedding"].astype(c.dtype)[jnp.arange(t)][None]
+    ks, vs = [], []
+    for i in range(c.n_layer):
+        x, k, v = _block_apply(
+            c, layer_params(params, i), x,
+            lambda m, p, h: m.apply({"params": p}, h, method="prefill"))
+        ks.append(k)
+        vs.append(v)
+    x = nn.LayerNorm(dtype=c.dtype).apply({"params": params["ln_f"]}, x)
+    return _tied_logits(c, params, x), ks, vs
+
+
+def gpt2_decode(config: GPT2Config, params, tokens, positions, dests,
+                block_tables, context_lens, k_caches, v_caches):
+    """Single-token decode forward: ``tokens`` [B] -> (fp32 logits
+    [B, V], updated k_caches, v_caches); positions feed the wpe lookup."""
+    c = config
+    x = params["wte"]["embedding"].astype(c.dtype)[tokens] + \
+        params["wpe"]["embedding"].astype(c.dtype)[positions]
+    new_k, new_v = [], []
+    for i in range(c.n_layer):
+        ki, vi = k_caches[i], v_caches[i]
+
+        def attn_fn(m, p, h, ki=ki, vi=vi):
+            return m.apply({"params": p}, h, ki, vi, dests, block_tables,
+                           context_lens, method="decode_step")
+
+        x, k, v = _block_apply(c, layer_params(params, i), x, attn_fn)
+        new_k.append(k)
+        new_v.append(v)
+    x = nn.LayerNorm(dtype=c.dtype).apply({"params": params["ln_f"]}, x)
+    return _tied_logits(c, params, x), new_k, new_v

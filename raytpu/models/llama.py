@@ -97,26 +97,58 @@ def apply_rope(x, cos, sin):
                             x2 * cos + x1 * sin], axis=-1)
 
 
+def apply_rope_single(x, cos, sin):
+    """Rotate one token per sequence; x is [B, H, D], cos/sin [B, D/2]
+    (from ``rope_tables(d, positions)`` with per-sequence absolute
+    positions — the decode-step counterpart of :func:`apply_rope`)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[:, None, :].astype(x.dtype)
+    sin = sin[:, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
+
+
 class LlamaAttention(nn.Module):
+    """GQA attention with three entry points sharing one parameter set:
+    ``__call__`` (training forward), ``prefill`` (forward that also
+    returns the roped K/V for cache writing), and ``decode_step``
+    (single-token paged-cache attention). setup()-style so all three
+    can touch the projections; attribute names keep the param tree
+    identical to the old compact version (q_proj/k_proj/v_proj/o_proj),
+    so ``TRANSFORMER_RULES`` sharding and existing checkpoints are
+    unaffected."""
+
     config: LlamaConfig
 
-    @nn.compact
-    def __call__(self, x):
+    def setup(self):
         c = self.config
-        b, t, e = x.shape
+        self.q_proj = nn.Dense(c.n_head * c.head_dim, use_bias=False,
+                               dtype=c.dtype)
+        self.k_proj = nn.Dense(c.n_kv_head * c.head_dim, use_bias=False,
+                               dtype=c.dtype)
+        self.v_proj = nn.Dense(c.n_kv_head * c.head_dim, use_bias=False,
+                               dtype=c.dtype)
+        self.o_proj = nn.Dense(c.n_embd, use_bias=False, dtype=c.dtype)
+
+    def __call__(self, x):
+        return self.prefill(x)[0]
+
+    def prefill(self, x):
+        """Full-sequence attention over ``x`` [B, T, E]; returns
+        ``(out [B, T, E], k [B, T, KV, D], v [B, T, KV, D])`` where
+        k (roped, pre-GQA-repeat) and v are exactly what belongs in the
+        paged KV cache for positions 0..T-1."""
+        c = self.config
+        b, t, _ = x.shape
         h, kv, d = c.n_head, c.n_kv_head, c.head_dim
-        q = nn.Dense(h * d, use_bias=False, dtype=c.dtype,
-                     name="q_proj")(x)
-        k = nn.Dense(kv * d, use_bias=False, dtype=c.dtype,
-                     name="k_proj")(x)
-        v = nn.Dense(kv * d, use_bias=False, dtype=c.dtype,
-                     name="v_proj")(x)
-        q = q.reshape(b, t, h, d).transpose(0, 2, 1, 3)
-        k = k.reshape(b, t, kv, d).transpose(0, 2, 1, 3)
-        v = v.reshape(b, t, kv, d).transpose(0, 2, 1, 3)
+        q = self.q_proj(x).reshape(b, t, h, d).transpose(0, 2, 1, 3)
+        k = self.k_proj(x).reshape(b, t, kv, d).transpose(0, 2, 1, 3)
+        v = self.v_proj(x).reshape(b, t, kv, d).transpose(0, 2, 1, 3)
         cos, sin = rope_tables(d, jnp.arange(t), c.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+        k_cache = k.transpose(0, 2, 1, 3)
+        v_cache = v.transpose(0, 2, 1, 3)
         if kv != h:
             # GQA: each kv head serves n_head/n_kv_head query heads.
             rep = h // kv
@@ -126,7 +158,55 @@ class LlamaAttention(nn.Module):
 
         y = flash_attention(q, k, v, causal=True, force=c.attn_impl)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, h * d)
-        return nn.Dense(e, use_bias=False, dtype=c.dtype, name="o_proj")(y)
+        return self.o_proj(y), k_cache, v_cache
+
+    def decode_step(self, x, k_pages, v_pages, dests, block_tables,
+                    positions, context_lens):
+        """One-token attention against the paged cache.
+
+        Args:
+            x: [B, E] current-token hidden states.
+            k_pages / v_pages: [num_pages, page_size, KV, D] cache.
+            dests: [B] flat slots where this token's K/V is written.
+            block_tables: [B, P] page ids per sequence (0-padded; page
+                0 is scratch so padding attends to masked garbage only).
+            positions: [B] absolute position of the current token.
+            context_lens: [B] tokens visible INCLUDING the current one.
+
+        Returns ``(out [B, E], k_pages', v_pages')``. The scatter
+        happens before the gather so the token attends to itself.
+        """
+        c = self.config
+        b, _ = x.shape
+        h, kv, d = c.n_head, c.n_kv_head, c.head_dim
+        q = self.q_proj(x).reshape(b, h, d)
+        k = self.k_proj(x).reshape(b, kv, d)
+        v = self.v_proj(x).reshape(b, kv, d)
+        cos, sin = rope_tables(d, positions, c.rope_theta)
+        q = apply_rope_single(q, cos, sin)
+        k = apply_rope_single(k, cos, sin)
+        n_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+        flat = (n_pages * page_size, kv, d)
+        k_pages = k_pages.reshape(flat).at[dests].set(
+            k.astype(k_pages.dtype)).reshape(k_pages.shape)
+        v_pages = v_pages.reshape(flat).at[dests].set(
+            v.astype(v_pages.dtype)).reshape(v_pages.shape)
+        # Gather each sequence's pages into [B, P*page_size, KV, D].
+        ks = k_pages[block_tables].reshape(b, -1, kv, d)
+        vs = v_pages[block_tables].reshape(b, -1, kv, d)
+        if kv != h:
+            rep = h // kv
+            ks = jnp.repeat(ks, rep, axis=2)
+            vs = jnp.repeat(vs, rep, axis=2)
+        # fp32 score math matching the flash-attention reference path.
+        s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                       ks.astype(jnp.float32)) * (d ** -0.5)
+        visible = jnp.arange(ks.shape[1])[None, :] < context_lens[:, None]
+        s = jnp.where(visible[:, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhl,blhd->bhd", p, vs.astype(jnp.float32))
+        y = o.astype(c.dtype).reshape(b, h * d)
+        return self.o_proj(y), k_pages, v_pages
 
 
 class LlamaMLP(nn.Module):
@@ -232,3 +312,73 @@ def init_params(model: Llama, config: LlamaConfig, seed: int = 0,
                 batch: int = 2):
     tokens = jnp.zeros((batch, config.block_size), jnp.int32)
     return model.init(jax.random.PRNGKey(seed), tokens)["params"]
+
+
+# ---------------------------------------------------------------------------
+# Inference forward paths (used by raytpu.inference.engine). These are
+# pure functions over the SAME param tree __call__ trains: layers are
+# looped in Python (the engine jits the whole prefill/decode step, so
+# an unrolled loop over 2-32 layers compiles fine and sidesteps
+# carrying the paged cache through nn.scan).
+# ---------------------------------------------------------------------------
+
+def layer_params(params, i: int):
+    """Params of layer ``i`` from either layout: scanned (stacked under
+    "layers" with a leading layer axis) or unrolled ("layers_{i}")."""
+    if "layers" in params:
+        return jax.tree_util.tree_map(lambda p: p[i], params["layers"])
+    return params[f"layers_{i}"]
+
+
+def _lm_logits(c: LlamaConfig, params, x):
+    kernel = params["lm_head"]["kernel"].astype(c.dtype)
+    return jnp.dot(x, kernel).astype(jnp.float32)
+
+
+def llama_prefill(config: LlamaConfig, params, tokens):
+    """Prefill forward: ``tokens`` [B, T] -> (fp32 logits [B, T, V],
+    per-layer roped K [B, T, KV, D] list, per-layer V list) — the K/V
+    halves are what the engine scatters into the paged cache."""
+    c = config
+    x = params["embed_tokens"]["embedding"].astype(c.dtype)[tokens]
+    attn = LlamaAttention(c)
+    mlp = LlamaMLP(c)
+    norm = RMSNorm(dtype=c.dtype)
+    ks, vs = [], []
+    for i in range(c.n_layer):
+        lp = layer_params(params, i)
+        h = norm.apply({"params": lp["input_norm"]}, x)
+        y, k, v = attn.apply({"params": lp["attn"]}, h, method="prefill")
+        ks.append(k)
+        vs.append(v)
+        x = x + y
+        h = norm.apply({"params": lp["post_attn_norm"]}, x)
+        x = x + mlp.apply({"params": lp["mlp"]}, h)
+    x = norm.apply({"params": params["final_norm"]}, x)
+    return _lm_logits(c, params, x), ks, vs
+
+
+def llama_decode(config: LlamaConfig, params, tokens, positions, dests,
+                 block_tables, context_lens, k_caches, v_caches):
+    """Single-token decode forward: ``tokens`` [B] -> (fp32 logits
+    [B, V], updated k_caches, v_caches). See
+    :meth:`LlamaAttention.decode_step` for the cache argument shapes."""
+    c = config
+    x = params["embed_tokens"]["embedding"].astype(c.dtype)[tokens]
+    attn = LlamaAttention(c)
+    mlp = LlamaMLP(c)
+    norm = RMSNorm(dtype=c.dtype)
+    new_k, new_v = [], []
+    for i in range(c.n_layer):
+        lp = layer_params(params, i)
+        h = norm.apply({"params": lp["input_norm"]}, x)
+        y, kc, vc = attn.apply(
+            {"params": lp["attn"]}, h, k_caches[i], v_caches[i], dests,
+            block_tables, positions, context_lens, method="decode_step")
+        new_k.append(kc)
+        new_v.append(vc)
+        x = x + y
+        h = norm.apply({"params": lp["post_attn_norm"]}, x)
+        x = x + mlp.apply({"params": lp["mlp"]}, h)
+    x = norm.apply({"params": params["final_norm"]}, x)
+    return _lm_logits(c, params, x), new_k, new_v

@@ -25,12 +25,23 @@ from raytpu.serve._private.proxy import Request
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "Request",
+    "DeploymentHandle", "DeploymentResponse", "HTTPOptions",
+    "LLMDeployment", "Request",
     "batch", "delete", "deployment", "get_app_handle",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
     "run",
     "ingress", "shutdown", "start", "status",
 ]
+
+
+def __getattr__(name):
+    # Lazy: the LLM deployment pulls in the model + inference stack
+    # (flax, jax model code), which plain serve users shouldn't import.
+    if name == "LLMDeployment":
+        from raytpu.inference.serving import LLMDeployment
+
+        return LLMDeployment
+    raise AttributeError(name)
 
 from raytpu.util import usage_stats as _usage_stats
 

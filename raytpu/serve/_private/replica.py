@@ -282,12 +282,53 @@ class Replica:
                             yield chunk
                     elif hasattr(result, "__next__") or hasattr(
                             result, "__iter__"):
-                        for chunk in result:
-                            yield chunk
+                        # Drain sync generators on the executor: each
+                        # next() may block (an LLM replica waits a full
+                        # decode step per token) and must not stall the
+                        # event loop — concurrent streams and health
+                        # checks keep running between chunks.
+                        it = iter(result)
+                        loop = asyncio.get_event_loop()
+
+                        def _next_chunk():
+                            try:
+                                return True, next(it)
+                            except StopIteration:
+                                return False, None
+
+                        try:
+                            while True:
+                                ok, chunk = await loop.run_in_executor(
+                                    self._executor, _next_chunk)
+                                if not ok:
+                                    break
+                                yield chunk
+                        finally:
+                            # Consumer went away mid-stream: push
+                            # GeneratorExit into the handler so its
+                            # finally blocks (request abort, KV-page
+                            # free) run now, not at GC time. If next()
+                            # is mid-flight on the executor the close
+                            # raises ValueError; GC finalization stays
+                            # the fallback then.
+                            close_fn = getattr(it, "close", None)
+                            if close_fn is not None:
+                                try:
+                                    close_fn()
+                                except ValueError:
+                                    pass
                     else:  # non-streaming handler: one chunk
                         yield result
                 finally:
-                    _request_context.reset(token)
+                    try:
+                        _request_context.reset(token)
+                    except ValueError:
+                        # A cancelled stream's GeneratorExit arrives via
+                        # aclose() scheduled in a fresh Context (asyncgen
+                        # GC finalizer); the original request Context —
+                        # and the var set in it — died with the consumer
+                        # task, so there is nothing to reset.
+                        pass
                     self._num_ongoing -= 1
                     self._total_handled += 1
         finally:

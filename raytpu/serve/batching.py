@@ -96,7 +96,12 @@ def batch(
     receives a list and must return a same-length list."""
 
     def wrap(fn: Callable):
-        queues = {}  # one queue per bound instance (keyed by id(self))
+        # One queue per bound instance, keyed by id(self). Entries are
+        # removed by a weakref finalizer when the instance is collected
+        # (and the queue's fn holds the instance weakly), so the
+        # registry can't leak instances and a recycled id() after GC
+        # can never reach a stale queue bound to a dead instance.
+        queues = {}
 
         is_method = "self" in inspect.signature(fn).parameters
 
@@ -105,19 +110,22 @@ def batch(
             if is_method:
                 self_arg, item = args[0], args[1]
                 key = id(self_arg)
-                bound = functools.partial(fn, self_arg)
             else:
                 (item,) = args
-                key = None
-                bound = fn
+                self_arg, key = None, None
             q = queues.get(key)
             if q is None:
+                from raytpu.serve.multiplex import _bind_weak
+
+                bound = _bind_weak(fn, self_arg, queues, key) \
+                    if is_method else fn
                 q = queues[key] = _BatchQueue(
                     bound, max_batch_size, batch_wait_timeout_s,
                     pad_batch_to_max,
                 )
             return await q.put(item)
 
+        wrapper._queues = queues
         wrapper._is_serve_batch = True
         return wrapper
 

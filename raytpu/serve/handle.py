@@ -61,6 +61,22 @@ class DeploymentResponseGenerator:
         except StopIteration:
             return False, None
 
+    def close(self) -> None:
+        """Cancel the stream: tells the producer side to stop (its
+        generator sees GeneratorExit at the next yield, running any
+        ``finally`` cleanup — e.g. an LLM replica freeing the
+        sequence's KV pages). Safe to call twice; iteration after
+        close raises StopIteration."""
+        close_fn = getattr(self._gen, "close", None)
+        if close_fn is not None:
+            close_fn()
+
+    def __enter__(self) -> "DeploymentResponseGenerator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
 
 class DeploymentHandle:
     def __init__(

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
+import weakref
 from collections import OrderedDict
 from typing import Callable, Optional
 
@@ -26,22 +27,30 @@ def get_multiplexed_model_id() -> str:
 
 
 class _ModelCache:
+    """LRU model cache with single-flight loads: concurrent ``get``s for
+    the same missing model share ONE loader call via a per-key pending
+    future (model loads are seconds-to-minutes of HBM traffic — a
+    duplicated load is both slow and an OOM hazard). A failed load
+    propagates to every waiter and clears the pending entry, so the
+    next request retries cleanly."""
+
     def __init__(self, loader: Callable, capacity: int):
         self.loader = loader
         self.capacity = capacity
         self.cache: OrderedDict = OrderedDict()
-        self.locks = {}
+        self.pending = {}  # model_id -> Future of the in-flight load
 
     async def get(self, *args) -> object:
         model_id = args[-1] if args else get_multiplexed_model_id()
         if model_id in self.cache:
             self.cache.move_to_end(model_id)
             return self.cache[model_id]
-        lock = self.locks.setdefault(model_id, asyncio.Lock())
-        async with lock:
-            if model_id in self.cache:  # loaded while we waited
-                self.cache.move_to_end(model_id)
-                return self.cache[model_id]
+        pending = self.pending.get(model_id)
+        if pending is not None:
+            return await pending
+        fut = asyncio.get_event_loop().create_future()
+        self.pending[model_id] = fut
+        try:
             while len(self.cache) >= self.capacity:
                 _, evicted = self.cache.popitem(last=False)
                 unload = getattr(evicted, "unload", None)
@@ -52,15 +61,23 @@ class _ModelCache:
             model = self.loader(*args)
             if inspect.isawaitable(model):
                 model = await model
-            self.cache[model_id] = model
-            return model
+        except BaseException as e:
+            self.pending.pop(model_id, None)
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()  # retrieved: no-waiter GC warning averted
+            raise
+        self.cache[model_id] = model
+        self.pending.pop(model_id, None)
+        fut.set_result(model)
+        return model
 
 
 def multiplexed(
     _fn: Optional[Callable] = None, *, max_num_models_per_replica: int = 3
 ):
     def wrap(fn: Callable):
-        caches = {}  # per bound instance
+        caches = {}  # key -> _ModelCache; entries die with their instance
 
         is_method = "self" in inspect.signature(fn).parameters
 
@@ -69,16 +86,41 @@ def multiplexed(
             key = id(args[0]) if is_method else None
             cache = caches.get(key)
             if cache is None:
-                bound = functools.partial(fn, args[0]) if is_method else fn
+                bound = _bind_weak(fn, args[0], caches, key) \
+                    if is_method else fn
                 cache = caches[key] = _ModelCache(
                     bound, max_num_models_per_replica
                 )
             call_args = args[1:] if is_method else args
             return await cache.get(*call_args)
 
+        wrapper._caches = caches
         wrapper._is_serve_multiplexed = True
         return wrapper
 
     if _fn is not None:
         return wrap(_fn)
     return wrap
+
+
+def _bind_weak(fn: Callable, instance, registry: dict, key):
+    """Bind ``fn`` to ``instance`` without a strong reference, and drop
+    ``registry[key]`` when the instance is collected. A strong bind
+    would chain registry -> entry -> fn -> instance, keeping every
+    instance (and its id()-keyed entry) alive for the process — and a
+    recycled id() after GC would silently reuse the dead instance's
+    entry. Falls back to a strong bind for un-weakref-able instances."""
+    try:
+        ref = weakref.ref(instance)
+        weakref.finalize(instance, registry.pop, key, None)
+    except TypeError:
+        return functools.partial(fn, instance)
+
+    def bound(*args, **kwargs):
+        inst = ref()
+        if inst is None:
+            raise RuntimeError(
+                f"instance bound to {fn.__qualname__} was garbage-collected")
+        return fn(inst, *args, **kwargs)
+
+    return bound

@@ -1,0 +1,408 @@
+"""Inference subsystem tests: paged KV cache, continuous-batching
+scheduler, engine correctness (batched output == non-batched reference
+for llama AND gpt2), compile-once-per-bucket discipline, preemption-
+recompute, sampling invariance, and the jit-placement AST lint."""
+
+import ast
+import dataclasses
+import pathlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raytpu.inference import (InferenceEngine, PagedKVCache, SamplingParams,
+                              Scheduler, Sequence)
+from raytpu.models.gpt2 import GPT2, GPT2Config
+from raytpu.models.gpt2 import init_params as gpt2_init
+from raytpu.models.llama import Llama, LlamaConfig
+from raytpu.models.llama import init_params as llama_init
+
+LCFG = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                           attn_impl="reference", remat=False)
+GCFG = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32,
+                           attn_impl="reference", remat=False)
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    model = Llama(LCFG)
+    return model, llama_init(model, LCFG, seed=0, batch=1)
+
+
+@pytest.fixture(scope="module")
+def gpt2_model():
+    model = GPT2(GCFG)
+    return model, gpt2_init(model, GCFG, seed=0, batch=1)
+
+
+def reference_greedy(model, params, prompt, n_new):
+    """Non-batched, non-cached decode: full forward over the growing
+    sequence, argmax at the last position — ground truth."""
+    toks = list(prompt)
+    outs = []
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, jnp.asarray([toks]))
+        tok = int(jnp.argmax(logits[0, len(toks) - 1]))
+        toks.append(tok)
+        outs.append(tok)
+    return outs
+
+
+class TestPagedKVCache:
+    def make(self, pages=9, page_size=4):
+        return PagedKVCache(num_layers=2, num_pages=pages, page_size=page_size,
+                            num_kv_heads=2, head_dim=8)
+
+    def test_layout_and_accounting(self):
+        c = self.make()
+        assert c.k[0].shape == (9, 4, 2, 8) and len(c.k) == 2
+        assert c.total_pages == 8 and c.free_pages() == 8
+        assert c.pages_for(1) == 1 and c.pages_for(4) == 1
+        assert c.pages_for(5) == 2 and c.pages_for(0) == 0
+
+    def test_allocate_extend_free(self):
+        c = self.make()
+        assert c.allocate("a", 6)  # 2 pages
+        assert c.used_pages() == 2 and c.utilization() == pytest.approx(0.25)
+        assert c.extend("a", 8)  # still 2 pages
+        assert c.used_pages() == 2
+        assert c.extend("a", 9)  # 3rd page
+        assert c.used_pages() == 3
+        table = c.block_table("a")
+        assert len(table) == 3 and 0 not in table  # page 0 is scratch
+        c.free("a")
+        assert c.free_pages() == 8
+        c.free("a")  # idempotent
+
+    def test_allocation_is_all_or_nothing(self):
+        c = self.make(pages=4)  # 3 usable
+        assert c.allocate("a", 8)  # 2 pages
+        free_before = c.free_pages()
+        assert not c.allocate("b", 8)  # needs 2, only 1 free
+        assert c.free_pages() == free_before
+        assert not c.extend("a", 17)  # needs 3 more, has 1
+        assert len(c.block_table("a")) == 2
+
+    def test_double_allocate_raises(self):
+        c = self.make()
+        assert c.allocate("a", 1)
+        with pytest.raises(ValueError):
+            c.allocate("a", 1)
+
+    def test_slot_math(self):
+        c = self.make()
+        c.allocate("a", 10)  # 3 pages
+        table = c.block_table("a")
+        assert c.slot("a", 0) == table[0] * 4
+        assert c.slot("a", 5) == table[1] * 4 + 1
+        assert c.slot("a", 9) == table[2] * 4 + 1
+        with pytest.raises(IndexError):
+            c.slot("a", 12)
+
+    def test_table_array_pads_with_scratch(self):
+        c = self.make()
+        c.allocate("a", 6)
+        arr = c.table_array(["a"], max_pages=4, batch=3)
+        assert arr.shape == (3, 4) and arr.dtype == np.int32
+        assert list(arr[0][:2]) == c.block_table("a")
+        assert not arr[0][2:].any() and not arr[1].any()
+
+    def test_prefill_dests_pad_into_page0(self):
+        c = self.make()
+        c.allocate("a", 5)
+        dests = c.prefill_dests("a", 5, bucket=8)
+        assert dests.shape == (8,)
+        for i in range(5):
+            assert dests[i] == c.slot("a", i)
+        assert all(0 <= d < 4 for d in dests[5:])  # page-0 slots
+
+
+class _FakePageCache(PagedKVCache):
+    """Real cache minus the JAX arrays (scheduler never touches them)."""
+
+    def __init__(self, num_pages, page_size):
+        super().__init__(num_layers=1, num_pages=num_pages,
+                         page_size=page_size, num_kv_heads=1, head_dim=1)
+
+
+class TestScheduler:
+    def make(self, pages=9, page_size=4, max_num_seqs=8):
+        cache = _FakePageCache(pages, page_size)
+        return cache, Scheduler(cache, max_num_seqs=max_num_seqs,
+                                max_model_len=64)
+
+    def seq(self, rid, prompt_len):
+        return Sequence(request_id=rid, prompt=list(range(1, prompt_len + 1)))
+
+    def test_fifo_admission_and_merge_with_decodes(self):
+        _, sched = self.make()
+        a = self.seq("a", 6)
+        sched.add(a)
+        plan = sched.schedule()
+        assert plan.prefills == [a] and plan.decodes == []
+        a.cached_len = a.prefill_len
+        a.generated.append(1)
+        b = self.seq("b", 3)
+        sched.add(b)
+        plan = sched.schedule()
+        # New prefill merges with the in-flight decode in one iteration.
+        assert plan.prefills == [b] and plan.decodes == [a]
+
+    def test_admission_respects_page_budget(self):
+        cache, sched = self.make(pages=4)  # 3 usable
+        a, b = self.seq("a", 8), self.seq("b", 8)  # 2 pages each
+        sched.add(a)
+        sched.add(b)
+        plan = sched.schedule()
+        assert plan.prefills == [a]  # b doesn't fit
+        assert list(sched.waiting) == [b]
+
+    def test_admission_respects_max_num_seqs(self):
+        _, sched = self.make(max_num_seqs=1)
+        a, b = self.seq("a", 2), self.seq("b", 2)
+        sched.add(a)
+        sched.add(b)
+        assert sched.schedule().prefills == [a]
+        assert list(sched.waiting) == [b]
+
+    def test_preempts_youngest_under_page_pressure(self):
+        cache, sched = self.make(pages=5)  # 4 usable
+        a, b = self.seq("a", 8), self.seq("b", 7)  # 2 pages each
+        sched.add(a)
+        sched.add(b)
+        assert sched.schedule().prefills == [a, b]
+        a.cached_len, b.cached_len = 8, 7
+        a.generated.append(1)
+        b.generated.append(1)
+        # a needs a 3rd page for token 9; none free -> b (youngest) is
+        # preempted-to-recompute and no admission happens this round.
+        plan = sched.schedule()
+        assert plan.preempted == [b] and plan.prefills == []
+        assert plan.decodes == [a]
+        assert b.cached_len == 0 and b.state == "waiting"
+        assert sched.num_preemptions == 1
+        assert list(sched.waiting) == [b]  # front of the queue
+        # b resumes later with prompt+generated prefilled, nothing resampled.
+        assert b.prefill_len == 7  # 8 known tokens, newest decoded next
+
+    def test_abort_everywhere(self):
+        cache, sched = self.make()
+        a, b = self.seq("a", 4), self.seq("b", 4)
+        sched.add(a)
+        sched.add(b)
+        sched.schedule()
+        assert sched.abort("a")  # running
+        assert cache.num_sequences() == 1
+        assert not sched.abort("a")  # idempotent
+        assert sched.abort("b")
+        assert cache.free_pages() == cache.total_pages
+        assert not sched.has_unfinished()
+
+
+class TestEngineLlama:
+    def make_engine(self, params, **kw):
+        kw.setdefault("page_size", 8)
+        kw.setdefault("max_num_seqs", 4)
+        kw.setdefault("max_model_len", 64)
+        return InferenceEngine(LCFG, params, **kw)
+
+    def test_single_request_matches_reference(self, llama_model):
+        model, params = llama_model
+        eng = self.make_engine(params)
+        prompt = list(range(1, 10))
+        (out,) = eng.generate([prompt], SamplingParams(max_new_tokens=6))
+        assert out == reference_greedy(model, params, prompt, 6)
+
+    def test_staggered_requests_share_decode_and_match(self, llama_model):
+        model, params = llama_model
+        eng = self.make_engine(params)
+        pa, pb = list(range(1, 12)), [7, 3, 9]
+        eng.add_request("a", pa, SamplingParams(max_new_tokens=8))
+        results = {"a": [], "b": []}
+
+        def drain(outs):
+            for o in outs:
+                results[o.request_id].append(o.token_id)
+
+        drain(eng.step())  # a prefills
+        drain(eng.step())  # a decodes alone
+        eng.add_request("b", pb, SamplingParams(max_new_tokens=5))
+        while eng.has_unfinished():
+            drain(eng.step())
+        assert results["a"] == reference_greedy(model, params, pa, 8)
+        assert results["b"] == reference_greedy(model, params, pb, 5)
+        stats = eng.stats()
+        # They provably shared iterations: some step decoded batch 2.
+        assert max(stats["decode_batch_hist"]) >= 2
+        assert 1 in stats["decode_batch_hist"]
+
+    def test_decode_compiles_once_per_bucket(self, llama_model):
+        _, params = llama_model
+        eng = self.make_engine(params)
+        prompts = [list(range(1, 4 + i)) for i in range(4)]
+        eng.generate(prompts, SamplingParams(max_new_tokens=6))
+        stats = eng.stats()
+        # Batch composition changed every few iterations (staggered
+        # finishes) but each bucket size compiled exactly once.
+        assert stats["decode_compiles"]
+        assert all(v == 1 for v in stats["decode_compiles"].values())
+        assert all(v == 1 for v in stats["prefill_compiles"].values())
+
+    def test_prefill_buckets_compile_once_per_length_bucket(self,
+                                                            llama_model):
+        _, params = llama_model
+        eng = self.make_engine(params)
+        # Two prompts in the same bucket (16), one in the next (32).
+        for rid, plen in (("a", 5), ("b", 9), ("c", 20)):
+            eng.add_request(rid, list(range(1, plen + 1)),
+                            SamplingParams(max_new_tokens=2))
+        while eng.has_unfinished():
+            eng.step()
+        assert eng.stats()["prefill_compiles"] == {"16": 1, "32": 1}
+
+    def test_preemption_recompute_preserves_output(self, llama_model):
+        model, params = llama_model
+        # 5 usable pages of 4 tokens: two growing sequences can't both
+        # stay resident, forcing preempt-to-recompute mid-generation.
+        eng = InferenceEngine(LCFG, params, page_size=4, num_pages=6,
+                              max_num_seqs=2, max_model_len=24)
+        pa, pb = list(range(1, 8)), list(range(20, 25))
+        outs = eng.generate([pa, pb], SamplingParams(max_new_tokens=8))
+        assert eng.stats()["num_preemptions"] >= 1
+        assert outs[0] == reference_greedy(model, params, pa, 8)
+        assert outs[1] == reference_greedy(model, params, pb, 8)
+        assert eng.cache.free_pages() == eng.cache.total_pages
+
+    def test_temperature_sampling_batch_invariant(self, llama_model):
+        _, params = llama_model
+        sampling = SamplingParams(max_new_tokens=6, temperature=0.8,
+                                  top_k=12, seed=123)
+        solo = self.make_engine(params).generate([[5, 6, 7]], sampling)[0]
+        eng = self.make_engine(params)
+        batched = eng.generate([[5, 6, 7], list(range(1, 9))], sampling)[0]
+        assert solo == batched  # per-request RNG: batching is invisible
+        assert len(solo) == 6
+
+    def test_stop_tokens_and_length_finish(self, llama_model):
+        model, params = llama_model
+        prompt = list(range(1, 10))
+        first = reference_greedy(model, params, prompt, 1)[0]
+        eng = self.make_engine(params)
+        eng.add_request("s", prompt, SamplingParams(
+            max_new_tokens=8, stop_token_ids=(first,)))
+        outs = []
+        while eng.has_unfinished():
+            outs.extend(eng.step())
+        assert len(outs) == 1 and outs[0].finished
+        assert outs[0].finish_reason == "stop"
+        eng2 = self.make_engine(params)
+        eng2.add_request("l", prompt, SamplingParams(max_new_tokens=2))
+        outs = []
+        while eng2.has_unfinished():
+            outs.extend(eng2.step())
+        assert outs[-1].finish_reason == "length"
+        assert eng2.cache.free_pages() == eng2.cache.total_pages
+
+    def test_request_validation(self, llama_model):
+        _, params = llama_model
+        eng = self.make_engine(params)
+        with pytest.raises(ValueError):
+            eng.add_request("e", [])
+        with pytest.raises(ValueError):
+            eng.add_request("e", list(range(64)))  # no room to generate
+
+    def test_metrics_and_spans(self, llama_model):
+        from raytpu.inference import engine as engine_mod
+        from raytpu.util import tracing
+
+        _, params = llama_model
+        eng = self.make_engine(params)
+        before = engine_mod._decode_tokens_total.value
+        tracing.enable_tracing()
+        try:
+            eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3))
+            names = {s["name"] for s in tracing.get_spans()}
+        finally:
+            tracing.disable_tracing()
+            tracing.clear_spans()
+        assert {"infer.prefill", "infer.decode"} <= names
+        assert engine_mod._decode_tokens_total.value >= before + 2
+        assert engine_mod._running_gauge.value == 0
+        assert engine_mod._kv_util_gauge.value == 0.0
+
+
+class TestEngineGPT2:
+    def test_batched_greedy_matches_reference(self, gpt2_model):
+        model, params = gpt2_model
+        eng = InferenceEngine(GCFG, params, page_size=8, max_num_seqs=4,
+                              max_model_len=64)
+        pa, pb = list(range(1, 10)), [11, 12]
+        outs = eng.generate([pa, pb], SamplingParams(max_new_tokens=6))
+        assert outs[0] == reference_greedy(model, params, pa, 6)
+        assert outs[1] == reference_greedy(model, params, pb, 6)
+        assert max(eng.stats()["decode_batch_hist"]) >= 2
+
+
+# ---------------------------------------------------------------------------
+# Compile-once lint: jax.jit may appear ONLY inside _build_* constructors
+# (and never inside a loop) anywhere in raytpu/inference — the
+# per-iteration step() must call prebuilt functions, not re-jit.
+# ---------------------------------------------------------------------------
+
+def _jit_calls_outside_builders(tree):
+    """Return (all_jit_call_lines, violation_lines) for one module."""
+    total, violations = [], []
+
+    def is_jit(func):
+        return (isinstance(func, ast.Name) and func.id == "jit") or (
+            isinstance(func, ast.Attribute) and func.attr == "jit")
+
+    def visit(node, in_builder, in_loop):
+        for child in ast.iter_child_nodes(node):
+            builder = in_builder
+            loop = in_loop
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                builder = child.name.startswith("_build_")
+                loop = False  # a nested def resets loop lexicality
+            elif isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                loop = True
+            if isinstance(child, ast.Call) and is_jit(child.func):
+                total.append(child.lineno)
+                if not builder or loop:
+                    violations.append(child.lineno)
+            visit(child, builder, loop)
+
+    visit(tree, False, False)
+    return total, violations
+
+
+class TestInferenceJitLint:
+    def test_jit_only_in_build_constructors(self):
+        pkg = pathlib.Path(__file__).resolve().parent.parent / \
+            "raytpu" / "inference"
+        total, violations = [], []
+        for path in sorted(pkg.glob("*.py")):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            t, v = _jit_calls_outside_builders(tree)
+            total.extend((path.name, ln) for ln in t)
+            violations.extend((path.name, ln) for ln in v)
+        assert len(total) >= 2, "expected the prefill + decode jit sites"
+        assert not violations, (
+            "jax.jit outside a _build_* constructor (or inside a loop) in "
+            "raytpu/inference — the per-iteration path must only CALL "
+            f"prebuilt compiled functions: {violations}")
+
+    def test_lint_catches_planted_violation(self):
+        planted = ast.parse(
+            "import jax\n"
+            "def step(self):\n"
+            "    fn = jax.jit(lambda x: x)\n"
+            "def _build_decode_fn(self):\n"
+            "    return jax.jit(lambda x: x)\n"
+            "def _build_loopy(self):\n"
+            "    for _ in range(2):\n"
+            "        jax.jit(lambda x: x)\n")
+        total, violations = _jit_calls_outside_builders(planted)
+        assert len(total) == 3
+        assert len(violations) == 2  # step() and the in-loop builder call

@@ -284,6 +284,136 @@ class TestBatching:
         assert outs == [i + 1 for i in range(6)]
         assert all(s == 4 for s in shapes)  # every flush saw the padded size
 
+    def test_queue_registry_released_on_instance_gc(self):
+        """Regression: the per-instance queue registry used to key by
+        id(self) with a strong bound fn — entries (and the instances
+        they captured) lived forever, and a recycled id() after GC
+        could reuse a stale queue bound to a dead instance."""
+        import gc
+
+        class Holder:
+            @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+            async def handle(self, items):
+                return [i + 1 for i in items]
+
+        registry = Holder.handle._queues
+        loop = asyncio.new_event_loop()
+        try:
+            h = Holder()
+            assert loop.run_until_complete(h.handle(1)) == 2
+            assert len(registry) == 1
+            del h
+            gc.collect()
+            assert len(registry) == 0  # finalizer dropped the entry
+            # A fresh instance gets a fresh queue and still works.
+            h2 = Holder()
+            assert loop.run_until_complete(h2.handle(5)) == 6
+            assert len(registry) == 1
+        finally:
+            loop.close()
+
+    def test_plain_function_batch_unaffected(self):
+        @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+        async def double(items):
+            return [i * 2 for i in items]
+
+        loop = asyncio.new_event_loop()
+        try:
+            assert loop.run_until_complete(double(3)) == 6
+            assert len(double._queues) == 1  # the None (function) slot
+        finally:
+            loop.close()
+
+
+class TestMultiplexSingleFlight:
+    def test_concurrent_gets_share_one_load(self):
+        """Regression: concurrent awaits for the same missing model must
+        invoke the loader ONCE (single-flight), all returning its result."""
+        from raytpu.serve.multiplex import _ModelCache
+
+        calls = []
+
+        async def loader(model_id):
+            calls.append(model_id)
+            await asyncio.sleep(0.05)  # wide race window
+            return f"model:{model_id}"
+
+        cache = _ModelCache(loader, capacity=2)
+
+        async def main():
+            return await asyncio.gather(*[cache.get("a") for _ in range(5)])
+
+        outs = asyncio.new_event_loop().run_until_complete(main())
+        assert outs == ["model:a"] * 5
+        assert calls == ["a"]  # exactly one load
+        assert not cache.pending  # no leaked in-flight entries
+
+    def test_distinct_models_load_concurrently(self):
+        from raytpu.serve.multiplex import _ModelCache
+
+        in_flight = {"now": 0, "peak": 0}
+
+        async def loader(model_id):
+            in_flight["now"] += 1
+            in_flight["peak"] = max(in_flight["peak"], in_flight["now"])
+            await asyncio.sleep(0.05)
+            in_flight["now"] -= 1
+            return model_id
+
+        cache = _ModelCache(loader, capacity=4)
+
+        async def main():
+            return await asyncio.gather(cache.get("a"), cache.get("b"))
+
+        outs = asyncio.new_event_loop().run_until_complete(main())
+        assert outs == ["a", "b"]
+        assert in_flight["peak"] == 2  # not serialized by a global lock
+
+    def test_failed_load_propagates_to_all_waiters_then_retries(self):
+        from raytpu.serve.multiplex import _ModelCache
+
+        calls = []
+
+        async def loader(model_id):
+            calls.append(model_id)
+            await asyncio.sleep(0.02)
+            if len(calls) == 1:
+                raise RuntimeError("HBM OOM")
+            return f"model:{model_id}"
+
+        cache = _ModelCache(loader, capacity=2)
+
+        async def main():
+            results = await asyncio.gather(
+                *[cache.get("a") for _ in range(3)], return_exceptions=True)
+            retry = await cache.get("a")  # pending cleared -> clean retry
+            return results, retry
+
+        results, retry = asyncio.new_event_loop().run_until_complete(main())
+        assert all(isinstance(r, RuntimeError) for r in results)
+        assert retry == "model:a"
+        assert calls == ["a", "a"]  # one shared failure + one retry
+
+    def test_cache_registry_released_on_instance_gc(self):
+        import gc
+
+        class Holder:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            async def get_model(self, model_id):
+                return f"m:{model_id}"
+
+        registry = Holder.get_model._caches
+        loop = asyncio.new_event_loop()
+        try:
+            h = Holder()
+            assert loop.run_until_complete(h.get_model("x")) == "m:x"
+            assert len(registry) == 1
+            del h
+            gc.collect()
+            assert len(registry) == 0
+        finally:
+            loop.close()
+
 
 class TestMultiplex:
     def test_multiplexed_lru(self, serve_instance):
