@@ -171,6 +171,44 @@ def _bare_gang_seconds(workers: int) -> float:
     return max(times)
 
 
+# -- flight-recorder overhead: same submit path, recorder off vs on ------
+
+def _recorder_overhead(n_tasks: int = 200) -> dict:
+    """Per-task wall cost of the task-event flight recorder, measured on
+    the live session's submit→finish path with the recorder off, then
+    on. The off column is the disabled-cost contract (one flag check per
+    seam); the delta is what ``RAYTPU_TASK_EVENTS=1`` buys into."""
+    import raytpu
+    from raytpu.util import task_events
+
+    @raytpu.remote
+    def _noop():
+        return None
+
+    def timed() -> float:
+        raytpu.get([_noop.remote() for _ in range(n_tasks)])  # warm
+        t0 = time.perf_counter()
+        raytpu.get([_noop.remote() for _ in range(n_tasks)])
+        return (time.perf_counter() - t0) / n_tasks
+
+    was_enabled = task_events.enabled()
+    try:
+        task_events.disable_task_events()
+        off_s = timed()
+        task_events.enable_task_events()
+        on_s = timed()
+    finally:
+        if was_enabled:
+            task_events.enable_task_events()
+        else:
+            task_events.disable_task_events()
+        task_events.clear()
+    return {"recorder_off_us_per_task": round(off_s * 1e6, 2),
+            "recorder_on_us_per_task": round(on_s * 1e6, 2),
+            "recorder_delta_us_per_task": round((on_s - off_s) * 1e6, 2),
+            "recorder_tasks_measured": n_tasks}
+
+
 # -- (b) fabric gang: JaxTrainer with live reporting ---------------------
 
 def _trainer_loop(config):
@@ -213,6 +251,10 @@ def main() -> None:
         scaling_config=ScalingConfig(num_workers=WORKERS),
         run_config=RunConfig(storage_path="/tmp/raytpu_train_bench"),
     ).fit()
+    try:
+        recorder = _recorder_overhead()
+    except Exception as e:
+        recorder = {"recorder_error": f"{type(e).__name__}: {e}"}
     raytpu.shutdown()
     if result.error is not None:
         print(json.dumps({"metric": "train_orchestration_overhead_pct",
@@ -231,6 +273,7 @@ def main() -> None:
                    "steps": STEPS, "epochs": EPOCHS,
                    "workers": WORKERS, "best_of": REPEATS,
                    "reference_bar_pct": REFERENCE_OVERHEAD_PCT,
+                   **recorder,
                    "note": "gang time = slowest rank (max-allreduce); "
                            "per-epoch train.report live on every rank; "
                            "gang spawn/rendezvous excluded (the "

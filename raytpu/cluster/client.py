@@ -40,6 +40,7 @@ from raytpu.util.errors import (
     PlacementInfeasibleError,
     RpcTimeoutError,
 )
+from raytpu.util import task_events
 from raytpu.util import tracing
 from raytpu.util.resilience import Deadline, RetryPolicy, breaker_for
 from raytpu.core.ids import (
@@ -57,6 +58,18 @@ from raytpu.runtime.task_spec import ArgKind, SchedulingKind, TaskSpec
 import logging
 
 logger = logging.getLogger(__name__)
+
+
+def _ambient_task_id() -> Optional[str]:
+    """The enclosing task's id when submitting from inside a worker
+    (nested tasks) — the event's parent link; None from a driver."""
+    try:
+        from raytpu.runtime import context as _ctx
+
+        tid = _ctx.current().task_id
+        return tid.hex() if tid is not None else None
+    except Exception:
+        return None
 
 
 class _InFlight:
@@ -223,6 +236,13 @@ class ClusterBackend:
             if tracing.enabled():
                 attrs["task"] = spec.task_id.hex()[:16]
                 attrs["name"] = spec.name
+            # Inside the span on purpose: the emitted event captures the
+            # ambient trace id, cross-linking timeline <-> chrome trace.
+            if task_events.enabled():
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.SUBMITTED,
+                                 name=spec.name, attempt=spec.attempt,
+                                 parent_task_id=_ambient_task_id())
             self._route_task(spec)
         return refs
 
@@ -296,6 +316,10 @@ class ClusterBackend:
         if node_id is None:
             with self._lock:
                 self._pending.append(spec)
+            if task_events.enabled():
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.PENDING_SCHED,
+                                 name=spec.name, attempt=spec.attempt)
             return
         self._send_to_node(spec, node_id, "submit_task")
 
@@ -359,6 +383,11 @@ class ClusterBackend:
             with self._lock:
                 self._inflight.pop(spec.task_id, None)
                 self._pending.append(spec)
+            if task_events.enabled():
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.PENDING_SCHED,
+                                 name=spec.name, attempt=spec.attempt,
+                                 error="node submit failed; requeued")
 
     def _push_local_args(self, spec: TaskSpec, addr: str) -> None:
         """Proxy-mode drivers host no serve endpoint, so nodes cannot pull
@@ -747,6 +776,16 @@ class ClusterBackend:
         sv = serialize(err)
         for oid in spec.return_ids():
             self.store.put(oid, sv)
+        if task_events.enabled():
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.FAILED,
+                             name=spec.name, attempt=spec.attempt,
+                             error=f"{type(err).__name__}: {err}")
+            log_dir = getattr(self._node, "log_dir", None)
+            if log_dir:
+                task_events.write_postmortem(
+                    log_dir, f"task {spec.name} failed terminally "
+                    f"(attempt {spec.attempt}): {type(err).__name__}")
 
     def _on_node_event(self, data: dict) -> None:
         if data.get("event") != "removed":
@@ -771,6 +810,12 @@ class ClusterBackend:
                 continue
             if spec.attempt < spec.max_retries:
                 spec.attempt += 1
+                if task_events.enabled():
+                    task_events.emit(
+                        "task", spec.task_id.hex(),
+                        task_events.TaskTransition.RETRIED,
+                        name=spec.name, attempt=spec.attempt,
+                        error=f"node {node_id[:12]} died")
                 try:
                     self._route_task(spec)
                 except Exception as e:

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import failpoints
+from raytpu.util import task_events
 from raytpu.util import tracing
 from raytpu.util.errors import PlacementInfeasibleError
 from raytpu.util.failpoints import DROP, failpoint
@@ -250,6 +251,14 @@ class HeadServer:
         # Structured-event ring (reference: dashboard event module over
         # RAY_EVENT files); nodes forward their events here.
         self._events = deque(maxlen=2000)
+        # Flight recorder (reference: GcsTaskManager storage): lifecycle
+        # events batch-shipped from every process, folded into one
+        # bounded, indexed store the state API queries.
+        from raytpu.core.config import cfg as _cfg
+
+        self._task_event_store = task_events.TaskEventStore(
+            per_kind=_cfg.task_event_store_per_kind,
+            events_per_entity=_cfg.task_event_store_events_per_entity)
         self._object_waiters: Dict[str, List[Peer]] = {}
         # Push-path demand (reference: push_manager.h): object -> nodes
         # whose pull loops asked for it before any copy existed. When the
@@ -300,6 +309,14 @@ class HeadServer:
         h("task_done", self._task_done)
         h("report_event", self._report_event)
         h("list_events", self._list_events)
+        # Flight-recorder surface: batch ingest (notify path for drivers
+        # and worker relays; heartbeats piggyback instead) + the state
+        # API's list/summary/timeline queries.
+        h("report_task_events", self._h_report_task_events)
+        h("state_list", self._state_list)
+        h("state_summary", self._state_summary)
+        h("state_timeline", self._state_timeline)
+        h("task_events_stats", self._task_events_stats)
         h("create_pg", self._create_pg)
         h("remove_pg", self._remove_pg)
         h("pg_info", self._pg_info)
@@ -466,13 +483,22 @@ class HeadServer:
             peer.meta["node_id"] = node_id
             self._nodes[node_id] = entry
             snap = [n.snapshot() for n in self._nodes.values() if n.alive]
+        if task_events.enabled():
+            task_events.emit("node", node_id,
+                             task_events.TaskTransition.NODE_ADDED,
+                             name=labels.get("role") or "node",
+                             node_id=node_id)
         self._publish("nodes", {"event": "added", "node": entry.snapshot()})
         return {"nodes": snap}
 
     def _heartbeat(self, peer: Peer, node_id: str,
-                   available: Dict[str, float], seq: int = 0) -> None:
+                   available: Dict[str, float], seq: int = 0,
+                   events: Optional[List[dict]] = None,
+                   dropped: int = 0) -> None:
         # drop => the head never saw this heartbeat; enough consecutive
-        # drops and the health loop declares the node dead.
+        # drops and the health loop declares the node dead. The node
+        # requeues the piggybacked event batch on call failure, so a
+        # dropped heartbeat loses liveness proof but not flight records.
         if failpoint("head.heartbeat.handle") is DROP:
             return
         with self._lock:
@@ -485,6 +511,8 @@ class HeadServer:
                 if seq == 0 or seq >= entry.avail_seq:
                     entry.available = dict(available)
                     entry.avail_seq = max(entry.avail_seq, seq)
+        if events or dropped:
+            self._task_event_store.add_batch(events or [], dropped)
 
     def _resource_update(self, peer: Peer, node_id: str,
                          available: Dict[str, float],
@@ -589,6 +617,7 @@ class HeadServer:
 
     def _health_loop(self) -> None:
         while not self._stop.wait(CHECK_PERIOD_S):
+            self._ingest_local_events()
             now = time.monotonic()
             dead = []
             with self._lock:
@@ -621,6 +650,10 @@ class HeadServer:
                 pg["nodes"] = [
                     (None if n == node_id else n) for n in pg["nodes"]
                 ]
+        if task_events.enabled():
+            task_events.emit("node", node_id,
+                             task_events.TaskTransition.NODE_DIED,
+                             error=reason, node_id=node_id)
         self._publish("nodes", {"event": "removed", "node_id": node_id,
                                 "reason": reason})
         from raytpu.util.events import record_event
@@ -704,6 +737,48 @@ class HeadServer:
         if int(limit) <= 0:
             return []
         return events[-int(limit):]
+
+    # -- flight recorder ----------------------------------------------------
+
+    def _ingest_local_events(self) -> None:
+        """Fold the head's OWN process ring into the store. Runs from the
+        health loop and lazily before every state query, so head-emitted
+        transitions (NODE_*/SCHEDULED/actor lifecycle) are never staler
+        than one query."""
+        if not task_events.enabled():
+            return
+        batch, dropped = task_events.drain()
+        if batch or dropped:
+            self._task_event_store.add_batch(batch, dropped)
+
+    def _h_report_task_events(self, peer: Peer, events: List[dict],
+                              dropped: int = 0) -> None:
+        """Batch ingest off the notify path (drivers flush through their
+        serve-only node daemon; worker batches arrive relayed via their
+        node's heartbeat instead)."""
+        self._task_event_store.add_batch(events or [], dropped)
+
+    def _state_list(self, peer: Peer, kind: str,
+                    state: Optional[str] = None, node: Optional[str] = None,
+                    name: Optional[str] = None, limit: int = 100,
+                    detail: bool = False) -> List[dict]:
+        self._ingest_local_events()
+        return self._task_event_store.list(kind, state=state, node=node,
+                                           name=name, limit=limit,
+                                           detail=detail)
+
+    def _state_summary(self, peer: Peer, kind: str) -> dict:
+        self._ingest_local_events()
+        return self._task_event_store.summary(kind)
+
+    def _state_timeline(self, peer: Peer, entity_id: str,
+                        kind: str = "task") -> Optional[dict]:
+        self._ingest_local_events()
+        return self._task_event_store.get(kind, entity_id)
+
+    def _task_events_stats(self, peer: Peer) -> dict:
+        self._ingest_local_events()
+        return self._task_event_store.stats()
 
     def _borrow_info(self, peer: Peer) -> dict:
         with self._lock:
@@ -815,6 +890,12 @@ class HeadServer:
             node_id = self._schedule_impl(peer, resources, node_hint,
                                           spread_threshold, req_id)
             attrs["node"] = node_id
+            # req_id IS the task id (clients key their schedule requests
+            # by it), so the decision lands on the task's timeline.
+            if node_id is not None and req_id and task_events.enabled():
+                task_events.emit("task", req_id,
+                                 task_events.TaskTransition.SCHEDULED,
+                                 node_id=node_id)
             return node_id
 
     def _schedule_impl(self, peer: Peer, resources: Dict[str, float],
@@ -893,6 +974,10 @@ class HeadServer:
                     "state": "alive",
                 }
             self._persist_actor(actor_id)
+        if task_events.enabled():
+            task_events.emit("actor", actor_id,
+                             task_events.TaskTransition.CREATED,
+                             name=name, node_id=node_id)
         self._publish("actors", {"event": "registered",
                                  "actor_id": actor_id, "node_id": node_id})
 
@@ -944,6 +1029,12 @@ class HeadServer:
                 if info.get("name"):
                     self._named.pop((info["namespace"], info["name"]), None)
             self._persist_actor(actor_id)
+        if task_events.enabled():
+            task_events.emit(
+                "actor", actor_id,
+                task_events.TaskTransition.RESTARTING if restartable
+                else task_events.TaskTransition.DEAD,
+                attempt=info.get("restarts_used", 0), error=reason)
         if restartable:
             self._publish("actors", {"event": "restarting",
                                      "actor_id": actor_id, "reason": reason})
@@ -991,6 +1082,12 @@ class HeadServer:
                     continue
                 # The node's create_actor re-registers the actor (state
                 # flips to alive there).
+                if task_events.enabled():
+                    task_events.emit(
+                        "actor", actor_id,
+                        task_events.TaskTransition.RESTARTED,
+                        attempt=info.get("restarts_used", 0),
+                        node_id=node_id)
                 self._publish("actors", {"event": "restarted",
                                          "actor_id": actor_id,
                                          "node_id": node_id})

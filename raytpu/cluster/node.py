@@ -30,6 +30,7 @@ from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
 from raytpu.core.config import cfg
 from raytpu.util import failpoints
+from raytpu.util import task_events
 from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.events import record_event
@@ -130,6 +131,11 @@ class _ProcActorRuntime:
             self.ready_event.set()
             return
         self.ready_event.set()
+        if task_events.enabled():
+            task_events.emit("actor", self.actor_id.hex(),
+                             task_events.TaskTransition.CREATED,
+                             name=self.name,
+                             worker_id=self.handle.worker_id.hex())
         if self.max_concurrency > 1:
             self._pump_concurrent()
         else:
@@ -213,6 +219,11 @@ class _ProcActorRuntime:
                 return
             self.dead = True
             self.death_reason = reason
+        if task_events.enabled():
+            task_events.emit("actor", self.actor_id.hex(),
+                             task_events.TaskTransition.DEAD,
+                             name=self.name, error=reason)
+        with self.state_lock:
             drained = []
             while True:
                 try:
@@ -261,6 +272,9 @@ class NodeBackend(LocalBackend):
         def _on_put(oid):
             if chained is not None:
                 chained(oid)
+            if task_events.enabled():
+                task_events.emit("object", oid.hex(),
+                                 task_events.TaskTransition.PUT)
             if self.on_object_local is not None:
                 self.on_object_local(oid)
 
@@ -380,6 +394,11 @@ class NodeBackend(LocalBackend):
                 WorkerCrashedError(f"worker lease failed: {e}")
         with self._lock:
             self._task_worker[spec.task_id] = handle
+        if task_events.enabled():
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.LEASED,
+                             name=spec.name, attempt=spec.attempt,
+                             worker_id=handle.worker_id.hex())
         try:
             reply = handle.client.call(
                 "execute", wire.dumps(spec), timeout=None)
@@ -582,6 +601,10 @@ class NodeServer:
         # Distributed tracing: this daemon's span buffer plus every pool
         # worker's (the head's trace_dump fans out here).
         h("trace_dump", self._h_trace_dump)
+        # Flight recorder: pool workers flush their event rings here
+        # after each task; the batches relay head-ward on the next
+        # heartbeat (one ship path, no extra connections).
+        h("report_task_events", self._h_report_task_events)
         # Worker-process plane
         h("register_worker", self._h_register_worker)
         h("task_blocked", self._h_task_blocked)
@@ -655,6 +678,7 @@ class NodeServer:
         tracing.set_process_identity(
             "driver" if self.labels.get("role") == "driver" else "node",
             self.node_id.hex()[:12])
+        task_events.set_emitter_identity(node_id=self.node_id.hex())
         if self._worker_processes:
             from raytpu.cluster.worker_pool import WorkerPool
 
@@ -821,10 +845,26 @@ class NodeServer:
                 if failpoint("node.heartbeat.emit") is DROP:
                     continue
                 avail, seq = self._snapshot_avail()
-                self._head.call(
-                    "heartbeat", self.node_id.hex(), avail, seq,
-                    timeout=tuning.CONTROL_CALL_TIMEOUT_S,
-                )
+                if task_events.enabled():
+                    # Piggyback the flight-recorder batch on the liveness
+                    # beat (reference: task events ride the raylet's
+                    # existing GCS traffic). A failed call requeues the
+                    # batch so records survive a head bounce.
+                    batch, dropped = task_events.drain()
+                    try:
+                        self._head.call(
+                            "heartbeat", self.node_id.hex(), avail, seq,
+                            batch, dropped,
+                            timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                        )
+                    except Exception:
+                        task_events.requeue(batch, dropped)
+                        raise
+                else:
+                    self._head.call(
+                        "heartbeat", self.node_id.hex(), avail, seq,
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                    )
                 backoff = 0.0
             except Exception:
                 if self._stop.is_set():
@@ -932,6 +972,11 @@ class NodeServer:
                 with self._notify_buffer_lock:
                     self._notify_buffer.appendleft((method, args))
                 break
+        # The store the old head held is gone; dump this node's flight
+        # record to disk so the window around the bounce stays debuggable.
+        if task_events.enabled() and self.log_dir:
+            task_events.write_postmortem(
+                self.log_dir, "head bounce: node re-registered")
         return True
 
     # -- head reporting ----------------------------------------------------
@@ -950,6 +995,12 @@ class NodeServer:
         except Exception:
             with self._notify_buffer_lock:
                 self._notify_buffer.append((method, args))
+
+    def _h_report_task_events(self, peer: Peer, events: List[dict],
+                              dropped: int = 0) -> None:
+        """Fold a pool worker's flushed event batch into this daemon's
+        ring; the next heartbeat relays it to the head's store."""
+        task_events.ingest(events or [], dropped)
 
     def _report_object(self, oid: ObjectID) -> None:
         self._wake_obj_waiters(oid.hex())
@@ -1114,6 +1165,11 @@ class NodeServer:
                     if blob is not None:
                         self.backend.store.put(
                             oid, SerializedValue.from_buffer(blob))
+                        if task_events.enabled():
+                            task_events.emit(
+                                "object", oid.hex(),
+                                task_events.TaskTransition.TRANSFERRED,
+                                name="pull")
                         return
                 if not locs:
                     # No copy anywhere: nudge the owner to reconstruct via
@@ -1418,6 +1474,10 @@ class NodeServer:
             self.backend.store.put(
                 oid, SerializedValue.from_buffer(bytes(buf)))
         self.push_rx_completed += 1
+        if task_events.enabled():
+            task_events.emit("object", oid_hex,
+                             task_events.TaskTransition.TRANSFERRED,
+                             name="push")
         return True
 
     def _h_push_object_abort(self, peer: Peer, oid_hex: str) -> None:
@@ -1776,6 +1836,20 @@ class NodeServer:
                 "running": [t.hex()[:8] for t in b._running],
                 "store_size": b.store.size(),
                 "actors": [a.hex()[:8] for a in b._actors],
+                # Full records (state API's list_actors must not drop
+                # name/pending_tasks); "actors" above keeps the compact
+                # shape existing tooling greps for.
+                "actor_records": [
+                    {
+                        "actor_id": aid.hex(),
+                        "name": rt.name,
+                        "state": "DEAD" if rt.dead else "ALIVE",
+                        "max_concurrency": rt.max_concurrency,
+                        "detached": rt.detached,
+                        "pending_tasks": rt.queue.qsize(),
+                    }
+                    for aid, rt in b._actors.items()
+                ],
                 "available": b.node.available.to_dict(),
                 "push_rx_completed": self.push_rx_completed,
                 "push_tx_completed": self.push_tx_completed,

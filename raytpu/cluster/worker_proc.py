@@ -35,7 +35,7 @@ from raytpu.cluster import constants as tuning
 from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
-from raytpu.util import tracing
+from raytpu.util import task_events, tracing
 from raytpu.util.failpoints import failpoint
 from raytpu.core.errors import ActorDiedError, TaskError
 from raytpu.core.ids import JobID, NodeID, ObjectID, TaskID
@@ -341,13 +341,40 @@ class _WorkerHost:
         # kill_process here is the canonical "worker dies mid-task" chaos
         # scenario: the task was accepted but no result ever comes back.
         failpoint("worker.task.run")
+        if task_events.enabled():
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.RUNNING,
+                             name=spec.name, attempt=spec.attempt)
         # store_errors=False: the daemon owns retry policy — it stores the
         # error into the return slots only once retries are exhausted.
         err = self.worker.execute_task(spec, self.get_serialized,
                                        store_errors=False)
+        if task_events.enabled():
+            if err is None:
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.FINISHED,
+                                 name=spec.name, attempt=spec.attempt)
+            else:
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.FAILED,
+                                 name=spec.name, attempt=spec.attempt,
+                                 error=f"{type(err).__name__}: {err}"[:256])
+            self.flush_task_events()
         return {"results": self.collect_results(spec),
                 "borrows": self.collect_borrows(spec),
                 "error": None if err is None else _dump_err(spec.name, err)}
+
+    def flush_task_events(self) -> None:
+        """Ship this worker's ring to the node daemon (which folds it into
+        its own ring for the next heartbeat hop to the head). Requeued on
+        failure so a transient daemon hiccup never loses events."""
+        batch, dropped = task_events.drain()
+        if not batch and not dropped:
+            return
+        try:
+            self.node.notify("report_task_events", batch, dropped)
+        except Exception:
+            task_events.requeue(batch, dropped)
 
     def create_actor(self, spec: TaskSpec) -> dict:
         self.actor_spec = spec
@@ -393,6 +420,15 @@ class _WorkerHost:
         else:
             err = self.worker.execute_task(
                 spec, self.get_serialized, actor_instance=self.actor_instance)
+        if task_events.enabled():
+            task_events.emit(
+                "task", spec.task_id.hex(),
+                task_events.TaskTransition.FINISHED if err is None
+                else task_events.TaskTransition.FAILED,
+                name=spec.name, attempt=spec.attempt,
+                error=None if err is None
+                else f"{type(err).__name__}: {err}"[:256])
+            self.flush_task_events()
         return {"results": self.collect_results(spec),
                 "borrows": self.collect_borrows(spec),
                 "error": None if err is None else _dump_err(spec.name, err)}
@@ -465,6 +501,8 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     ap.add_argument("--node-id", required=True)
     args = ap.parse_args()
     tracing.set_process_identity("worker", args.worker_id[:12])
+    task_events.set_emitter_identity(node_id=args.node_id,
+                                     worker_id=args.worker_id)
 
     host = _WorkerHost(
         args.node, args.shm or None,

@@ -137,6 +137,11 @@ declare("proxy_relay_timeout_s", 120.0)
 declare("metrics_report_interval_ms", 2500)
 declare("task_events_buffer_size", 100000)
 declare("enable_timeline", True)
+# Head-side flight-recorder store: max entities kept per kind
+# (task/actor/object/node) before FIFO eviction, and max events folded
+# per entity (reference: RAY_task_events_max_num_task_in_gcs).
+declare("task_event_store_per_kind", 4096)
+declare("task_event_store_events_per_entity", 256)
 # Log infrastructure (reference: per-process log files under the session
 # dir + the log monitor streaming worker output to drivers).
 declare("session_dir", "")  # empty = /tmp/raytpu/session_<node pid>

@@ -72,7 +72,9 @@ class DashboardServer:
         out: Dict[str, Any] = {}
         for key, fn in (
             ("nodes", state.list_nodes),
-            ("actors", state.list_actors),
+            # list_actors wraps its rows with partial/errors markers;
+            # the dashboard sections keep the flat-list shape.
+            ("actors", lambda: state.list_actors().get("actors", [])),
             ("tasks", lambda: state.list_tasks()),
             ("placement_groups", state.list_placement_groups),
             ("task_summary", state.summarize_tasks),
@@ -451,12 +453,93 @@ class DashboardServer:
                               f"{total:,} KiB traced (weights = KiB)")
             return web.Response(text=svg, content_type="image/svg+xml")
 
+        async def api_state_list(request):
+            """Flight-recorder state listings (reference: the state API
+            REST endpoints over GcsTaskManager). ?state= ?node= ?name=
+            filter; ?detail=1 attaches event timelines."""
+            from raytpu.state import api as state
+
+            kind = request.match_info["kind"]
+            q = request.query
+            detail = q.get("detail", "0") == "1"
+            try:
+                limit = int(q.get("limit", 100))
+            except ValueError:
+                return web.Response(status=400,
+                                    text="limit must be an integer")
+            loop = asyncio.get_running_loop()
+            try:
+                if kind == "tasks":
+                    data = await loop.run_in_executor(
+                        None, lambda: state.list_tasks(
+                            state=q.get("state"), node=q.get("node"),
+                            name=q.get("name"), detail=detail,
+                            limit=limit))
+                elif kind == "actors":
+                    data = await loop.run_in_executor(
+                        None, lambda: state.list_actors(
+                            state=q.get("state"), node=q.get("node"),
+                            name=q.get("name"), detail=detail))
+                elif kind == "objects":
+                    data = await loop.run_in_executor(
+                        None, lambda: state.list_objects(detail=detail))
+                elif kind == "nodes":
+                    data = await loop.run_in_executor(
+                        None, lambda: state.list_nodes(detail=detail))
+                else:
+                    return web.Response(status=404,
+                                        text=f"unknown kind {kind!r}")
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=503)
+            return web.json_response(data)
+
+        async def api_state_summary(request):
+            from raytpu.state import api as state
+
+            kind = request.match_info["kind"]
+            if kind not in ("tasks", "actors"):
+                return web.Response(status=404,
+                                    text=f"no summary for {kind!r}")
+            fn = (state.summary_tasks if kind == "tasks"
+                  else state.summary_actors)
+            loop = asyncio.get_running_loop()
+            try:
+                data = await loop.run_in_executor(None, fn)
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=503)
+            return web.json_response(data)
+
+        async def api_state_timeline(request):
+            from raytpu.state import api as state
+
+            entity_id = request.match_info["entity_id"]
+            kind = request.query.get("kind", "task")
+            loop = asyncio.get_running_loop()
+            try:
+                data = await loop.run_in_executor(
+                    None, state.get_timeline, entity_id, kind)
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=503)
+            if data is None:
+                return web.Response(
+                    status=404,
+                    text=f"no recorded {kind} matching {entity_id!r}")
+            return web.json_response(data)
+
         app = web.Application()
         app.router.add_get("/", index)
         app.router.add_get("/api/summary", api_summary)
-        # /api/trace must register before the /api/{section} wildcard or
-        # the section handler would 404 it as an unknown snapshot key.
+        # /api/trace and /api/state/* must register before the
+        # /api/{section} wildcard or the section handler would 404 them
+        # as unknown snapshot keys.
         app.router.add_get("/api/trace", api_trace)
+        app.router.add_get("/api/state/summary/{kind}", api_state_summary)
+        app.router.add_get("/api/state/timeline/{entity_id}",
+                           api_state_timeline)
+        app.router.add_get("/api/state/{kind}", api_state_list)
         app.router.add_get("/api/{section}", api_section)
         app.router.add_get("/timeline", timeline)
         app.router.add_get("/metrics", metrics)

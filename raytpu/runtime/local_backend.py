@@ -41,6 +41,7 @@ from raytpu.runtime.object_store import MemoryStore
 from raytpu.runtime.serialization import deserialize, serialize
 from raytpu.runtime.task_spec import ArgKind, SchedulingKind, TaskSpec
 from raytpu.runtime.worker import Worker
+from raytpu.util import task_events
 
 
 @dataclass
@@ -231,6 +232,11 @@ class _ActorRuntime:
             self.ready_event.set()
             return
         self.ready_event.set()
+        if task_events.enabled():
+            task_events.emit("actor", self.actor_id.hex(),
+                             task_events.TaskTransition.CREATED,
+                             name=self.name,
+                             attempt=self.creation_spec.attempt)
 
         if self.is_async:
             self._run_async_loop()
@@ -354,6 +360,10 @@ class _ActorRuntime:
         self.backend._task_finished(spec)
 
     def _die(self, reason: str):
+        if task_events.enabled():
+            task_events.emit("actor", self.actor_id.hex(),
+                             task_events.TaskTransition.DEAD,
+                             name=self.name, error=reason)
         with self.state_lock:
             self.dead = True
             self.death_reason = reason
@@ -449,6 +459,18 @@ class LocalBackend:
                 self._ready.append(spec.task_id)
                 self._cv.notify_all()
         self._record_event(spec, "submitted")
+        if task_events.enabled():
+            parent = None
+            try:
+                from raytpu.runtime import context as _rt_ctx
+                tid = _rt_ctx.current().task_id
+                parent = tid.hex() if tid is not None else None
+            except Exception:
+                pass
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.SUBMITTED,
+                             name=spec.name, attempt=spec.attempt,
+                             parent_task_id=parent)
         return refs
 
     def create_actor(self, spec: TaskSpec) -> None:
@@ -492,6 +514,10 @@ class LocalBackend:
         # direct_actor_task_submitter.cc).
         actor.submit(spec)
         self._record_event(spec, "submitted")
+        if task_events.enabled():
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.SUBMITTED,
+                             name=spec.name, attempt=spec.attempt)
         return refs
 
     def get_actor_handle_info(self, name: str, namespace: str):
@@ -852,6 +878,10 @@ class LocalBackend:
     def _run_task(self, rec: _TaskRecord):
         spec = rec.spec
         self._record_event(spec, "running")
+        if task_events.enabled():
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.RUNNING,
+                             name=spec.name, attempt=spec.attempt)
         if spec.is_actor_creation():
             with self._lock:
                 runtime = self._actors.get(spec.actor_creation.actor_id)
@@ -870,6 +900,10 @@ class LocalBackend:
                 rec.state = "done"
                 self._cv.notify_all()
             self._record_event(spec, "finished")
+            if task_events.enabled():
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.FINISHED,
+                                 name=spec.name, attempt=spec.attempt)
             self._after_task(spec)
             return
         err = self._execute_plain(rec)
@@ -878,6 +912,13 @@ class LocalBackend:
             retried = True
         elif err is not None:
             self.worker._store_error(spec.return_ids(), spec, err)
+        if err is not None and task_events.enabled():
+            # Emitted before the attempt counter moves so FAILED carries
+            # the attempt that actually failed.
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.FAILED,
+                             name=spec.name, attempt=spec.attempt,
+                             error=f"{type(err).__name__}: {err}"[:256])
         with self._lock:
             self._running.pop(spec.task_id, None)
             if rec.released_while_blocked == 0:
@@ -900,6 +941,15 @@ class LocalBackend:
                 rec.state = "done"
             self._cv.notify_all()
         self._record_event(spec, "finished" if err is None else "failed")
+        if task_events.enabled():
+            if retried:
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.RETRIED,
+                                 name=spec.name, attempt=spec.attempt)
+            elif err is None:
+                task_events.emit("task", spec.task_id.hex(),
+                                 task_events.TaskTransition.FINISHED,
+                                 name=spec.name, attempt=spec.attempt)
         if not retried:
             self._after_task(spec)
 
@@ -951,6 +1001,10 @@ class LocalBackend:
     def _task_finished(self, spec: TaskSpec):
         """Called by actor runtimes when an actor task completes."""
         self._record_event(spec, "finished")
+        if task_events.enabled():
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.FINISHED,
+                             name=spec.name, attempt=spec.attempt)
         self._after_task(spec)
 
     def _actor_died(self, runtime: _ActorRuntime):
@@ -987,6 +1041,11 @@ class LocalBackend:
         with self._lock:
             self._actor_restarts[aid] = used + 1
         spec.attempt += 1
+        if task_events.enabled():
+            task_events.emit("actor", aid.hex(),
+                             task_events.TaskTransition.RESTARTED,
+                             name=runtime.name, attempt=spec.attempt,
+                             error=runtime.death_reason)
         try:
             self.create_actor(spec)
         except Exception:

@@ -168,6 +168,60 @@ def _cmd_events(args) -> int:
     return 0
 
 
+def _cmd_state(args) -> int:
+    """Query the flight-recorder-backed state API (reference: ``ray
+    list tasks`` / ``ray summary tasks`` over the GCS task-event
+    store)."""
+    import raytpu
+    from raytpu.state import api as state
+
+    raytpu.init(address=args.address, ignore_reinit_error=True)
+    if args.state_cmd == "list":
+        kind = args.kind
+        if kind == "tasks":
+            rows = state.list_tasks(state=args.state, node=args.node,
+                                    name=args.name, detail=args.detail,
+                                    limit=args.limit)
+        elif kind == "actors":
+            res = state.list_actors(state=args.state, node=args.node,
+                                    name=args.name, detail=args.detail)
+            rows = res["actors"]
+            if res["partial"]:
+                print(f"WARNING: partial listing — "
+                      f"{len(res['errors'])} node(s) unreachable:",
+                      file=sys.stderr)
+                for err in res["errors"]:
+                    print(f"  {str(err['node_id'])[:12]}: {err['error']}",
+                          file=sys.stderr)
+        elif kind == "objects":
+            rows = state.list_objects(detail=args.detail)
+        else:  # nodes
+            rows = state.list_nodes(detail=args.detail)
+        if args.detail:
+            print(json.dumps(rows, indent=2, default=str))
+            return 0
+        for r in rows:
+            rid = (r.get("task_id") or r.get("actor_id")
+                   or r.get("object_id") or r.get("node_id") or "?")
+            print(f"{str(rid)[:16]:16s} "
+                  f"{str(r.get('state', '-')):22s} "
+                  f"{str(r.get('name') or '')}")
+        return 0
+    if args.state_cmd == "summary":
+        fn = (state.summary_tasks if args.kind == "tasks"
+              else state.summary_actors)
+        print(json.dumps(fn(), indent=2, default=str))
+        return 0
+    # timeline
+    rec = state.get_timeline(args.entity_id, kind=args.kind)
+    if rec is None:
+        print(f"no recorded {args.kind} matching {args.entity_id!r} "
+              f"(is RAYTPU_TASK_EVENTS=1 set?)", file=sys.stderr)
+        return 1
+    print(json.dumps(rec, indent=2, default=str))
+    return 0
+
+
 def _cluster_worker_nodes(address: str):
     """Live non-driver nodes from the head: ``[(node_id, addr), ...]``
     (shared by every fan-out command so they always agree on targets)."""
@@ -478,6 +532,36 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--label", default=None)
     s.add_argument("--limit", type=int, default=50)
     s.set_defaults(fn=_cmd_events)
+
+    s = sub.add_parser(
+        "state", help="task/actor/object/node lifecycle state "
+                      "(reference: ray list / ray summary over the GCS "
+                      "task-event store)")
+    ssub = s.add_subparsers(dest="state_cmd", required=True)
+    st = ssub.add_parser("list", help="list entities of one kind")
+    st.add_argument("kind",
+                    choices=("tasks", "actors", "objects", "nodes"))
+    st.add_argument("--address", default=None)
+    st.add_argument("--state", default=None,
+                    help="filter: lifecycle state (e.g. FAILED, RUNNING)")
+    st.add_argument("--node", default=None, help="node id prefix filter")
+    st.add_argument("--name", default=None, help="name substring filter")
+    st.add_argument("--detail", action="store_true",
+                    help="full records incl. event timelines, as JSON")
+    st.add_argument("--limit", type=int, default=100)
+    st.set_defaults(fn=_cmd_state)
+    st = ssub.add_parser("summary",
+                         help="counts by state x name + latency pcts")
+    st.add_argument("kind", choices=("tasks", "actors"))
+    st.add_argument("--address", default=None)
+    st.set_defaults(fn=_cmd_state)
+    st = ssub.add_parser("timeline",
+                         help="one entity's full lifecycle record")
+    st.add_argument("entity_id", help="id (unique prefix accepted)")
+    st.add_argument("--kind", default="task",
+                    choices=("task", "actor", "object", "node"))
+    st.add_argument("--address", default=None)
+    st.set_defaults(fn=_cmd_state)
 
     s = sub.add_parser(
         "stack", help="live stack dump of cluster workers (reference: "

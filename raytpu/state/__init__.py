@@ -1,16 +1,21 @@
 """raytpu.state — cluster introspection (reference: python/ray/util/state/)."""
 
 from raytpu.state.api import (
+    get_timeline,
     list_actors,
+    list_events,
     list_nodes,
     list_objects,
     list_placement_groups,
     list_tasks,
     object_summary,
     summarize_tasks,
+    summary_actors,
+    summary_tasks,
 )
 
 __all__ = [
-    "list_actors", "list_nodes", "list_objects", "list_placement_groups",
-    "list_tasks", "object_summary", "summarize_tasks",
+    "get_timeline", "list_actors", "list_events", "list_nodes",
+    "list_objects", "list_placement_groups", "list_tasks",
+    "object_summary", "summarize_tasks", "summary_actors", "summary_tasks",
 ]

@@ -2,10 +2,21 @@
 
 Reference analogue: ``python/ray/util/state/api.py`` (``ray list actors /
 tasks / objects / nodes / placement-groups`` and summaries) backed by the
-GCS task-event store (``GcsTaskManager``). Ours reads the live backend:
-single-process mode inspects the local scheduler's tables directly;
-cluster mode aggregates the head's directories plus each node's
-``debug_state``.
+GCS task-event store (``GcsTaskManager``). Ours reads two planes and
+merges them:
+
+- **Live tables** — single-process mode inspects the local scheduler's
+  tables directly; cluster mode aggregates the head's directories plus
+  each node's ``debug_state``.
+- **The flight recorder** (:mod:`raytpu.util.task_events`) — lifecycle
+  timelines for finished/failed/retried entities that live tables have
+  already forgotten. Cluster mode queries the head's
+  :class:`~raytpu.util.task_events.TaskEventStore`; local mode folds the
+  in-process ring on demand.
+
+``detail=True`` attaches the per-entity event timeline (ts-sorted), and
+``state``/``node``/``name`` filter server-side so a busy head ships only
+the rows asked for.
 """
 
 from __future__ import annotations
@@ -25,87 +36,208 @@ def _is_cluster(b) -> bool:
     return type(b).__name__ == "ClusterBackend"
 
 
-def list_nodes() -> List[Dict[str, Any]]:
-    import raytpu
-
-    return raytpu.nodes()
+# -- flight-recorder plumbing -------------------------------------------------
 
 
-def list_actors() -> List[Dict[str, Any]]:
+def _local_store():
+    """Fold the in-process event ring into a throwaway store (local mode
+    has no head to ship to — the ring IS the record)."""
+    from raytpu.util import task_events
+
+    store = task_events.TaskEventStore()
+    store.add_batch(task_events.get_events())
+    return store
+
+
+def _recorder_list(kind: str, state: Optional[str] = None,
+                   node: Optional[str] = None, name: Optional[str] = None,
+                   limit: int = 1000,
+                   detail: bool = False) -> Optional[List[dict]]:
+    """Flight-recorder records for ``kind``; None when unavailable
+    (recorder never armed locally, or head unreachable)."""
     b = _backend()
     if _is_cluster(b):
-        out = []
-        for info in b._head.call("list_nodes"):
+        try:
+            return b._head.call("state_list", kind, state, node, name,
+                                limit, detail)
+        except Exception:
+            return None
+    from raytpu.util import task_events
+
+    if not task_events.enabled() and not task_events.get_events():
+        return None
+    return _local_store().list(kind, state=state, node=node, name=name,
+                               limit=limit, detail=detail)
+
+
+def _norm_task(rec: dict) -> Dict[str, Any]:
+    """Recorder record → the state-API task row shape."""
+    out: Dict[str, Any] = {
+        "task_id": rec.get("id"),
+        "name": rec.get("name"),
+        "state": rec.get("state"),
+        "node_id": rec.get("node_id"),
+        "attempt": rec.get("attempt", 0),
+        "num_events": rec.get("num_events", 0),
+        "first_ts": rec.get("first_ts"),
+        "last_ts": rec.get("last_ts"),
+    }
+    for k in ("error", "trace_id", "parent_task_id", "worker_id"):
+        if rec.get(k):
+            out[k] = rec[k]
+    if "events" in rec:
+        out["events"] = rec["events"]
+    return out
+
+
+def _match(row: dict, state: Optional[str], node: Optional[str],
+           name: Optional[str]) -> bool:
+    if state is not None and row.get("state") != state:
+        return False
+    if node and not str(row.get("node_id") or "").startswith(node):
+        return False
+    if name and name not in str(row.get("name") or ""):
+        return False
+    return True
+
+
+# -- listings -----------------------------------------------------------------
+
+
+def list_nodes(detail: bool = False) -> List[Dict[str, Any]]:
+    import raytpu
+
+    nodes = raytpu.nodes()
+    if detail:
+        recs = _recorder_list("node", limit=0, detail=True) or []
+        by_id = {r.get("id"): r for r in recs}
+        for n in nodes:
+            rec = by_id.get(n.get("node_id"))
+            if rec:
+                n["events"] = rec.get("events", [])
+    return nodes
+
+
+def list_actors(state: Optional[str] = None, node: Optional[str] = None,
+                name: Optional[str] = None,
+                detail: bool = False) -> Dict[str, Any]:
+    """Actors across the cluster. Returns ``{"actors": [...],
+    "partial": bool, "errors": [{"node_id", "error"}, ...]}`` — an
+    unreachable node marks the listing partial instead of silently
+    shrinking it (reference: the state API's warn-on-partial-response
+    behavior in ``util/state/api.py``)."""
+    b = _backend()
+    errors: List[Dict[str, Any]] = []
+    actors: List[Dict[str, Any]] = []
+    if _is_cluster(b):
+        try:
+            nodes = b._head.call("list_nodes")
+        except Exception as e:
+            return {"actors": [], "partial": True,
+                    "errors": [{"node_id": "head",
+                                "error": f"{type(e).__name__}: {e}"}]}
+        for info in nodes:
             if not info["alive"] or info["labels"].get("role") == "driver":
                 continue
             try:
                 st = b._peer(info["address"]).call("debug_state")
-            except Exception:
+            except Exception as e:
+                errors.append({"node_id": info["node_id"],
+                               "error": f"{type(e).__name__}: {e}"})
                 continue
-            for aid in st.get("actors", ()):
-                out.append({"actor_id": aid, "node_id": info["node_id"],
-                            "state": "ALIVE"})
-        return out
-    with b._lock:
-        return [
-            {
-                "actor_id": aid.hex(),
-                "name": rt.name,
-                "state": "DEAD" if rt.dead else "ALIVE",
-                "max_concurrency": rt.max_concurrency,
-                "detached": rt.detached,
-                "pending_tasks": rt.queue.qsize(),
-            }
-            for aid, rt in b._actors.items()
-        ]
+            recs = st.get("actor_records")
+            if recs is None:
+                # Old daemon: only compact id prefixes are available.
+                recs = [{"actor_id": aid, "name": None, "state": "ALIVE",
+                         "pending_tasks": None}
+                        for aid in st.get("actors", ())]
+            for rec in recs:
+                actors.append({**rec, "node_id": info["node_id"]})
+    else:
+        with b._lock:
+            actors = [
+                {
+                    "actor_id": aid.hex(),
+                    "name": rt.name,
+                    "state": "DEAD" if rt.dead else "ALIVE",
+                    "max_concurrency": rt.max_concurrency,
+                    "detached": rt.detached,
+                    "pending_tasks": rt.queue.qsize(),
+                    "node_id": b.node_id.hex(),
+                }
+                for aid, rt in b._actors.items()
+            ]
+    actors = [a for a in actors if _match(a, state, node, name)]
+    if detail:
+        recs = _recorder_list("actor", limit=0, detail=True) or []
+        by_id = {r.get("id"): r for r in recs}
+        for a in actors:
+            rec = by_id.get(a.get("actor_id"))
+            if rec:
+                a["events"] = rec.get("events", [])
+    return {"actors": actors, "partial": bool(errors), "errors": errors}
 
 
-def list_tasks(state: Optional[str] = None) -> List[Dict[str, Any]]:
+def list_tasks(state: Optional[str] = None, node: Optional[str] = None,
+               name: Optional[str] = None, detail: bool = False,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    """Tasks: flight-recorder records (full lifecycle, survives task
+    completion) merged with the live scheduling tables (covers the
+    recorder-disabled case and queue states the store may lag on)."""
     b = _backend()
+    live: List[Dict[str, Any]] = []
     if _is_cluster(b):
-        out = []
         with b._lock:
             for rec in b._inflight.values():
-                out.append({"task_id": rec.spec.task_id.hex(),
-                            "name": rec.spec.name,
-                            "state": "RUNNING_OR_PENDING_NODE",
-                            "node_id": rec.node_id})
+                live.append({"task_id": rec.spec.task_id.hex(),
+                             "name": rec.spec.name,
+                             "state": "RUNNING_OR_PENDING_NODE",
+                             "node_id": rec.node_id})
             for spec in b._pending:
-                out.append({"task_id": spec.task_id.hex(),
-                            "name": spec.name,
-                            "state": "PENDING_SCHEDULING",
-                            "node_id": None})
-        return [t for t in out if state is None or t["state"] == state]
-    with b._lock:
-        out = [
-            {
-                "task_id": tid.hex(),
-                "name": rec.spec.name,
-                "state": rec.state.upper(),
-                "attempt": rec.spec.attempt,
-                "missing_deps": len(rec.missing_deps),
-            }
-            for tid, rec in b._tasks.items()
-        ]
-        live = {t["task_id"] for t in out}
-        # Finished tasks live on in the event buffer (reference: finished
-        # tasks come from the GcsTaskManager event store, not live tables).
-        latest: Dict[str, dict] = {}
-        for ev in b._task_events:
-            latest[ev["task_id"]] = ev
-        for tid, ev in latest.items():
-            if tid not in live:
-                out.append({
-                    "task_id": tid,
-                    "name": ev.get("name"),
-                    "state": ev.get("state", "finished").upper(),
-                    "attempt": 0,
-                    "missing_deps": 0,
-                })
-    return [t for t in out if state is None or t["state"] == state]
+                live.append({"task_id": spec.task_id.hex(),
+                             "name": spec.name,
+                             "state": "PENDING_SCHEDULING",
+                             "node_id": None})
+    else:
+        with b._lock:
+            live = [
+                {
+                    "task_id": tid.hex(),
+                    "name": rec.spec.name,
+                    "state": rec.state.upper(),
+                    "attempt": rec.spec.attempt,
+                    "missing_deps": len(rec.missing_deps),
+                }
+                for tid, rec in b._tasks.items()
+            ]
+            seen_live = {t["task_id"] for t in live}
+            # Finished tasks live on in the event buffer (reference:
+            # finished tasks come from the GcsTaskManager event store,
+            # not live tables).
+            latest: Dict[str, dict] = {}
+            for ev in b._task_events:
+                latest[ev["task_id"]] = ev
+            for tid, ev in latest.items():
+                if tid not in seen_live:
+                    live.append({
+                        "task_id": tid,
+                        "name": ev.get("name"),
+                        "state": ev.get("state", "finished").upper(),
+                        "attempt": 0,
+                        "missing_deps": 0,
+                    })
+    live = [t for t in live if _match(t, state, node, name)]
+    recorded = _recorder_list("task", state=state, node=node, name=name,
+                              limit=limit, detail=detail)
+    if recorded is None:
+        return live[:limit] if limit else live
+    out = [_norm_task(r) for r in recorded]
+    have = {t["task_id"] for t in out}
+    out.extend(t for t in live if t["task_id"] not in have)
+    return out[:limit] if limit else out
 
 
-def list_objects() -> List[Dict[str, Any]]:
+def list_objects(detail: bool = False) -> List[Dict[str, Any]]:
     b = _backend()
     store = b.store
     with store._cv:
@@ -113,6 +245,13 @@ def list_objects() -> List[Dict[str, Any]]:
             {"object_id": oid.hex(), "size_bytes": sv.total_bytes()}
             for oid, sv in store._objects.items()
         ]
+    if detail:
+        recs = _recorder_list("object", limit=0, detail=True) or []
+        by_id = {r.get("id"): r for r in recs}
+        for e in entries:
+            rec = by_id.get(e["object_id"])
+            if rec:
+                e["events"] = rec.get("events", [])
     return entries
 
 
@@ -150,6 +289,43 @@ def list_events(severity: Optional[str] = None,
     if int(limit) <= 0:
         return []
     return events.recent_events(severity, label)[-int(limit):]
+
+
+# -- summaries & timelines ----------------------------------------------------
+
+
+def _recorder_summary(kind: str) -> Dict[str, Any]:
+    b = _backend()
+    if _is_cluster(b):
+        try:
+            return b._head.call("state_summary", kind)
+        except Exception as e:
+            return {"kind": kind, "total": 0, "by_state": {},
+                    "error": f"{type(e).__name__}: {e}"}
+    return _local_store().summary(kind)
+
+
+def summary_tasks() -> Dict[str, Any]:
+    """Counts by state × function name plus queue→run latency
+    percentiles from SUBMITTED→RUNNING event deltas (the ``ray summary
+    tasks`` shape)."""
+    return _recorder_summary("task")
+
+
+def summary_actors() -> Dict[str, Any]:
+    return _recorder_summary("actor")
+
+
+def get_timeline(entity_id: str, kind: str = "task") -> Optional[dict]:
+    """One entity's full lifecycle record (ts-sorted events, attempt
+    numbers, trace-id cross-link). Accepts a unique id prefix."""
+    b = _backend()
+    if _is_cluster(b):
+        try:
+            return b._head.call("state_timeline", entity_id, kind)
+        except Exception:
+            return None
+    return _local_store().get(kind, entity_id)
 
 
 def summarize_tasks() -> Dict[str, int]:

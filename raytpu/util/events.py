@@ -26,6 +26,7 @@ _lock = threading.Lock()
 _buffer: Deque[Dict[str, Any]] = deque(maxlen=1000)
 _file_path: Optional[str] = None
 _reporter: Optional[Callable[[Dict[str, Any]], None]] = None
+_dropped = 0  # monotonic: events evicted from the ring by overflow
 
 
 def configure(log_dir: Optional[str] = None,
@@ -58,7 +59,10 @@ def record_event(severity: str, label: str, message: str,
         "pid": os.getpid(),
         **{k: v for k, v in fields.items() if _plain(v)},
     }
+    global _dropped
     with _lock:
+        if len(_buffer) == _buffer.maxlen:
+            _dropped += 1  # oldest record falls off; newest survives
         _buffer.append(event)
         path = _file_path
         reporter = _reporter
@@ -91,9 +95,17 @@ def recent_events(severity: Optional[str] = None,
     return events
 
 
+def dropped_count() -> int:
+    """Monotonically increasing count of events lost to ring overflow
+    (the overflow signal ``recent_events`` alone cannot give; included
+    in post-mortem dumps so truncation is visible, not silent)."""
+    return _dropped
+
+
 def reset() -> None:
-    global _file_path, _reporter
+    global _file_path, _reporter, _dropped
     with _lock:
         _buffer.clear()
         _file_path = None
         _reporter = None
+        _dropped = 0

@@ -225,6 +225,16 @@ class RetryPolicy:
                     except Exception:
                         pass
                 try:
+                    from raytpu.util import task_events
+
+                    if task_events.enabled():
+                        task_events.emit(
+                            "node", what,
+                            task_events.TaskTransition.RETRIED,
+                            attempt=attempt, error=type(e).__name__)
+                except Exception:
+                    pass
+                try:
                     # Exception class name keeps tag cardinality bounded
                     # (vs. str(e), which embeds addresses/ids).
                     m = _metric("counter", "raytpu_retries_total",
@@ -390,6 +400,14 @@ def breaker_for(peer: str, **kwargs) -> CircuitBreaker:
             b = CircuitBreaker(peer=peer, **kwargs)
             _breakers[peer] = b
         return b
+
+
+def breaker_states() -> Dict[str, str]:
+    """Snapshot of every registered breaker's current state, keyed by
+    peer (post-mortem dumps record which peers were dark at death)."""
+    with _breakers_lock:
+        items = list(_breakers.items())
+    return {peer: b.state for peer, b in items}
 
 
 def reset_breakers() -> None:

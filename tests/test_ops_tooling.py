@@ -193,8 +193,9 @@ class TestStateApi:
         raytpu.get([f.remote(i) for i in range(3)])
         held = raytpu.put("hello")  # held ref keeps the object in store
 
-        actors = state.list_actors()
-        assert any(x["name"] == "state-actor" for x in actors)
+        res = state.list_actors()
+        assert res["partial"] is False and res["errors"] == []
+        assert any(x["name"] == "state-actor" for x in res["actors"])
         tasks = state.list_tasks()
         assert len(tasks) >= 3
         assert state.summarize_tasks().get("FINISHED", 0) >= 3

@@ -1,0 +1,641 @@
+"""Task-event flight recorder: ring, store, state API, lints, chaos.
+
+Covers the PR's contracts:
+
+- ring buffer never blocks: overflow drops the OLDEST event and bumps a
+  monotonic ``dropped`` counter; drain/requeue/ingest keep drop
+  accounting exact across failed ships and relay hops;
+- the head-side :class:`TaskEventStore` folds batches into per-entity
+  records with a by-state index and FIFO eviction;
+- disabled cost: the per-task submit path executes exactly ONE
+  ``task_events.enabled()`` flag check (asserted at runtime and by AST);
+- AST lint: every ``TaskTransition`` member is emitted somewhere under
+  ``raytpu/`` (with a planted-violation self-test, the server-span lint
+  pattern);
+- chaos: a worker SIGKILLed mid-task leaves a
+  SUBMITTED -> ... -> FAILED -> RETRIED -> ... -> FINISHED flight record
+  in the head store with correct attempt numbers.
+"""
+
+import ast
+import glob
+import json
+import os
+import time
+
+import pytest
+
+import raytpu
+from raytpu.util import task_events
+from raytpu.util.task_events import TaskEventStore, TaskTransition
+
+
+@pytest.fixture
+def recorder():
+    """Armed recorder with a fresh ring; restores defaults on exit."""
+    task_events.clear()
+    task_events.enable_task_events()
+    yield task_events
+    task_events.disable_task_events(env=True)
+    task_events.enable_task_events(ring_size=8192)
+    task_events.disable_task_events()
+    task_events.clear()
+
+
+def _ev(kind="task", eid="aa11", transition=TaskTransition.SUBMITTED,
+        **over):
+    ev = {"kind": kind, "id": eid, "transition": transition,
+          "ts": time.time(), "mono": time.monotonic(), "node_id": "n1",
+          "worker_id": "", "attempt": 0}
+    ev.update(over)
+    return ev
+
+
+class TestRingBuffer:
+    def test_disabled_emit_is_noop(self):
+        task_events.clear()
+        assert not task_events.enabled()
+        task_events.emit("task", "t1", TaskTransition.SUBMITTED)
+        assert task_events.get_events() == []
+        assert task_events.dropped_count() == 0
+
+    def test_emit_records_primitives_only(self, recorder):
+        task_events.emit("task", "t1", TaskTransition.SUBMITTED,
+                         name="f", attempt=2, error="boom",
+                         parent_task_id="p1")
+        (ev,) = task_events.get_events()
+        assert ev["kind"] == "task" and ev["id"] == "t1"
+        assert ev["transition"] == "SUBMITTED"
+        assert ev["attempt"] == 2 and ev["error"] == "boom"
+        assert ev["parent_task_id"] == "p1"
+        # strict-wire safety: every field is a primitive
+        for v in ev.values():
+            assert isinstance(v, (str, int, float, bool, type(None)))
+        json.dumps(ev)  # and the whole event is JSON-encodable
+
+    def test_overflow_drops_oldest_and_counts(self, recorder):
+        task_events.enable_task_events(ring_size=4)
+        for i in range(10):
+            task_events.emit("task", f"t{i}", TaskTransition.SUBMITTED)
+        events = task_events.get_events()
+        assert len(events) == 4
+        # the NEWEST records survive, oldest fell off
+        assert [e["id"] for e in events] == ["t6", "t7", "t8", "t9"]
+        assert task_events.dropped_count() == 6
+
+    def test_drain_reports_drop_delta_once(self, recorder):
+        task_events.enable_task_events(ring_size=2)
+        for i in range(5):
+            task_events.emit("task", f"t{i}", TaskTransition.SUBMITTED)
+        batch, dropped = task_events.drain()
+        assert len(batch) == 2 and dropped == 3
+        # nothing new happened: next drain reports no additional loss
+        batch2, dropped2 = task_events.drain()
+        assert batch2 == [] and dropped2 == 0
+
+    def test_requeue_preserves_order_and_drop_accounting(self, recorder):
+        for i in range(3):
+            task_events.emit("task", f"t{i}", TaskTransition.SUBMITTED)
+        batch, dropped = task_events.drain()
+        task_events.emit("task", "t-new", TaskTransition.SUBMITTED)
+        task_events.requeue(batch, dropped)
+        ids = [e["id"] for e in task_events.get_events()]
+        assert ids == ["t0", "t1", "t2", "t-new"]
+        # the un-shipped drop count is reported again on the next drain
+        _, redrained = task_events.drain()
+        assert redrained == dropped
+
+    def test_requeue_overflow_drops_oldest_of_batch(self, recorder):
+        task_events.enable_task_events(ring_size=3)
+        batch = [_ev(eid=f"old{i}") for i in range(4)]
+        task_events.emit("task", "fresh", TaskTransition.SUBMITTED)
+        before = task_events.dropped_count()
+        task_events.requeue(batch)
+        ids = [e["id"] for e in task_events.get_events()]
+        # newer in-ring event survives; the oldest of the batch is lost
+        assert ids == ["old2", "old3", "fresh"]
+        assert task_events.dropped_count() == before + 2
+
+    def test_ingest_folds_batch_and_forwarded_drops(self, recorder):
+        task_events.ingest([_ev(eid="w1"), _ev(eid="w2")], dropped=7)
+        assert [e["id"] for e in task_events.get_events()] == ["w1", "w2"]
+        # forwarded drops accumulate so the head eventually sees them
+        _, dropped = task_events.drain()
+        assert dropped == 7
+
+    def test_emit_never_blocks_under_pressure(self, recorder):
+        task_events.enable_task_events(ring_size=8)
+        t0 = time.perf_counter()
+        for i in range(5000):
+            task_events.emit("task", f"t{i}", TaskTransition.RUNNING)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0  # lossy, yes; blocking, never
+        assert task_events.dropped_count() == 5000 - 8
+
+
+class TestOperationalEventsDropCounter:
+    """Satellite: util/events.py overflow accounting."""
+
+    def test_overflow_increments_and_newest_survive(self):
+        from raytpu.util import events
+
+        events.reset()
+        assert events.dropped_count() == 0
+        cap = events._buffer.maxlen
+        for i in range(cap + 25):
+            events.record_event("INFO", "TEST_OVERFLOW", f"m{i}")
+        assert events.dropped_count() == 25
+        recent = events.recent_events(label="TEST_OVERFLOW")
+        assert len(recent) == cap
+        assert recent[-1]["message"] == f"m{cap + 24}"  # newest survives
+        assert recent[0]["message"] == "m25"  # 0..24 fell off
+        events.reset()
+        assert events.dropped_count() == 0
+
+
+class TestTaskEventStore:
+    def test_folds_events_into_one_record(self):
+        store = TaskEventStore()
+        t0 = time.time()
+        store.add_batch([
+            _ev(eid="t1", transition=TaskTransition.SUBMITTED,
+                name="f", ts=t0),
+            _ev(eid="t1", transition=TaskTransition.RUNNING, ts=t0 + 1,
+                worker_id="w9", trace_id="abc"),
+            _ev(eid="t1", transition=TaskTransition.FINISHED, ts=t0 + 2,
+                attempt=1),
+        ])
+        rec = store.get("task", "t1")
+        assert rec["state"] == "FINISHED"
+        assert rec["name"] == "f" and rec["worker_id"] == "w9"
+        assert rec["trace_id"] == "abc" and rec["attempt"] == 1
+        assert rec["first_ts"] == t0 and rec["last_ts"] == t0 + 2
+        assert [e["transition"] for e in rec["events"]] == [
+            "SUBMITTED", "RUNNING", "FINISHED"]
+
+    def test_state_index_and_filters(self):
+        store = TaskEventStore()
+        store.add_batch([
+            _ev(eid="t1", transition=TaskTransition.RUNNING, name="f",
+                node_id="nodeA"),
+            _ev(eid="t2", transition=TaskTransition.FAILED, name="g",
+                node_id="nodeB"),
+            _ev(eid="t3", transition=TaskTransition.FAILED, name="f",
+                node_id="nodeA"),
+        ])
+        failed = store.list("task", state="failed")  # case-insensitive
+        assert {r["id"] for r in failed} == {"t2", "t3"}
+        assert {r["id"] for r in store.list("task", node="nodeA")} == \
+            {"t1", "t3"}
+        assert {r["id"] for r in store.list("task", name="g")} == {"t2"}
+        # default rows are summaries; detail attaches the timeline
+        assert "events" not in failed[0]
+        assert store.list("task", detail=True)[0]["events"]
+
+    def test_state_index_moves_on_transition(self):
+        store = TaskEventStore()
+        store.add_batch([_ev(eid="t1",
+                             transition=TaskTransition.RUNNING)])
+        store.add_batch([_ev(eid="t1",
+                             transition=TaskTransition.FINISHED)])
+        assert store.list("task", state="RUNNING") == []
+        assert [r["id"] for r in store.list("task", state="FINISHED")] \
+            == ["t1"]
+
+    def test_state_follows_event_time_not_arrival_order(self):
+        """Batches from different processes arrive out of order: the
+        driver's SUBMITTED heartbeat often lands AFTER the worker's
+        FINISHED. The overlay state must follow wall time."""
+        store = TaskEventStore()
+        t0 = time.time()
+        # worker's batch first (RUNNING, FINISHED)...
+        store.add_batch([
+            _ev(eid="t1", transition=TaskTransition.RUNNING, ts=t0 + 1),
+            _ev(eid="t1", transition=TaskTransition.FINISHED,
+                ts=t0 + 2),
+        ])
+        # ...then the driver's late beat with the older SUBMITTED
+        store.add_batch([_ev(eid="t1", name="f",
+                             transition=TaskTransition.SUBMITTED,
+                             ts=t0)])
+        rec = store.get("task", "t1")
+        assert rec["state"] == "FINISHED"
+        assert rec["name"] == "f"  # overlays still fold in
+        assert rec["first_ts"] == t0 and rec["last_ts"] == t0 + 2
+        assert [r["id"] for r in store.list("task", state="FINISHED")] \
+            == ["t1"]
+        assert store.list("task", state="SUBMITTED") == []
+
+    def test_fifo_eviction_keeps_index_consistent(self):
+        store = TaskEventStore(per_kind=16)
+        for i in range(40):
+            store.add_batch([_ev(eid=f"t{i:03d}",
+                                 transition=TaskTransition.FINISHED)])
+        assert store.stats()["entities"]["task"] == 16
+        assert store.stats()["evicted"] == 24
+        listed = store.list("task", state="FINISHED", limit=0)
+        assert {r["id"] for r in listed} == \
+            {f"t{i:03d}" for i in range(24, 40)}
+        assert store.get("task", "t000") is None  # evicted
+
+    def test_events_per_entity_bounded(self):
+        store = TaskEventStore(events_per_entity=8)
+        for i in range(30):
+            store.add_batch([_ev(eid="t1",
+                                 transition=TaskTransition.RUNNING,
+                                 attempt=i)])
+        rec = store.get("task", "t1")
+        assert rec["num_events"] == 8
+        assert rec["attempt"] == 29  # overlay survives event eviction
+
+    def test_get_by_unique_prefix(self):
+        store = TaskEventStore()
+        store.add_batch([_ev(eid="abcdef01"), _ev(eid="abxyz")])
+        assert store.get("task", "abc")["id"] == "abcdef01"
+        assert store.get("task", "ab") is None  # ambiguous
+        assert store.get("task", "zz") is None  # no match
+
+    def test_dropped_reported_accumulates(self):
+        store = TaskEventStore()
+        store.add_batch([], dropped=5)
+        store.add_batch([_ev()], dropped=2)
+        assert store.stats()["dropped_reported"] == 7
+
+    def test_rejects_malformed_events(self):
+        store = TaskEventStore()
+        store.add_batch([{"kind": "nope", "id": "x", "transition": "Y"},
+                         {"kind": "task"}, "garbage", None,
+                         _ev(eid="ok")])
+        assert store.stats()["entities"]["task"] == 1
+
+    def test_summary_counts_and_latency(self):
+        store = TaskEventStore()
+        t0 = time.time()
+        for i in range(4):
+            store.add_batch([
+                _ev(eid=f"t{i}", transition=TaskTransition.SUBMITTED,
+                    name="f", ts=t0),
+                _ev(eid=f"t{i}", transition=TaskTransition.RUNNING,
+                    name="f", ts=t0 + 0.5),
+                _ev(eid=f"t{i}", transition=TaskTransition.FINISHED,
+                    name="f", ts=t0 + 1),
+            ])
+        store.add_batch([_ev(eid="t9", name="g",
+                             transition=TaskTransition.FAILED, ts=t0)])
+        s = store.summary("task")
+        assert s["total"] == 5
+        assert s["by_state"]["FINISHED"] == {"f": 4}
+        assert s["by_state"]["FAILED"] == {"g": 1}
+        lat = s["queue_to_run_latency_s"]
+        assert lat["count"] == 4
+        assert abs(lat["p50"] - 0.5) < 1e-6
+        assert abs(lat["p95"] - 0.5) < 1e-6
+
+
+class TestLocalStateApi:
+    def test_timeline_and_summary_local_mode(self, recorder,
+                                             raytpu_local):
+        from raytpu.state import api as state
+
+        @raytpu.remote
+        def work(x):
+            return x + 1
+
+        refs = [work.remote(i) for i in range(3)]
+        assert raytpu.get(refs) == [1, 2, 3]
+
+        rows = state.list_tasks(name="work", state="FINISHED")
+        assert len(rows) >= 3
+        tid = rows[0]["task_id"]
+        rec = state.get_timeline(tid)
+        assert rec is not None and rec["state"] == "FINISHED"
+        transitions = [e["transition"] for e in rec["events"]]
+        assert "SUBMITTED" in transitions and "FINISHED" in transitions
+        # unique-prefix lookup (CLI users paste truncated ids)
+        assert state.get_timeline(tid[:12])["id"] == tid
+        s = state.summary_tasks()
+        finished = s["by_state"]["FINISHED"]  # keyed by qualified name
+        assert sum(v for k, v in finished.items() if "work" in k) >= 3
+        assert s["queue_to_run_latency_s"]["count"] >= 3
+
+    def test_actor_lifecycle_recorded(self, recorder, raytpu_local):
+        from raytpu.state import api as state
+
+        @raytpu.remote
+        class Counter:
+            def bump(self):
+                return 1
+
+        c = Counter.options(name="flight-actor").remote()
+        assert raytpu.get(c.bump.remote()) == 1
+        res = state.list_actors(name="flight-actor", detail=True)
+        assert res["partial"] is False
+        (a,) = res["actors"]
+        assert a["name"] == "flight-actor" and a["state"] == "ALIVE"
+        assert any(e["transition"] == "CREATED"
+                   for e in a.get("events", ()))
+
+    def test_list_actors_shape_without_recorder(self, raytpu_local):
+        from raytpu.state import api as state
+
+        res = state.list_actors()
+        assert set(res) == {"actors", "partial", "errors"}
+        assert res["partial"] is False and res["errors"] == []
+
+
+class TestDisabledCost:
+    def test_disabled_path_never_calls_emit(self, raytpu_local,
+                                            monkeypatch):
+        """RAYTPU_TASK_EVENTS=0: zero emit() calls anywhere on the
+        submit/run path — sites must guard, not rely on emit's own
+        internal check."""
+        assert not task_events.enabled()
+
+        def _boom(*a, **k):
+            raise AssertionError("emit called with recorder disabled")
+
+        monkeypatch.setattr(task_events, "emit", _boom)
+
+        @raytpu.remote
+        def f(x):
+            return x * 2
+
+        assert raytpu.get(f.remote(21)) == 42
+
+    def test_submit_path_is_one_flag_check(self, raytpu_local,
+                                           monkeypatch, tmp_path):
+        """The acceptance contract: one ``enabled()`` evaluation per
+        task submission. Dispatch is pinned behind a resource hog so the
+        counter sees the submit path alone."""
+        started = str(tmp_path / "started")
+        gate = str(tmp_path / "go")
+
+        # File-gated (a closure over threading primitives won't pickle).
+        @raytpu.remote(num_cpus=4)
+        def hog(started_path, gate_path):
+            open(started_path, "w").close()
+            deadline = time.monotonic() + 30
+            while (not os.path.exists(gate_path)
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            return "hog"
+
+        hog_ref = hog.remote(started, gate)
+        deadline = time.monotonic() + 10
+        while not os.path.exists(started) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert os.path.exists(started), "hog never started"
+        calls = []
+        real = task_events.enabled
+        monkeypatch.setattr(task_events, "enabled",
+                            lambda: (calls.append(1), real())[1])
+
+        @raytpu.remote(num_cpus=4)
+        def f():
+            return "f"
+
+        ref = f.remote()  # queued behind hog: submit path only
+        assert len(calls) == 1
+        monkeypatch.undo()
+        open(gate, "w").close()
+        assert raytpu.get([hog_ref, ref], timeout=30) == ["hog", "f"]
+
+    def test_submit_functions_have_single_guard_ast(self):
+        """Both backends' submit_task: exactly one task_events.enabled()
+        check, and every task_events.emit() inside a guarded branch."""
+        import raytpu as _pkg
+
+        root = os.path.dirname(os.path.abspath(_pkg.__file__))
+        for rel, cls in (("runtime/local_backend.py", "LocalBackend"),
+                         ("cluster/client.py", "ClusterBackend")):
+            with open(os.path.join(root, rel)) as f:
+                tree = ast.parse(f.read())
+            fn = _find_method(tree, cls, "submit_task")
+            assert fn is not None, f"{cls}.submit_task missing in {rel}"
+            checks = [n for n in ast.walk(fn)
+                      if _is_task_events_call(n, "enabled")]
+            assert len(checks) == 1, (
+                f"{cls}.submit_task has {len(checks)} enabled() checks; "
+                f"the disabled-cost contract allows exactly 1")
+            emits = [n for n in ast.walk(fn)
+                     if _is_task_events_call(n, "emit")]
+            assert emits, f"{cls}.submit_task emits nothing"
+            guarded = [n for g in _enabled_guards(fn)
+                       for n in ast.walk(g)
+                       if _is_task_events_call(n, "emit")]
+            assert len(guarded) == len(emits), (
+                f"{cls}.submit_task has emit() calls outside the "
+                f"enabled() guard")
+
+
+# -- AST lint: every transition is emitted (satellite) ------------------------
+
+
+def _find_method(tree, cls_name, fn_name):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for f in node.body:
+                if isinstance(f, ast.FunctionDef) and f.name == fn_name:
+                    return f
+    return None
+
+
+def _is_task_events_call(node, attr):
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == attr
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "task_events")
+
+
+def _enabled_guards(fn):
+    """``if task_events.enabled():`` blocks within a function."""
+    return [n for n in ast.walk(fn) if isinstance(n, ast.If)
+            and any(_is_task_events_call(t, "enabled")
+                    for t in ast.walk(n.test))]
+
+
+def _transitions_referenced(tree) -> set:
+    """TaskTransition members referenced anywhere in a module."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            v = node.value
+            if ((isinstance(v, ast.Name) and v.id == "TaskTransition")
+                    or (isinstance(v, ast.Attribute)
+                        and v.attr == "TaskTransition")):
+                out.add(node.attr)
+    return out & set(TaskTransition.ALL)
+
+
+class TestTransitionCoverageLint:
+    def test_every_transition_is_emitted_somewhere(self):
+        import raytpu as _pkg
+
+        root = os.path.dirname(os.path.abspath(_pkg.__file__))
+        emitted = set()
+        scanned = 0
+        for path in glob.glob(os.path.join(root, "**", "*.py"),
+                              recursive=True):
+            # the defining module trivially references every member
+            if path.endswith(os.path.join("util", "task_events.py")):
+                continue
+            with open(path) as f:
+                emitted |= _transitions_referenced(ast.parse(f.read()))
+            scanned += 1
+        assert scanned > 10
+        missing = set(TaskTransition.ALL) - emitted
+        assert not missing, (
+            f"TaskTransition members declared but never emitted under "
+            f"raytpu/: {sorted(missing)} — a lifecycle state without "
+            f"instrumentation is a lie in the schema")
+
+    def test_lint_catches_planted_violation(self):
+        bad = ast.parse(
+            "from raytpu.util import task_events\n"
+            "def f(spec):\n"
+            "    if task_events.enabled():\n"
+            "        task_events.emit('task', 't',\n"
+            "            task_events.TaskTransition.SUBMITTED)\n")
+        found = _transitions_referenced(bad)
+        assert found == {"SUBMITTED"}
+        assert set(TaskTransition.ALL) - found  # lint would flag these
+        good = ast.parse("\n".join(
+            f"x{i} = TaskTransition.{m}"
+            for i, m in enumerate(TaskTransition.ALL)))
+        assert _transitions_referenced(good) == set(TaskTransition.ALL)
+
+
+class TestPostmortem:
+    def test_writes_snapshot_and_rate_limits(self, recorder, tmp_path):
+        task_events._last_postmortem[0] = -10_000.0  # reset the limiter
+        from raytpu.util import events
+
+        events.reset()
+        events.record_event("ERROR", "PM_TEST", "it broke")
+        task_events.emit("task", "t1", TaskTransition.FAILED,
+                         name="f", error="boom")
+        path = task_events.write_postmortem(str(tmp_path), "unit test")
+        assert path is not None and os.path.exists(path)
+        with open(path) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "unit test"
+        assert dump["task_events_dropped"] == 0
+        assert any(e["id"] == "t1" for e in dump["task_events"])
+        assert any(e.get("label") == "PM_TEST"
+                   for e in dump["recent_events"])
+        assert "events_dropped" in dump and "breakers" in dump
+        # rate-limited: an immediate second dump is suppressed
+        assert task_events.write_postmortem(str(tmp_path), "again") is None
+        events.reset()
+
+    def test_never_raises_on_bad_log_dir(self, recorder):
+        task_events._last_postmortem[0] = -10_000.0
+        assert task_events.write_postmortem(
+            "/proc/definitely/not/writable", "nope") is None
+
+
+# -- chaos: the flight record of a killed worker (satellite) ------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestChaosFlightRecord:
+    def test_worker_kill_leaves_full_flight_record(self):
+        """SIGKILL the worker on the task's first run: the head store
+        must show the whole story — SUBMITTED, the FAILED attempt 0, the
+        RETRIED attempt 1, and a terminal FINISHED — with the trace id
+        cross-link on the submit event."""
+        from raytpu.cluster.cluster_utils import Cluster
+        from raytpu.cluster.protocol import RpcClient
+        from raytpu.util import failpoints, tracing
+
+        failpoints.cfg("worker.task.run", "1*kill_process", env=True)
+        task_events.enable_task_events(env=True)
+        tracing.enable_tracing(env=True)
+        cluster = Cluster()
+        failpoints.clear()  # driver side clean; children captured env
+        head = None
+        try:
+            cluster.add_node(num_cpus=1, num_tpus=0)
+            cluster.add_node(num_cpus=1, num_tpus=0)
+            cluster.wait_for_nodes(2)
+            raytpu.init(address=cluster.address)
+
+            @raytpu.remote(max_retries=8)
+            def double(x):
+                return x * 2
+
+            with tracing.span("chaos.flight"):
+                ref = double.remote(21)
+
+            head = RpcClient(cluster.address)
+            deadline = time.monotonic() + 60
+            crashed = []
+            while time.monotonic() < deadline and not crashed:
+                crashed = [e for e in head.call("list_events", "ERROR")
+                           if e.get("label") in ("WORKER_CRASHED",
+                                                 "WORKER_KILLED")]
+                time.sleep(0.05)
+            assert crashed, "armed worker never crashed"
+            # Scrub every node daemon's env so the NEXT worker is clean
+            # (the retry may land on either node).
+            for node in head.call("list_nodes"):
+                if node["labels"].get("role") == "driver":
+                    continue
+                node_cli = RpcClient(node["address"])
+                node_cli.call("failpoint_clear")
+                node_cli.close()
+            assert raytpu.get(ref, timeout=90) == 42
+
+            from raytpu.state import api as state
+
+            rec = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                rows = state.list_tasks(name="double", state="FINISHED")
+                if rows:
+                    rec = state.get_timeline(rows[0]["task_id"])
+                    if rec is not None and any(
+                            e["transition"] == "RETRIED"
+                            for e in rec["events"]):
+                        break
+                time.sleep(0.25)
+            assert rec is not None, "flight record never reached head"
+            transitions = [e["transition"] for e in rec["events"]]
+            for t in ("SUBMITTED", "FAILED", "RETRIED", "FINISHED"):
+                assert t in transitions, (
+                    f"missing {t}; record shows {transitions}")
+            # order: the failure precedes the retry precedes the finish
+            assert (transitions.index("FAILED")
+                    < transitions.index("RETRIED")
+                    < len(transitions) - transitions[::-1].index(
+                        "FINISHED"))
+            fails = [e for e in rec["events"]
+                     if e["transition"] == "FAILED"]
+            assert fails[0]["attempt"] == 0
+            retries = [e for e in rec["events"]
+                       if e["transition"] == "RETRIED"]
+            assert retries[0]["attempt"] == 1
+            finishes = [e for e in rec["events"]
+                        if e["transition"] == "FINISHED"]
+            assert finishes[-1]["attempt"] >= 1
+            assert rec["attempt"] >= 1
+            # PR-3 cross-link: submit happened inside a sampled span
+            submits = [e for e in rec["events"]
+                       if e["transition"] == "SUBMITTED"]
+            assert any(e.get("trace_id") for e in submits)
+            # summaries see the same story (keyed by qualified name)
+            s = state.summary_tasks()
+            assert sum(v for k, v in
+                       s["by_state"].get("FINISHED", {}).items()
+                       if "double" in k) >= 1
+        finally:
+            if head is not None:
+                head.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+            failpoints.clear()
+            tracing.disable_tracing(env=True)
+            task_events.disable_task_events(env=True)
+            task_events.clear()
