@@ -1,0 +1,34 @@
+"""raytpulint — static analysis enforcing the runtime's cross-cutting
+invariants.
+
+Reference analogue: Ray's custom correctness tooling (``ci/lint/``,
+clang-tidy configs, the ASAN/TSAN wiring in ``ci/``) — a concurrent
+runtime keeps its invariants honest with purpose-built static checks,
+not code review. Ours parses each source file exactly once and runs
+every registered rule over the shared AST.
+
+Usage:
+    raytpu lint [paths] [--json] [--select RTP001,RTP005]
+    python -m raytpu.analysis
+
+Rules carry stable ``RTPxxx`` ids. One-line suppressions::
+
+    something_exempt()  # raytpulint: disable=RTP001 -- one-line reason
+
+Grandfathered findings may live in a checked-in baseline file
+(``raytpu/analysis/baseline.json``); the intent is an *empty* baseline —
+inline suppressions with reasons are the preferred escape hatch.
+"""
+
+from raytpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    ParsedModule,
+    Rule,
+    all_rules,
+    default_baseline_path,
+    load_baseline,
+    run_lint,
+    run_rule_on_source,
+    save_baseline,
+)

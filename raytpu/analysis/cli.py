@@ -1,0 +1,87 @@
+"""``raytpu lint`` / ``python -m raytpu.analysis`` — CLI front end.
+
+Exit codes: 0 clean, 1 unsuppressed findings (or unparseable files),
+2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Optional
+
+
+def add_arguments(parser: argparse.ArgumentParser) -> None:
+    """Shared between ``python -m raytpu.analysis`` and the ``raytpu
+    lint`` subcommand."""
+    parser.add_argument(
+        "paths", nargs="*", type=pathlib.Path,
+        help="files/directories to scan (default: the raytpu package)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--select", default=None, metavar="IDS",
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--baseline", type=pathlib.Path, default=None,
+                        help="baseline file (default: "
+                             "raytpu/analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings to the baseline "
+                             "and exit 0")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    parser.add_argument("--stats", action="store_true",
+                        help="append scan statistics to human output")
+
+
+def run(args: argparse.Namespace, out=None) -> int:
+    from raytpu.analysis.core import (all_rules, run_lint, save_baseline)
+
+    out = out if out is not None else sys.stdout
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:22s} {rule.invariant}", file=out)
+        return 0
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    try:
+        result = run_lint(paths=args.paths or None, select=select,
+                          baseline_path=args.baseline,
+                          use_baseline=not args.no_baseline)
+    except ValueError as e:  # unknown rule id
+        print(f"raytpulint: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        path = save_baseline(result.findings, args.baseline)
+        print(f"wrote {len(result.findings)} fingerprint(s) to {path}",
+              file=out)
+        return 0
+    if args.as_json:
+        print(json.dumps(result.to_dict(), indent=2), file=out)
+        return 0 if result.ok else 1
+    for f in result.errors + result.findings:
+        print(str(f), file=out)
+    n = len(result.findings) + len(result.errors)
+    summary = (f"raytpulint: {n} finding(s), "
+               f"{len(result.suppressed)} suppressed, "
+               f"{len(result.baselined)} baselined — "
+               f"{result.files_scanned} files in "
+               f"{result.elapsed_s * 1000:.0f} ms")
+    print(summary, file=out)
+    if args.stats:
+        print(f"  parses: {result.parse_count} "
+              f"(one per file: "
+              f"{result.parse_count == result.files_scanned})", file=out)
+    return 0 if result.ok else 1
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="raytpu lint",
+        description="static analysis enforcing raytpu's cross-cutting "
+                    "invariants")
+    add_arguments(parser)
+    return run(parser.parse_args(argv))

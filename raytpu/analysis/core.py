@@ -1,0 +1,290 @@
+"""raytpulint framework core: parsed-module cache, rule registry,
+suppressions, baseline, and the runner.
+
+Design contract (pinned by ``tests/test_lint.py``):
+
+- each ``*.py`` file under the scanned root is ``ast.parse``d exactly
+  once per run, no matter how many rules inspect it;
+- rules are stateless classes instantiated fresh per run — cross-file
+  rules accumulate in ``check`` and report from ``finalize``;
+- a finding is suppressed by a ``# raytpulint: disable=RTPxxx`` comment
+  on the finding's line, or matched against the baseline file by a
+  line-number-free fingerprint (rule, path, message) so baselines
+  survive unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Type
+
+__all__ = [
+    "Finding", "LintResult", "ParsedModule", "Rule", "all_rules",
+    "default_baseline_path", "load_baseline", "run_lint",
+    "run_rule_on_source", "save_baseline", "register",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*raytpulint:\s*disable=((?:RTP\d+|all)(?:\s*,\s*(?:RTP\d+|all))*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str      # stable id, e.g. "RTP001"
+    path: str      # repo-relative posix path, e.g. "raytpu/cluster/node.py"
+    line: int      # 1-based
+    col: int       # 0-based
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        # No line/col: baselines must survive edits elsewhere in the file.
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class ParsedModule:
+    """One source file, parsed once, shared by every rule."""
+
+    def __init__(self, path: pathlib.Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self._suppressions: Optional[Dict[int, Set[str]]] = None
+
+    def suppressions(self) -> Dict[int, Set[str]]:
+        """line number -> suppressed rule ids ("all" suppresses any)."""
+        if self._suppressions is None:
+            out: Dict[int, Set[str]] = {}
+            for i, text in enumerate(self.lines, start=1):
+                if "raytpulint" not in text:
+                    continue
+                m = _SUPPRESS_RE.search(text)
+                if m:
+                    out[i] = {s.strip() for s in m.group(1).split(",")}
+            self._suppressions = out
+        return self._suppressions
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        ids = self.suppressions().get(finding.line)
+        return bool(ids) and (finding.rule in ids or "all" in ids)
+
+
+class Rule:
+    """Base class. Subclasses set the class attributes and implement
+    ``check`` (per module) and/or ``finalize`` (after every module has
+    been checked — for whole-tree invariants)."""
+
+    id: str = ""
+    name: str = ""
+    invariant: str = ""       # one-line statement of what must hold
+    rationale: str = ""       # why it is load-bearing
+    scope: Sequence[str] = ("raytpu/",)   # rel-path prefixes examined
+    exempt: Sequence[str] = ()            # rel paths skipped (reasons in doc)
+
+    def applies(self, mod: ParsedModule) -> bool:
+        if mod.rel in self.exempt:
+            return False
+        return any(mod.rel.startswith(p) for p in self.scope)
+
+    def check(self, mod: ParsedModule) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, modules: Sequence[ParsedModule]) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, mod: ParsedModule, node, message: str,
+                line: Optional[int] = None,
+                col: Optional[int] = None) -> Finding:
+        if line is None:
+            line = getattr(node, "lineno", 1) if node is not None else 1
+        if col is None:
+            col = getattr(node, "col_offset", 0) if node is not None else 0
+        return Finding(self.id, mod.rel, line, col, message)
+
+
+_RULES: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id or cls.id in _RULES:
+        raise ValueError(f"rule id {cls.id!r} missing or already registered")
+    _RULES[cls.id] = cls
+    return cls
+
+
+def all_rules(select: Optional[Iterable[str]] = None) -> List[Rule]:
+    """Fresh instances of every registered rule, sorted by id."""
+    from raytpu.analysis import rules as _rules  # noqa: F401  (registers)
+
+    wanted = set(select) if select else None
+    out = []
+    for rid in sorted(_RULES):
+        if wanted is None or rid in wanted:
+            out.append(_RULES[rid]())
+    if wanted:
+        unknown = wanted - set(_RULES)
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+
+
+def default_baseline_path() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Optional[pathlib.Path] = None) -> Set[str]:
+    p = pathlib.Path(path) if path else default_baseline_path()
+    if not p.exists():
+        return set()
+    data = json.loads(p.read_text())
+    return set(data.get("fingerprints", ()))
+
+
+def save_baseline(findings: Iterable[Finding],
+                  path: Optional[pathlib.Path] = None) -> pathlib.Path:
+    p = pathlib.Path(path) if path else default_baseline_path()
+    fps = sorted({f.fingerprint for f in findings})
+    p.write_text(json.dumps({"version": 1, "fingerprints": fps},
+                            indent=2) + "\n")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Runner
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]            # active (reportable) findings
+    suppressed: List[Finding]          # silenced by inline comments
+    baselined: List[Finding]           # matched the baseline file
+    files_scanned: int
+    parse_count: int                   # must equal files_scanned (parse once)
+    elapsed_s: float
+    errors: List[Finding]              # files that failed to parse
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "errors": [f.to_dict() for f in self.errors],
+            "stats": {
+                "files_scanned": self.files_scanned,
+                "parse_count": self.parse_count,
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+                "elapsed_s": round(self.elapsed_s, 4),
+            },
+        }
+
+
+def _package_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def _collect_files(paths: Optional[Sequence[pathlib.Path]]
+                   ) -> List[pathlib.Path]:
+    if not paths:
+        paths = [_package_root()]
+    out: List[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            out.extend(f for f in sorted(p.rglob("*.py"))
+                       if "__pycache__" not in f.parts)
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def run_lint(paths: Optional[Sequence[pathlib.Path]] = None,
+             select: Optional[Iterable[str]] = None,
+             baseline_path: Optional[pathlib.Path] = None,
+             use_baseline: bool = True) -> LintResult:
+    """Parse every file once, run all (selected) rules, partition the
+    findings into active / suppressed / baselined."""
+    t0 = time.perf_counter()
+    repo_root = _package_root().parent
+    files = _collect_files(paths)
+    modules: List[ParsedModule] = []
+    errors: List[Finding] = []
+    parse_count = 0
+    for f in files:
+        try:
+            rel = f.resolve().relative_to(repo_root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            src = f.read_text()
+            modules.append(ParsedModule(f, rel, src))
+            parse_count += 1
+        except SyntaxError as e:
+            errors.append(Finding("RTP000", rel, e.lineno or 1, 0,
+                                  f"syntax error: {e.msg}"))
+        except OSError as e:
+            errors.append(Finding("RTP000", rel, 1, 0, f"unreadable: {e}"))
+
+    rules = all_rules(select)
+    raw: List[Finding] = []
+    by_rel = {m.rel: m for m in modules}
+    for rule in rules:
+        applicable = [m for m in modules if rule.applies(m)]
+        for mod in applicable:
+            raw.extend(rule.check(mod))
+        raw.extend(rule.finalize(applicable))
+
+    baseline = load_baseline(baseline_path) if use_baseline else set()
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    for fd in sorted(raw, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        mod = by_rel.get(fd.path)
+        if mod is not None and mod.is_suppressed(fd):
+            suppressed.append(fd)
+        elif fd.fingerprint in baseline:
+            baselined.append(fd)
+        else:
+            active.append(fd)
+    return LintResult(active, suppressed, baselined, len(modules),
+                      parse_count, time.perf_counter() - t0, errors)
+
+
+def run_rule_on_source(rule: Rule, source: str,
+                       rel: str = "raytpu/cluster/_planted.py",
+                       whole_tree: bool = False) -> List[Finding]:
+    """Run one rule over an in-memory source snippet (self-tests). The
+    ``rel`` path decides scoping, so pick one inside the rule's scope.
+    ``whole_tree=True`` also runs the rule's ``finalize``; suppression
+    comments in ``source`` are honored either way."""
+    mod = ParsedModule(pathlib.Path("<planted>"), rel, source)
+    if not rule.applies(mod):
+        return []
+    out = list(rule.check(mod))
+    if whole_tree:
+        out.extend(rule.finalize([mod]))
+    return [f for f in out if not mod.is_suppressed(f)]

@@ -1,0 +1,40 @@
+"""Rule catalogue. Importing this package registers every rule.
+
+Stable ids (append-only — never renumber a shipped rule):
+
+====== ======================= ====================================
+id     name                    invariant
+====== ======================= ====================================
+RTP001 timing-literals         cluster timing constants come from
+                               cluster/constants.py, never inline
+RTP002 server-span             every RPC handler runs inside the
+                               rpc.server.* tracing span
+RTP003 transition-coverage     every declared TaskTransition is
+                               emitted somewhere under raytpu/
+RTP004 jit-in-builders         jax.jit only inside _build_*
+                               constructors, never in a loop
+RTP005 wire-envelope-purity    RPC envelope fields are registered
+                               and built from wire primitives
+RTP006 contextvar-crossing     executor/queue hops carry the trace
+                               context via run_with_trace / stash
+RTP007 blocking-in-async       no time.sleep / blocking socket or
+                               subprocess calls inside async def
+RTP008 env-registry            every RAYTPU_* env read is declared
+                               in cluster/constants.py or
+                               core/config.py
+RTP009 seam-swallow            no bare except / silently swallowed
+                               RPC failures at cluster seams
+====== ======================= ====================================
+"""
+
+from raytpu.analysis.rules import (  # noqa: F401
+    blocking_in_async,
+    contextvar_crossing,
+    env_registry,
+    jit_in_builders,
+    seam_swallow,
+    server_span,
+    timing_literals,
+    transition_coverage,
+    wire_purity,
+)

@@ -1,0 +1,96 @@
+"""RTP007: no blocking calls inside ``async def``.
+
+Every RPC server in the runtime is one asyncio loop on one thread
+(:class:`~raytpu.cluster.protocol.RpcServer`); a single blocking call in
+an async handler stalls *every* connected peer — heartbeats miss, the
+head declares nodes dead, and the failure reads as a network partition.
+The sanctioned patterns are ``await asyncio.sleep`` and offloading via
+``run_in_executor`` (a nested sync ``def`` shipped to an executor is
+fine and not flagged — only the async function's own lexical body is
+scanned).
+
+Blocked calls: ``time.sleep``, blocking socket module/ops
+(``socket.create_connection``/``getaddrinfo``/``gethostbyname``,
+``.recv``/``.recv_into``/``.sendall``/``.accept``), ``subprocess.run``/
+``call``/``check_call``/``check_output``, and ``os.system``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_MODULE_CALLS = {
+    "time": {"sleep"},
+    "socket": {"create_connection", "getaddrinfo", "gethostbyname"},
+    "subprocess": {"run", "call", "check_call", "check_output"},
+    "os": {"system"},
+}
+_SOCKET_METHODS = {"recv", "recv_into", "sendall", "accept"}
+
+
+def _blocking_reason(call: ast.Call):
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if isinstance(f.value, ast.Name):
+        mod = f.value.id.lstrip("_")
+        if f.attr in _MODULE_CALLS.get(mod, ()):
+            return f"{f.value.id}.{f.attr}()"
+    if f.attr in _SOCKET_METHODS:
+        return f".{f.attr}() (blocking socket op)"
+    return None
+
+
+class _AsyncScan(ast.NodeVisitor):
+    """Walk collecting blocking calls lexically inside ``async def``
+    bodies, without descending into nested sync ``def``s (those run on
+    executors) while still descending into nested ``async def``s."""
+
+    def __init__(self):
+        self.in_async = False
+        self.hits = []  # (node, reason)
+
+    def visit_FunctionDef(self, node):
+        prev, self.in_async = self.in_async, False
+        self.generic_visit(node)
+        self.in_async = prev
+
+    def visit_AsyncFunctionDef(self, node):
+        prev, self.in_async = self.in_async, True
+        self.generic_visit(node)
+        self.in_async = prev
+
+    def visit_Lambda(self, node):
+        # a lambda defined in async code usually runs elsewhere
+        # (call_soon_threadsafe, executor) — skip its body
+        pass
+
+    def visit_Call(self, node):
+        if self.in_async:
+            reason = _blocking_reason(node)
+            if reason:
+                self.hits.append((node, reason))
+        self.generic_visit(node)
+
+
+@register
+class BlockingInAsync(Rule):
+    id = "RTP007"
+    name = "blocking-in-async"
+    invariant = ("async def bodies must not call time.sleep, blocking "
+                 "socket ops, or subprocess waits")
+    rationale = ("every RPC server is one asyncio loop; one blocking "
+                 "call stalls every peer on the process and reads as a "
+                 "network partition")
+    scope = ("raytpu/",)
+
+    def check(self, mod):
+        scan = _AsyncScan()
+        scan.visit(mod.tree)
+        for node, reason in scan.hits:
+            yield self.finding(
+                mod, node,
+                f"blocking call {reason} inside async def — await the "
+                f"async equivalent or offload via run_in_executor")

@@ -1,0 +1,121 @@
+"""RTP006: trace context must survive executor/queue hops.
+
+``run_in_executor`` and ``ThreadPoolExecutor.submit`` do NOT copy
+contextvars, so the per-dispatch trace context (and deadline) anchored
+by :class:`~raytpu.cluster.protocol.RpcServer` dies at every such hop
+unless it is carried explicitly. PR 3 established two sanctioned
+patterns:
+
+- **capture + re-anchor**: ``tc = tracing.current_trace()`` on the loop
+  thread, then hand the callable through
+  :func:`raytpu.util.tracing.run_with_trace`;
+- **per-task stash**: stash the submitter's context keyed by task id
+  (``_stash_task_trace`` / ``_pop_task_trace`` in ``node.py``) when the
+  hop is queue-decoupled.
+
+This rule checks every ``*.run_in_executor(...)`` / ``*.submit(...)``
+call in the contextvar-carrying cluster files. A hop passes when the
+callable mentions ``run_with_trace``, when the enclosing function
+captures the context (``current_trace`` / ``run_with_trace`` / stash
+helpers), or when the callable resolves to a function in the same
+module that re-anchors via those helpers. Long-lived background threads
+(``threading.Thread``) are exempt by design — they own fresh traces.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from raytpu.analysis.core import Rule, register
+
+_CARRIERS = {"run_with_trace", "current_trace",
+             "_stash_task_trace", "_pop_task_trace"}
+
+
+def _mentions_carrier(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _CARRIERS:
+            return True
+        if isinstance(n, ast.Name) and n.id in _CARRIERS:
+            return True
+    return False
+
+
+def _callable_name(node) -> Optional[str]:
+    """Resolvable local name of the submitted callable: bare ``f`` or
+    method ``self.f`` / ``obj.f``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, defs: Dict[str, ast.AST]):
+        self.defs = defs
+        self.stack = []
+        self.hops = []  # (call_node, callable_expr, enclosing_def)
+
+    def visit_FunctionDef(self, node):
+        self._fn(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._fn(node)
+
+    def _fn(self, node):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "run_in_executor" and len(node.args) >= 2:
+                self.hops.append((node, node.args[1],
+                                  self.stack[-1] if self.stack else None))
+            elif f.attr == "submit" and node.args:
+                self.hops.append((node, node.args[0],
+                                  self.stack[-1] if self.stack else None))
+        self.generic_visit(node)
+
+
+@register
+class ContextvarCrossing(Rule):
+    id = "RTP006"
+    name = "contextvar-crossing"
+    invariant = ("callables handed to executors in the cluster dispatch "
+                 "files must carry the trace context via run_with_trace "
+                 "or the per-task stash")
+    rationale = ("run_in_executor/submit drop contextvars; a hop without "
+                 "an explicit carry severs the trace (and orphans every "
+                 "downstream span)")
+    scope = ("raytpu/cluster/driver_proxy.py",
+             "raytpu/cluster/worker_proc.py",
+             "raytpu/cluster/node.py")
+
+    def check(self, mod):
+        defs: Dict[str, ast.AST] = {}
+        for n in ast.walk(mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # first definition wins; collisions are all methods with
+                # the same re-anchoring contract in these files
+                defs.setdefault(n.name, n)
+        v = _Visitor(defs)
+        v.visit(mod.tree)
+        for call, fn_expr, enclosing in v.hops:
+            if _mentions_carrier(fn_expr):
+                continue
+            if enclosing is not None and _mentions_carrier(enclosing):
+                continue
+            name = _callable_name(fn_expr)
+            target = defs.get(name) if name else None
+            if target is not None and _mentions_carrier(target):
+                continue
+            yield self.finding(
+                mod, call,
+                "executor hop drops the trace context — capture "
+                "tracing.current_trace() on the submitting thread and "
+                "wrap the callable in tracing.run_with_trace (or use the "
+                "per-task stash)")

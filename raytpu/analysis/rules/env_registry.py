@@ -1,0 +1,151 @@
+"""RTP008: every ``RAYTPU_*`` environment read is declared in a registry.
+
+The runtime has exactly two environment-variable registries —
+``raytpu/cluster/constants.py`` (timing knobs, ``_f``/``_i``) and
+``raytpu/core/config.py`` (``declare`` config knobs and ``declare_env``
+for flags read elsewhere). An undeclared ``RAYTPU_*`` read is a knob
+nobody can discover: it appears in no docs, no ``cfg.items()`` dump,
+and no operator runbook, and two modules inevitably invent slightly
+different names for the same thing (the pre-registry state of
+``RAYTPU_HEARTBEAT_*``).
+
+Detected reads: ``os.environ.get/setdefault/pop``, ``os.getenv``,
+``os.environ[...]`` (load or store — arming writes count as uses), and
+``"..." in os.environ`` — with the name given as a literal or as a
+module-level ``NAME = "RAYTPU_..."`` alias. Dynamic names
+(f-strings) are only allowed inside the registries themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Optional, Set
+
+from raytpu.analysis.core import ParsedModule, Rule, register
+
+_REGISTRY_RELS = ("raytpu/cluster/constants.py", "raytpu/core/config.py")
+
+
+def declared_env_vars(modules=()) -> Set[str]:
+    """Parse the two registry files (reusing already-parsed modules when
+    the scan includes them) into the declared RAYTPU_* name set."""
+    by_rel = {m.rel: m for m in modules}
+    out: Set[str] = set()
+    pkg = pathlib.Path(__file__).resolve().parents[2]
+    for rel in _REGISTRY_RELS:
+        mod = by_rel.get(rel)
+        tree = mod.tree if mod is not None else ast.parse(
+            (pkg / rel.split("/", 1)[1]).read_text())
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            arg = node.args[0].value
+            if node.func.id in ("_f", "_i"):
+                out.add(f"RAYTPU_{arg}")
+            elif node.func.id == "declare":
+                out.add(f"RAYTPU_{arg.upper()}")
+            elif node.func.id == "declare_env":
+                out.add(arg)
+    return out
+
+
+def _module_aliases(tree) -> dict:
+    """Module-level ``NAME = "RAYTPU_..."`` constant bindings."""
+    out = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and node.value.value.startswith("RAYTPU_")):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.value
+    return out
+
+
+def _is_environ(node) -> bool:
+    """``os.environ`` or a bare ``environ`` name."""
+    if isinstance(node, ast.Name):
+        return node.id == "environ"
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os")
+
+
+def _resolve_name(node, aliases) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value.startswith("RAYTPU_") else None
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    return None
+
+
+def _is_dynamic_raytpu(node) -> bool:
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        return (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("RAYTPU_"))
+    return False
+
+
+@register
+class EnvRegistry(Rule):
+    id = "RTP008"
+    name = "env-registry"
+    invariant = ("every RAYTPU_* environment variable read under "
+                 "raytpu/ is declared in cluster/constants.py or "
+                 "core/config.py")
+    rationale = ("an undeclared env knob is undiscoverable and invites "
+                 "divergent names for the same setting")
+    scope = ("raytpu/",)
+    exempt = _REGISTRY_RELS  # dynamic f-string reads ARE the registry
+
+    def __init__(self):
+        self._declared: Optional[Set[str]] = None
+
+    def check(self, mod: ParsedModule):
+        aliases = _module_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            name_node = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in ("get", "setdefault", "pop")
+                        and _is_environ(f.value) and node.args):
+                    name_node = node.args[0]
+                elif (isinstance(f, ast.Attribute) and f.attr == "getenv"
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "os" and node.args):
+                    name_node = node.args[0]
+            elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+                name_node = node.slice
+            elif isinstance(node, ast.Compare):
+                if (len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                        and _is_environ(node.comparators[0])):
+                    name_node = node.left
+            if name_node is None:
+                continue
+            if _is_dynamic_raytpu(name_node):
+                yield self.finding(
+                    mod, node,
+                    "dynamically-built RAYTPU_* env name outside the "
+                    "registries — only cluster/constants.py and "
+                    "core/config.py may derive env names")
+                continue
+            name = _resolve_name(name_node, aliases)
+            if name is None:
+                continue
+            if self._declared is None:
+                self._declared = declared_env_vars()
+            if name not in self._declared:
+                yield self.finding(
+                    mod, node,
+                    f"{name} read but not declared — add declare_env("
+                    f"{name!r}, ...) to core/config.py (or a constants.py "
+                    f"knob)")

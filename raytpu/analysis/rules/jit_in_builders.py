@@ -1,0 +1,63 @@
+"""RTP004: ``jax.jit`` only inside ``_build_*`` constructors.
+
+Migrated from ``tests/test_inference.py::TestInferenceJitLint`` (PR 4).
+The inference engine's compile-once-per-bucket contract means the
+per-iteration ``step()`` path must only CALL prebuilt compiled
+functions; a ``jax.jit`` outside a ``_build_*`` constructor (or inside
+a loop, even in a builder) re-traces per call and silently turns the
+decode hot loop into a compile loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from raytpu.analysis.core import Rule, register
+
+
+def jit_calls_outside_builders(tree) -> Tuple[List[int], List[int]]:
+    """``(all_jit_call_lines, violation_lines)`` for one module."""
+    total, violations = [], []
+
+    def is_jit(func):
+        return (isinstance(func, ast.Name) and func.id == "jit") or (
+            isinstance(func, ast.Attribute) and func.attr == "jit")
+
+    def visit(node, in_builder, in_loop):
+        for child in ast.iter_child_nodes(node):
+            builder = in_builder
+            loop = in_loop
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                builder = child.name.startswith("_build_")
+                loop = False  # a nested def resets loop lexicality
+            elif isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                loop = True
+            if isinstance(child, ast.Call) and is_jit(child.func):
+                total.append(child.lineno)
+                if not builder or loop:
+                    violations.append(child.lineno)
+            visit(child, builder, loop)
+
+    visit(tree, False, False)
+    return total, violations
+
+
+@register
+class JitInBuilders(Rule):
+    id = "RTP004"
+    name = "jit-in-builders"
+    invariant = ("jax.jit in raytpu/inference/ may appear only inside a "
+                 "_build_* constructor and never inside a loop")
+    rationale = ("the per-iteration step path must call prebuilt "
+                 "compiled functions; a stray jit re-traces per call")
+    scope = ("raytpu/inference/",)
+
+    def check(self, mod):
+        _total, violations = jit_calls_outside_builders(mod.tree)
+        for line in violations:
+            yield self.finding(
+                mod, None,
+                "jax.jit outside a _build_* constructor (or inside a "
+                "loop) — the per-iteration path must only call prebuilt "
+                "compiled functions", line=line, col=0)

@@ -1,0 +1,75 @@
+"""RTP009: no silently-swallowed exceptions at cluster RPC seams.
+
+A ``try`` whose body issues a cross-process call (``.call(...)`` /
+``.notify(...)`` — the :class:`~raytpu.cluster.protocol.RpcClient`
+surface) and whose handler catches everything with a bare ``pass``
+erases the only evidence of a sick peer: retries look like hangs,
+breakers never learn, and post-mortems have nothing to show. Tolerating
+the failure is usually *correct* at these seams (best-effort notifies,
+teardown paths) — the rule only demands the swallow be recorded:
+``except Exception as e: errors.swallow("seam.name", e)`` (a never-
+raising debug-log + counter in :mod:`raytpu.util.errors`), a log call,
+or any other handling statement. Bare ``except:`` is flagged anywhere
+in ``raytpu/cluster/`` regardless of the try body — it eats
+``KeyboardInterrupt``/``SystemExit``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_RPC_ATTRS = {"call", "notify"}
+
+
+def _body_has_rpc(try_node: ast.Try) -> bool:
+    for stmt in try_node.body:
+        for n in ast.walk(stmt):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _RPC_ATTRS):
+                return True
+    return False
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    return (isinstance(handler.type, ast.Name)
+            and handler.type.id in ("Exception", "BaseException"))
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    return all(
+        isinstance(s, ast.Pass)
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+        for s in handler.body)
+
+
+@register
+class SeamSwallow(Rule):
+    id = "RTP009"
+    name = "seam-swallow"
+    invariant = ("no bare except in raytpu/cluster/; broad handlers "
+                 "around RpcClient calls must record the swallowed "
+                 "failure (errors.swallow / logging), not pass")
+    rationale = ("a swallowed RPC failure erases the only evidence of a "
+                 "sick peer — post-mortems and breaker tuning go blind")
+    scope = ("raytpu/cluster/",)
+
+    def check(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            has_rpc = _body_has_rpc(node)
+            for h in node.handlers:
+                if h.type is None:
+                    yield self.finding(
+                        mod, h,
+                        "bare except: catches KeyboardInterrupt/"
+                        "SystemExit — name the exception type")
+                elif has_rpc and _is_broad(h) and _swallows(h):
+                    yield self.finding(
+                        mod, h,
+                        "RPC failure silently swallowed at a cluster "
+                        "seam — record it: except Exception as e: "
+                        "errors.swallow('<seam>', e)")

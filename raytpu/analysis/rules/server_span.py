@@ -1,0 +1,62 @@
+"""RTP002: every RPC handler call runs inside the server tracing span.
+
+Migrated from ``tests/test_tracing.py::TestServerSpanLint`` (PR 3). A
+``_dispatch`` function that invokes a registered ``handler`` outside a
+``with tracing.span(...)`` produces server-side work invisible to the
+cluster timeline — the one span site in ``protocol.py`` is what makes
+"where did this request spend its time" answerable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from raytpu.analysis.core import Rule, register
+
+
+def handler_call_sites(tree) -> Tuple[List[tuple], List[tuple]]:
+    """``(total, violations)`` — calls to a bare name ``handler`` inside
+    any ``_dispatch`` function; a violation is one NOT lexically inside
+    a ``with`` whose context expression mentions ``span``."""
+
+    def calls(node):
+        return [(n.lineno, n.col_offset) for n in ast.walk(node)
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "handler"]
+
+    total, spanned = [], set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name != "_dispatch":
+            continue
+        total.extend(calls(node))
+        for w in ast.walk(node):
+            if not isinstance(w, (ast.With, ast.AsyncWith)):
+                continue
+            if any("span" in ast.dump(item.context_expr)
+                   for item in w.items):
+                spanned.update(calls(w))
+    return total, [c for c in total if c not in spanned]
+
+
+@register
+class ServerSpan(Rule):
+    id = "RTP002"
+    name = "server-span"
+    invariant = ("_dispatch must invoke registered RPC handlers inside "
+                 "a tracing.span context")
+    rationale = ("unspanned handlers are invisible in the cluster "
+                 "timeline; the server span is the anchor every child "
+                 "span parents under")
+    scope = ("raytpu/cluster/",)
+
+    def check(self, mod):
+        _total, violations = handler_call_sites(mod.tree)
+        for line, col in violations:
+            yield self.finding(
+                mod, None,
+                "RPC handler invoked outside tracing.span in _dispatch — "
+                "every registered handler must run inside the server span",
+                line=line, col=col)

@@ -1,0 +1,57 @@
+"""RTP001: no hardcoded timing literals in ``raytpu/cluster/``.
+
+Migrated from ``tests/test_resilience.py::TestNoHardcodedTimeouts``
+(PR 2). Every retry sleep and timeout budget in the cluster layer must
+come from :mod:`raytpu.cluster.constants` (env-overridable), not inline
+literals — scattered magic timeouts are how one slow peer becomes an
+undebuggable gray failure: nobody can say which knob to turn, and no
+two sites agree.
+
+Exempt files: ``constants.py`` is the registry itself;
+``cluster_utils.py`` is the subprocess test harness (``proc.wait`` on
+spawn scripts is not a cluster timing knob).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+
+@register
+class TimingLiterals(Rule):
+    id = "RTP001"
+    name = "timing-literals"
+    invariant = ("numeric time.sleep()/timeout= literals in raytpu/cluster/ "
+                 "must be hoisted into cluster/constants.py")
+    rationale = ("every timing knob env-overridable and in one place; "
+                 "inline literals are untunable and undebuggable")
+    scope = ("raytpu/cluster/",)
+    exempt = ("raytpu/cluster/constants.py", "raytpu/cluster/cluster_utils.py")
+
+    def check(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_sleep = isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+            if (is_sleep and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, (int, float))
+                    and not isinstance(node.args[0].value, bool)):
+                yield self.finding(
+                    mod, node,
+                    f"time.sleep({node.args[0].value}): hardcoded timing "
+                    f"literal — hoist into cluster/constants.py "
+                    f"(RAYTPU_* env-overridable)")
+            for kw in node.keywords:
+                if (kw.arg == "timeout"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, (int, float))
+                        and not isinstance(kw.value.value, bool)):
+                    yield self.finding(
+                        mod, kw.value,
+                        f"timeout={kw.value.value}: hardcoded timing "
+                        f"literal — hoist into cluster/constants.py "
+                        f"(RAYTPU_* env-overridable)")

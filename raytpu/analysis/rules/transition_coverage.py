@@ -1,0 +1,78 @@
+"""RTP003: every declared ``TaskTransition`` is emitted somewhere.
+
+Migrated from ``tests/test_task_events.py::TestTransitionCoverageLint``
+(PR 5). A lifecycle state declared in the schema but never emitted from
+any seam is a lie in the schema: operators filter on it, dashboards
+legend it, and it never fires. Whole-tree rule: references are collected
+per module in ``check`` and the gap is reported from ``finalize``,
+anchored to the defining module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from raytpu.analysis.core import Rule, register
+
+_DEFINING = "raytpu/util/task_events.py"
+
+
+def transitions_referenced(tree) -> Set[str]:
+    """``TaskTransition.X`` member names referenced anywhere in a module
+    (unvalidated — callers intersect with the declared set)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            v = node.value
+            if ((isinstance(v, ast.Name) and v.id == "TaskTransition")
+                    or (isinstance(v, ast.Attribute)
+                        and v.attr == "TaskTransition")):
+                out.add(node.attr)
+    return out
+
+
+def declared_transitions() -> Set[str]:
+    from raytpu.util.task_events import TaskTransition
+
+    return set(TaskTransition.ALL)
+
+
+@register
+class TransitionCoverage(Rule):
+    id = "RTP003"
+    name = "transition-coverage"
+    invariant = ("every TaskTransition member is referenced (emitted) "
+                 "somewhere under raytpu/ outside its defining module")
+    rationale = ("a lifecycle state without instrumentation is a lie in "
+                 "the schema — state filters and summaries silently "
+                 "return nothing for it")
+    scope = ("raytpu/",)
+    # The defining module trivially references every member; the analysis
+    # package names members in rule docs/messages.
+    exempt = (_DEFINING,)
+
+    def __init__(self):
+        self._seen: Set[str] = set()
+
+    def applies(self, mod):
+        if mod.rel.startswith("raytpu/analysis/"):
+            return False
+        return super().applies(mod)
+
+    def check(self, mod):
+        self._seen |= transitions_referenced(mod.tree)
+        return ()
+
+    def finalize(self, modules):
+        if not modules:
+            return
+        from raytpu.analysis.core import Finding
+
+        # Anchor to the defining module (stable fingerprint) even though
+        # it is exempt from the reference scan itself.
+        for member in sorted(declared_transitions() - self._seen):
+            yield Finding(
+                self.id, _DEFINING, 1, 0,
+                f"TaskTransition.{member} is declared but never emitted "
+                f"under raytpu/ — instrument the seam or drop the member")

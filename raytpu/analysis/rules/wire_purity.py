@@ -1,0 +1,128 @@
+"""RTP005: strict-wire envelope purity at frame construction sites.
+
+:mod:`raytpu.cluster.wire` only enforces frame purity at *runtime* — a
+non-primitive envelope field rides the pickle fallback on trusted wires
+and explodes with :class:`~raytpu.cluster.wire.PickleRejected` the first
+time the same code path crosses a strict surface (the driver proxy).
+This rule pins the invariant statically at every construction site in
+``raytpu/cluster/``:
+
+- every top-level frame key must be registered in
+  ``wire.FRAME_FIELDS`` (append-only, like proto field numbers — an
+  unregistered key is a schema change nobody reviewed);
+- envelope *metadata* fields (``m``/``i``/``d``/``tc``/``p``) must be
+  built from wire-primitive expressions: constants, plain names/
+  attributes, ``*.to_wire()`` encodings, primitive constructors, or
+  string concatenation — never object literals, lambdas, container
+  displays, or arbitrary constructor calls.
+
+Frame sites recognized: dict displays whose string keys look like an
+RPC envelope (contain ``"m"``, ``"i"``, or ``"p"``, all keys <= 2
+chars), and subscript stores on names ``frame`` / ``reply``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_METADATA_KEYS = {"m", "i", "d", "tc", "p"}
+_PRIMITIVE_CTORS = {"str", "int", "float", "bool", "bytes", "len", "next"}
+
+
+def _frame_fields() -> dict:
+    from raytpu.cluster import wire
+
+    return wire.FRAME_FIELDS
+
+
+def _is_primitive_expr(node) -> bool:
+    """Conservatively wire-primitive: we can't type names/attributes, so
+    only provably-object expression *forms* are rejected."""
+    if isinstance(node, ast.Constant):
+        return node.value is None or isinstance(
+            node.value, (str, int, float, bool, bytes))
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+        return True
+    if isinstance(node, ast.JoinedStr):  # f-string -> str
+        return True
+    if isinstance(node, ast.IfExp):
+        return _is_primitive_expr(node.body) and _is_primitive_expr(
+            node.orelse)
+    if isinstance(node, ast.BinOp):
+        return _is_primitive_expr(node.left) and _is_primitive_expr(
+            node.right)
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "to_wire":
+            return True
+        if isinstance(f, ast.Name) and f.id in _PRIMITIVE_CTORS:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in ("get", "hex",
+                                                       "format", "join"):
+            return True
+        return False
+    return False
+
+
+def _looks_like_frame(node: ast.Dict) -> bool:
+    keys = []
+    for k in node.keys:
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return False
+        keys.append(k.value)
+    return (len(keys) >= 2 and len(set(keys)) == len(keys)
+            and all(len(k) <= 2 for k in keys)
+            and bool({"m", "i", "p"} & set(keys)))
+
+
+@register
+class WireEnvelopePurity(Rule):
+    id = "RTP005"
+    name = "wire-envelope-purity"
+    invariant = ("RPC frame keys are registered in wire.FRAME_FIELDS and "
+                 "envelope metadata fields are wire-primitive expressions")
+    rationale = ("an object-valued envelope field works on trusted wires "
+                 "via the pickle fallback and breaks the strict proxy "
+                 "surface at runtime; a new key is an unreviewed schema "
+                 "change")
+    scope = ("raytpu/cluster/",)
+    exempt = ("raytpu/cluster/wire.py",)  # the codec/registry itself
+
+    def check(self, mod):
+        fields = _frame_fields()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Dict) and _looks_like_frame(node):
+                keys = {k.value for k in node.keys}
+                is_push = "p" in keys
+                for k, v in zip(node.keys, node.values):
+                    yield from self._check_field(mod, fields, k.value, v,
+                                                 k, is_push=is_push)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Subscript)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id in ("frame", "reply")
+                            and isinstance(tgt.slice, ast.Constant)
+                            and isinstance(tgt.slice.value, str)):
+                        yield from self._check_field(
+                            mod, fields, tgt.slice.value, node.value, tgt,
+                            is_push=False)
+
+    def _check_field(self, mod, fields, key, value, anchor, is_push):
+        if key not in fields:
+            yield self.finding(
+                mod, anchor,
+                f"unregistered frame field {key!r} — register it in "
+                f"wire.FRAME_FIELDS (append-only envelope schema) and "
+                f"keep it wire-primitive")
+            return
+        if key in _METADATA_KEYS and not (is_push and key == "d"):
+            if not _is_primitive_expr(value):
+                yield self.finding(
+                    mod, value,
+                    f"frame field {key!r} built from a non-primitive "
+                    f"expression — envelope metadata must be wire-"
+                    f"primitive on every surface (use .to_wire() or "
+                    f"primitives)")

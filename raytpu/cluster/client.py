@@ -34,6 +34,7 @@ from raytpu.core.errors import (
     PlacementGroupError,
     WorkerCrashedError,
 )
+from raytpu.util import errors
 from raytpu.util.errors import (
     CircuitOpenError,
     NodeVanishedError,
@@ -422,8 +423,8 @@ class ClusterBackend:
             try:
                 self._head.call("request_free", oid.hex(),
                                 timeout=tuning.CONTROL_CALL_TIMEOUT_S)
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("client.free_loop", e)
 
     def _pending_loop(self) -> None:
         while not self._shutdown_flag:
@@ -591,8 +592,8 @@ class ClusterBackend:
             try:
                 self._peer(addr).call("kill_actor", actor_id.hex(),
                                       no_restart)
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("client.kill_actor", e)
 
     def actor_handle_added(self, actor_id: ActorID) -> None:
         pass  # cluster actors live until killed or their node dies
@@ -611,8 +612,8 @@ class ClusterBackend:
             if addr is not None:
                 try:
                     self._peer(addr).notify(method, task_id.hex(), count)
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("client.stream_notify", e)
             return
         if method != "stream_close":
             return
@@ -629,10 +630,10 @@ class ClusterBackend:
                 try:
                     self._peer(loc["address"]).notify(
                         method, task_id.hex(), count)
-                except Exception:
-                    pass
-        except Exception:
-            pass
+                except Exception as e:
+                    errors.swallow("client.stream_close_holder", e)
+        except Exception as e:
+            errors.swallow("client.stream_close_locate", e)
 
     def stream_ack(self, task_id: TaskID, consumed: int) -> None:
         self._stream_notify("stream_ack", task_id, consumed)
@@ -649,8 +650,8 @@ class ClusterBackend:
         if addr is not None:
             try:
                 self._peer(addr).call("cancel_task", task_id.binary())
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("client.cancel_task", e)
 
     # -- objects -----------------------------------------------------------
 
@@ -979,8 +980,8 @@ class ClusterBackend:
             if addr is not None:
                 try:
                     self._peer(addr).call("remove_pg_shard", pg_id.binary())
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("client.remove_pg_shard", e)
         self._head.call("remove_pg", pg_id.hex())
 
     def placement_group_info(self, pg_id: PlacementGroupID) -> Optional[dict]:

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from raytpu.core.config import cfg
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+from raytpu.util import errors
 from raytpu.util.resilience import current_deadline
 from raytpu.util.tracing import current_trace
 
@@ -96,8 +97,8 @@ class DriverProxy:
             with self._lock:
                 self._allowed = {self._head_address} | {
                     n["address"] for n in nodes if n.get("address")}
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("proxy.refresh_allowed", e)
         with self._lock:
             if target not in self._allowed:
                 raise PermissionError(
@@ -119,8 +120,8 @@ class DriverProxy:
             try:
                 c.subscribe(topic, self._make_fanout((address, topic)))
                 c.call("subscribe", topic)
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("proxy.rewire_subscription", e)
         return c
 
     def _make_fanout(self, key: Tuple[str, str]):
@@ -169,7 +170,9 @@ class DriverProxy:
     async def _relay_notify(self, peer: Peer, target: str, method: str,
                             args: list) -> None:
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
+        # Notify frames are fire-and-forget and carry no trace context —
+        # there is nothing to propagate across this hop.
+        await loop.run_in_executor(  # raytpulint: disable=RTP006
             self._pool, self._relay_notify_blocking, target, method, args)
 
     def _relay_notify_blocking(self, target: str, method: str,

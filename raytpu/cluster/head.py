@@ -37,6 +37,7 @@ from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import failpoints
 from raytpu.util import task_events
 from raytpu.util import tracing
+from raytpu.util import errors
 from raytpu.util.errors import PlacementInfeasibleError
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.resilience import breaker_for
@@ -550,8 +551,9 @@ class HeadServer:
                         timeout=tuning.CONTROL_CALL_TIMEOUT_S,
                         breaker=breaker_for(address))
                     reached.append(node_id)
-                except Exception:
-                    pass  # a dying node is exactly what chaos runs expect
+                except Exception as e:
+                    # a dying node is exactly what chaos runs expect
+                    errors.swallow("head.failpoint_cfg", e)
         return reached
 
     def _failpoint_clear(self, peer: Peer,
@@ -569,8 +571,8 @@ class HeadServer:
                         timeout=tuning.CONTROL_CALL_TIMEOUT_S,
                         breaker=breaker_for(address))
                     reached.append(node_id)
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("head.failpoint_clear", e)
         return reached
 
     # -- tracing -----------------------------------------------------------
@@ -593,8 +595,8 @@ class HeadServer:
                         breaker=breaker_for(address))
                     if isinstance(got, list):
                         dumps.extend(d for d in got if isinstance(d, dict))
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("head.trace_dump", e)
         return dumps
 
     def _peer_gone(self, peer: Peer) -> None:
@@ -809,8 +811,8 @@ class HeadServer:
             try:
                 self._node_client(node_id, address).notify(
                     "free_object", oid_hex)
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("head.free_object", e)
 
     def _node_client(self, node_id: str, address: str):
         client = self._node_clients.get(node_id)

@@ -40,6 +40,7 @@ from raytpu.runtime.local_backend import LocalBackend, _Bundle, _PlacementGroup
 from raytpu.runtime.serialization import SerializedValue
 from raytpu.runtime.task_spec import SchedulingKind, TaskSpec
 from raytpu.core.resources import ResourceSet
+from raytpu.util import errors
 from raytpu.util.errors import PlacementInfeasibleError
 from raytpu.util.resilience import RetryPolicy
 
@@ -804,8 +805,8 @@ class NodeServer:
             if self._head is not None:
                 self._head.call("drain_node", self.node_id.hex(),
                                 timeout=tuning.DRAIN_TIMEOUT_S)
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("node.drain_on_shutdown", e)
         self.backend.shutdown()
         try:
             self.backend.store.teardown_spill()
@@ -947,8 +948,8 @@ class NodeServer:
                     self.node_id.hex(), ac.name, ac.namespace,
                     ac.max_restarts, dict(rt.creation_spec.resources),
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("node.reregister_actor", e)
         # Re-announce object locations.
         for oid in self.backend.store.keys():
             try:
@@ -1024,7 +1025,10 @@ class NodeServer:
 
             self._push_tx_pool = ThreadPoolExecutor(
                 max_workers=4, thread_name_prefix="raytpu-push-tx")
-        self._push_tx_pool.submit(self._push_object_to, oid_hex, targets)
+        tc = tracing.current_trace()
+        self._push_tx_pool.submit(tracing.run_with_trace, tc,
+                                  "object.push_tx", self._push_object_to,
+                                  oid_hex, targets)
 
     def _push_object_to(self, oid_hex: str, addresses: List[str]) -> None:
         from raytpu.cluster.transfer import push_blob
@@ -1180,8 +1184,8 @@ class NodeServer:
                         try:
                             self._head.notify("object_unavailable",
                                               oid.hex())
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            errors.swallow("node.object_unavailable", e)
                 ev.clear()
                 ev.wait(delay)
                 delay = min(delay * 2, 0.2)
@@ -1630,11 +1634,14 @@ class NodeServer:
                                     "locate_object", oh, True,
                                     timeout=tuning.CONTROL_CALL_TIMEOUT_S):
                                 found = True
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            errors.swallow("node.wait_locate", e)
                     return found
 
-                if await loop.run_in_executor(None, _locate):
+                tc = tracing.current_trace()
+                if await loop.run_in_executor(
+                        None, tracing.run_with_trace, tc,
+                        "node.wait_locate", _locate):
                     return True
             try:
                 await asyncio.wait_for(
@@ -1685,8 +1692,8 @@ class NodeServer:
             try:
                 handle.client.notify(method, task_id_hex, count)
                 return
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("node.stream_relay_worker", e)
         with self.backend.worker._streams_cv:
             local_stream = tid in self.backend.worker._streams
         if local_stream:
@@ -1705,8 +1712,8 @@ class NodeServer:
                     self._peer_client(loc["address"]).notify(
                         method, task_id_hex, count)
                     return
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("node.stream_relay_remote", e)
 
     def _h_task_blocked(self, peer: Peer, task_id_bin: bytes) -> None:
         self.backend.task_blocked(TaskID(task_id_bin))
@@ -1913,8 +1920,9 @@ class NodeServer:
                                   timeout=tuning.CONTROL_CALL_TIMEOUT_S)
                 if isinstance(got, dict):
                     dumps.append(got)
-            except Exception:
-                pass  # a dying worker just misses the timeline
+            except Exception as e:
+                # a dying worker just misses the timeline
+                errors.swallow("node.worker_trace_dump", e)
         return dumps
 
     async def _fanout_worker_profiling(self, worker_id, payload_key,
@@ -1953,10 +1961,13 @@ class NodeServer:
                                 rpc_name, *rpc_args, timeout=timeout)}
                 jobs.append((wid, one))
         if jobs:
+            tc = tracing.current_trace()
             with ThreadPoolExecutor(
                     max_workers=min(16, len(jobs)),
                     thread_name_prefix="raytpu-profile") as ex:
-                futs = {wid: loop.run_in_executor(ex, fn)
+                futs = {wid: loop.run_in_executor(
+                            ex, tracing.run_with_trace, tc,
+                            "node.profile_fanout", fn)
                         for wid, fn in jobs}
                 for wid, fut in futs.items():
                     try:

@@ -30,6 +30,7 @@ from typing import List, Optional
 from raytpu.core.config import cfg
 from raytpu.cluster import constants as tuning
 from raytpu.runtime.serialization import SerializedValue
+from raytpu.util import errors
 from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.resilience import Deadline
@@ -188,8 +189,8 @@ def _push_blob_impl(client, oid_hex: str, sv: SerializedValue,
     if not ok:
         try:
             client.notify("push_object_abort", oid_hex)
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("transfer.push_abort", e)
         return False
     return client.call("push_object_end", oid_hex, timeout=timeout,
                        deadline=deadline) is True

@@ -48,6 +48,26 @@ from raytpu.util.failpoints import failpoint
 
 WIRE_VERSION = 1
 
+# The RPC envelope schema: every top-level frame key used at a frame
+# construction site anywhere in raytpu/cluster/ must be registered here
+# (enforced by raytpulint RTP005). Envelope *metadata* fields (method,
+# correlation id, deadline, trace context, push topic) must stay
+# wire-primitive on every surface — including the strict no-pickle wire —
+# so they are built only from primitives or ``.to_wire()`` encodings.
+# Payload fields ("a"/"r"/"e", and "d" on a push frame) may carry any
+# codec-encodable value.
+FRAME_FIELDS = {
+    "m": "method name (str)",
+    "a": "positional args (payload)",
+    "i": "request correlation id (int)",
+    "d": "deadline: remaining seconds (float) — push frames reuse it "
+         "as the payload slot",
+    "tc": "trace context (list of primitives, TraceContext.to_wire)",
+    "r": "reply payload",
+    "e": "reply error (structural exception encoding)",
+    "p": "push topic (str)",
+}
+
 _EXT_STRUCT = 1
 _EXT_TUPLE = 2
 _EXT_ID = 3

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import RpcClient
 from raytpu.core.config import cfg
+from raytpu.util import errors
 from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.events import record_event
@@ -263,8 +264,8 @@ class WorkerPool:
             if h.client is not None and not h.client.closed:
                 h.client.call("kill", reason,
                               timeout=tuning.WORKER_KILL_TIMEOUT_S)
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("pool.kill_rpc", e)
         try:
             if h.proc is not None:
                 h.proc.terminate()

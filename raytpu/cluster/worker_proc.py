@@ -35,6 +35,7 @@ from raytpu.cluster import constants as tuning
 from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+from raytpu.util import errors
 from raytpu.util import task_events, tracing
 from raytpu.util.failpoints import failpoint
 from raytpu.core.errors import ActorDiedError, TaskError
@@ -134,28 +135,28 @@ class WorkerBackend:
     def stream_ack(self, task_id: TaskID, consumed: int) -> None:
         try:
             self._host.node.notify("stream_ack", task_id.hex(), consumed)
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("worker.stream_ack", e)
 
     def stream_close(self, task_id: TaskID, consumed: int) -> None:
         try:
             self._host.node.notify("stream_close", task_id.hex(), consumed)
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("worker.stream_close", e)
 
     # -- blocked-worker protocol ------------------------------------------
 
     def task_blocked(self, task_id: TaskID) -> None:
         try:
             self._host.node.notify("task_blocked", task_id.binary())
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("worker.task_blocked", e)
 
     def task_unblocked(self, task_id: TaskID) -> None:
         try:
             self._host.node.notify("task_unblocked", task_id.binary())
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("worker.task_unblocked", e)
 
     # -- introspection -----------------------------------------------------
 
@@ -241,8 +242,8 @@ class _WorkerHost:
                 try:
                     self.node.notify("borrow_released", oid.hex(),
                                      self.worker_id_hex)
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("worker.borrow_released", e)
 
         threading.Thread(target=_release_loop, name="borrow-release",
                          daemon=True).start()
@@ -524,7 +525,9 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     server = RpcServer("127.0.0.1", 0)
 
     async def _offload(fn, *a):
-        return await asyncio.get_event_loop().run_in_executor(
+        # Callers pass tracing.run_with_trace with tc already captured on
+        # the loop thread (see h_execute below).
+        return await asyncio.get_event_loop().run_in_executor(  # raytpulint: disable=RTP006
             None, fn, *a)
 
     def h_execute(peer: Peer, blob: bytes):

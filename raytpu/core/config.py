@@ -74,6 +74,79 @@ class _Config:
 
 cfg = _Config()
 
+# --- Environment-variable registry -------------------------------------------
+#
+# Some RAYTPU_* variables are read directly (process-boot flags, opt-in
+# debug hooks) rather than through a ``declare``d knob — usually because
+# they must be readable before config snapshots exist, or because the
+# reading module must stay import-light. They are still declared here so
+# every environment knob is discoverable in one place; the RTP008 lint
+# rule enforces that no RAYTPU_* read escapes the registries.
+
+_ENV_REGISTRY: Dict[str, str] = {}
+
+
+def declare_env(name: str, doc: str) -> None:
+    """Register a RAYTPU_* variable that is read via ``os.environ``
+    directly (not through ``declare``)."""
+    if not name.startswith("RAYTPU_"):
+        raise ValueError(f"env var {name!r} must start with RAYTPU_")
+    if name in _ENV_REGISTRY:
+        raise ValueError(f"env var {name} declared twice")
+    _ENV_REGISTRY[name] = doc
+
+
+def declared_env() -> Dict[str, str]:
+    """All directly-read env vars with their one-line docs."""
+    return dict(_ENV_REGISTRY)
+
+
+# Tracing (util/tracing.py): read at import so tracing works before any
+# cluster config exists.
+declare_env("RAYTPU_TRACING", "enable distributed tracing spans (bool)")
+declare_env("RAYTPU_TRACE_SAMPLE", "trace sampling rate in [0,1]")
+declare_env("RAYTPU_TRACE_BUFFER", "per-process span ring-buffer size")
+
+# Task-event flight recorder (util/task_events.py).
+declare_env("RAYTPU_TASK_EVENTS", "enable the task-event flight recorder (bool)")
+declare_env("RAYTPU_TASK_EVENTS_RING", "per-process task-event ring size")
+
+# Fault injection (util/failpoints.py): armed via env so child worker
+# processes inherit the failure plan without any RPC.
+declare_env("RAYTPU_FAILPOINTS", "failpoint spec armed for this process tree")
+declare_env("RAYTPU_FAILPOINTS_SEED", "deterministic seed for probabilistic failpoints")
+
+# Resilience defaults (util/resilience.py): read before config snapshots
+# arrive so retry/breaker policies cover the bootstrap RPCs too.
+declare_env("RAYTPU_RETRY_MAX_ATTEMPTS", "default retry attempt cap")
+declare_env("RAYTPU_RETRY_BASE_DELAY_S", "retry backoff base delay (s)")
+declare_env("RAYTPU_RETRY_MAX_DELAY_S", "retry backoff delay ceiling (s)")
+declare_env("RAYTPU_BREAKER_FAILURE_THRESHOLD", "circuit-breaker trip threshold")
+declare_env("RAYTPU_BREAKER_RESET_TIMEOUT_S", "circuit-breaker half-open delay (s)")
+
+# Usage stats (util/usage_stats.py).
+declare_env("RAYTPU_USAGE_STATS_ENABLED", "opt-in anonymous usage stats (bool)")
+declare_env("RAYTPU_USAGE_STATS_PATH", "override usage-stats spool path")
+
+# Head / node boot flags (cluster/head.py, cluster/node.py,
+# cluster/topology.py): consumed during process bring-up, before the
+# head's config snapshot has been shipped.
+declare_env("RAYTPU_HEARTBEAT_TIMEOUT_S", "head marks a node dead after this silence")
+declare_env("RAYTPU_HEARTBEAT_PERIOD_S", "node heartbeat send period (s)")
+declare_env("RAYTPU_HEALTH_CHECK_PERIOD_S", "head health-check sweep period (s)")
+declare_env("RAYTPU_HOST_IP", "advertised address override for this host")
+declare_env("RAYTPU_NUM_TPUS", "TPU chip count override for topology detection")
+
+# Kernels (tpu/flash_attention.py).
+declare_env("RAYTPU_FLASH_DOT", "force the dot-product flash-attention path (bool)")
+
+# Runtime environments (runtime_env/container.py, runtime_env/pip_env.py).
+declare_env("RAYTPU_CONTAINER_ENGINE", "container engine binary (docker/podman)")
+declare_env("RAYTPU_ALLOW_PIP", "allow pip-install runtime envs (bool)")
+
+# Workflows (workflow/storage.py).
+declare_env("RAYTPU_WORKFLOW_ROOT", "workflow checkpoint storage root")
+
 # --- Declared knobs (reference: ray_config_def.h) ----------------------------
 
 # Scheduling. Hybrid policy packs nodes until utilization crosses this

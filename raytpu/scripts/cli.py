@@ -649,7 +649,19 @@ def build_parser() -> argparse.ArgumentParser:
         j.add_argument("job_id")
     jsub.add_parser("list")
     s.set_defaults(fn=_cmd_job)
+
+    s = sub.add_parser(
+        "lint", help="static analysis: the runtime's cross-cutting "
+                     "invariants (see raytpu/analysis/)")
+    from raytpu.analysis import cli as _lint_cli
+    _lint_cli.add_arguments(s)
+    s.set_defaults(fn=_cmd_lint)
     return p
+
+
+def _cmd_lint(args) -> int:
+    from raytpu.analysis import cli as _lint_cli
+    return _lint_cli.run(args)
 
 
 def main(argv=None) -> int:

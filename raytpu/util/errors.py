@@ -24,7 +24,8 @@ survive the hop back to a remote caller.
 
 from __future__ import annotations
 
-from typing import Optional
+import logging
+from typing import Dict, Optional
 
 from raytpu.core.errors import RayTpuError
 
@@ -112,6 +113,33 @@ class RpcTimeoutError(RetryableError, TimeoutError):
         if elapsed_s is not None:
             msg += f" (elapsed {elapsed_s:.3f}s)"
         super().__init__(msg)
+
+
+_SWALLOWED: "Dict[str, int]" = {}
+
+
+def swallow(where: str, exc: BaseException) -> None:
+    """Record an intentionally-tolerated failure at a cluster seam.
+
+    Best-effort paths (notify fan-out, teardown, metrics push) are
+    *allowed* to tolerate peer failures — but a silent ``pass`` erases
+    the only evidence of a sick peer. This helper is the sanctioned
+    swallow: it bumps a per-seam counter and debug-logs the exception,
+    and is guaranteed never to raise, so it is safe in ``finally`` and
+    teardown paths. ``swallowed_counts()`` exposes the tallies for
+    post-mortems and tests.
+    """
+    try:
+        _SWALLOWED[where] = _SWALLOWED.get(where, 0) + 1
+        logging.getLogger("raytpu.errors").debug(
+            "swallowed at %s: %r", where, exc)
+    except Exception:  # the never-raise contract trumps reporting
+        pass
+
+
+def swallowed_counts() -> "Dict[str, int]":
+    """Per-seam tallies of swallowed failures (copy)."""
+    return dict(_SWALLOWED)
 
 
 def is_retryable(exc: BaseException) -> bool:

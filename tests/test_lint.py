@@ -1,0 +1,506 @@
+"""raytpulint: the static-analysis framework (raytpu/analysis/).
+
+Covers the PR's contracts:
+
+- every rule has a planted-violation self-test (the rule bites) and a
+  clean fixture (the rule does not cry wolf);
+- ``# raytpulint: disable=RTPxxx`` same-line suppressions silence a
+  finding; ``disable=all`` silences any rule;
+- the baseline round-trips through JSON and its fingerprints survive
+  unrelated edits (no line numbers in the fingerprint);
+- ``--json`` output follows the documented schema;
+- the whole-tree run is the tier-1 gate: zero unsuppressed findings
+  over ``raytpu/``, each file parsed exactly once, well under 5 s.
+"""
+
+import io
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from raytpu.analysis import cli as lint_cli
+from raytpu.analysis.core import (
+    Finding,
+    all_rules,
+    load_baseline,
+    run_lint,
+    run_rule_on_source,
+    save_baseline,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+MIGRATED = {"RTP001", "RTP002", "RTP003", "RTP004"}
+
+
+def _rule(rid):
+    (r,) = all_rules(select=[rid])
+    return r
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip("\n")
+
+
+# -- registry ----------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_catalogue_shape(self):
+        rules = all_rules()
+        ids = [r.id for r in rules]
+        assert ids == sorted(ids)
+        assert len(set(ids)) == len(ids)
+        assert MIGRATED <= set(ids)
+        assert len(set(ids) - MIGRATED) >= 4  # the new invariants
+        for r in rules:
+            assert r.id.startswith("RTP") and len(r.id) == 6
+            assert r.name and r.invariant and r.rationale
+            assert r.scope
+
+    def test_unknown_rule_id_raises(self):
+        with pytest.raises(ValueError, match="RTP999"):
+            all_rules(select=["RTP999"])
+
+    def test_fresh_instances_per_run(self):
+        # Whole-tree rules accumulate state; a second run must not see
+        # the first run's accumulation.
+        a, b = _rule("RTP003"), _rule("RTP003")
+        assert a is not b
+
+
+# -- per-rule planted violation + clean fixture ------------------------------
+
+
+class TestTimingLiterals:  # RTP001
+    def test_planted(self):
+        findings = run_rule_on_source(_rule("RTP001"), _src("""
+            import time
+            def f(c):
+                time.sleep(0.5)
+                c.call('x', timeout=5.0)
+        """))
+        assert len(findings) == 2
+        assert all(f.rule == "RTP001" for f in findings)
+
+    def test_clean(self):
+        assert run_rule_on_source(_rule("RTP001"), _src("""
+            import time
+            from raytpu.cluster import constants as tuning
+            def f(c):
+                time.sleep(tuning.PENDING_POLL_PERIOD_S)
+                c.call('x', timeout=tuning.CONTROL_CALL_TIMEOUT_S)
+        """)) == []
+
+    def test_registry_file_is_exempt(self):
+        assert run_rule_on_source(
+            _rule("RTP001"), "import time\ntime.sleep(1.0)\n",
+            rel="raytpu/cluster/constants.py") == []
+
+
+class TestServerSpan:  # RTP002
+    def test_planted(self):
+        findings = run_rule_on_source(_rule("RTP002"), _src("""
+            async def _dispatch(self, peer, frame):
+                handler = self._handlers.get(frame.get('m'))
+                result = handler(peer)
+        """))
+        assert len(findings) == 1
+
+    def test_clean(self):
+        assert run_rule_on_source(_rule("RTP002"), _src("""
+            async def _dispatch(self, peer, frame):
+                handler = self._handlers.get(frame.get('m'))
+                with tracing.span('rpc.server.x'):
+                    result = handler(peer)
+        """)) == []
+
+
+class TestTransitionCoverage:  # RTP003 (whole-tree)
+    def test_planted(self):
+        from raytpu.util.task_events import TaskTransition
+
+        findings = run_rule_on_source(_rule("RTP003"), _src("""
+            from raytpu.util import task_events
+            def f():
+                task_events.emit('task', 't',
+                    task_events.TaskTransition.SUBMITTED)
+        """), whole_tree=True)
+        missing = {f.message.split()[0] for f in findings}
+        assert f"TaskTransition.{TaskTransition.ALL[0]}" not in missing or \
+            TaskTransition.ALL[0] != "SUBMITTED"
+        assert len(findings) == len(TaskTransition.ALL) - 1
+
+    def test_clean(self):
+        from raytpu.util.task_events import TaskTransition
+
+        src = "\n".join(f"x{i} = TaskTransition.{m}"
+                        for i, m in enumerate(TaskTransition.ALL))
+        assert run_rule_on_source(_rule("RTP003"), src,
+                                  whole_tree=True) == []
+
+
+class TestJitInBuilders:  # RTP004
+    def test_planted(self):
+        findings = run_rule_on_source(_rule("RTP004"), _src("""
+            import jax
+            def step(self):
+                fn = jax.jit(lambda x: x)
+            def _build_decode_fn(self):
+                return jax.jit(lambda x: x)
+            def _build_loopy(self):
+                for _ in range(2):
+                    jax.jit(lambda x: x)
+        """), rel="raytpu/inference/_planted.py")
+        assert len(findings) == 2  # step() and the in-loop builder call
+
+    def test_clean(self):
+        assert run_rule_on_source(_rule("RTP004"), _src("""
+            import jax
+            def _build_decode_fn(self):
+                return jax.jit(lambda x: x)
+            def step(self):
+                return self._decode_fn(1)
+        """), rel="raytpu/inference/_planted.py") == []
+
+
+class TestWirePurity:  # RTP005
+    def test_planted_non_primitive_metadata(self):
+        findings = run_rule_on_source(_rule("RTP005"), _src("""
+            def send(self, make_method, rid):
+                frame = {"m": make_method(), "i": rid}
+        """))
+        assert len(findings) == 1
+        assert "non-primitive" in findings[0].message
+
+    def test_planted_unregistered_key(self):
+        findings = run_rule_on_source(_rule("RTP005"), _src("""
+            def send(self, rid):
+                frame = {"m": "call", "i": rid, "q": 2}
+                frame["zz"] = 1
+        """))
+        assert len(findings) == 2
+        assert all("unregistered frame field" in f.message
+                   for f in findings)
+
+    def test_clean(self):
+        # "a" is the payload slot: arbitrary values are allowed there
+        # (the codec handles them); metadata must stay primitive.
+        assert run_rule_on_source(_rule("RTP005"), _src("""
+            def send(self, method, rid, args, tc, dl):
+                frame = {"m": method, "i": rid, "a": [args, {}],
+                         "tc": tc.to_wire(), "d": float(dl)}
+        """)) == []
+
+    def test_all_runtime_keys_are_registered(self):
+        from raytpu.cluster import wire
+
+        assert set(wire.FRAME_FIELDS) >= {"m", "a", "i", "d", "tc",
+                                          "r", "e", "p"}
+
+
+class TestContextvarCrossing:  # RTP006
+    REL = "raytpu/cluster/node.py"
+
+    def test_planted(self):
+        findings = run_rule_on_source(_rule("RTP006"), _src("""
+            def kick(self, loop, pool, work):
+                loop.run_in_executor(None, work)
+                pool.submit(work)
+        """), rel=self.REL)
+        assert len(findings) == 2
+
+    def test_clean_wrapped_callable(self):
+        assert run_rule_on_source(_rule("RTP006"), _src("""
+            def kick(self, loop, pool, work):
+                tc = tracing.current_trace()
+                loop.run_in_executor(None, tracing.run_with_trace,
+                                     tc, "hop", work)
+                pool.submit(tracing.run_with_trace, tc, "hop", work)
+        """), rel=self.REL) == []
+
+    def test_clean_target_reanchors(self):
+        # The submitted function itself re-anchors via the stash.
+        assert run_rule_on_source(_rule("RTP006"), _src("""
+            def _drain(self):
+                tc = _pop_task_trace(self)
+            def kick(self, pool):
+                pool.submit(self._drain)
+        """), rel=self.REL) == []
+
+    def test_out_of_scope_file_ignored(self):
+        assert run_rule_on_source(
+            _rule("RTP006"),
+            "def kick(self, pool, work):\n    pool.submit(work)\n",
+            rel="raytpu/cluster/transfer.py") == []
+
+
+class TestBlockingInAsync:  # RTP007
+    def test_planted(self):
+        findings = run_rule_on_source(_rule("RTP007"), _src("""
+            import time, subprocess
+            async def handler(self, sock):
+                time.sleep(0.1)
+                subprocess.run(["ls"])
+                sock.recv(4096)
+        """))
+        assert len(findings) == 3
+
+    def test_clean_nested_sync_def_is_executor_bound(self):
+        assert run_rule_on_source(_rule("RTP007"), _src("""
+            import time, asyncio
+            async def handler(self, loop):
+                def blocking():
+                    time.sleep(0.1)  # runs on the executor: fine
+                await loop.run_in_executor(None, blocking)
+                await asyncio.sleep(0.1)
+        """)) == []
+
+    def test_sync_code_not_flagged(self):
+        assert run_rule_on_source(
+            _rule("RTP007"),
+            "import time\ndef f():\n    time.sleep(1)\n") == []
+
+
+class TestEnvRegistry:  # RTP008
+    def test_planted_literal_and_alias(self):
+        findings = run_rule_on_source(_rule("RTP008"), _src("""
+            import os
+            _K = "RAYTPU_BOGUS_KNOB_B"
+            def f():
+                a = os.environ.get("RAYTPU_BOGUS_KNOB_A")
+                b = os.getenv(_K)
+                if "RAYTPU_BOGUS_KNOB_C" in os.environ:
+                    pass
+        """))
+        assert len(findings) == 3
+
+    def test_planted_dynamic_name(self):
+        findings = run_rule_on_source(_rule("RTP008"), _src("""
+            import os
+            def f(name):
+                return os.environ.get(f"RAYTPU_{name}")
+        """))
+        assert len(findings) == 1
+        assert "dynamically-built" in findings[0].message
+
+    def test_clean_declared_names(self):
+        assert run_rule_on_source(_rule("RTP008"), _src("""
+            import os
+            def f():
+                a = os.environ.get("RAYTPU_TRACING")
+                b = os.getenv("RAYTPU_FAILPOINTS")
+                c = os.environ.get("NOT_OURS")  # other namespaces: fine
+        """)) == []
+
+    def test_registry_parse_matches_runtime_registry(self):
+        from raytpu.analysis.rules.env_registry import declared_env_vars
+        from raytpu.core.config import declared_env
+
+        statically = declared_env_vars()
+        assert set(declared_env()) <= statically
+        # constants.py knobs are in there too
+        assert "RAYTPU_CONTROL_CALL_TIMEOUT_S" in statically
+
+
+class TestSeamSwallow:  # RTP009
+    def test_planted_swallowed_rpc(self):
+        findings = run_rule_on_source(_rule("RTP009"), _src("""
+            def f(self, c):
+                try:
+                    c.call("x")
+                except Exception:
+                    pass
+        """))
+        assert len(findings) == 1
+        assert "swallowed" in findings[0].message
+
+    def test_planted_bare_except(self):
+        findings = run_rule_on_source(_rule("RTP009"), _src("""
+            def f(self):
+                try:
+                    local_work()
+                except:
+                    pass
+        """))
+        assert len(findings) == 1
+        assert "bare except" in findings[0].message
+
+    def test_clean_recorded_swallow(self):
+        assert run_rule_on_source(_rule("RTP009"), _src("""
+            from raytpu.util import errors
+            def f(self, c):
+                try:
+                    c.call("x")
+                except Exception as e:
+                    errors.swallow("test.seam", e)
+        """)) == []
+
+    def test_clean_narrow_handler(self):
+        assert run_rule_on_source(_rule("RTP009"), _src("""
+            def f(self, c):
+                try:
+                    c.call("x")
+                except ConnectionError:
+                    pass
+        """)) == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+class TestSuppressions:
+    def test_same_line_disable_silences_one_rule(self):
+        src = ("import time\n"
+               "def f():\n"
+               "    time.sleep(0.5)  # raytpulint: disable=RTP001\n")
+        assert run_rule_on_source(_rule("RTP001"), src) == []
+
+    def test_disable_all(self):
+        src = ("import time\n"
+               "def f():\n"
+               "    time.sleep(0.5)  # raytpulint: disable=all\n")
+        assert run_rule_on_source(_rule("RTP001"), src) == []
+
+    def test_wrong_rule_id_does_not_silence(self):
+        src = ("import time\n"
+               "def f():\n"
+               "    time.sleep(0.5)  # raytpulint: disable=RTP002\n")
+        assert len(run_rule_on_source(_rule("RTP001"), src)) == 1
+
+    def test_suppressed_findings_are_counted_not_dropped(self):
+        # Whole-tree scan: the two sanctioned RTP006 exemptions (proxy
+        # notify relay, worker _offload) surface as suppressed, so a
+        # grep for mass-suppression regressions stays possible.
+        result = run_lint(select=["RTP006"], use_baseline=False)
+        assert len(result.suppressed) == 2
+        assert {f.path for f in result.suppressed} == {
+            "raytpu/cluster/driver_proxy.py",
+            "raytpu/cluster/worker_proc.py"}
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        f1 = Finding("RTP001", "raytpu/cluster/x.py", 10, 4, "msg one")
+        f2 = Finding("RTP009", "raytpu/cluster/y.py", 20, 0, "msg two")
+        path = tmp_path / "baseline.json"
+        save_baseline([f1, f2, f1], path)
+        data = json.loads(path.read_text())
+        assert data["version"] == 1
+        assert len(data["fingerprints"]) == 2  # deduped
+        assert load_baseline(path) == {f1.fingerprint, f2.fingerprint}
+
+    def test_fingerprint_survives_line_moves(self):
+        a = Finding("RTP001", "raytpu/cluster/x.py", 10, 4, "msg")
+        b = Finding("RTP001", "raytpu/cluster/x.py", 99, 0, "msg")
+        assert a.fingerprint == b.fingerprint
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert load_baseline(tmp_path / "nope.json") == set()
+
+    def test_baselined_finding_is_partitioned_out(self, tmp_path):
+        # Plant a real violating file inside the package, baseline it,
+        # and verify the finding moves to the baselined bucket — then
+        # shift its line and verify the fingerprint still matches.
+        planted = REPO / "raytpu" / "cluster" / "_lint_baseline_probe.py"
+        base = tmp_path / "baseline.json"
+        body = "import time\n\n\ndef probe():\n    time.sleep(0.5)\n"
+        try:
+            planted.write_text(body)
+            r = run_lint(select=["RTP001"], use_baseline=False)
+            mine = [f for f in r.findings
+                    if f.path.endswith("_lint_baseline_probe.py")]
+            assert len(mine) == 1
+            save_baseline(mine, base)
+            r2 = run_lint(select=["RTP001"], baseline_path=base)
+            assert r2.ok
+            assert [f.path for f in r2.baselined] == [mine[0].path]
+            # unrelated edit shifts the line: fingerprint still matches
+            planted.write_text("# shifted\n" + body)
+            r3 = run_lint(select=["RTP001"], baseline_path=base)
+            assert r3.ok and len(r3.baselined) == 1
+        finally:
+            planted.unlink(missing_ok=True)
+
+    def test_checked_in_baseline_is_empty(self):
+        # The acceptance bar: a clean tree, not a grandfathered one.
+        from raytpu.analysis.core import default_baseline_path
+
+        assert load_baseline(default_baseline_path()) == set()
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+class TestCli:
+    def _run(self, argv):
+        out = io.StringIO()
+        import argparse
+
+        parser = argparse.ArgumentParser()
+        lint_cli.add_arguments(parser)
+        code = lint_cli.run(parser.parse_args(argv), out=out)
+        return code, out.getvalue()
+
+    def test_json_schema(self):
+        code, text = self._run(["--json", str(REPO / "raytpu")])
+        data = json.loads(text)
+        assert code == 0 and data["ok"] is True
+        assert data["version"] == 1
+        assert data["findings"] == [] and data["errors"] == []
+        stats = data["stats"]
+        assert set(stats) == {"files_scanned", "parse_count",
+                              "suppressed", "baselined", "elapsed_s"}
+        assert stats["parse_count"] == stats["files_scanned"] > 100
+
+    def test_json_finding_shape(self, tmp_path):
+        planted = REPO / "raytpu" / "cluster" / "_lint_json_probe.py"
+        try:
+            planted.write_text(
+                "import time\n\n\ndef probe():\n    time.sleep(0.5)\n")
+            code, text = self._run(
+                ["--json", "--select", "RTP001", str(planted)])
+            data = json.loads(text)
+            assert code == 1 and data["ok"] is False
+            (f,) = data["findings"]
+            assert set(f) == {"rule", "path", "line", "col", "message"}
+            assert f["rule"] == "RTP001" and f["line"] == 5
+        finally:
+            planted.unlink(missing_ok=True)
+
+    def test_list_rules(self):
+        code, text = self._run(["--list-rules"])
+        assert code == 0
+        for rid in sorted(MIGRATED) + ["RTP005", "RTP009"]:
+            assert rid in text
+
+    def test_unknown_select_is_usage_error(self):
+        code, _ = self._run(["--select", "RTP999"])
+        assert code == 2
+
+    def test_module_entrypoint_and_cli_subcommand_agree(self):
+        import raytpu.analysis.__main__  # noqa: F401  (import side check)
+        from raytpu.scripts.cli import build_parser
+
+        args = build_parser().parse_args(["lint", "--list-rules"])
+        assert args.fn(args) == 0
+
+
+# -- whole-tree gate (tier-1) ------------------------------------------------
+
+
+class TestWholeTree:
+    def test_tree_is_clean_parse_once_and_fast(self):
+        result = run_lint()
+        assert result.errors == []
+        assert result.findings == [], (
+            "raytpulint found unsuppressed violations:\n  "
+            + "\n  ".join(str(f) for f in result.findings))
+        assert result.files_scanned > 100
+        assert result.parse_count == result.files_scanned
+        assert result.elapsed_s < 5.0

@@ -10,8 +10,6 @@ monotonically across hops; DEADLINE_EXCEEDED fails locally), Hystrix /
 resilience4j breaker lifecycle (closed → open → half-open → closed).
 """
 
-import ast
-import pathlib
 import threading
 import time
 
@@ -570,70 +568,30 @@ class TestHeadNotifyBuffer:
 
 
 class TestNoHardcodedTimeouts:
-    """AST scan of raytpu/cluster/: every retry sleep and timeout budget
-    must come from cluster/constants.py (env-overridable), not inline
-    literals — scattered magic timeouts are untunable and undebuggable.
-    cluster_utils.py is the subprocess test harness (proc.wait on spawn
-    scripts) and constants.py is the registry itself: both allowlisted.
-    """
-
-    ALLOWLIST = {"constants.py", "cluster_utils.py"}
-
-    def _violations(self):
-        pkg = pathlib.Path(__file__).resolve().parent.parent / \
-            "raytpu" / "cluster"
-        out = []
-        for path in sorted(pkg.glob("*.py")):
-            if path.name in self.ALLOWLIST:
-                continue
-            tree = ast.parse(path.read_text(), filename=str(path))
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                fn = node.func
-                is_sleep = (isinstance(fn, ast.Attribute)
-                            and fn.attr == "sleep")
-                if is_sleep and node.args and isinstance(
-                        node.args[0], ast.Constant) and isinstance(
-                        node.args[0].value, (int, float)):
-                    out.append(f"{path.name}:{node.lineno}: "
-                               f"time.sleep({node.args[0].value})")
-                for kw in node.keywords:
-                    if kw.arg == "timeout" and isinstance(
-                            kw.value, ast.Constant) and isinstance(
-                            kw.value.value, (int, float)):
-                        out.append(f"{path.name}:{node.lineno}: "
-                                   f"timeout={kw.value.value}")
-        return out
+    """Thin wrapper over RTP001 (raytpu/analysis/rules/timing_literals.py)
+    — the ad-hoc AST scan that lived here migrated into the lint
+    framework; this keeps the invariant visible from the resilience
+    suite and proves the rule still bites."""
 
     def test_no_numeric_sleep_or_timeout_literals(self):
-        violations = self._violations()
-        assert not violations, (
+        from raytpu.analysis.core import run_lint
+
+        result = run_lint(select=["RTP001"], use_baseline=False)
+        assert not result.findings, (
             "hardcoded timing literals in raytpu/cluster/ — hoist them "
             "into raytpu/cluster/constants.py (RAYTPU_* env-overridable):"
-            "\n  " + "\n  ".join(violations))
+            "\n  " + "\n  ".join(str(f) for f in result.findings))
 
     def test_scanner_catches_a_planted_literal(self):
-        # The lint must actually bite: a synthetic tree with both
-        # violation shapes is flagged.
+        from raytpu.analysis.core import run_rule_on_source
+        from raytpu.analysis.rules.timing_literals import TimingLiterals
+
         src = ("import time\n"
                "def f(c):\n"
                "    time.sleep(0.5)\n"
                "    c.call('x', timeout=5.0)\n")
-        tree = ast.parse(src)
-        hits = 0
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call):
-                fn = node.func
-                if (isinstance(fn, ast.Attribute) and fn.attr == "sleep"
-                        and node.args
-                        and isinstance(node.args[0], ast.Constant)):
-                    hits += 1
-                for kw in node.keywords:
-                    if kw.arg == "timeout" and isinstance(
-                            kw.value, ast.Constant):
-                        hits += 1
-        assert hits == 2
+        findings = run_rule_on_source(TimingLiterals(), src)
+        assert len(findings) == 2
 
 
 # -- env-overridable constants (satellite c) ---------------------------------

@@ -18,7 +18,6 @@ Covers the PR's contracts:
 """
 
 import ast
-import glob
 import json
 import os
 import time
@@ -455,55 +454,42 @@ def _enabled_guards(fn):
                     for t in ast.walk(n.test))]
 
 
-def _transitions_referenced(tree) -> set:
-    """TaskTransition members referenced anywhere in a module."""
-    out = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute):
-            v = node.value
-            if ((isinstance(v, ast.Name) and v.id == "TaskTransition")
-                    or (isinstance(v, ast.Attribute)
-                        and v.attr == "TaskTransition")):
-                out.add(node.attr)
-    return out & set(TaskTransition.ALL)
-
-
 class TestTransitionCoverageLint:
-    def test_every_transition_is_emitted_somewhere(self):
-        import raytpu as _pkg
+    """Thin wrapper over RTP003 (raytpu/analysis/rules/
+    transition_coverage.py) — the whole-tree reference scan migrated
+    into the lint framework; this keeps the invariant visible from the
+    task-events suite and proves the rule still bites."""
 
-        root = os.path.dirname(os.path.abspath(_pkg.__file__))
-        emitted = set()
-        scanned = 0
-        for path in glob.glob(os.path.join(root, "**", "*.py"),
-                              recursive=True):
-            # the defining module trivially references every member
-            if path.endswith(os.path.join("util", "task_events.py")):
-                continue
-            with open(path) as f:
-                emitted |= _transitions_referenced(ast.parse(f.read()))
-            scanned += 1
-        assert scanned > 10
-        missing = set(TaskTransition.ALL) - emitted
-        assert not missing, (
-            f"TaskTransition members declared but never emitted under "
-            f"raytpu/: {sorted(missing)} — a lifecycle state without "
-            f"instrumentation is a lie in the schema")
+    def test_every_transition_is_emitted_somewhere(self):
+        from raytpu.analysis.core import run_lint
+
+        result = run_lint(select=["RTP003"], use_baseline=False)
+        assert result.files_scanned > 10
+        assert not result.findings, (
+            "TaskTransition members declared but never emitted under "
+            "raytpu/ — a lifecycle state without instrumentation is a "
+            "lie in the schema:\n  "
+            + "\n  ".join(str(f) for f in result.findings))
 
     def test_lint_catches_planted_violation(self):
+        from raytpu.analysis.rules.transition_coverage import (
+            transitions_referenced,
+        )
+
         bad = ast.parse(
             "from raytpu.util import task_events\n"
             "def f(spec):\n"
             "    if task_events.enabled():\n"
             "        task_events.emit('task', 't',\n"
             "            task_events.TaskTransition.SUBMITTED)\n")
-        found = _transitions_referenced(bad)
+        found = transitions_referenced(bad) & set(TaskTransition.ALL)
         assert found == {"SUBMITTED"}
         assert set(TaskTransition.ALL) - found  # lint would flag these
         good = ast.parse("\n".join(
             f"x{i} = TaskTransition.{m}"
             for i, m in enumerate(TaskTransition.ALL)))
-        assert _transitions_referenced(good) == set(TaskTransition.ALL)
+        assert (transitions_referenced(good)
+                == set(TaskTransition.ALL))
 
 
 class TestPostmortem:
