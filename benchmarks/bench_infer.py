@@ -18,9 +18,26 @@ Prints one JSON line:
   {"metric": "infer_decode_tokens_per_s", "value": ...,
    "detail": {"decode_compiles": {...}, "prefill_compiles": {...}, ...}}
 
+``--load`` instead runs the SERVING load bench: concurrent client
+threads against a directly-instantiated ``LLMDeployment`` replica (the
+background stepping loop pumps the engine), three scenarios —
+
+- ``mixed_load``: concurrent mixed-length prompts; generated tokens/s
+  and client-observed TTFT p50/p95.
+- ``shared_system_prompt``: every prompt opens with the same 48-token
+  system prefix (prefix cache warm) — later streams prefill only their
+  tails, so TTFT collapses and prefilled tokens count the tails only.
+- ``shared_system_prompt_cache_off``: the identical workload with
+  ``enable_prefix_cache=False`` — every stream pays the full prefill;
+  the p95-TTFT gap against the cached scenario is the headline.
+
+Writes the scenario table to BENCH_r07.json at the repo root and prints
+the same object as one JSON line.
+
 Env: RAYTPU_INFER_BENCH_REQUESTS (default 6),
 RAYTPU_INFER_BENCH_NEW_TOKENS (default 24),
-RAYTPU_INFER_BENCH_STAGGER (iterations between arrivals, default 3).
+RAYTPU_INFER_BENCH_STAGGER (iterations between arrivals, default 3),
+RAYTPU_INFER_LOAD_STREAMS (load mode, default 8).
 """
 
 from __future__ import annotations
@@ -110,5 +127,111 @@ def main() -> None:
     }))
 
 
+def _quantile(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _run_load_scenario(name, prompts, *, enable_prefix_cache, new_tokens):
+    """Fire all prompts concurrently at one fresh replica; measure
+    generated tokens/s plus client-observed TTFT quantiles.
+
+    The identical concurrent pass runs twice: the first (untimed) pass
+    compiles every program the workload touches — prefill/chunk length
+    buckets AND the decode batch buckets the growing batch walks
+    through — and, when caching, leaves the shared prefix pages warm.
+    The second pass is the measured steady state."""
+    import threading
+
+    from raytpu import serve
+
+    dep = serve.LLMDeployment._target(engine_options={
+        "page_size": 8, "max_num_seqs": len(prompts),
+        "max_model_len": 128, "enable_prefix_cache": enable_prefix_cache})
+    try:
+        ttfts, counts = [], []
+
+        def consume(prompt):
+            t0 = time.perf_counter()
+            gen = dep.generate(prompt, max_new_tokens=new_tokens)
+            next(gen)
+            ttfts.append(time.perf_counter() - t0)
+            counts.append(1 + sum(1 for _ in gen))
+
+        def one_pass():
+            threads = [threading.Thread(target=consume, args=(p,))
+                       for p in prompts]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+
+        one_pass()  # warm pass: compiles + prefix registration
+        warm_prefill = dep.stats()["prefill_tokens"]
+        ttfts, counts = [], []
+        elapsed = one_pass()
+        stats = dep.stats()
+    finally:
+        dep.shutdown()
+    generated = sum(counts)
+    out = {
+        "scenario": name,
+        "streams": len(prompts),
+        "prefix_cache": enable_prefix_cache,
+        "generated_tokens_per_s": round(generated / max(elapsed, 1e-9), 2),
+        "ttft_p50_s": round(_quantile(ttfts, 0.5), 4),
+        "ttft_p95_s": round(_quantile(ttfts, 0.95), 4),
+        "prefill_tokens": stats["prefill_tokens"] - warm_prefill,
+        "elapsed_s": round(elapsed, 3),
+    }
+    if stats["prefix_cache"]:
+        out["prefix_hit_tokens"] = stats["prefix_cache"]["hit_tokens"]
+    return out
+
+
+def main_load() -> None:
+    _force_cpu()
+    streams = int(os.environ.get("RAYTPU_INFER_LOAD_STREAMS", 8))
+    mixed = [list(range(1, 4 + 7 * (i % 4))) for i in range(streams)]
+    system = list(range(1, 49))  # 48 toks = 6 full pages at page_size 8
+    shared = [system + [100 + 3 * i, 101 + 3 * i, 102 + 3 * i]
+              for i in range(streams)]
+    scenarios = [
+        _run_load_scenario("mixed_load", mixed,
+                           enable_prefix_cache=True, new_tokens=NEW_TOKENS),
+        _run_load_scenario("shared_system_prompt", shared,
+                           enable_prefix_cache=True, new_tokens=NEW_TOKENS),
+        _run_load_scenario("shared_system_prompt_cache_off", shared,
+                           enable_prefix_cache=False,
+                           new_tokens=NEW_TOKENS),
+    ]
+    on, off = scenarios[1], scenarios[2]
+    result = {
+        "metric": "infer_serving_load",
+        "unit": "generated tokens/s + client TTFT quantiles per scenario "
+                "(tiny llama, CPU reference attention, background "
+                "stepping loop)",
+        "scenarios": scenarios,
+        "headline": {
+            "shared_prefix_ttft_p95_speedup": round(
+                off["ttft_p95_s"] / max(on["ttft_p95_s"], 1e-9), 2),
+            "shared_prefix_prefill_tokens_saved":
+                off["prefill_tokens"] - on["prefill_tokens"],
+        },
+    }
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_r07.json"), "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if "--load" in sys.argv[1:]:
+        main_load()
+    else:
+        main()

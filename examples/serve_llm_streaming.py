@@ -4,7 +4,11 @@
 Deploys ``LLMDeployment`` (tiny CPU Llama), fires two staggered
 requests with different prompt/output lengths, and prints tokens as
 they stream back — both sequences share decode iterations inside the
-single engine while each client sees only its own stream.
+single engine while each client sees only its own stream. A second
+phase sends three requests that open with the same 16-token system
+prompt: the first prefills and registers the shared pages, the rest
+graft them from the prefix cache and prefill only their 3-token tails
+(watch ``prefill_tokens`` vs ``prefix_cache.hit_tokens``).
 
   python examples/serve_llm_streaming.py
 """
@@ -53,6 +57,22 @@ def main():
         stats = handle.stats.remote().result()
         print(f"decode batch sizes seen: {stats['decode_batch_hist']}")
         print(f"decode compiles per bucket: {stats['decode_compiles']}")
+
+        # -- shared system prompt: prefix-cache hits ------------------
+        # Token ids disjoint from phase 1's prompts, so the pages it
+        # registered can't partially match here.
+        system = list(range(101, 117))  # 2 full pages at page_size 8
+        prefill_before = stats["prefill_tokens"]
+        for i, tail in enumerate(([31, 32, 33], [41, 42, 43],
+                                  [51, 52, 53])):
+            # Sequential on purpose: request 0 must finish (and register
+            # the system-prompt pages) before 1 and 2 can hit them.
+            consume(f"sys{i}", handle, system + tail, 4)
+        stats = handle.stats.remote().result()
+        print(f"prefill tokens for 3 shared-prefix requests: "
+              f"{stats['prefill_tokens'] - prefill_before} "
+              f"(19 + 3 + 3 — tails only after the first)")
+        print(f"prefix cache: {stats['prefix_cache']}")
     finally:
         serve.shutdown()
         raytpu.shutdown()

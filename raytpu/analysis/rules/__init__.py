@@ -24,6 +24,9 @@ RTP008 env-registry            every RAYTPU_* env read is declared
                                core/config.py
 RTP009 seam-swallow            no bare except / silently swallowed
                                RPC failures at cluster seams
+RTP010 step-loop-blocking      no raytpu.get/wait, time.sleep, or
+                               socket/subprocess waits on the engine
+                               stepping path
 ====== ======================= ====================================
 """
 
@@ -34,6 +37,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     jit_in_builders,
     seam_swallow,
     server_span,
+    step_loop_blocking,
     timing_literals,
     transition_coverage,
     wire_purity,

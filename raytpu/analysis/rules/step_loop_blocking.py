@@ -1,0 +1,109 @@
+"""RTP010: no blocking calls on the engine stepping path.
+
+The inference engine is pumped by ONE thread (the replica's
+``_step_loop`` daemon, or whatever drives :meth:`InferenceEngine.step`
+directly); every concurrent stream's tokens flow through that single
+pump. A blocking call there — ``raytpu.get``/``raytpu.wait`` (a remote
+round-trip), ``time.sleep``, socket or subprocess waits — stalls every
+request on the replica at once, and under continuous batching the
+stall multiplies: N streams each lose a decode iteration. The
+sanctioned idle primitive is ``Condition.wait`` (releases the engine
+lock so producers can wake the loop), which this rule deliberately
+does NOT flag: only the ``raytpu`` module's own blocking entry points
+are matched by name.
+
+Scope: the engine-side inference modules (engine/scheduler/kv_cache/
+prefix_cache/sampling) are scanned whole — they execute inside the
+step — while ``serving.py`` is scanned only inside functions named
+``*step_loop*`` (its request-facing generators legitimately park on
+the condition variable while other threads make progress).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_MODULE_CALLS = {
+    "raytpu": {"get", "wait"},
+    "time": {"sleep"},
+    "socket": {"create_connection", "getaddrinfo", "gethostbyname"},
+    "subprocess": {"run", "call", "check_call", "check_output"},
+    "os": {"system"},
+}
+_SOCKET_METHODS = {"recv", "recv_into", "sendall", "accept"}
+
+# Modules whose every statement runs inside the engine step.
+_WHOLE_MODULE = (
+    "raytpu/inference/engine.py",
+    "raytpu/inference/scheduler.py",
+    "raytpu/inference/kv_cache.py",
+    "raytpu/inference/prefix_cache.py",
+    "raytpu/inference/sampling.py",
+)
+
+
+def _blocking_reason(call: ast.Call):
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if isinstance(f.value, ast.Name):
+        mod = f.value.id.lstrip("_")
+        if f.attr in _MODULE_CALLS.get(mod, ()):
+            return f"{f.value.id}.{f.attr}()"
+    if f.attr in _SOCKET_METHODS:
+        return f".{f.attr}() (blocking socket op)"
+    return None
+
+
+class _Scan(ast.NodeVisitor):
+    """Collect blocking calls, either everywhere (``always=True``) or
+    only lexically inside functions named ``*step_loop*``."""
+
+    def __init__(self, always: bool):
+        self.always = always
+        self.in_loop = False
+        self.hits = []  # (node, reason)
+
+    def _visit_def(self, node):
+        prev, self.in_loop = self.in_loop, (
+            self.in_loop or "step_loop" in node.name)
+        self.generic_visit(node)
+        self.in_loop = prev
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Call(self, node):
+        if self.always or self.in_loop:
+            reason = _blocking_reason(node)
+            if reason:
+                self.hits.append((node, reason))
+        self.generic_visit(node)
+
+
+@register
+class StepLoopBlocking(Rule):
+    id = "RTP010"
+    name = "step-loop-blocking"
+    invariant = ("the engine stepping loop, scheduler, and KV/prefix "
+                 "cache must not call raytpu.get/wait, time.sleep, or "
+                 "socket/subprocess waits")
+    rationale = ("one thread pumps every stream on a replica; a single "
+                 "blocking call there stalls all concurrent requests "
+                 "for its full duration")
+    scope = ("raytpu/inference/",)
+
+    def check(self, mod):
+        always = mod.rel in _WHOLE_MODULE
+        if not always and mod.rel != "raytpu/inference/serving.py":
+            return
+        scan = _Scan(always)
+        scan.visit(mod.tree)
+        for node, reason in scan.hits:
+            yield self.finding(
+                mod, node,
+                f"blocking call {reason} on the engine stepping path — "
+                f"every concurrent stream stalls behind it; park on the "
+                f"condition variable or move the work off the loop")

@@ -17,31 +17,39 @@ Layout:
 
 - :mod:`raytpu.inference.kv_cache` — paged KV cache: fixed-size pages
   preallocated as ``[num_pages, page_size, kv_heads, head_dim]`` JAX
-  arrays (one per layer), per-sequence block tables, allocate /
+  arrays (one per layer), per-sequence block tables with per-page
+  refcounts (shared prefix pages), allocate / allocate_shared /
   extend / free, utilization accounting. Decode never reallocates.
+- :mod:`raytpu.inference.prefix_cache` — content-hash prompt-page
+  cache: chained page hashes over token ids, retain-on-release of
+  unreferenced prompt pages, LRU eviction under allocation pressure.
+  A prefix hit turns a prefill into a block-table pointer copy.
 - :mod:`raytpu.inference.scheduler` — Orca-style continuous-batching
-  scheduler: admits waiting requests by KV-page budget each iteration,
-  merges fresh prefills with in-flight decodes, preempts-to-recompute
-  the youngest sequence under page pressure.
+  scheduler: admits waiting requests by KV-page budget each iteration
+  (grafting prefix-cache hits), merges fresh prefills with in-flight
+  decodes, preempts-to-recompute the youngest sequence under pressure.
 - :mod:`raytpu.inference.sampling` — greedy / temperature / top-k
   sampling with a *per-request* RNG, so sampled outputs are invariant
   to batch composition.
 - :mod:`raytpu.inference.engine` — :class:`InferenceEngine`: bucketed
-  static-shape prefill + a single jit-compiled decode step, stop
-  conditions, ``raytpu_infer_*`` metrics and ``infer.*`` tracing spans.
+  static-shape prefill (full or chunked, interleaved with decodes),
+  a single jit-compiled decode step, stop conditions, ``raytpu_infer_*``
+  metrics (incl. TTFT) and ``infer.*`` tracing spans.
 - :mod:`raytpu.inference.serving` — ``LLMDeployment``: a serve replica
-  running the engine loop, streaming tokens through the existing
-  ``ObjectRefGenerator`` path.
+  with a background stepping loop pumping the engine, streaming tokens
+  through the existing ``ObjectRefGenerator`` path and exporting
+  engine pressure for autoscaling.
 """
 
 from raytpu.inference.kv_cache import PagedKVCache
+from raytpu.inference.prefix_cache import PrefixCache
 from raytpu.inference.sampling import SamplingParams
 from raytpu.inference.scheduler import Scheduler, Sequence
 from raytpu.inference.engine import InferenceEngine, StepOutput
 
 __all__ = [
-    "InferenceEngine", "LLMDeployment", "PagedKVCache", "SamplingParams",
-    "Scheduler", "Sequence", "StepOutput",
+    "InferenceEngine", "LLMDeployment", "PagedKVCache", "PrefixCache",
+    "SamplingParams", "Scheduler", "Sequence", "StepOutput",
 ]
 
 

@@ -26,6 +26,7 @@ Sampling runs on the host with per-request RNGs (see
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Dict, List, Optional, Sequence as SequenceT
@@ -33,10 +34,11 @@ from typing import Dict, List, Optional, Sequence as SequenceT
 import numpy as np
 
 from raytpu.inference.kv_cache import PagedKVCache
+from raytpu.inference.prefix_cache import PrefixCache
 from raytpu.inference.sampling import SamplingParams, sample_token
 from raytpu.inference.scheduler import Scheduler, Sequence
 from raytpu.util import tracing
-from raytpu.util.metrics import Counter, Gauge
+from raytpu.util.metrics import Counter, Gauge, Histogram
 
 _running_gauge = Gauge("raytpu_infer_running_requests",
                        "Sequences currently decoding")
@@ -52,6 +54,10 @@ _prefill_tokens_total = Counter("raytpu_infer_prefill_tokens_total",
                                 "Prompt tokens prefilled")
 _decode_tokens_total = Counter("raytpu_infer_decode_tokens_total",
                                "Tokens decoded")
+_ttft_hist = Histogram(
+    "raytpu_infer_ttft_seconds",
+    "Time from request admission to its first sampled token",
+    boundaries=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,22 +99,28 @@ class InferenceEngine:
                  num_pages: Optional[int] = None, max_num_seqs: int = 8,
                  max_model_len: Optional[int] = None,
                  prefill_buckets: Optional[SequenceT[int]] = None,
-                 decode_buckets: Optional[SequenceT[int]] = None):
+                 decode_buckets: Optional[SequenceT[int]] = None,
+                 prefill_chunk: Optional[int] = None,
+                 enable_prefix_cache: bool = True):
         import jax
 
         from raytpu.models.gpt2 import GPT2Config
         from raytpu.models.llama import LlamaConfig
 
         if isinstance(model_config, LlamaConfig):
-            from raytpu.models.llama import llama_decode, llama_prefill
+            from raytpu.models.llama import (llama_decode, llama_prefill,
+                                             llama_prefill_chunk)
 
             self._prefill_fwd, self._decode_fwd = llama_prefill, llama_decode
+            self._chunk_fwd = llama_prefill_chunk
             kv_heads = model_config.n_kv_head
             head_dim = model_config.head_dim
         elif isinstance(model_config, GPT2Config):
-            from raytpu.models.gpt2 import gpt2_decode, gpt2_prefill
+            from raytpu.models.gpt2 import (gpt2_decode, gpt2_prefill,
+                                            gpt2_prefill_chunk)
 
             self._prefill_fwd, self._decode_fwd = gpt2_prefill, gpt2_decode
+            self._chunk_fwd = gpt2_prefill_chunk
             kv_heads = model_config.n_head
             head_dim = model_config.n_embd // model_config.n_head
         else:
@@ -128,19 +140,35 @@ class InferenceEngine:
         self.cache = PagedKVCache(
             model_config.n_layer, num_pages, page_size, kv_heads, head_dim,
             dtype=model_config.dtype)
+        self.prefix_cache = (PrefixCache(self.cache)
+                             if enable_prefix_cache else None)
         self.scheduler = Scheduler(self.cache, max_num_seqs=max_num_seqs,
-                                   max_model_len=self.max_model_len)
+                                   max_model_len=self.max_model_len,
+                                   prefix_cache=self.prefix_cache)
+        # Chunked prefill: at most this many prompt tokens per engine
+        # step per sequence, so a long prompt never stalls in-flight
+        # decodes. Default = max_model_len, i.e. one-shot prefill (the
+        # chunk path still runs for prefix-hit tails, which start at a
+        # nonzero offset).
+        self.prefill_chunk = min(prefill_chunk or self.max_model_len,
+                                 self.max_model_len)
         self.prefill_buckets = sorted(prefill_buckets or _pow2_buckets(
             min(16, self.max_model_len), self.max_model_len))
+        self.chunk_buckets = _pow2_buckets(
+            min(16, self.prefill_chunk), self.prefill_chunk)
         self.decode_buckets = sorted(decode_buckets or _pow2_buckets(
             1, max_num_seqs))
         self._prefill_compiles: Dict[int, int] = {}
+        self._chunk_compiles: Dict[int, int] = {}
         self._decode_compiles: Dict[int, int] = {}
         self._decode_batch_hist: List[int] = []
         self._prefill_tokens = 0
         self._decode_tokens = 0
+        self._arrival_ts: Dict[str, float] = {}
+        self._ttft_window = collections.deque(maxlen=256)
         self._jnp = jax.numpy
         self._prefill_fn = self._build_prefill_fn(jax)
+        self._chunk_fn = self._build_chunk_prefill_fn(jax)
         self._decode_fn = self._build_decode_fn(jax)
 
     # ---- compiled steps (the ONLY jax.jit call sites) ---------------
@@ -164,6 +192,18 @@ class InferenceEngine:
             return logits[0], ks2, vs2
 
         return jax.jit(_prefill)
+
+    def _build_chunk_prefill_fn(self, jax):
+        cfg, fwd = self._config, self._chunk_fwd
+        compiles = self._chunk_compiles
+
+        def _chunk(params, ks, vs, tokens, positions, dests, block_tables):
+            bucket = tokens.shape[1]
+            compiles[bucket] = compiles.get(bucket, 0) + 1
+            return fwd(cfg, params, tokens, positions, dests, block_tables,
+                       ks, vs)
+
+        return jax.jit(_chunk)
 
     def _build_decode_fn(self, jax):
         cfg, fwd = self._config, self._decode_fwd
@@ -194,10 +234,12 @@ class InferenceEngine:
             raise ValueError("prompt exceeds total KV-page capacity")
         seq = Sequence(request_id=request_id, prompt=prompt,
                        sampling=sampling)
+        self._arrival_ts[request_id] = time.perf_counter()
         self.scheduler.add(seq)
         return seq
 
     def abort(self, request_id: str) -> bool:
+        self._arrival_ts.pop(request_id, None)
         return self.scheduler.abort(request_id)
 
     def has_unfinished(self) -> bool:
@@ -221,22 +263,55 @@ class InferenceEngine:
             decoded = self._run_decode(plan.decodes, out)
         t2 = time.perf_counter()
 
+        # Throughput gauges reflect THIS step — a step that moved no
+        # tokens zeroes them, so autoscalers never read the last busy
+        # step's value as live pressure.
         if prefilled:
             self._prefill_tokens += prefilled
             _prefill_tokens_total.inc(prefilled)
             _prefill_tps_gauge.set(prefilled / max(t1 - t0, 1e-9))
+        else:
+            _prefill_tps_gauge.set(0.0)
         if decoded:
             self._decode_tokens += decoded
             _decode_tokens_total.inc(decoded)
             _decode_tps_gauge.set(decoded / max(t2 - t1, 1e-9))
+        else:
+            _decode_tps_gauge.set(0.0)
         _running_gauge.set(len(self.scheduler.running))
         _waiting_gauge.set(len(self.scheduler.waiting))
         _kv_util_gauge.set(self.cache.utilization())
         return out
 
     def _run_prefill(self, seq: Sequence, out: List[StepOutput]) -> int:
-        jnp = self._jnp
+        """Advance one sequence's prefill by (at most) one chunk.
+
+        A sequence starting from zero whose whole prompt fits in one
+        chunk takes the legacy full-prefill path (flash attention, one
+        program per length bucket). Anything with prior cached context
+        — a prefix-cache hit tail, or chunk 2..n of a long prompt —
+        runs through the paged chunk path, which attends to the cached
+        pages. The FINAL chunk's last logit samples the first token.
+        """
         plen = seq.prefill_len
+        start = seq.cached_len
+        if start == 0 and plen <= self.prefill_chunk:
+            return self._prefill_full(seq, plen, out)
+        return self._prefill_one_chunk(seq, start, plen, out)
+
+    def _register_prefix(self, seq: Sequence) -> None:
+        """Index every fully-written full PROMPT page for sharing.
+        (Pages holding generated tokens stay private.) Must run before
+        sampling: emitting can finish the sequence and drop its block
+        table."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.register(
+                seq.request_id, seq.prompt,
+                min(seq.cached_len, len(seq.prompt)))
+
+    def _prefill_full(self, seq: Sequence, plen: int,
+                      out: List[StepOutput]) -> int:
+        jnp = self._jnp
         bucket = _bucket_for(plen, self.prefill_buckets)
         tokens = np.zeros((1, bucket), dtype=np.int32)
         tokens[0, :plen] = seq.tokens[:plen]
@@ -249,6 +324,7 @@ class InferenceEngine:
                 jnp.asarray(tokens), jnp.asarray(dests))
             self.cache.k, self.cache.v = ks, vs
         seq.cached_len = plen
+        self._register_prefix(seq)
         if not seq.generated:
             # Fresh prompt: its last logit samples the first new token.
             # A preemption-resume prefill must NOT resample — the tail
@@ -257,6 +333,36 @@ class InferenceEngine:
                                  seq.sampling, seq.rng)
             self._emit(seq, token, out)
         return plen
+
+    def _prefill_one_chunk(self, seq: Sequence, start: int, plen: int,
+                           out: List[StepOutput]) -> int:
+        jnp = self._jnp
+        take = min(self.prefill_chunk, plen - start)
+        bucket = _bucket_for(take, self.chunk_buckets)
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, :take] = seq.tokens[start:start + take]
+        positions = np.zeros(bucket, dtype=np.int32)
+        positions[:take] = np.arange(start, start + take)
+        dests = self.cache.chunk_dests(seq.request_id, start, take, bucket)
+        tables = self.cache.table_array([seq.request_id],
+                                        self.max_pages_per_seq)
+        with tracing.span("infer.prefill_chunk", {
+                "request_id": seq.request_id, "start": start,
+                "take": take, "bucket": bucket}):
+            logits, ks, vs = self._chunk_fn(
+                self._params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(dests), jnp.asarray(tables))
+            self.cache.k, self.cache.v = ks, vs
+        seq.cached_len = start + take
+        self._register_prefix(seq)
+        if seq.cached_len >= plen and not seq.generated:
+            # Final chunk of a fresh prompt: sample the first token
+            # from the last REAL row (same no-resample rule as above).
+            token = sample_token(np.asarray(logits[0, take - 1]),
+                                 seq.sampling, seq.rng)
+            self._emit(seq, token, out)
+        return take
 
     def _run_decode(self, seqs: List[Sequence],
                     out: List[StepOutput]) -> int:
@@ -294,6 +400,12 @@ class InferenceEngine:
     def _emit(self, seq: Sequence, token: int,
               out: List[StepOutput]) -> None:
         seq.generated.append(token)
+        if len(seq.generated) == 1:
+            t0 = self._arrival_ts.pop(seq.request_id, None)
+            if t0 is not None:
+                ttft = time.perf_counter() - t0
+                _ttft_hist.observe(ttft)
+                self._ttft_window.append(ttft)
         reason = None
         if token in seq.sampling.stop_token_ids:
             reason = "stop"
@@ -325,12 +437,40 @@ class InferenceEngine:
                     results[o.request_id].append(o.token_id)
         return [results[rid] for rid in ids]
 
+    def note_idle(self) -> None:
+        """Called by the stepping loop when there is no work: zero the
+        throughput gauges so scrapes between bursts read true idle."""
+        _prefill_tps_gauge.set(0.0)
+        _decode_tps_gauge.set(0.0)
+        _running_gauge.set(len(self.scheduler.running))
+        _waiting_gauge.set(len(self.scheduler.waiting))
+        _kv_util_gauge.set(self.cache.utilization())
+
+    def ttft_quantile(self, q: float) -> float:
+        """Recent-window TTFT quantile in seconds (0.0 when empty)."""
+        if not self._ttft_window:
+            return 0.0
+        xs = sorted(self._ttft_window)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    def pressure(self) -> Dict[str, float]:
+        """Load snapshot for engine-pressure autoscaling — plain floats
+        so it crosses the serve wire untouched."""
+        return {
+            "waiting_requests": float(len(self.scheduler.waiting)),
+            "running_requests": float(len(self.scheduler.running)),
+            "kv_utilization": float(self.cache.utilization()),
+            "ttft_p95_s": float(self.ttft_quantile(0.95)),
+        }
+
     def stats(self) -> dict:
         # Bucket keys as strings: the dict crosses the wire from serve
         # replicas and msgpack (strict_map_key) rejects int map keys.
         return {
             "prefill_compiles": {str(k): v for k, v
                                  in self._prefill_compiles.items()},
+            "chunk_prefill_compiles": {str(k): v for k, v
+                                       in self._chunk_compiles.items()},
             "decode_compiles": {str(k): v for k, v
                                 in self._decode_compiles.items()},
             "decode_batch_hist": list(self._decode_batch_hist),
@@ -340,4 +480,8 @@ class InferenceEngine:
             "kv_utilization": self.cache.utilization(),
             "prefill_tokens": self._prefill_tokens,
             "decode_tokens": self._decode_tokens,
+            "ttft_p50_s": self.ttft_quantile(0.5),
+            "ttft_p95_s": self.ttft_quantile(0.95),
+            "prefix_cache": (self.prefix_cache.stats()
+                             if self.prefix_cache else None),
         }

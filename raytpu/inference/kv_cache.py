@@ -16,8 +16,17 @@ every padded slot in a bucketed prefill or dummy row in a padded decode
 batch writes there. Garbage lands only in page 0, so real pages are
 never polluted by static-shape padding.
 
-Host-side bookkeeping (block tables, free list) is plain Python — it's
-O(pages touched) per step and never traced.
+Pages are REFCOUNTED so a prefix cache can share prompt pages across
+sequences copy-on-write-style: ``allocate_shared`` grafts already-filled
+pages into a new block table by bumping their refcount, and ``free``
+only surrenders a page once its last owner releases it. A page whose
+refcount drops to 0 is offered to an optional *retainer* (the prefix
+cache) before returning to the free list; retained pages stay
+reclaimable and are evicted LRU when an allocation would otherwise
+fail, so caching never reduces usable capacity.
+
+Host-side bookkeeping (block tables, free list, refcounts) is plain
+Python — it's O(pages touched) per step and never traced.
 """
 
 from __future__ import annotations
@@ -63,6 +72,14 @@ class PagedKVCache:
         # LIFO free list over pages 1..num_pages-1 (0 is scratch).
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._tables: Dict[str, List[int]] = {}
+        # page id -> number of block tables referencing it. Pages on
+        # the free list (or retained by the prefix cache) have no entry.
+        self._refs: Dict[int, int] = {}
+        # Optional prefix-cache hook (see PrefixCache): retain(page)
+        # keeps a ref-0 page reclaimable instead of freeing it;
+        # reclaim(n) evicts up to n retained pages back to the free
+        # list; reclaimable() counts pages reclaim could recover.
+        self._retainer = None
 
     # ---- accounting -------------------------------------------------
 
@@ -76,10 +93,16 @@ class PagedKVCache:
         return self.num_pages - 1
 
     def free_pages(self) -> int:
-        return len(self._free)
+        """Allocatable pages: the free list plus whatever the retainer
+        could evict on demand (cached-but-unreferenced prefix pages)."""
+        n = len(self._free)
+        if self._retainer is not None:
+            n += self._retainer.reclaimable()
+        return n
 
     def used_pages(self) -> int:
-        return self.total_pages - len(self._free)
+        """Pages referenced by at least one live sequence."""
+        return self.total_pages - self.free_pages()
 
     def utilization(self) -> float:
         """Fraction of usable pages currently owned by sequences."""
@@ -87,6 +110,9 @@ class PagedKVCache:
 
     def num_sequences(self) -> int:
         return len(self._tables)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
 
     # ---- allocation -------------------------------------------------
 
@@ -100,9 +126,37 @@ class PagedKVCache:
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
         need = self.pages_for(max(1, num_tokens))
-        if need > len(self._free):
+        if not self._reserve(need):
             return False
-        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = [self._take_free() for _ in range(need)]
+        return True
+
+    def allocate_shared(self, seq_id: str, num_tokens: int,
+                        prefix_pages: Sequence[int]) -> bool:
+        """Reserve pages for a new sequence whose first
+        ``len(prefix_pages)`` pages are already-filled shared pages (a
+        prefix-cache hit): those are grafted in by refcount bump, and
+        only the tail is drawn from the free list. All-or-nothing —
+        on failure nothing is referenced."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        need = self.pages_for(max(1, num_tokens))
+        tail = need - len(prefix_pages)
+        if tail < 0:
+            raise ValueError(
+                f"prefix of {len(prefix_pages)} pages exceeds the "
+                f"{need}-page allocation of {seq_id!r}")
+        # Pin the shared pages FIRST: reserving the tail may evict
+        # retained pages, and a pinned (referenced) page is never on
+        # the retainer's eviction list.
+        for page in prefix_pages:
+            self._incref(page)
+        if not self._reserve(tail):
+            for page in reversed(prefix_pages):
+                self._decref(page)  # rollback: back to parked/free
+            return False
+        self._tables[seq_id] = list(prefix_pages) + [
+            self._take_free() for _ in range(tail)]
         return True
 
     def extend(self, seq_id: str, num_tokens_total: int) -> bool:
@@ -114,17 +168,54 @@ class PagedKVCache:
         need = self.pages_for(num_tokens_total) - len(table)
         if need <= 0:
             return True
-        if need > len(self._free):
+        if not self._reserve(need):
             return False
-        table.extend(self._free.pop() for _ in range(need))
+        table.extend(self._take_free() for _ in range(need))
         return True
 
     def free(self, seq_id: str) -> None:
-        """Return a sequence's pages to the pool (idempotent)."""
+        """Release a sequence's pages (idempotent). A page returns to
+        the pool only when its last reference drops; ref-0 pages the
+        retainer claims stay out of the free list but reclaimable."""
         table = self._tables.pop(seq_id, None)
-        if table:
-            # LIFO reuse keeps the hot working set in a few pages.
-            self._free.extend(reversed(table))
+        if not table:
+            return
+        # LIFO reuse keeps the hot working set in a few pages.
+        for page in reversed(table):
+            self._decref(page)
+
+    # ---- refcount plumbing ------------------------------------------
+
+    def _take_free(self) -> int:
+        page = self._free.pop()
+        self._refs[page] = 1
+        return page
+
+    def _incref(self, page: int) -> None:
+        n = self._refs.get(page, 0)
+        if n == 0 and self._retainer is not None:
+            # Page was sitting in the retainer's reclaimable set; it is
+            # referenced again and must not be evicted under it.
+            self._retainer.activate(page)
+        self._refs[page] = n + 1
+
+    def _decref(self, page: int) -> None:
+        n = self._refs.get(page, 0) - 1
+        if n > 0:
+            self._refs[page] = n
+            return
+        self._refs.pop(page, None)
+        if self._retainer is not None and self._retainer.retain(page):
+            return  # cached: reclaimable, but its KV stays warm
+        self._free.append(page)
+
+    def _reserve(self, need: int) -> bool:
+        """Ensure ``need`` pages are on the free list, evicting retained
+        prefix pages LRU if that closes the gap."""
+        short = need - len(self._free)
+        if short > 0 and self._retainer is not None:
+            self._retainer.reclaim(short)
+        return need <= len(self._free)
 
     # ---- addressing -------------------------------------------------
 
@@ -162,5 +253,17 @@ class PagedKVCache:
         for i in range(min(length, bucket)):
             out[i] = self.slot(seq_id, i)
         for i in range(length, bucket):
+            out[i] = i % self.page_size  # page 0 slots
+        return out
+
+    def chunk_dests(self, seq_id: str, start: int, take: int,
+                    bucket: int) -> np.ndarray:
+        """Flat destination slots ``[bucket]`` int32 for writing a
+        prefill CHUNK covering logical positions ``[start, start+take)``
+        padded to ``bucket``; padding cycles through page 0."""
+        out = np.empty(bucket, dtype=np.int32)
+        for i in range(min(take, bucket)):
+            out[i] = self.slot(seq_id, start + i)
+        for i in range(take, bucket):
             out[i] = i % self.page_size  # page 0 slots
         return out

@@ -88,10 +88,13 @@ class ScheduleOutput:
 
 class Scheduler:
     def __init__(self, cache: PagedKVCache, max_num_seqs: int = 8,
-                 max_model_len: int = 2048):
+                 max_model_len: int = 2048, prefix_cache=None):
         self.cache = cache
         self.max_num_seqs = max_num_seqs
         self.max_model_len = max_model_len
+        # Optional raytpu.inference.prefix_cache.PrefixCache: admission
+        # then grafts cached prompt pages instead of allocating them.
+        self.prefix_cache = prefix_cache
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self.num_preemptions = 0
@@ -134,13 +137,17 @@ class Scheduler:
     def schedule(self) -> ScheduleOutput:
         preempted: List[Sequence] = []
 
-        # 1) Secure a KV slot for every running sequence's next token,
+        # 1) Secure a KV slot for every DECODING sequence's next token,
         #    oldest first. Under page pressure evict the youngest
         #    running sequence; if a sequence must evict itself, it just
         #    waits (it's already the lowest-priority survivor).
+        #    Sequences still mid-prefill (chunked) skip this: their
+        #    admission already reserved pages for the whole prompt.
         for seq in sorted(self.running, key=lambda s: s.arrival):
             if seq.state != RUNNING:
                 continue  # preempted by an earlier turn of this loop
+            if seq.cached_len < seq.prefill_len:
+                continue  # mid-prefill: allocation covers prefill_len
             while not self.cache.extend(seq.request_id, seq.cached_len + 1):
                 victim = max(self.running, key=lambda s: s.arrival)
                 self._preempt(victim)
@@ -148,17 +155,21 @@ class Scheduler:
                 if victim is seq:
                     break
 
-        decodes = [s for s in self.running if s.state == RUNNING]
+        decodes = [s for s in self.running if s.state == RUNNING
+                   and s.cached_len >= s.prefill_len]
+        # Running sequences whose prompt isn't fully cached yet keep
+        # prefilling (one chunk per engine step) alongside the decodes.
+        prefills: List[Sequence] = [
+            s for s in self.running if s.state == RUNNING
+            and s.cached_len < s.prefill_len]
 
         # 2) Admit waiting requests FIFO — but never in an iteration
         #    that preempted (we'd thrash: admitting took the very pages
         #    the preemption just freed for older sequences).
-        prefills: List[Sequence] = []
         if not preempted:
             while self.waiting and len(self.running) < self.max_num_seqs:
                 seq = self.waiting[0]
-                if not self.cache.allocate(seq.request_id,
-                                           seq.prefill_len):
+                if not self._admit(seq):
                     break  # FIFO head-of-line: don't skip ahead
                 self.waiting.popleft()
                 seq.state = RUNNING
@@ -167,6 +178,25 @@ class Scheduler:
 
         return ScheduleOutput(prefills=prefills, decodes=decodes,
                               preempted=preempted)
+
+    def _admit(self, seq: Sequence) -> bool:
+        """Allocate KV for a waiting sequence. With a prefix cache,
+        fully-matched prompt pages are grafted (pointer copy + ref
+        bump) and ``cached_len`` jumps past them so the engine only
+        prefills the tail. The match is capped one token short of
+        ``prefill_len`` — at least one token must run through the model
+        so there are logits to sample the next token from."""
+        if self.prefix_cache is None:
+            return self.cache.allocate(seq.request_id, seq.prefill_len)
+        ps = self.cache.page_size
+        cap = (seq.prefill_len - 1) // ps
+        matched = (self.prefix_cache.match(seq.tokens, max_pages=cap)
+                   if cap > 0 else [])
+        if not self.cache.allocate_shared(seq.request_id,
+                                          seq.prefill_len, matched):
+            return False
+        seq.cached_len = len(matched) * ps
+        return True
 
     def _preempt(self, seq: Sequence) -> None:
         self.cache.free(seq.request_id)

@@ -8,14 +8,20 @@ id travels back through the worker's object stream →
 ``ObjectRefGenerator`` → ``DeploymentResponseGenerator`` on the
 client, which sees tokens *while the sequence still decodes*.
 
-The engine is stepped by whichever request thread is currently waiting
-for a token (caller-driven, no background loop): a thread holding the
-engine lock runs ``engine.step()`` and fans the produced tokens out to
-every request's buffer, so N concurrent streams cost one continuously
-batched decode per iteration, not N. Cancellation rides generator
+The engine is pumped by a REPLICA-OWNED background stepping loop: one
+daemon thread per replica steps the engine whenever any request is
+unfinished and parks on a condition variable otherwise. Request
+threads only drain their own buffers — a slow (or stalled) consumer
+never stalls other streams, and tokens keep decoding while nobody is
+pulling. This replaces the PR-4 caller-driven design where whichever
+request thread was waiting ran the step. Cancellation rides generator
 close: the client's ``close()`` (or GC of an abandoned stream)
 delivers GeneratorExit to :meth:`LLMDeployment.generate`'s frame,
 whose ``finally`` aborts the request — freeing its KV pages.
+
+The loop also maintains a lock-free ``engine_pressure()`` snapshot
+(waiting depth, KV-page occupancy, TTFT p95) that the replica exports
+through ``get_metrics`` for engine-pressure autoscaling.
 """
 
 from __future__ import annotations
@@ -40,7 +46,8 @@ class LLMDeployment:
             for one). Defaults to the family's ``tiny()`` config in
             fp32/reference-attention mode (CPU-runnable).
         engine_options: kwargs forwarded to :class:`InferenceEngine`
-            (page_size, num_pages, max_num_seqs, ...).
+            (page_size, num_pages, max_num_seqs, prefill_chunk,
+            enable_prefix_cache, ...).
         seed: parameter-init seed — two replicas (or a test building a
             reference model) with the same seed hold identical weights.
     """
@@ -71,11 +78,56 @@ class LLMDeployment:
                       batch=1)
         self._engine = InferenceEngine(model_config, params,
                                        **(engine_options or {}))
-        # One lock serializes engine mutation; the thread that holds it
-        # while buffers are dry runs the next engine step for everyone.
-        self._lock = threading.Lock()
+        # One condition serializes engine mutation (add/abort/step) and
+        # carries wakeups both ways: producers signal "new work" to the
+        # loop, the loop signals "new tokens" to consumers.
+        self._cv = threading.Condition()
         self._buffers: Dict[str, deque] = {}
         self._finished: Dict[str, str] = {}
+        self._closed = False
+        # Lock-free pressure snapshot: the loop REPLACES the dict, so
+        # readers never see a half-written one (GIL-atomic store).
+        self._pressure = self._engine.pressure()
+        self._step_thread = threading.Thread(
+            target=self._step_loop, name="llm-step-loop", daemon=True)
+        self._step_thread.start()
+
+    # ---- the replica-owned stepping loop ----------------------------
+
+    def _step_loop(self) -> None:
+        """Pump the engine while any request is unfinished; park on the
+        condition when idle. Runs on a daemon thread for the replica's
+        whole life — consumers never step the engine themselves."""
+        while True:
+            with self._cv:
+                while not self._closed and not self._engine.has_unfinished():
+                    self._engine.note_idle()
+                    self._pressure = self._engine.pressure()
+                    self._cv.wait(timeout=0.5)
+                if self._closed:
+                    return
+                outs = self._engine.step()
+                for out in outs:
+                    buf = self._buffers.get(out.request_id)
+                    if buf is not None:
+                        buf.append(out.token_id)
+                    if out.finished:
+                        self._finished[out.request_id] = out.finish_reason
+                self._pressure = self._engine.pressure()
+                if outs:
+                    self._cv.notify_all()
+            # The lock is dropped between iterations so request threads
+            # can drain buffers / add / abort while the engine is busy.
+
+    def shutdown(self) -> None:
+        """Stop the stepping loop (used by direct-instantiation tests;
+        replica teardown kills the daemon thread with the process)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._step_thread.join(timeout=5.0)
+
+    # ---- request-facing API -----------------------------------------
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
@@ -86,9 +138,10 @@ class LLMDeployment:
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, seed=seed, stop_token_ids=tuple(stop_token_ids))
         request_id = uuid.uuid4().hex
-        with self._lock:
+        with self._cv:
             self._engine.add_request(request_id, prompt, sampling)
             self._buffers[request_id] = deque()
+            self._cv.notify_all()  # wake the stepping loop
         try:
             while True:
                 token = self._next_token(request_id)
@@ -96,38 +149,49 @@ class LLMDeployment:
                     return
                 yield token
         finally:
-            with self._lock:
+            with self._cv:
                 self._engine.abort(request_id)  # no-op if finished
                 self._buffers.pop(request_id, None)
                 self._finished.pop(request_id, None)
+                self._cv.notify_all()
 
     def _next_token(self, request_id: str) -> Optional[int]:
-        while True:
-            with self._lock:
+        with self._cv:
+            while True:
                 buf = self._buffers.get(request_id)
                 if buf is None:
                     return None
                 if buf:
                     return buf.popleft()
-                if request_id in self._finished:
+                if request_id in self._finished or self._closed:
                     return None
-                # Our turn to advance the world one iteration.
-                outs = self._engine.step()
-                for out in outs:
-                    b = self._buffers.get(out.request_id)
-                    if b is not None:
-                        b.append(out.token_id)
-                    if out.finished:
-                        self._finished[out.request_id] = out.finish_reason
-                if not outs and not self._engine.has_unfinished():
-                    # Request left the engine without a finish marker
-                    # (out-of-band abort): end the stream, don't spin.
+                if not self._engine_knows(request_id):
+                    # Out-of-band abort: the request left the engine
+                    # without a finish marker — end the stream.
                     return None
+                # Timed wait guards against a lost wakeup if the loop
+                # notified between our buffer check and the wait.
+                self._cv.wait(timeout=1.0)
+
+    def _engine_knows(self, request_id: str) -> bool:
+        sched = self._engine.scheduler
+        return (any(s.request_id == request_id for s in sched.running)
+                or any(s.request_id == request_id for s in sched.waiting))
+
+    # ---- introspection ----------------------------------------------
+
+    def engine_pressure(self) -> dict:
+        """Latest engine-load snapshot, readable without the engine
+        lock — the controller polls this through ``get_metrics`` even
+        while a step is in flight."""
+        return dict(self._pressure)
 
     def stats(self) -> dict:
-        with self._lock:
+        with self._cv:
             return self._engine.stats()
 
     def abort(self, request_id: str) -> bool:
-        with self._lock:
-            return self._engine.abort(request_id)
+        with self._cv:
+            ok = self._engine.abort(request_id)
+            self._cv.notify_all()
+            return ok

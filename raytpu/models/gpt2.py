@@ -105,6 +105,38 @@ class CausalSelfAttention(nn.Module):
         y = y.transpose(0, 2, 1, 3).reshape(b, t, e)
         return self.c_proj(y), k_cache, v_cache
 
+    def prefill_chunk(self, x, k_pages, v_pages, dests, block_tables,
+                      positions):
+        """Chunked-prefill paged-cache attention; same contract as
+        :meth:`raytpu.models.llama.LlamaAttention.prefill_chunk` minus
+        rope (``positions`` here only drive the causal mask — the wpe
+        lookup upstream already positioned the embeddings)."""
+        c = self.config
+        b, t, e = x.shape
+        h = c.n_head
+        d = e // h
+        qkv = self.c_attn(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+        k_cache = k.reshape(b, t, h, d)[0]  # [T, H, D]
+        v_cache = v.reshape(b, t, h, d)[0]
+        n_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+        flat = (n_pages * page_size, h, d)
+        k_pages = k_pages.reshape(flat).at[dests].set(
+            k_cache.astype(k_pages.dtype)).reshape(k_pages.shape)
+        v_pages = v_pages.reshape(flat).at[dests].set(
+            v_cache.astype(v_pages.dtype)).reshape(v_pages.shape)
+        ks = k_pages[block_tables].reshape(b, -1, h, d)
+        vs = v_pages[block_tables].reshape(b, -1, h, d)
+        s = jnp.einsum("bhtd,blhd->bhtl", q.astype(jnp.float32),
+                       ks.astype(jnp.float32)) * (d ** -0.5)
+        visible = jnp.arange(ks.shape[1])[None, :] <= positions[:, None]
+        s = jnp.where(visible[None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhtl,blhd->bthd", p, vs.astype(jnp.float32))
+        y = o.astype(c.dtype).reshape(b, t, e)
+        return self.c_proj(y), k_pages, v_pages
+
     def decode_step(self, x, k_pages, v_pages, dests, block_tables,
                     context_lens):
         """One-token paged-cache attention; same contract as
@@ -335,6 +367,29 @@ def gpt2_prefill(config: GPT2Config, params, tokens):
         vs.append(v)
     x = nn.LayerNorm(dtype=c.dtype).apply({"params": params["ln_f"]}, x)
     return _tied_logits(c, params, x), ks, vs
+
+
+def gpt2_prefill_chunk(config: GPT2Config, params, tokens, positions,
+                       dests, block_tables, k_caches, v_caches):
+    """Chunked-prefill forward: ``tokens`` [1, T] at absolute
+    ``positions`` [T] -> (fp32 logits [1, T, V], updated k_caches,
+    v_caches); positions feed both the wpe lookup and the causal mask."""
+    c = config
+    x = params["wte"]["embedding"].astype(c.dtype)[tokens] + \
+        params["wpe"]["embedding"].astype(c.dtype)[positions][None]
+    new_k, new_v = [], []
+    for i in range(c.n_layer):
+        ki, vi = k_caches[i], v_caches[i]
+
+        def attn_fn(m, p, h, ki=ki, vi=vi):
+            return m.apply({"params": p}, h, ki, vi, dests, block_tables,
+                           positions, method="prefill_chunk")
+
+        x, k, v = _block_apply(c, layer_params(params, i), x, attn_fn)
+        new_k.append(k)
+        new_v.append(v)
+    x = nn.LayerNorm(dtype=c.dtype).apply({"params": params["ln_f"]}, x)
+    return _tied_logits(c, params, x), new_k, new_v
 
 
 def gpt2_decode(config: GPT2Config, params, tokens, positions, dests,

@@ -160,6 +160,54 @@ class LlamaAttention(nn.Module):
         y = y.transpose(0, 2, 1, 3).reshape(b, t, h * d)
         return self.o_proj(y), k_cache, v_cache
 
+    def prefill_chunk(self, x, k_pages, v_pages, dests, block_tables,
+                      positions):
+        """Chunked-prefill attention against the paged cache.
+
+        ``x`` [1, T, E] holds one CHUNK of a prompt whose earlier
+        tokens (prior chunks, or a shared prefix-cache hit) are already
+        in the pages. The chunk's roped K/V scatter into ``dests`` [T]
+        first — so the chunk attends to itself — then each token
+        attends to every cached position ``<=`` its own absolute
+        ``positions`` [T] through ``block_tables`` [1, P]. Padding rows
+        carry page-0 dests and position 0; their outputs are garbage
+        the engine discards. Returns ``(out [1, T, E], k_pages',
+        v_pages')``.
+        """
+        c = self.config
+        b, t, _ = x.shape
+        h, kv, d = c.n_head, c.n_kv_head, c.head_dim
+        q = self.q_proj(x).reshape(b, t, h, d).transpose(0, 2, 1, 3)
+        k = self.k_proj(x).reshape(b, t, kv, d).transpose(0, 2, 1, 3)
+        v = self.v_proj(x).reshape(b, t, kv, d).transpose(0, 2, 1, 3)
+        cos, sin = rope_tables(d, positions, c.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache = k.transpose(0, 2, 1, 3)[0]  # [T, KV, D]
+        v_cache = v.transpose(0, 2, 1, 3)[0]
+        n_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+        flat = (n_pages * page_size, kv, d)
+        k_pages = k_pages.reshape(flat).at[dests].set(
+            k_cache.astype(k_pages.dtype)).reshape(k_pages.shape)
+        v_pages = v_pages.reshape(flat).at[dests].set(
+            v_cache.astype(v_pages.dtype)).reshape(v_pages.shape)
+        ks = k_pages[block_tables].reshape(b, -1, kv, d)
+        vs = v_pages[block_tables].reshape(b, -1, kv, d)
+        if kv != h:
+            rep = h // kv
+            ks = jnp.repeat(ks, rep, axis=2)
+            vs = jnp.repeat(vs, rep, axis=2)
+        # fp32 score math matching decode_step; causal over absolute
+        # positions (gathered slot l holds logical position l).
+        s = jnp.einsum("bhtd,blhd->bhtl", q.astype(jnp.float32),
+                       ks.astype(jnp.float32)) * (d ** -0.5)
+        visible = jnp.arange(ks.shape[1])[None, :] <= positions[:, None]
+        s = jnp.where(visible[None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhtl,blhd->bthd", p, vs.astype(jnp.float32))
+        y = o.astype(c.dtype).reshape(b, t, h * d)
+        return self.o_proj(y), k_pages, v_pages
+
     def decode_step(self, x, k_pages, v_pages, dests, block_tables,
                     positions, context_lens):
         """One-token attention against the paged cache.
@@ -356,6 +404,33 @@ def llama_prefill(config: LlamaConfig, params, tokens):
         x = x + mlp.apply({"params": lp["mlp"]}, h)
     x = norm.apply({"params": params["final_norm"]}, x)
     return _lm_logits(c, params, x), ks, vs
+
+
+def llama_prefill_chunk(config: LlamaConfig, params, tokens, positions,
+                        dests, block_tables, k_caches, v_caches):
+    """Chunked-prefill forward: ``tokens`` [1, T] at absolute
+    ``positions`` [T] -> (fp32 logits [1, T, V], updated k_caches,
+    v_caches). See :meth:`LlamaAttention.prefill_chunk` for the cache
+    argument shapes."""
+    c = config
+    x = params["embed_tokens"]["embedding"].astype(c.dtype)[tokens]
+    attn = LlamaAttention(c)
+    mlp = LlamaMLP(c)
+    norm = RMSNorm(dtype=c.dtype)
+    new_k, new_v = [], []
+    for i in range(c.n_layer):
+        lp = layer_params(params, i)
+        h = norm.apply({"params": lp["input_norm"]}, x)
+        y, kc, vc = attn.apply(
+            {"params": lp["attn"]}, h, k_caches[i], v_caches[i], dests,
+            block_tables, positions, method="prefill_chunk")
+        new_k.append(kc)
+        new_v.append(vc)
+        x = x + y
+        h = norm.apply({"params": lp["post_attn_norm"]}, x)
+        x = x + mlp.apply({"params": lp["mlp"]}, h)
+    x = norm.apply({"params": params["final_norm"]}, x)
+    return _lm_logits(c, params, x), new_k, new_v
 
 
 def llama_decode(config: LlamaConfig, params, tokens, positions, dests,

@@ -18,7 +18,8 @@ from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
-from raytpu.serve._private.autoscaling_policy import AutoscalingPolicyManager
+from raytpu.serve._private.autoscaling_policy import (AutoscalingPolicyManager,
+                                                      EnginePressure)
 from raytpu.serve._private.long_poll import LongPollHost
 from raytpu.serve.config import DeploymentConfig, ReplicaConfig
 
@@ -330,16 +331,32 @@ class ServeController(LongPollHost):
         if state.autoscaler is None:
             return
         total = self._demand_level(state.full_name)
+        # Engine pressure aggregates: queue depths SUM (total unmet
+        # demand), occupancy and latency take the WORST replica (one
+        # saturated engine is a problem even if its peers are idle).
+        waiting = kv_util = ttft = 0.0
+        saw_pressure = False
         for rep in list(state.replicas.values()):
             try:
                 m = await asyncio.wait_for(
                     _await_ref(rep.handle.get_metrics.remote()), timeout=2.0
                 )
                 total += m["avg_ongoing"]
+                if "engine_waiting_requests" in m:
+                    saw_pressure = True
+                    waiting += m["engine_waiting_requests"]
+                    kv_util = max(kv_util,
+                                  m.get("engine_kv_utilization", 0.0))
+                    ttft = max(ttft, m.get("engine_ttft_p95_s", 0.0))
             except Exception:
                 pass
+        pressure = None
+        if saw_pressure:
+            pressure = EnginePressure(waiting_requests=waiting,
+                                      kv_utilization=kv_util,
+                                      ttft_p95_s=ttft)
         decision = state.autoscaler.get_decision_num_replicas(
-            total, state.target_num_replicas
+            total, state.target_num_replicas, engine_pressure=pressure
         )
         if decision is not None and decision != state.target_num_replicas:
             logger.info(

@@ -112,13 +112,26 @@ class Replica:
             avg = sum(v for _, v in self._metric_samples) / len(self._metric_samples)
         else:
             avg = float(self._num_ongoing + self._num_queued)
-        return {
+        out = {
             "replica_id": self._replica_id,
             "ongoing": float(self._num_ongoing),
             "queued": float(self._num_queued),
             "avg_ongoing": avg,
             "total_handled": float(self._total_handled),
         }
+        # Deployments that expose engine-level load (LLMDeployment's
+        # engine_pressure) get their gauges forwarded as engine_* so
+        # the controller can autoscale on engine pressure, not just
+        # request count. Never let a user callable's bug break the
+        # metrics path the autoscaler depends on.
+        pressure_fn = getattr(self._callable, "engine_pressure", None)
+        if callable(pressure_fn):
+            try:
+                for k, v in dict(pressure_fn()).items():
+                    out[f"engine_{k}"] = float(v)
+            except Exception:
+                pass
+        return out
 
     async def handle_request(
         self,

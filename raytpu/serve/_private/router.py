@@ -21,6 +21,11 @@ from raytpu.serve._private.controller import CONTROLLER_NAME
 
 BACKOFF_S = 0.02
 MAX_BACKOFF_S = 0.5
+# Queue-length probe budget. A replica that can't answer within this is
+# scored worst-queue for the pick — NEVER assumed idle: a wedged replica
+# that looks like a zero-length queue would attract every request the
+# power-of-two pick routes.
+PROBE_TIMEOUT_S = 2.0
 
 
 class ReplicaSet:
@@ -109,10 +114,14 @@ class ReplicaSet:
             probed = []
             for rid, handle in candidates:
                 try:
-                    qlen = raytpu.get(handle.get_queue_len.remote(), timeout=2.0)
-                    probed.append((qlen, rid, handle))
+                    qlen = raytpu.get(handle.get_queue_len.remote(),
+                                      timeout=PROBE_TIMEOUT_S)
                 except Exception:
-                    continue  # dead replica; long-poll will remove it
+                    # Timed-out/dead probe: score worst-queue so this
+                    # pick can never select it (inf >= max_ongoing);
+                    # the long-poll/health-check path removes it.
+                    qlen = float("inf")
+                probed.append((qlen, rid, handle))
             probed.sort(key=lambda t: t[0])
             if probed and probed[0][0] < self._max_ongoing:
                 return probed[0][2]

@@ -32,6 +32,16 @@ class AutoscalingConfig:
     upscale_smoothing_factor: float = 1.0
     downscale_smoothing_factor: float = 1.0
     initial_replicas: Optional[int] = None
+    # Engine-pressure targets (LLM replicas): scale on the inference
+    # engine's own load signals, not just ongoing request count. A
+    # deployment whose replicas export engine_* metrics (see
+    # LLMDeployment.engine_pressure) scales up when the summed engine
+    # admission queue exceeds target_engine_waiting per replica, when
+    # KV-page occupancy exceeds target_kv_utilization, or when TTFT p95
+    # exceeds target_ttft_s (None disables the TTFT term).
+    target_engine_waiting: float = 4.0
+    target_kv_utilization: float = 0.85
+    target_ttft_s: Optional[float] = None
 
     def __post_init__(self):
         if self.min_replicas < 0:
@@ -40,6 +50,12 @@ class AutoscalingConfig:
             raise ValueError("max_replicas must be >= max(min_replicas, 1)")
         if self.target_ongoing_requests <= 0:
             raise ValueError("target_ongoing_requests must be > 0")
+        if self.target_engine_waiting <= 0:
+            raise ValueError("target_engine_waiting must be > 0")
+        if not 0 < self.target_kv_utilization <= 1:
+            raise ValueError("target_kv_utilization must be in (0, 1]")
+        if self.target_ttft_s is not None and self.target_ttft_s <= 0:
+            raise ValueError("target_ttft_s must be > 0 when set")
 
 
 @dataclass

@@ -13,6 +13,7 @@ import pytest
 import raytpu
 from raytpu import serve
 from raytpu.models.llama import Llama, LlamaConfig, init_params
+from raytpu.serve.config import AutoscalingConfig
 
 LCFG = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
                            attn_impl="reference", remat=False)
@@ -70,7 +71,10 @@ class TestLLMServeE2E:
                 arrivals.setdefault(tag, []).append(time.monotonic())
             results[tag] = toks
 
-        ta = threading.Thread(target=consume, args=("a", pa, 8))
+        # a's output is long enough that it is still decoding (on the
+        # replica's background stepping loop) when b's request crosses
+        # the wire — the overlap the sharing assertions below need.
+        ta = threading.Thread(target=consume, args=("a", pa, 48))
         ta.start()
         # Stagger: b arrives after a already started decoding, so its
         # prefill must merge with a's in-flight decode (Orca-style).
@@ -83,7 +87,7 @@ class TestLLMServeE2E:
         assert not ta.is_alive() and not tb.is_alive()
 
         # Streamed greedy tokens match the non-batched reference decode.
-        assert results["a"] == reference(pa, 8)
+        assert results["a"] == reference(pa, 48)
         assert results["b"] == reference(pb, 5)
         # Tokens streamed incrementally (arrived over time, not at once).
         spread_a = arrivals["a"][-1] - arrivals["a"][0]
@@ -137,6 +141,49 @@ class TestLLMServeE2E:
         # The aborted request decoded far fewer than max_new_tokens.
         assert stats["decode_tokens"] < 40
 
+    def test_shared_system_prompt_prefills_shared_pages_once(
+            self, serve_instance, reference):
+        """THE prefix-cache acceptance count: three streams share a
+        16-token system prompt (2 full pages at page_size 8); the
+        shared pages prefill exactly once, every later stream pays only
+        its tail — proven on raytpu_infer_prefill_tokens_total."""
+        from raytpu.inference import engine as engine_mod
+        from raytpu.inference import prefix_cache as pc_mod
+
+        handle = _deploy("llm-prefix")
+        system = list(range(1, 17))
+        prompts = [system + tail for tail in
+                   ([31, 32, 33], [41, 42, 43], [51, 52, 53])]
+
+        before = engine_mod._prefill_tokens_total.value
+        hits_before = pc_mod._hit_tokens_total.value
+        # Stream 1 runs to completion first: its prefill registers the
+        # system-prompt pages before the other streams are admitted.
+        first = list(handle.generate.remote_streaming(prompts[0],
+                                                      max_new_tokens=4))
+        assert first == reference(prompts[0], 4)
+
+        results = {}
+
+        def consume(i):
+            results[i] = list(handle.generate.remote_streaming(
+                prompts[i], max_new_tokens=4))
+
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert results[1] == reference(prompts[1], 4)
+        assert results[2] == reference(prompts[2], 4)
+        # Stream 1 paid all 19 tokens; streams 2 and 3 grafted the two
+        # shared pages and paid only their 3-token tails: 19 + 3 + 3.
+        assert engine_mod._prefill_tokens_total.value - before == 25
+        assert pc_mod._hit_tokens_total.value - hits_before == 32
+        stats = handle.stats.remote().result()
+        assert stats["prefix_cache"]["hits"] >= 2
+
     def test_infer_metrics_exported(self, serve_instance):
         from raytpu.inference import engine as engine_mod
 
@@ -148,3 +195,116 @@ class TestLLMServeE2E:
         # raytpu_infer_* metrics observed the replica's engine loop.
         assert engine_mod._decode_tokens_total.value >= 3
         assert engine_mod._prefill_tokens_total.value >= 3
+
+
+class TestReplicaSteppingLoop:
+    """The replica-owned background stepping loop, proven on a directly
+    instantiated replica callable (``LLMDeployment._target`` is the
+    undecorated class) — no consumer thread ever steps the engine."""
+
+    def test_tokens_decode_without_consumer_pulling(self, reference):
+        dep = serve.LLMDeployment._target(engine_options=ENGINE_OPTIONS)
+        try:
+            gen = dep.generate(list(range(1, 9)), max_new_tokens=8)
+            first = next(gen)
+            # Nobody pulls from here on — the loop's daemon thread must
+            # run the sequence to completion entirely on its own.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                st = dep.stats()
+                if st["running"] == 0 and st["waiting"] == 0:
+                    break
+                time.sleep(0.05)
+            assert st["running"] == 0 and st["waiting"] == 0
+            # The remaining tokens were buffered; draining is instant
+            # and the stream is still byte-identical to the reference.
+            rest = list(gen)
+            assert [first] + rest == reference(list(range(1, 9)), 8)
+        finally:
+            dep.shutdown()
+
+    def test_idle_loop_maintains_pressure_snapshot(self):
+        from raytpu.inference import engine as engine_mod
+
+        dep = serve.LLMDeployment._target(engine_options=ENGINE_OPTIONS)
+        try:
+            list(dep.generate([1, 2, 3], max_new_tokens=2))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                p = dep.engine_pressure()
+                # The loop publishes the idle snapshot and zeroes the
+                # gauges on its first parked tick — poll for both.
+                if (p["running_requests"] == 0.0
+                        and p["kv_utilization"] == 0.0
+                        and engine_mod._decode_tps_gauge.value == 0.0):
+                    break
+                time.sleep(0.05)
+            assert p["running_requests"] == 0.0
+            assert p["waiting_requests"] == 0.0
+            assert p["kv_utilization"] == 0.0
+            assert p["ttft_p95_s"] > 0.0  # recent-window history kept
+            # Idle ticks also zero the throughput gauges, so scrapes
+            # between bursts never read the last busy step as live.
+            assert engine_mod._decode_tps_gauge.value == 0.0
+            assert engine_mod._prefill_tps_gauge.value == 0.0
+        finally:
+            dep.shutdown()
+
+
+class TestEnginePressureAutoscaling:
+    def test_engine_queue_scales_replicas_up_then_down(self, serve_instance):
+        """Admission-queue depth inside a max_num_seqs=1 engine —
+        invisible to request counting (target_ongoing_requests is set
+        absurdly high) — drives replica count up through the REAL
+        controller/policy path, and the drained engines scale back."""
+        app = serve.LLMDeployment.options(
+            autoscaling_config=AutoscalingConfig(
+                min_replicas=1, max_replicas=3,
+                target_ongoing_requests=1000.0,  # request term inert
+                target_engine_waiting=1.0,
+                upscale_delay_s=0.1, downscale_delay_s=0.5),
+        ).bind(model="llama",
+               engine_options={"page_size": 8, "max_num_seqs": 1,
+                               "max_model_len": 32},
+               seed=0)
+        handle = serve.run(app, name="llm-auto", route_prefix=None)
+        stop = threading.Event()
+        tokens = {}
+
+        def fire(i):
+            # Sustained load: keep streaming until the fleet has grown,
+            # so the engine's admission queue stays deep for as many
+            # reconcile ticks as the hysteresis window needs.
+            tokens[i] = 0
+            while not stop.is_set():
+                out = list(handle.generate.remote_streaming(
+                    [i + 1, i + 2, i + 3], max_new_tokens=24))
+                assert len(out) == 24
+                tokens[i] += len(out)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        scaled_up = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not scaled_up:
+            st = serve.status()
+            reps = st["llm-auto"]["deployments"]["LLMDeployment"]
+            scaled_up = reps["running_replicas"] > 1
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=180)
+        assert scaled_up
+        assert all(tokens[i] > 0 for i in range(6))
+        # Drained: every engine idle, pressure gone — the same policy
+        # path (short downscale window) shrinks the fleet back to min.
+        scaled_down = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not scaled_down:
+            st = serve.status()
+            reps = st["llm-auto"]["deployments"]["LLMDeployment"]
+            scaled_down = reps["running_replicas"] == 1
+            time.sleep(0.25)
+        assert scaled_down

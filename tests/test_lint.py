@@ -348,6 +348,51 @@ class TestSeamSwallow:  # RTP009
         """)) == []
 
 
+class TestStepLoopBlocking:  # RTP010
+    def test_planted_engine_module_scanned_whole(self):
+        findings = run_rule_on_source(_rule("RTP010"), _src("""
+            import raytpu, time
+
+            def _run_decode(self, seqs):
+                raytpu.get(self.remote_thing.remote())
+                time.sleep(0.1)
+        """), rel="raytpu/inference/engine.py")
+        assert len(findings) == 2
+        assert "raytpu.get()" in findings[0].message
+        assert "time.sleep()" in findings[1].message
+
+    def test_planted_serving_only_inside_step_loop(self):
+        src = _src("""
+            import raytpu
+
+            def _step_loop(self):
+                raytpu.get(self.handle.remote())
+
+            def generate(self, prompt):
+                raytpu.get(self.handle.remote())  # consumer thread: fine
+        """)
+        findings = run_rule_on_source(_rule("RTP010"), src,
+                                      rel="raytpu/inference/serving.py")
+        assert len(findings) == 1
+        assert findings[0].line == 4  # inside _step_loop only
+
+    def test_clean_condition_wait_is_sanctioned(self):
+        assert run_rule_on_source(_rule("RTP010"), _src("""
+            def _step_loop(self):
+                with self._cv:
+                    self._cv.wait(timeout=0.5)
+                    outs = self._engine.step()
+        """), rel="raytpu/inference/serving.py") == []
+
+    def test_out_of_scope_modules_ignored(self):
+        assert run_rule_on_source(_rule("RTP010"), _src("""
+            import time
+
+            def anything(self):
+                time.sleep(1.0)
+        """), rel="raytpu/serve/_private/router.py") == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

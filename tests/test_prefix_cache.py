@@ -1,0 +1,390 @@
+"""Prefix-cache subsystem tests: chained page hashes, refcount /
+copy-on-write invariants on the paged cache, LRU eviction under
+allocation pressure, randomized invariant sweep, and engine-level
+proofs — cached generation token-identical to a cold engine, chunked
+prefill interleaving with decodes, and idle-gauge zeroing."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raytpu.inference import (InferenceEngine, PagedKVCache, PrefixCache,
+                              SamplingParams)
+from raytpu.inference import engine as engine_mod
+from raytpu.models.llama import Llama, LlamaConfig
+from raytpu.models.llama import init_params as llama_init
+
+LCFG = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                           attn_impl="reference", remat=False)
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    model = Llama(LCFG)
+    return model, llama_init(model, LCFG, seed=0, batch=1)
+
+
+def reference_greedy(model, params, prompt, n_new):
+    toks = list(prompt)
+    outs = []
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, jnp.asarray([toks]))
+        tok = int(jnp.argmax(logits[0, len(toks) - 1]))
+        toks.append(tok)
+        outs.append(tok)
+    return outs
+
+
+def make_cache(pages=9, page_size=4):
+    cache = PagedKVCache(num_layers=2, num_pages=pages, page_size=page_size,
+                         num_kv_heads=2, head_dim=8)
+    return cache, PrefixCache(cache)
+
+
+class TestHashChain:
+    def test_chained_over_full_pages_only(self):
+        _, pc = make_cache(page_size=4)
+        toks = list(range(10))  # 2 full pages + 2-token tail
+        hashes = pc.page_hashes(toks)
+        assert len(hashes) == 2
+        # The chain is a pure function of the token prefix.
+        assert hashes == pc.page_hashes(toks[:8])
+        assert pc.page_hashes(toks[:3]) == []
+
+    def test_divergence_poisons_every_later_page(self):
+        _, pc = make_cache(page_size=4)
+        a = pc.page_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12])
+        b = pc.page_hashes([1, 2, 3, 4, 5, 6, 9, 8, 9, 10, 11, 12])
+        assert a[0] == b[0]          # identical first page
+        assert a[1] != b[1]          # diverged in page 2...
+        assert a[2] != b[2]          # ...so page 3 differs even though
+        #                              its own tokens are identical
+        # Same tokens shifted into different pages never collide.
+        c = pc.page_hashes([0, 1, 2, 3, 4, 5, 6, 7])
+        assert a[0] != c[0] and a[0] != c[1]
+
+    def test_register_then_match_round_trip(self):
+        cache, pc = make_cache(page_size=4)
+        toks = list(range(100, 110))
+        assert cache.allocate("a", len(toks))
+        assert pc.register("a", toks, covered_len=len(toks)) == 2
+        table = cache.block_table("a")
+        assert pc.match(toks) == table[:2]
+        # A different continuation after the shared pages still hits.
+        assert pc.match(toks[:8] + [999]) == table[:2]
+        # Divergence inside page 1 misses entirely.
+        assert pc.match([999] + toks[1:]) == []
+
+    def test_partial_coverage_registers_only_written_pages(self):
+        cache, pc = make_cache(page_size=4)
+        toks = list(range(12))
+        assert cache.allocate("a", 12)
+        assert pc.register("a", toks, covered_len=6) == 1  # page 2 unwritten
+        assert pc.match(toks) == cache.block_table("a")[:1]
+
+
+class TestRefcountCOW:
+    def test_shared_pages_refcounted_and_tails_private(self):
+        cache, pc = make_cache(page_size=4)
+        toks = list(range(10))
+        assert cache.allocate("a", 10)
+        pc.register("a", toks, covered_len=10)
+        shared = pc.match(toks)
+        assert cache.allocate_shared("b", 11, shared)
+        ta, tb = cache.block_table("a"), cache.block_table("b")
+        assert ta[:2] == tb[:2]              # pointer copy, no KV moved
+        assert set(ta[2:]).isdisjoint(tb[2:])  # tails are private (COW)
+        assert all(cache.refcount(p) == 2 for p in shared)
+        # Writes land past the shared prefix: b's slots for positions
+        # >= 8 resolve into b's private pages only.
+        for pos in range(8, 11):
+            assert cache.slot("b", pos) // 4 in tb[2:]
+
+    def test_free_decrefs_and_retains_registered_pages(self):
+        cache, pc = make_cache(page_size=4)
+        toks = list(range(10))
+        assert cache.allocate("a", 10)
+        pc.register("a", toks, covered_len=10)
+        shared = pc.match(toks)
+        assert cache.allocate_shared("b", 10, shared)
+        cache.free("a")
+        assert all(cache.refcount(p) == 1 for p in shared)  # b still holds
+        # a's partial 3rd page (tokens 8,9) was never registered: it
+        # goes straight back to the free list, nothing parks.
+        assert pc.reclaimable() == 0
+        cache.free("b")
+        # Both gone: the 2 registered pages park (reclaimable), every
+        # private tail page returns to the free list.
+        assert pc.reclaimable() == 2
+        assert cache.refcount(shared[0]) == 0
+        # Parked pages still count as allocatable capacity.
+        assert cache.free_pages() == cache.total_pages
+        assert cache.utilization() == 0.0
+        # And the warm KV is still matchable.
+        assert pc.match(toks) == shared
+
+    def test_reacquiring_parked_pages_unparks_them(self):
+        cache, pc = make_cache(page_size=4)
+        toks = list(range(8))
+        assert cache.allocate("a", 8)
+        pc.register("a", toks, covered_len=8)
+        cache.free("a")
+        assert pc.reclaimable() == 2
+        shared = pc.match(toks)
+        assert cache.allocate_shared("c", 9, shared)
+        assert pc.reclaimable() == 0   # referenced again — not evictable
+        assert all(cache.refcount(p) == 1 for p in shared)
+
+    def test_allocate_shared_rollback_on_failure(self):
+        cache, pc = make_cache(pages=5, page_size=4)  # 4 usable
+        toks = list(range(8))
+        assert cache.allocate("a", 8)  # 2 pages
+        pc.register("a", toks, covered_len=8)
+        shared = pc.match(toks)
+        # Needs 3 tail pages, only 2 exist: must fail atomically.
+        assert not cache.allocate_shared("b", 20, shared)
+        assert all(cache.refcount(p) == 1 for p in shared)  # a only
+        assert cache.num_sequences() == 1
+        with pytest.raises(ValueError):
+            cache.allocate_shared("c", 4, shared)  # prefix > allocation
+
+    def test_double_allocate_shared_raises(self):
+        cache, _ = make_cache()
+        assert cache.allocate("a", 4)
+        with pytest.raises(ValueError):
+            cache.allocate_shared("a", 4, [])
+
+
+class TestEviction:
+    def test_lru_eviction_under_allocation_pressure(self):
+        cache, pc = make_cache(pages=5, page_size=4)  # 4 usable
+        for sid, base in (("a", 0), ("b", 100)):
+            toks = list(range(base, base + 8))
+            assert cache.allocate(sid, 8)
+            pc.register(sid, toks, covered_len=8)
+            cache.free(sid)
+        assert pc.reclaimable() == 4
+        # Touch a's pages so b's become least-recently-matched.
+        assert len(pc.match(list(range(0, 8)))) == 2
+        before = pc.stats()["evictions"]
+        assert cache.allocate("c", 8)  # forces eviction of 2 pages
+        assert pc.stats()["evictions"] - before == 2
+        # b (LRU) was evicted; a survived.
+        assert pc.match(list(range(100, 108))) == []
+        assert len(pc.match(list(range(0, 8)))) == 2
+
+    def test_matched_pages_pinned_before_tail_reservation(self):
+        cache, pc = make_cache(pages=5, page_size=4)  # 4 usable
+        toks_a, toks_b = list(range(8)), list(range(100, 108))
+        for sid, toks in (("a", toks_a), ("x", toks_b)):
+            assert cache.allocate(sid, 8)
+            pc.register(sid, toks, covered_len=8)
+            cache.free(sid)
+        # All 4 usable pages are parked, the free list is EMPTY: the
+        # tail reservation below must evict — and must evict x's pages,
+        # never the just-matched pages it is about to graft.
+        shared = pc.match(toks_a)
+        assert cache.allocate_shared("b", 16, shared)
+        assert cache.block_table("b")[:2] == shared
+        assert pc.match(toks_a) == shared   # survived, still registered
+        assert pc.match(toks_b) == []       # x paid for the tail
+
+    def test_referenced_pages_never_reclaimed(self):
+        cache, pc = make_cache(pages=5, page_size=4)
+        toks = list(range(8))
+        assert cache.allocate("a", 8)
+        pc.register("a", toks, covered_len=8)
+        # a still holds its pages: nothing reclaimable, allocation of
+        # 4 more pages is simply refused.
+        assert pc.reclaimable() == 0
+        assert not cache.allocate("b", 16)
+        assert len(cache.block_table("a")) == 2
+
+
+class TestInvariantSweep:
+    def test_randomized_ops_preserve_partition(self):
+        """Every usable page is in exactly one of {free list, parked
+        LRU, referenced}; refcounts equal table membership counts."""
+        rng = np.random.default_rng(7)
+        cache, pc = make_cache(pages=17, page_size=4)  # 16 usable
+        live = {}
+        prompts = [list(range(b, b + int(n)))
+                   for b, n in ((0, 8), (50, 12), (0, 16), (200, 4))]
+        for step in range(300):
+            op = rng.integers(0, 3)
+            if op == 0 and len(live) < 6:
+                sid = f"s{step}"
+                toks = prompts[int(rng.integers(0, len(prompts)))]
+                cap = (len(toks) - 1) // 4
+                shared = pc.match(toks, max_pages=cap)
+                if cache.allocate_shared(sid, len(toks), shared):
+                    live[sid] = toks
+                    pc.register(sid, toks, covered_len=len(toks))
+            elif op == 1 and live:
+                sid = list(live)[int(rng.integers(0, len(live)))]
+                del live[sid]
+                cache.free(sid)
+            elif op == 2 and live:
+                sid = list(live)[int(rng.integers(0, len(live)))]
+                cache.extend(sid, len(live[sid]) + int(rng.integers(1, 8)))
+            # -- invariants ------------------------------------------
+            refcounts = {}
+            for t in cache._tables.values():
+                for p in t:
+                    refcounts[p] = refcounts.get(p, 0) + 1
+            assert refcounts == cache._refs
+            free = set(cache._free)
+            parked = set(pc._lru)
+            referenced = set(refcounts)
+            assert not free & parked
+            assert not free & referenced
+            assert not parked & referenced
+            assert free | parked | referenced == set(range(1, 17))
+            assert cache.free_pages() == len(free) + len(parked)
+            # Hash index is a bijection over registered pages.
+            assert len(pc._by_hash) == len(pc._hash_of)
+            for page, h in pc._hash_of.items():
+                assert pc._by_hash[h] == page
+
+
+ENGINE_OPTS = dict(page_size=4, max_num_seqs=2, max_model_len=32)
+
+
+class TestEnginePrefixCache:
+    def test_cache_hit_generation_token_identical_to_cold_engine(
+            self, llama_model):
+        """THE acceptance property: a prompt whose prefix is served
+        from cache generates exactly the tokens a cold engine does,
+        and only the tail was prefilled."""
+        model, params = llama_model
+        prompt1 = list(range(1, 11))             # 10 toks: 2 full pages
+        prompt2 = prompt1[:8] + [40, 41, 42]     # shares both pages
+
+        cold = InferenceEngine(LCFG, params, **ENGINE_OPTS,
+                               enable_prefix_cache=False)
+        expect1 = cold.generate([prompt1],
+                                SamplingParams(max_new_tokens=6))[0]
+        cold2 = InferenceEngine(LCFG, params, **ENGINE_OPTS,
+                                enable_prefix_cache=False)
+        expect2 = cold2.generate([prompt2],
+                                 SamplingParams(max_new_tokens=6))[0]
+
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS)
+        assert eng.generate([prompt1],
+                            SamplingParams(max_new_tokens=6))[0] == expect1
+        before = eng._prefill_tokens
+        hits_before = eng.prefix_cache.stats()["hit_tokens"]
+        out = eng.generate([prompt2], SamplingParams(max_new_tokens=6))[0]
+        assert out == expect2 == reference_greedy(model, params, prompt2, 6)
+        # Only the 3-token tail prefilled; 8 tokens came from cache.
+        assert eng._prefill_tokens - before == 3
+        assert eng.prefix_cache.stats()["hit_tokens"] - hits_before == 8
+
+    def test_repeat_prompt_prefills_one_token(self, llama_model):
+        _, params = llama_model
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS)
+        prompt = list(range(1, 10))  # 9 toks: cap = 2 pages = 8 toks
+        first = eng.generate([prompt], SamplingParams(max_new_tokens=4))[0]
+        before = eng._prefill_tokens
+        again = eng.generate([prompt], SamplingParams(max_new_tokens=4))[0]
+        assert again == first
+        # The match is capped one token short of the prompt: the final
+        # token always runs through the model to produce logits.
+        assert eng._prefill_tokens - before == 1
+
+    def test_pages_reclaimable_after_generate(self, llama_model):
+        _, params = llama_model
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS)
+        eng.generate([list(range(1, 10))], SamplingParams(max_new_tokens=4))
+        # Prompt pages stay parked for reuse but capacity is intact.
+        assert eng.cache.free_pages() == eng.cache.total_pages
+        assert eng.cache.utilization() == 0.0
+        assert eng.prefix_cache.stats()["registered_pages"] == 2
+
+    def test_chunked_prefill_matches_reference(self, llama_model):
+        model, params = llama_model
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS,
+                              prefill_chunk=8)
+        prompt = list(range(1, 21))  # 20 tokens -> chunks of 8/8/4
+        out = eng.generate([prompt], SamplingParams(max_new_tokens=5))[0]
+        assert out == reference_greedy(model, params, prompt, 5)
+        stats = eng.stats()
+        assert stats["chunk_prefill_compiles"]  # chunk path exercised
+        assert all(n == 1
+                   for n in stats["chunk_prefill_compiles"].values())
+
+    def test_chunked_prefill_interleaves_with_decode(self, llama_model):
+        """A long prompt admitted mid-stream must not stall the running
+        decode: chunks and decode steps share iterations."""
+        model, params = llama_model
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS,
+                              prefill_chunk=8)
+        eng.add_request("short", [1, 2, 3],
+                        SamplingParams(max_new_tokens=12))
+        outs = {"short": [], "long": []}
+        interleaved = 0
+        long_prompt = list(range(1, 21))
+        for i in range(60):
+            if i == 2:
+                eng.add_request("long", long_prompt,
+                                SamplingParams(max_new_tokens=4))
+            for o in eng.step():
+                outs[o.request_id].append(o.token_id)
+            if (eng.scheduler.running and "long" in {
+                    s.request_id for s in eng.scheduler.running}
+                    and any(s.request_id == "short" and s.generated
+                            for s in eng.scheduler.running)):
+                interleaved += 1
+            if not eng.has_unfinished():
+                break
+        assert outs["short"] == reference_greedy(model, params, [1, 2, 3], 12)
+        assert outs["long"] == reference_greedy(model, params, long_prompt, 4)
+        # The long prompt coexisted with the short stream for multiple
+        # iterations (its 3 chunks each took one step).
+        assert interleaved >= 2
+
+    def test_idle_steps_zero_throughput_gauges(self, llama_model):
+        _, params = llama_model
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS)
+        eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3))
+        assert engine_mod._decode_tps_gauge.value > 0.0
+        eng.step()  # empty step: no prefill, no decode
+        assert engine_mod._prefill_tps_gauge.value == 0.0
+        assert engine_mod._decode_tps_gauge.value == 0.0
+
+    def test_note_idle_zeroes_gauges_without_stepping(self, llama_model):
+        _, params = llama_model
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS)
+        eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3))
+        engine_mod._decode_tps_gauge.set(123.0)
+        eng.note_idle()
+        assert engine_mod._decode_tps_gauge.value == 0.0
+        assert engine_mod._prefill_tps_gauge.value == 0.0
+
+    def test_ttft_recorded_and_in_pressure(self, llama_model):
+        _, params = llama_model
+        eng = InferenceEngine(LCFG, params, **ENGINE_OPTS)
+        n0 = len(engine_mod._ttft_hist.observations)
+        eng.generate([[1, 2, 3], [4, 5, 6]],
+                     SamplingParams(max_new_tokens=2))
+        assert len(engine_mod._ttft_hist.observations) == n0 + 2
+        p = eng.pressure()
+        assert set(p) == {"waiting_requests", "running_requests",
+                          "kv_utilization", "ttft_p95_s"}
+        assert p["ttft_p95_s"] > 0.0
+
+    def test_preemption_with_prefix_cache_preserves_output(
+            self, llama_model):
+        """Preempt-to-recompute now resumes THROUGH the prefix cache
+        (freed prompt pages are matched on re-admission) and the chunk
+        path; the output stream must stay byte-identical."""
+        model, params = llama_model
+        eng = InferenceEngine(LCFG, params, page_size=4, num_pages=6,
+                              max_num_seqs=2, max_model_len=24)
+        prompts = [list(range(1, 9)), list(range(11, 17))]
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=8))
+        assert eng.scheduler.num_preemptions >= 1
+        for prompt, out in zip(prompts, outs):
+            assert out == reference_greedy(model, params, prompt, 8)

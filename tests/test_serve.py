@@ -8,7 +8,8 @@ import pytest
 
 import raytpu
 from raytpu import serve
-from raytpu.serve._private.autoscaling_policy import AutoscalingPolicyManager
+from raytpu.serve._private.autoscaling_policy import (AutoscalingPolicyManager,
+                                                      EnginePressure)
 from raytpu.serve.config import AutoscalingConfig
 
 
@@ -177,6 +178,126 @@ class TestAutoscalingPolicy:
             t.join()
         assert scaled
         assert len(results) == 12
+
+
+class TestEnginePressurePolicy:
+    """Engine-pressure terms of the autoscaling policy: demand the
+    router can't see (engine admission queues, KV occupancy, TTFT)."""
+
+    def _mgr(self, **kw):
+        cfg = AutoscalingConfig(
+            min_replicas=1, max_replicas=10,
+            target_ongoing_requests=100.0,  # request term stays inert
+            target_engine_waiting=2.0, target_kv_utilization=0.8,
+            upscale_delay_s=0.0, downscale_delay_s=0.0, **kw)
+        return AutoscalingPolicyManager(cfg)
+
+    def test_engine_waiting_drives_upscale(self):
+        mgr = self._mgr()
+        # One ongoing request reads as no load — but 8 requests queue
+        # INSIDE the engines, invisible to request counting.
+        assert mgr.desired(1.0, 1) == 1
+        assert mgr.desired(1.0, 1, EnginePressure(waiting_requests=8.0)) == 4
+
+    def test_kv_utilization_term_fires_only_above_target(self):
+        mgr = self._mgr()
+        assert mgr.desired(0.0, 2, EnginePressure(kv_utilization=0.5)) == 1
+        # 96% page occupancy on 2 replicas: 2 * 0.96 / 0.8 -> 3.
+        assert mgr.desired(0.0, 2, EnginePressure(kv_utilization=0.96)) == 3
+
+    def test_ttft_term_disabled_unless_configured(self):
+        assert self._mgr().desired(
+            0.0, 2, EnginePressure(ttft_p95_s=30.0)) == 1
+        mgr = self._mgr(target_ttft_s=0.5)
+        assert mgr.desired(0.0, 2, EnginePressure(ttft_p95_s=2.0)) == 8
+
+    def test_pressure_respects_hysteresis_windows(self):
+        cfg = AutoscalingConfig(min_replicas=1, max_replicas=10,
+                                target_ongoing_requests=100.0,
+                                target_engine_waiting=1.0,
+                                upscale_delay_s=1.0, downscale_delay_s=2.0)
+        mgr = AutoscalingPolicyManager(cfg)
+        deep = EnginePressure(waiting_requests=6.0)
+        assert mgr.get_decision_num_replicas(
+            0.0, 1, now=0.0, engine_pressure=deep) is None
+        assert mgr.get_decision_num_replicas(
+            0.0, 1, now=1.1, engine_pressure=deep) == 6
+        # Drained engines shrink through the same (slower) window.
+        assert mgr.get_decision_num_replicas(
+            0.0, 6, now=2.0, engine_pressure=EnginePressure()) is None
+        assert mgr.get_decision_num_replicas(
+            0.0, 6, now=4.1, engine_pressure=EnginePressure()) == 1
+
+
+class _ProbeRef:
+    def __init__(self, qlen):
+        self.qlen = qlen
+
+
+class _ProbeMethod:
+    def __init__(self, qlen):
+        self.qlen = qlen
+
+    def remote(self):
+        return _ProbeRef(self.qlen)
+
+
+class _FakeReplica:
+    def __init__(self, qlen):
+        self.get_queue_len = _ProbeMethod(qlen)
+
+
+class _StubRaytpu:
+    """raytpu.get stand-in: qlen=None simulates a probe that hangs
+    until the router's PROBE_TIMEOUT_S budget expires."""
+
+    @staticmethod
+    def get(ref, timeout=None):
+        if ref.qlen is None:
+            raise TimeoutError("queue-len probe timed out")
+        return ref.qlen
+
+
+def _replica_set(replicas, max_ongoing=4):
+    from raytpu.serve._private import router as router_mod
+
+    rs = object.__new__(router_mod.ReplicaSet)
+    rs._controller = None
+    rs._full_name = "t#D"
+    rs._max_ongoing = max_ongoing
+    rs._lock = threading.Lock()
+    rs._replicas = list(replicas)
+    rs._version = 0
+    rs._stopped = False
+    rs._have_replicas = threading.Event()
+    rs._have_replicas.set()
+    return rs
+
+
+class TestRouterProbeHardening:
+    def test_timed_out_probe_never_wins_the_pick(self, monkeypatch):
+        from raytpu.serve._private import router as router_mod
+
+        monkeypatch.setattr(router_mod, "raytpu", _StubRaytpu)
+        healthy = _FakeReplica(qlen=3)    # busy, but answering
+        wedged = _FakeReplica(qlen=None)  # probe hangs
+        rs = _replica_set([("r-ok", healthy), ("r-wedged", wedged)])
+        # Power-of-two probes both every round; the wedged replica must
+        # score WORST-queue (inf), so the busy-but-alive one wins every
+        # pick — a hung replica that scored 0 would attract everything.
+        for _ in range(10):
+            assert rs.choose(timeout_s=5.0) is healthy
+
+    def test_all_probes_failing_times_out_instead_of_guessing(
+            self, monkeypatch):
+        from raytpu.serve._private import router as router_mod
+
+        monkeypatch.setattr(router_mod, "raytpu", _StubRaytpu)
+        rs = _replica_set([("r-wedged", _FakeReplica(qlen=None))])
+        # No healthy alternative: choose must keep backing off and
+        # surface a timeout, never hand out the unprobeable replica.
+        with pytest.raises(TimeoutError):
+            rs.choose(timeout_s=0.3)
 
 
 class TestRedeploy:
