@@ -34,6 +34,23 @@ background stepping loop pumps the engine), three scenarios —
 Writes the scenario table to BENCH_r07.json at the repo root and prints
 the same object as one JSON line.
 
+``--decode-sweep`` runs the PAGED-ATTENTION decode sweep: single
+decode-step latency and tokens/s vs context length {128..4096} x batch
+{1, 8} on a tiny Llama, for three implementations —
+
+- ``reference`` with TRIMMED block tables (the engine's default CPU
+  path after r8: tables sliced to the batch's actual page count,
+  bucketed);
+- ``reference_untrimmed`` (pre-r8 behavior: every decode gathers the
+  full ``P_max``-wide padded table — the longest-ever sequence tax);
+- ``kernel`` (the Pallas paged-attention kernel, interpret mode on
+  CPU — correctness-honest but interpreter-speed; on TPU the same
+  code path is the fused in-place page reader).
+
+Also records the interpret-kernel bf16 max-abs error against the fp32
+reference (acceptance: <= 2e-2). Writes BENCH_r08.json at the repo
+root and prints the same object as one JSON line.
+
 Env: RAYTPU_INFER_BENCH_REQUESTS (default 6),
 RAYTPU_INFER_BENCH_NEW_TOKENS (default 24),
 RAYTPU_INFER_BENCH_STAGGER (iterations between arrivals, default 3),
@@ -230,8 +247,165 @@ def main_load() -> None:
     print(json.dumps(result))
 
 
+def _decode_once(fn, params, ks, vs, inputs):
+    logits, _, _ = fn(params, *inputs, ks, vs)
+    logits.block_until_ready()
+
+
+def _time_decode(fn, params, ks, vs, inputs, reps):
+    _decode_once(fn, params, ks, vs, inputs)  # compile + warm
+    best = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _decode_once(fn, params, ks, vs, inputs)
+        best.append(time.perf_counter() - t0)
+    return sorted(best)[len(best) // 2]  # median
+
+
+def main_decode_sweep() -> None:
+    _force_cpu()
+    import dataclasses
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raytpu.inference.engine import _bucket_for, _pow2_buckets
+    from raytpu.models.llama import Llama, LlamaConfig, init_params
+    from raytpu.models.llama import llama_decode
+    from raytpu.ops.paged_attention import (paged_attention,
+                                            paged_attention_reference)
+
+    contexts = [128, 256, 512, 1024, 2048, 4096]
+    batches = [1, 8]
+    page_size = 32
+    max_model_len = contexts[-1] + page_size  # room for the new token
+    p_max = -(-max_model_len // page_size)
+    page_buckets = _pow2_buckets(1, p_max)
+    reps = int(os.environ.get("RAYTPU_INFER_BENCH_REPS", 3))
+
+    base = dataclasses.replace(
+        LlamaConfig.tiny(), block_size=max_model_len,
+        dtype=jnp.float32, attn_impl="reference", remat=False)
+    params = init_params(Llama(base), base, seed=0, batch=1)
+    kv, d = base.n_kv_head, base.head_dim
+    rng = np.random.default_rng(0)
+
+    def make_state(batch, ctx, width):
+        """Synthetic page pool + per-seq tables/positions for a decode
+        step at context ``ctx`` (the new token is slot ctx)."""
+        pages_live = -(-(ctx + 1) // page_size)
+        num_pages = batch * pages_live + 1
+        ks = [jnp.asarray(rng.standard_normal(
+            (num_pages, page_size, kv, d)) * 0.02, base.dtype)
+            for _ in range(base.n_layer)]
+        vs = [jnp.asarray(rng.standard_normal(
+            (num_pages, page_size, kv, d)) * 0.02, base.dtype)
+            for _ in range(base.n_layer)]
+        tables = np.zeros((batch, width), np.int32)
+        dests = np.zeros(batch, np.int32)
+        for b in range(batch):
+            pages = 1 + b * pages_live + np.arange(pages_live)
+            tables[b, :pages_live] = pages
+            dests[b] = pages[ctx // page_size] * page_size + ctx % page_size
+        tokens = np.ones(batch, np.int32)
+        positions = np.full(batch, ctx, np.int32)
+        context_lens = np.full(batch, ctx + 1, np.int32)
+        return ks, vs, tuple(jnp.asarray(a) for a in (
+            tokens, positions, dests, tables, context_lens))
+
+    def decode_fn(paged):
+        cfg = dataclasses.replace(base, paged_attn=paged)
+        return jax.jit(functools.partial(llama_decode, cfg))
+
+    rows = []
+    for batch in batches:
+        for ctx in contexts:
+            width = _bucket_for(-(-(ctx + 1) // page_size), page_buckets)
+            variants = {
+                "reference": (decode_fn("reference"), width),
+                "reference_untrimmed": (decode_fn("reference"), p_max),
+                "kernel": (decode_fn("interpret"), width),
+            }
+            for name, (fn, w) in variants.items():
+                ks, vs, inputs = make_state(batch, ctx, w)
+                # The interpret-mode kernel runs seconds per step at
+                # long context on CPU (per-grid-step interpreter
+                # overhead — not representative of the TPU path); one
+                # rep keeps the sweep bounded.
+                dt = _time_decode(fn, params, ks, vs, inputs,
+                                  1 if name == "kernel" else reps)
+                rows.append({
+                    "impl": name, "batch": batch, "context": ctx,
+                    "table_width_pages": w,
+                    "decode_step_ms": round(dt * 1e3, 3),
+                    "tokens_per_s": round(batch / dt, 2),
+                })
+                print(f"# {name:>20s} b={batch} ctx={ctx:4d} "
+                      f"width={w:3d} {dt * 1e3:8.2f} ms")
+
+    # bf16 numerics: interpret kernel vs fp32 reference (acceptance
+    # bar 2e-2).
+    nb, nctx = 8, 1024
+    npages = nb * (-(-(nctx + 1) // page_size)) + 1
+    q16 = jnp.asarray(rng.standard_normal((nb, 1, base.n_head, d)),
+                      jnp.bfloat16)
+    k16 = jnp.asarray(rng.standard_normal((npages, page_size, kv, d)),
+                      jnp.bfloat16)
+    v16 = jnp.asarray(rng.standard_normal((npages, page_size, kv, d)),
+                      jnp.bfloat16)
+    bt = jnp.asarray(np.arange(1, npages).reshape(nb, -1), jnp.int32)
+    pos = jnp.full((nb, 1), nctx, jnp.int32)
+    ref = paged_attention_reference(
+        q16.astype(jnp.float32), k16.astype(jnp.float32),
+        v16.astype(jnp.float32), bt, pos, sm_scale=d ** -0.5)
+    ker = paged_attention(q16, k16, v16, bt, pos, force="interpret")
+    bf16_err = float(jnp.max(jnp.abs(
+        ref - ker.astype(jnp.float32))))
+
+    def _at(impl, batch, ctx):
+        (r,) = [r for r in rows if r["impl"] == impl
+                and r["batch"] == batch and r["context"] == ctx]
+        return r
+
+    result = {
+        "metric": "infer_decode_sweep",
+        "unit": "single decode-step latency (ms) and tokens/s vs "
+                "context x batch; tiny llama fp32 on CPU; kernel rows "
+                "are the Pallas paged-attention kernel in interpret "
+                "mode (correctness proxy — the TPU path is the fused "
+                "in-place reader)",
+        "page_size": page_size,
+        "max_model_len": max_model_len,
+        "rows": rows,
+        "kernel_bf16_max_abs_err": bf16_err,
+        "kernel_bf16_err_bound": 2e-2,
+        "headline": {
+            # The trim win: short-context decode no longer pays the
+            # longest-ever-sequence gather.
+            "trim_speedup_ctx128_b8": round(
+                _at("reference_untrimmed", 8, 128)["decode_step_ms"]
+                / max(_at("reference", 8, 128)["decode_step_ms"], 1e-9),
+                2),
+            "trim_speedup_ctx512_b8": round(
+                _at("reference_untrimmed", 8, 512)["decode_step_ms"]
+                / max(_at("reference", 8, 512)["decode_step_ms"], 1e-9),
+                2),
+        },
+    }
+    assert bf16_err <= 2e-2, f"bf16 kernel error {bf16_err} > 2e-2"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_r08.json"), "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
     if "--load" in sys.argv[1:]:
         main_load()
+    elif "--decode-sweep" in sys.argv[1:]:
+        main_decode_sweep()
     else:
         main()

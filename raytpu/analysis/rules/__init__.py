@@ -27,11 +27,15 @@ RTP009 seam-swallow            no bare except / silently swallowed
 RTP010 step-loop-blocking      no raytpu.get/wait, time.sleep, or
                                socket/subprocess waits on the engine
                                stepping path
+RTP011 cache-gather            no materializing *pages[...] gather in
+                               models/ or inference/ — paged attention
+                               reads KV pages in place
 ====== ======================= ====================================
 """
 
 from raytpu.analysis.rules import (  # noqa: F401
     blocking_in_async,
+    cache_gather,
     contextvar_crossing,
     env_registry,
     jit_in_builders,

@@ -1,0 +1,104 @@
+"""RTP011: no materializing KV-cache gather on the model/engine path.
+
+``k_pages[block_tables]`` (fancy-indexing the page pool with a block
+table) materializes O(B * P * page_size * kv_heads * head_dim) of HBM
+traffic per call — per layer, per generated token on the decode path.
+PR 8 moved that pattern into exactly one sanctioned home,
+``raytpu.ops.paged_attention`` (the dense reference the Pallas kernel
+is checked against); the hot path reads pages in place through the
+kernel's block-table index maps. This rule keeps the slow pattern from
+silently returning: any subscript of a ``*pages`` array by a
+non-literal index inside ``raytpu/models/`` or ``raytpu/inference/``
+is a finding.
+
+What counts as a gather: the subscript base is a name (or attribute)
+ending in ``pages``, and the index is computed — a name, call, or
+expression — rather than a literal int or a plain slice. Literal
+subscripts (``k_pages[0]``, ``k_pages[2:4]``, ``k_pages.shape[1]``)
+are pointwise/metadata reads and stay legal.
+
+Escape hatch: functions whose name contains ``reference`` are exempt,
+mirroring the ops-layer convention, so an in-scope numerics oracle can
+still be written next to what it checks.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+
+def _is_literal_index(node) -> bool:
+    """Indices that cannot be a materializing gather: constants,
+    negated constants, plain slices, and tuples thereof."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand,
+                                                   ast.Constant):
+        return True
+    if isinstance(node, ast.Slice):
+        for part in (node.lower, node.upper, node.step):
+            if part is not None and not _is_literal_index(part):
+                return False
+        return True
+    if isinstance(node, ast.Tuple):
+        return all(_is_literal_index(e) for e in node.elts)
+    return False
+
+
+def _pages_base(node) -> str | None:
+    """The dotted/bare name of a subscript base that is a page pool."""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    else:
+        return None
+    return name if name.lstrip("_").lower().endswith("pages") else None
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self):
+        self.in_reference = False
+        self.hits = []  # (node, base_name)
+
+    def _visit_def(self, node):
+        prev, self.in_reference = self.in_reference, (
+            self.in_reference or "reference" in node.name)
+        self.generic_visit(node)
+        self.in_reference = prev
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Subscript(self, node):
+        base = _pages_base(node.value)
+        if (base and not self.in_reference
+                and not _is_literal_index(node.slice)):
+            self.hits.append((node, base))
+        self.generic_visit(node)
+
+
+@register
+class CacheGather(Rule):
+    id = "RTP011"
+    name = "cache-gather"
+    invariant = ("models/ and inference/ never fancy-index a *pages "
+                 "array — paged attention reads pages in place via "
+                 "raytpu.ops.paged_attention")
+    rationale = ("a materializing k_pages[block_tables] gather moves "
+                 "the whole padded page pool through HBM per layer per "
+                 "decode step; the paged kernel makes that traffic "
+                 "zero and the pattern must not creep back")
+    scope = ("raytpu/models/", "raytpu/inference/")
+
+    def check(self, mod):
+        scan = _Scan()
+        scan.visit(mod.tree)
+        for node, base in scan.hits:
+            yield self.finding(
+                mod, node,
+                f"materializing gather {base}[...] — route cache "
+                f"attention through raytpu.ops.paged_attention "
+                f"(reference-named functions are exempt)")

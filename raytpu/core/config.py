@@ -137,8 +137,13 @@ declare_env("RAYTPU_HEALTH_CHECK_PERIOD_S", "head health-check sweep period (s)"
 declare_env("RAYTPU_HOST_IP", "advertised address override for this host")
 declare_env("RAYTPU_NUM_TPUS", "TPU chip count override for topology detection")
 
-# Kernels (tpu/flash_attention.py).
+# Kernels (ops/flash_attention.py, ops/paged_attention.py).
 declare_env("RAYTPU_FLASH_DOT", "force the dot-product flash-attention path (bool)")
+declare_env("RAYTPU_FLASH_BLOCK_Q", "flash-attention query tile rows")
+declare_env("RAYTPU_FLASH_BLOCK_K", "flash-attention key tile rows")
+declare_env("RAYTPU_PAGED_ATTN",
+            "paged-attention impl: auto|on|off|kernel|interpret|reference")
+declare_env("RAYTPU_PAGED_BLOCK_Q", "paged-attention query-token block")
 
 # Runtime environments (runtime_env/container.py, runtime_env/pip_env.py).
 declare_env("RAYTPU_CONTAINER_ENGINE", "container engine binary (docker/podman)")

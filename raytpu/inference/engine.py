@@ -158,9 +158,21 @@ class InferenceEngine:
             min(16, self.prefill_chunk), self.prefill_chunk)
         self.decode_buckets = sorted(decode_buckets or _pow2_buckets(
             1, max_num_seqs))
+        # Block-table width buckets: decode/chunk pass tables trimmed
+        # to the batch's actual max page count (bucketed so the trim
+        # adds at most log2(P_max) programs per batch bucket) instead
+        # of always paying for the longest-ever sequence.
+        self.page_buckets = _pow2_buckets(1, self.max_pages_per_seq)
+        # Resolved paged-attention impl ("tpu"/"interpret"/"reference")
+        # — informational, and gates the pages-gathered accounting: the
+        # kernel path never materializes a gather.
+        from raytpu.ops.paged_attention import resolve_paged_impl
+        self.paged_attn_impl = resolve_paged_impl(
+            getattr(model_config, "paged_attn", None))
+        self._pages_gathered = 0
         self._prefill_compiles: Dict[int, int] = {}
-        self._chunk_compiles: Dict[int, int] = {}
-        self._decode_compiles: Dict[int, int] = {}
+        self._chunk_compiles: Dict[str, int] = {}
+        self._decode_compiles: Dict[str, int] = {}
         self._decode_batch_hist: List[int] = []
         self._prefill_tokens = 0
         self._decode_tokens = 0
@@ -198,7 +210,9 @@ class InferenceEngine:
         compiles = self._chunk_compiles
 
         def _chunk(params, ks, vs, tokens, positions, dests, block_tables):
-            bucket = tokens.shape[1]
+            # Length bucket x trimmed block-table width: each combo is
+            # one XLA program.
+            bucket = f"{tokens.shape[1]}x{block_tables.shape[1]}"
             compiles[bucket] = compiles.get(bucket, 0) + 1
             return fwd(cfg, params, tokens, positions, dests, block_tables,
                        ks, vs)
@@ -211,7 +225,9 @@ class InferenceEngine:
 
         def _decode(params, ks, vs, tokens, positions, dests, block_tables,
                     context_lens):
-            bucket = tokens.shape[0]
+            # Batch bucket x trimmed block-table width: each combo is
+            # one XLA program.
+            bucket = f"{tokens.shape[0]}x{block_tables.shape[1]}"
             compiles[bucket] = compiles.get(bucket, 0) + 1
             return fwd(cfg, params, tokens, positions, dests, block_tables,
                        context_lens, ks, vs)
@@ -344,8 +360,13 @@ class InferenceEngine:
         positions = np.zeros(bucket, dtype=np.int32)
         positions[:take] = np.arange(start, start + take)
         dests = self.cache.chunk_dests(seq.request_id, start, take, bucket)
-        tables = self.cache.table_array([seq.request_id],
-                                        self.max_pages_per_seq)
+        # Trim to this sequence's allocated pages (bucketed) — the
+        # reference gather pays O(table width), not O(P_max).
+        p_used = _bucket_for(self.cache.num_seq_pages(seq.request_id),
+                             self.page_buckets)
+        tables = self.cache.table_array([seq.request_id], p_used)
+        if self.paged_attn_impl == "reference":
+            self._pages_gathered += p_used
         with tracing.span("infer.prefill_chunk", {
                 "request_id": seq.request_id, "start": start,
                 "take": take, "bucket": bucket}):
@@ -369,7 +390,11 @@ class InferenceEngine:
         jnp = self._jnp
         b = len(seqs)
         bucket = _bucket_for(b, self.decode_buckets)
-        P = self.max_pages_per_seq
+        # Trim the block tables to the batch's actual max page count
+        # (bucketed): the reference gather then reads O(batch max
+        # context), not O(longest-ever sequence).
+        P = _bucket_for(max(self.cache.num_seq_pages(s.request_id)
+                            for s in seqs), self.page_buckets)
         tokens = np.zeros(bucket, dtype=np.int32)
         positions = np.zeros(bucket, dtype=np.int32)
         dests = np.zeros(bucket, dtype=np.int32)  # page-0 slot 0 = scratch
@@ -382,6 +407,8 @@ class InferenceEngine:
             context_lens[i] = pos + 1
         tables = self.cache.table_array(
             [s.request_id for s in seqs], P, batch=bucket)
+        if self.paged_attn_impl == "reference":
+            self._pages_gathered += bucket * P
         with tracing.span("infer.decode", {"batch": b, "bucket": bucket}):
             logits, ks, vs = self._decode_fn(
                 self._params, self.cache.k, self.cache.v,
@@ -474,6 +501,11 @@ class InferenceEngine:
             "decode_compiles": {str(k): v for k, v
                                 in self._decode_compiles.items()},
             "decode_batch_hist": list(self._decode_batch_hist),
+            # Block-table columns handed to the reference gather (each
+            # model layer materializes page_size tokens per column;
+            # 0 on the kernel path).
+            "gathered_pages": self._pages_gathered,
+            "paged_attn_impl": self.paged_attn_impl,
             "num_preemptions": self.scheduler.num_preemptions,
             "running": len(self.scheduler.running),
             "waiting": len(self.scheduler.waiting),

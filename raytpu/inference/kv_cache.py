@@ -222,6 +222,11 @@ class PagedKVCache:
     def block_table(self, seq_id: str) -> List[int]:
         return list(self._tables[seq_id])
 
+    def num_seq_pages(self, seq_id: str) -> int:
+        """Pages currently allocated to ``seq_id`` (no copy — the
+        engine reads this per step to trim block-table widths)."""
+        return len(self._tables[seq_id])
+
     def slot(self, seq_id: str, pos: int) -> int:
         """Flat slot index (into ``[num_pages*page_size]``) of logical
         token position ``pos`` of sequence ``seq_id``."""

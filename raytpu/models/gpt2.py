@@ -40,6 +40,10 @@ class GPT2Config:
     remat: Any = True
     scan_layers: bool = True
     attn_impl: Optional[str] = None  # None=auto, "reference", "interpret", "tpu"
+    # Paged-attention impl for decode/chunked-prefill against the KV
+    # page pool: None defers to RAYTPU_PAGED_ATTN; "kernel"/"interpret"/
+    # "reference" pin it (see raytpu.ops.paged_attention).
+    paged_attn: Optional[str] = None
     # Cross-entropy chunking: 0 = one [B,T,V] fp32 logits buffer (1.6 GB at
     # batch 8 / 50k vocab); N>0 = flash-xent style, logits computed N rows at
     # a time and recomputed in backward, so peak HBM holds one chunk.
@@ -126,15 +130,12 @@ class CausalSelfAttention(nn.Module):
             k_cache.astype(k_pages.dtype)).reshape(k_pages.shape)
         v_pages = v_pages.reshape(flat).at[dests].set(
             v_cache.astype(v_pages.dtype)).reshape(v_pages.shape)
-        ks = k_pages[block_tables].reshape(b, -1, h, d)
-        vs = v_pages[block_tables].reshape(b, -1, h, d)
-        s = jnp.einsum("bhtd,blhd->bhtl", q.astype(jnp.float32),
-                       ks.astype(jnp.float32)) * (d ** -0.5)
-        visible = jnp.arange(ks.shape[1])[None, :] <= positions[:, None]
-        s = jnp.where(visible[None, None, :, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhtl,blhd->bthd", p, vs.astype(jnp.float32))
-        y = o.astype(c.dtype).reshape(b, t, e)
+        from raytpu.ops.paged_attention import paged_attention
+
+        o = paged_attention(q.transpose(0, 2, 1, 3), k_pages, v_pages,
+                            block_tables, positions[None, :],
+                            force=c.paged_attn)
+        y = o.reshape(b, t, e)
         return self.c_proj(y), k_pages, v_pages
 
     def decode_step(self, x, k_pages, v_pages, dests, block_tables,
@@ -155,15 +156,13 @@ class CausalSelfAttention(nn.Module):
             k.reshape(b, h, d).astype(k_pages.dtype)).reshape(k_pages.shape)
         v_pages = v_pages.reshape(flat).at[dests].set(
             v.reshape(b, h, d).astype(v_pages.dtype)).reshape(v_pages.shape)
-        ks = k_pages[block_tables].reshape(b, -1, h, d)
-        vs = v_pages[block_tables].reshape(b, -1, h, d)
-        s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
-                       ks.astype(jnp.float32)) * (d ** -0.5)
-        visible = jnp.arange(ks.shape[1])[None, :] < context_lens[:, None]
-        s = jnp.where(visible[:, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhl,blhd->bhd", p, vs.astype(jnp.float32))
-        y = o.astype(c.dtype).reshape(b, e)
+        from raytpu.ops.paged_attention import paged_attention
+
+        # The token at position p sees slots 0..p = 0..context_lens-1.
+        o = paged_attention(q[:, None], k_pages, v_pages, block_tables,
+                            (context_lens - 1)[:, None],
+                            force=c.paged_attn)
+        y = o[:, 0].reshape(b, e)
         return self.c_proj(y), k_pages, v_pages
 
 

@@ -38,6 +38,10 @@ class LlamaConfig:
     remat: Any = "dots"              # False/"none" | True/"full" | "dots"
     scan_layers: bool = True
     attn_impl: Optional[str] = None
+    # Paged-attention impl for decode/chunked-prefill against the KV
+    # page pool: None defers to RAYTPU_PAGED_ATTN; "kernel"/"interpret"/
+    # "reference" pin it (see raytpu.ops.paged_attention).
+    paged_attn: Optional[str] = None
     loss_chunk: int = 0
 
     @classmethod
@@ -191,21 +195,14 @@ class LlamaAttention(nn.Module):
             k_cache.astype(k_pages.dtype)).reshape(k_pages.shape)
         v_pages = v_pages.reshape(flat).at[dests].set(
             v_cache.astype(v_pages.dtype)).reshape(v_pages.shape)
-        ks = k_pages[block_tables].reshape(b, -1, kv, d)
-        vs = v_pages[block_tables].reshape(b, -1, kv, d)
-        if kv != h:
-            rep = h // kv
-            ks = jnp.repeat(ks, rep, axis=2)
-            vs = jnp.repeat(vs, rep, axis=2)
-        # fp32 score math matching decode_step; causal over absolute
-        # positions (gathered slot l holds logical position l).
-        s = jnp.einsum("bhtd,blhd->bhtl", q.astype(jnp.float32),
-                       ks.astype(jnp.float32)) * (d ** -0.5)
-        visible = jnp.arange(ks.shape[1])[None, :] <= positions[:, None]
-        s = jnp.where(visible[None, None, :, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhtl,blhd->bthd", p, vs.astype(jnp.float32))
-        y = o.astype(c.dtype).reshape(b, t, h * d)
+        from raytpu.ops.paged_attention import paged_attention
+
+        # Each chunk token attends cached slots <= its absolute
+        # position (gathered/paged slot l holds logical position l).
+        o = paged_attention(q.transpose(0, 2, 1, 3), k_pages, v_pages,
+                            block_tables, positions[None, :],
+                            force=c.paged_attn)
+        y = o.reshape(b, t, h * d)
         return self.o_proj(y), k_pages, v_pages
 
     def decode_step(self, x, k_pages, v_pages, dests, block_tables,
@@ -239,21 +236,13 @@ class LlamaAttention(nn.Module):
             k.astype(k_pages.dtype)).reshape(k_pages.shape)
         v_pages = v_pages.reshape(flat).at[dests].set(
             v.astype(v_pages.dtype)).reshape(v_pages.shape)
-        # Gather each sequence's pages into [B, P*page_size, KV, D].
-        ks = k_pages[block_tables].reshape(b, -1, kv, d)
-        vs = v_pages[block_tables].reshape(b, -1, kv, d)
-        if kv != h:
-            rep = h // kv
-            ks = jnp.repeat(ks, rep, axis=2)
-            vs = jnp.repeat(vs, rep, axis=2)
-        # fp32 score math matching the flash-attention reference path.
-        s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
-                       ks.astype(jnp.float32)) * (d ** -0.5)
-        visible = jnp.arange(ks.shape[1])[None, :] < context_lens[:, None]
-        s = jnp.where(visible[:, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhl,blhd->bhd", p, vs.astype(jnp.float32))
-        y = o.astype(c.dtype).reshape(b, h * d)
+        from raytpu.ops.paged_attention import paged_attention
+
+        # The token at position p sees slots 0..p = 0..context_lens-1.
+        o = paged_attention(q[:, None], k_pages, v_pages, block_tables,
+                            (context_lens - 1)[:, None],
+                            force=c.paged_attn)
+        y = o[:, 0].reshape(b, h * d)
         return self.o_proj(y), k_pages, v_pages
 
 

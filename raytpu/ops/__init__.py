@@ -7,5 +7,7 @@ kernels with jnp fallbacks everywhere else.
 
 from raytpu.ops.flash_attention import flash_attention
 from raytpu.ops.fused import rmsnorm, swiglu
+from raytpu.ops.paged_attention import paged_attention, resolve_paged_impl
 
-__all__ = ["flash_attention", "rmsnorm", "swiglu"]
+__all__ = ["flash_attention", "paged_attention", "resolve_paged_impl",
+           "rmsnorm", "swiglu"]

@@ -62,9 +62,10 @@ def _env_dot_mode() -> str:
     mode = {"input": "input", "bf16": "input",
             "f32": "f32", "fp32": "f32", "float32": "f32"}.get(raw)
     if mode is None:
-        import sys
-        print(f"# RAYTPU_FLASH_DOT={raw!r} not recognized "
-              f"(use 'input' or 'f32'); using 'input'", file=sys.stderr)
+        import warnings
+        warnings.warn(f"RAYTPU_FLASH_DOT={raw!r} not recognized "
+                      f"(use 'input' or 'f32'); using 'input'",
+                      RuntimeWarning, stacklevel=2)
         mode = "input"
     return mode
 

@@ -1,0 +1,346 @@
+"""Paged flash-decode attention: fused block-table attention over the
+inference KV page pool.
+
+The decode hot path used to materialize the whole padded page pool with
+``k_pages[block_tables]`` — O(B * P_max * page_size * kv_heads *
+head_dim) HBM traffic per generated token — then run dense fp32
+attention over mostly padding.  This module replaces that with a Pallas
+kernel that reads KV pages **in place**, vLLM-PagedAttention style:
+
+- grid ``(batch, kv_head, q_blocks, kv_pages)``; the innermost page
+  dimension is sequential so online-softmax state (m / l / acc) lives
+  in VMEM scratch across it.
+- the block table and per-sequence query-start positions are
+  scalar-prefetch operands: the k/v BlockSpec index maps translate the
+  page-grid coordinate through the block table, so each step DMAs one
+  ``[page_size, head_dim]`` tile straight out of the pool.
+- pages past a sequence's live length are *clamped* to the last live
+  page in the index map — the Mosaic pipeline sees the same block again
+  and skips the fetch — and ``pl.when`` skips their flops.
+- GQA folds query heads onto their kv head: q ``[B, T, H, D]`` becomes
+  ``[B, KV, T*rep, D]`` (row = t*rep + r, matching ``jnp.repeat``), so
+  one grid step attends all query heads sharing a kv head.
+- pages may be bf16; scores and accumulators are fp32.
+
+Like :mod:`raytpu.ops.flash_attention` this ships a sanctioned dense
+reference (`paged_attention_reference`, the ONE place a materializing
+gather is allowed — lint rule RTP011 bans it from models/ and
+inference/), an ``interpret=True`` path so CPU tier-1 tests execute the
+real kernel, and a ``force=`` override.
+
+Implementation selection (``resolve_paged_impl``):
+
+- ``RAYTPU_PAGED_ATTN`` unset / ``auto``: kernel on TPU, reference on
+  CPU (default CPU behavior unchanged).
+- ``1`` / ``on`` / ``true``: kernel on TPU, *interpret-mode kernel* on
+  CPU — tests toggle this to execute the real kernel.
+- ``0`` / ``off`` / ``false`` / ``reference``: dense reference.
+- model configs override the env via their ``paged_attn`` field
+  (``kernel`` / ``interpret`` / ``reference`` / ``auto`` / ``on``).
+
+Env knobs (see ``raytpu.core.config.describe_env``):
+
+- ``RAYTPU_PAGED_ATTN``: implementation toggle, above.
+- ``RAYTPU_PAGED_BLOCK_Q``: query-token block (default 256; decode uses
+  T=1 so this only matters for chunked prefill).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raytpu.ops.flash_attention import _on_tpu
+
+_NEG_INF = -1e30
+# Online-softmax running max/denominator are (rows, LANES) f32 scratch:
+# TPU vector scratch wants the 128-wide lane dimension even though only
+# column 0 is meaningful.
+_LANES = 128
+
+__all__ = [
+    "paged_attention",
+    "paged_attention_reference",
+    "gather_kv_pages",
+    "resolve_paged_impl",
+]
+
+
+def _env_block(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        warnings.warn(
+            f"{name}={raw!r} is not an int; using {default}",
+            RuntimeWarning, stacklevel=2)
+        return default
+    return max(1, val)
+
+
+_VALID_PAGED = {
+    "auto": "auto", "": "auto",
+    "1": "on", "on": "on", "true": "on", "yes": "on",
+    "0": "reference", "off": "reference", "false": "reference",
+    "no": "reference", "reference": "reference",
+    "kernel": "tpu", "tpu": "tpu",
+    "interpret": "interpret",
+}
+
+
+def resolve_paged_impl(selector=None) -> str:
+    """Resolve the paged-attention implementation to run.
+
+    ``selector`` is the model config's ``paged_attn`` field; ``None``
+    defers to the ``RAYTPU_PAGED_ATTN`` env toggle.  Returns one of
+    ``"tpu"`` / ``"interpret"`` / ``"reference"``.
+    """
+    source = "config paged_attn"
+    if selector is None:
+        selector = os.environ.get("RAYTPU_PAGED_ATTN", "auto")
+        source = "RAYTPU_PAGED_ATTN"
+    raw = str(selector).strip().lower()
+    mode = _VALID_PAGED.get(raw)
+    if mode is None:
+        warnings.warn(
+            f"{source}={raw!r} not recognized (use 'auto', 'on', 'off', "
+            f"'kernel', 'interpret', or 'reference'); using 'auto'",
+            RuntimeWarning, stacklevel=2)
+        mode = "auto"
+    if mode == "auto":
+        return "tpu" if _on_tpu() else "reference"
+    if mode == "on":
+        # Toggled on: run the real kernel even without hardware, via
+        # the Pallas interpreter, so CPU tests cover the kernel path.
+        return "tpu" if _on_tpu() else "interpret"
+    return mode
+
+
+# ---------------------------------------------------------------------------
+# Sanctioned dense reference.
+# ---------------------------------------------------------------------------
+
+
+def gather_kv_pages(pages: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """Materialize ``[B, P*page_size, kv_heads, head_dim]`` from the
+    page pool.  This is the ONE sanctioned home of the
+    ``pages[block_tables]`` gather; RTP011 bans the pattern from
+    ``raytpu/models/`` and ``raytpu/inference/``.
+    """
+    b = block_tables.shape[0]
+    _, _, kv, d = pages.shape
+    return pages[block_tables].reshape(b, -1, kv, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, positions,
+                              *, sm_scale):
+    """Dense fp32 attention over the gathered pages — numerics ground
+    truth for the kernel, and the CPU default. Reproduces the op order
+    of the pre-kernel model code (gather, repeat, fp32 einsums,
+    additive-free masking via where, jax.nn.softmax) exactly so
+    fallback greedy generation is unchanged."""
+    b, t, h, d = q.shape
+    kv = k_pages.shape[2]
+    ks = gather_kv_pages(k_pages, block_tables)
+    vs = gather_kv_pages(v_pages, block_tables)
+    if kv != h:
+        rep = h // kv
+        ks = jnp.repeat(ks, rep, axis=2)
+        vs = jnp.repeat(vs, rep, axis=2)
+    s = jnp.einsum("bthd,blhd->bhtl", q.astype(jnp.float32),
+                   ks.astype(jnp.float32)) * sm_scale
+    # Slot l holds token l of the sequence; query token at absolute
+    # position p sees slots 0..p.
+    visible = (jnp.arange(ks.shape[1], dtype=jnp.int32)[None, None, :]
+               <= positions[:, :, None])
+    s = jnp.where(visible[:, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhtl,blhd->bthd", p, vs.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel.
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(bt_ref, qs_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr,
+                  *, sm_scale, page_size, bq_t, rep, n_pg):
+    """One grid step: all query heads of kv-head j, query-token block
+    iq, attending page ik of sequence b. Scratch carries the online
+    softmax across the (sequential) page dimension."""
+    b = pl.program_id(0)
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    rows = bq_t * rep
+    d = q_ref.shape[-1]
+    q_start = qs_ref[b]  # absolute position of query token 0
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full((rows, _LANES), _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros((rows, _LANES), jnp.float32)
+        acc_scr[...] = jnp.zeros((rows, d), jnp.float32)
+
+    # The last page any row of this q block may see; later pages are
+    # clamped in the index maps (no DMA) and skipped here (no flops).
+    live = ik * page_size <= q_start + iq * bq_t + bq_t - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]  # [rows, d]
+        kb = k_ref[0, :, 0, :].astype(q.dtype)  # [page_size, d]
+        vb = v_ref[0, :, 0, :].astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        # Row r holds query token iq*bq_t + r//rep; column c is slot
+        # ik*page_size + c.
+        tok = iq * bq_t + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 0) // rep
+        slot = ik * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 1)
+        s = jnp.where(slot <= q_start + tok, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * corr + jnp.sum(p, axis=-1, keepdims=True),
+            (rows, _LANES))
+        m_scr[...] = jnp.broadcast_to(m_new, (rows, _LANES))
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p.astype(q.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_pg - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _fit_q_block(t: int, want: int) -> int:
+    """Largest divisor of t that is <= want (grid blocks must tile the
+    query axis exactly)."""
+    want = min(want, t)
+    while t % want:
+        want -= 1
+    return want
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_pallas(q, k_pages, v_pages, block_tables, positions,
+                  *, sm_scale, interpret):
+    b, t, h, d = q.shape
+    _, page_size, kv, _ = k_pages.shape
+    if h % kv:
+        raise ValueError(f"heads ({h}) not a multiple of kv_heads ({kv})")
+    rep = h // kv
+    n_pg = block_tables.shape[1]
+    bq_t = _fit_q_block(t, _env_block("RAYTPU_PAGED_BLOCK_Q", 256))
+    rows = bq_t * rep
+    n_qb = t // bq_t
+
+    # Fold query heads onto their kv head: row = t*rep + r matches
+    # jnp.repeat(axis=2) semantics in the reference.
+    qg = q.reshape(b, t, kv, rep, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, kv, t * rep, d)
+    q_start = positions[:, 0].astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+
+    def q_index(b_, j, iq, ik, bt_ref, qs_ref):
+        del ik, bt_ref, qs_ref
+        return (b_, j, iq, 0)
+
+    def kv_index(b_, j, iq, ik, bt_ref, qs_ref):
+        # Clamp dead pages to the last live one: the pipeline sees a
+        # repeated block and skips the DMA.
+        last = (qs_ref[b_] + iq * bq_t + bq_t - 1) // page_size
+        last = jnp.clip(last, 0, n_pg - 1)
+        return (bt_ref[b_, jnp.minimum(ik, last)], 0, j, 0)
+
+    def o_index(b_, j, iq, ik, bt_ref, qs_ref):
+        del ik, bt_ref, qs_ref
+        return (b_, j, iq, 0)
+
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=sm_scale, page_size=page_size,
+        bq_t=bq_t, rep=rep, n_pg=n_pg)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv, n_qb, n_pg),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, d), q_index),
+            pl.BlockSpec((1, page_size, 1, d), kv_index),
+            pl.BlockSpec((1, page_size, 1, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, d), o_index),
+        scratch_shapes=[
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            ))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, t * rep, d), q.dtype),
+        interpret=interpret,
+        **kwargs,
+    )(block_tables, q_start, qg, k_pages, v_pages)
+    out = out.reshape(b, kv, t, rep, d).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, t, h, d)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, positions, *,
+                    sm_scale=None, force=None):
+    """Attention of queries ``q`` against the paged KV cache.
+
+    Args:
+      q: ``[B, T, H, D]`` queries (decode: T=1; chunked prefill: B=1).
+      k_pages / v_pages: ``[num_pages, page_size, kv_heads, head_dim]``
+        page pools (may be bf16).
+      block_tables: ``[B, P]`` int page ids per sequence; dead columns
+        may hold any valid page id (page 0 scratch by convention).
+      positions: ``[B, T]`` absolute position of each query token; a
+        token at position p attends slots 0..p.
+      sm_scale: softmax scale (default ``head_dim ** -0.5``).
+      force: implementation selector (the model config's ``paged_attn``
+        field); ``None`` defers to ``RAYTPU_PAGED_ATTN``.
+
+    Returns ``[B, T, H, D]`` in q's dtype.  Rows whose position is
+    padding produce well-defined garbage (they attend real slots of
+    whatever pages the table names); callers discard them.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    impl = resolve_paged_impl(force)
+    positions = positions.astype(jnp.int32)
+    if impl == "reference":
+        return paged_attention_reference(
+            q, k_pages, v_pages, block_tables, positions,
+            sm_scale=sm_scale)
+    return _paged_pallas(
+        q, k_pages, v_pages, block_tables, positions,
+        sm_scale=sm_scale, interpret=(impl == "interpret"))

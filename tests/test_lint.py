@@ -393,6 +393,38 @@ class TestStepLoopBlocking:  # RTP010
         """), rel="raytpu/serve/_private/router.py") == []
 
 
+class TestCacheGather:  # RTP011
+    def test_planted_gather_in_models(self):
+        findings = run_rule_on_source(_rule("RTP011"), _src("""
+            def decode_step(self, x, k_pages, v_pages, block_tables):
+                ks = k_pages[block_tables].reshape(4, -1, 2, 8)
+                vs = self.v_pages[idx]
+        """), rel="raytpu/models/llama.py")
+        assert len(findings) == 2
+        assert "k_pages[...]" in findings[0].message
+        assert "paged_attention" in findings[0].message
+
+    def test_clean_literal_reads_and_reference_exempt(self):
+        assert run_rule_on_source(_rule("RTP011"), _src("""
+            def decode_step(self, k_pages, block_tables):
+                scratch = k_pages[0]
+                head = k_pages[1:3]
+                n = k_pages.shape[1]
+                tile = k_pages[0, :, 1]
+
+            def _decode_reference(self, k_pages, block_tables):
+                ks = k_pages[block_tables]  # sanctioned numerics oracle
+        """), rel="raytpu/inference/engine.py") == []
+
+    def test_out_of_scope_ops_layer_ignored(self):
+        # The ops layer HOSTS the sanctioned gather; the rule must not
+        # reach it.
+        assert run_rule_on_source(_rule("RTP011"), _src("""
+            def gather_kv_pages(pages, block_tables):
+                return pages[block_tables]
+        """), rel="raytpu/ops/paged_attention.py") == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

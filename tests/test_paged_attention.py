@@ -1,0 +1,337 @@
+"""Paged flash-decode attention (raytpu/ops/paged_attention.py):
+kernel-vs-reference numerics across ragged contexts / GQA ratios /
+page sizes, implementation resolution (env toggle + config override,
+warnings on bad values), engine integration (greedy generation
+token-identical with the kernel on vs off — including prefix-cache
+hits and preemption-resume), the compile-once-per-bucket discipline
+with trimmed block tables, and the pages-gathered accounting behind
+the reference-gather trim."""
+
+import dataclasses
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raytpu.inference import InferenceEngine, SamplingParams
+from raytpu.models.gpt2 import GPT2Config
+from raytpu.models.gpt2 import init_params as gpt2_init
+from raytpu.models.llama import Llama, LlamaConfig
+from raytpu.models.llama import init_params as llama_init
+from raytpu.ops.paged_attention import (
+    gather_kv_pages,
+    paged_attention,
+    paged_attention_reference,
+    resolve_paged_impl,
+)
+
+LCFG = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                           attn_impl="reference", remat=False)
+GCFG = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32,
+                           attn_impl="reference", remat=False)
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    return llama_init(Llama(LCFG), LCFG, seed=0, batch=1)
+
+
+@pytest.fixture(scope="module")
+def gpt2_params():
+    from raytpu.models.gpt2 import GPT2
+
+    return gpt2_init(GPT2(GCFG), GCFG, seed=0, batch=1)
+
+
+def _setup(rng, b, t, heads, kv, d, page_size, pages_per_seq, dtype,
+           ctx=None):
+    """Random pool + block tables + positions for ``b`` sequences whose
+    query tokens end at ragged context lengths."""
+    num_pages = b * pages_per_seq + 1
+    q = jnp.asarray(rng.standard_normal((b, t, heads, d)), dtype)
+    k = jnp.asarray(rng.standard_normal(
+        (num_pages, page_size, kv, d)), dtype)
+    v = jnp.asarray(rng.standard_normal(
+        (num_pages, page_size, kv, d)), dtype)
+    # Distinct live pages per sequence (page 0 stays scratch).
+    bt = np.arange(1, num_pages).reshape(b, pages_per_seq)
+    if ctx is None:
+        ctx = rng.integers(t, pages_per_seq * page_size, size=(b,))
+    pos = np.maximum(ctx[:, None] - (t - 1) + np.arange(t)[None], 0)
+    return (q, k, v, jnp.asarray(bt, jnp.int32),
+            jnp.asarray(pos, jnp.int32))
+
+
+class TestKernelNumerics:
+    @pytest.mark.parametrize("heads,kv", [(4, 4), (8, 2), (4, 1)])
+    @pytest.mark.parametrize("page_size", [4, 8, 16])
+    def test_decode_matches_reference_ragged(self, heads, kv, page_size):
+        rng = np.random.default_rng(heads * 100 + page_size)
+        args = _setup(rng, b=4, t=1, heads=heads, kv=kv, d=16,
+                      page_size=page_size, pages_per_seq=6,
+                      dtype=jnp.float32)
+        ref = paged_attention_reference(*args, sm_scale=16 ** -0.5)
+        out = paged_attention(*args, force="interpret")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_chunk_shape_matches_reference(self):
+        # Chunked prefill: B=1, many query tokens at consecutive
+        # positions, attending cached slots <= their own position.
+        rng = np.random.default_rng(7)
+        args = _setup(rng, b=1, t=24, heads=6, kv=3, d=16, page_size=8,
+                      pages_per_seq=8, dtype=jnp.float32)
+        ref = paged_attention_reference(*args, sm_scale=16 ** -0.5)
+        out = paged_attention(*args, force="interpret")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_bf16_pages_fp32_accumulators(self):
+        # Acceptance bar: interpret-mode kernel within 2e-2 of the fp32
+        # reference when pages and activations are bf16.
+        rng = np.random.default_rng(11)
+        q, k, v, bt, pos = _setup(rng, b=4, t=1, heads=8, kv=2, d=32,
+                                  page_size=16, pages_per_seq=8,
+                                  dtype=jnp.bfloat16)
+        ref = paged_attention_reference(q, k, v, bt, pos,
+                                        sm_scale=32 ** -0.5)
+        out = paged_attention(q, k, v, bt, pos, force="interpret")
+        err = np.max(np.abs(np.asarray(ref, np.float32)
+                            - np.asarray(out, np.float32)))
+        assert err <= 2e-2, f"bf16 kernel error {err} exceeds 2e-2"
+
+    def test_single_token_context(self):
+        # Context of exactly one token (first decode after a 1-token
+        # prompt): the softmax must normalize over that slot alone.
+        rng = np.random.default_rng(3)
+        args = _setup(rng, b=2, t=1, heads=4, kv=2, d=8, page_size=4,
+                      pages_per_seq=3, dtype=jnp.float32,
+                      ctx=np.array([1, 1]))
+        ref = paged_attention_reference(*args, sm_scale=8 ** -0.5)
+        out = paged_attention(*args, force="interpret")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_gather_helper_layout(self):
+        rng = np.random.default_rng(5)
+        k = jnp.asarray(rng.standard_normal((9, 4, 2, 8)), jnp.float32)
+        bt = jnp.asarray([[3, 1], [2, 2]], jnp.int32)
+        out = gather_kv_pages(k, bt)
+        assert out.shape == (2, 8, 2, 8)
+        np.testing.assert_array_equal(np.asarray(out[0, :4]),
+                                      np.asarray(k[3]))
+        np.testing.assert_array_equal(np.asarray(out[1, 4:]),
+                                      np.asarray(k[2]))
+
+
+class TestImplResolution:
+    def test_env_toggle(self, monkeypatch):
+        # CPU: auto -> reference; on -> interpret (real kernel in
+        # tests); off -> reference.
+        monkeypatch.delenv("RAYTPU_PAGED_ATTN", raising=False)
+        assert resolve_paged_impl() == "reference"
+        for raw in ("1", "on", "true"):
+            monkeypatch.setenv("RAYTPU_PAGED_ATTN", raw)
+            assert resolve_paged_impl() == "interpret"
+        for raw in ("0", "off", "reference"):
+            monkeypatch.setenv("RAYTPU_PAGED_ATTN", raw)
+            assert resolve_paged_impl() == "reference"
+
+    def test_config_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("RAYTPU_PAGED_ATTN", "off")
+        assert resolve_paged_impl("interpret") == "interpret"
+        assert resolve_paged_impl("reference") == "reference"
+
+    def test_bad_env_value_warns_and_falls_back(self, monkeypatch):
+        monkeypatch.setenv("RAYTPU_PAGED_ATTN", "bogus")
+        with pytest.warns(RuntimeWarning, match="RAYTPU_PAGED_ATTN"):
+            assert resolve_paged_impl() == "reference"  # auto on CPU
+
+    def test_bad_config_value_warns(self):
+        with pytest.warns(RuntimeWarning, match="paged_attn"):
+            resolve_paged_impl("not-an-impl")
+
+    def test_bad_flash_dot_env_warns(self, monkeypatch):
+        # Satellite: ops/flash_attention's bad-env report goes through
+        # warnings, not a bare print.
+        from raytpu.ops.flash_attention import _env_dot_mode
+
+        monkeypatch.setenv("RAYTPU_FLASH_DOT", "bogus")
+        with pytest.warns(RuntimeWarning, match="RAYTPU_FLASH_DOT"):
+            assert _env_dot_mode() == "input"
+
+    def test_good_values_do_not_warn(self, monkeypatch):
+        from raytpu.ops.flash_attention import _env_dot_mode
+
+        monkeypatch.setenv("RAYTPU_FLASH_DOT", "f32")
+        monkeypatch.setenv("RAYTPU_PAGED_ATTN", "on")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert _env_dot_mode() == "f32"
+            assert resolve_paged_impl() == "interpret"
+
+
+def _kernel_cfg(cfg):
+    return dataclasses.replace(cfg, paged_attn="interpret")
+
+
+def _ref_cfg(cfg):
+    return dataclasses.replace(cfg, paged_attn="reference")
+
+
+class TestEngineTokenIdentity:
+    """Greedy generation must be token-identical with the kernel on vs
+    off, across batch buckets, prefix-cache hits, and preemption."""
+
+    PROMPTS = [list(range(1, 9)), list(range(3, 25)), [7, 8],
+               list(range(40, 50))]
+
+    def _generate(self, cfg, params, prompts, **eng_kw):
+        eng = InferenceEngine(cfg, params, **eng_kw)
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=8))
+        return outs, eng.stats()
+
+    def _staggered(self, cfg, params, prompts, **eng_kw):
+        """Staggered arrivals: the decode batch grows/shrinks, walking
+        multiple batch buckets in one run."""
+        eng = InferenceEngine(cfg, params, **eng_kw)
+        pending = list(enumerate(prompts))
+        results = {i: [] for i in range(len(prompts))}
+        it = 0
+        while pending or eng.has_unfinished():
+            if pending and it % 3 == 0:
+                i, p = pending.pop(0)
+                eng.add_request(f"r{i}", p,
+                                SamplingParams(max_new_tokens=8))
+            for o in eng.step():
+                results[int(o.request_id[1:])].append(o.token_id)
+            it += 1
+        return [results[i] for i in range(len(prompts))], eng.stats()
+
+    def test_llama_kernel_matches_reference_across_buckets(
+            self, llama_params):
+        kw = dict(page_size=8, max_num_seqs=4, max_model_len=64)
+        ref, sref = self._staggered(_ref_cfg(LCFG), llama_params,
+                                    self.PROMPTS, **kw)
+        ker, sker = self._staggered(_kernel_cfg(LCFG), llama_params,
+                                    self.PROMPTS, **kw)
+        assert ref == ker
+        # The batch walked multiple decode buckets in both runs.
+        assert len(sker["decode_compiles"]) >= 2
+        assert sref["paged_attn_impl"] == "reference"
+        assert sker["paged_attn_impl"] == "interpret"
+        # Kernel path never materializes a gather.
+        assert sref["gathered_pages"] > 0
+        assert sker["gathered_pages"] == 0
+
+    def test_gpt2_kernel_matches_reference(self, gpt2_params):
+        kw = dict(page_size=8, max_num_seqs=4, max_model_len=64)
+        ref, _ = self._generate(_ref_cfg(GCFG), gpt2_params,
+                                self.PROMPTS, **kw)
+        ker, sker = self._generate(_kernel_cfg(GCFG), gpt2_params,
+                                   self.PROMPTS, **kw)
+        assert ref == ker
+        assert sker["gathered_pages"] == 0
+
+    def test_prefix_cache_hit_identical(self, llama_params):
+        # Shared 16-token system prefix: the second/third request hit
+        # the prefix cache and prefill only their tails via the paged
+        # chunk path — which must also run the kernel.
+        system = list(range(1, 17))
+        prompts = [system + [30 + i] for i in range(3)]
+        kw = dict(page_size=8, max_num_seqs=4, max_model_len=64,
+                  enable_prefix_cache=True)
+
+        def collect(cfg):
+            eng = InferenceEngine(cfg, llama_params, **kw)
+            results = {}
+            for i, p in enumerate(prompts):  # sequential: hits warm
+                eng.add_request(f"p{i}", p,
+                                SamplingParams(max_new_tokens=6))
+                toks = []
+                while eng.has_unfinished():
+                    for o in eng.step():
+                        toks.append(o.token_id)
+                results[i] = toks
+            return results, eng.stats()
+
+        ref, sref = collect(_ref_cfg(LCFG))
+        ker, sker = collect(_kernel_cfg(LCFG))
+        assert ref == ker
+        assert sref["prefix_cache"]["hit_tokens"] > 0
+        assert sker["prefix_cache"]["hit_tokens"] > 0
+        # The prefix-hit tails ran the chunk path in both impls.
+        assert sref["chunk_prefill_compiles"]
+        assert sker["chunk_prefill_compiles"]
+        assert sker["gathered_pages"] == 0
+
+    def test_preemption_resume_identical(self, llama_params):
+        # 5 usable pages of 4 tokens force preempt-to-recompute; the
+        # resumed prefill + decode must be token-identical too.
+        prompts = [list(range(1, 8)), list(range(20, 25))]
+        kw = dict(page_size=4, num_pages=6, max_num_seqs=2,
+                  max_model_len=24)
+        ref, sref = self._generate(_ref_cfg(LCFG), llama_params,
+                                   prompts, **kw)
+        ker, sker = self._generate(_kernel_cfg(LCFG), llama_params,
+                                   prompts, **kw)
+        assert sref["num_preemptions"] >= 1
+        assert sker["num_preemptions"] >= 1
+        assert ref == ker
+
+
+class TestCompileOnceAndTrim:
+    def test_decode_compiles_once_per_batch_x_pages_bucket(
+            self, llama_params):
+        eng = InferenceEngine(_kernel_cfg(LCFG), llama_params,
+                              page_size=8, max_num_seqs=4,
+                              max_model_len=64)
+        # Staggered arrivals churn batch composition AND context
+        # growth walks the page-width buckets.
+        pending = [(f"r{i}", list(range(1, 4 + 3 * i))) for i in range(4)]
+        it = 0
+        while pending or eng.has_unfinished():
+            if pending and it % 2 == 0:
+                rid, p = pending.pop(0)
+                eng.add_request(rid, p, SamplingParams(max_new_tokens=10))
+            eng.step()
+            it += 1
+        stats = eng.stats()
+        assert stats["decode_compiles"]
+        assert all(v == 1 for v in stats["decode_compiles"].values()), (
+            f"recompile within a (batch x pages) bucket: "
+            f"{stats['decode_compiles']}")
+        # Keys are "BxP" combos; every width is a pow2 page bucket.
+        for key in stats["decode_compiles"]:
+            b, p = key.split("x")
+            assert int(p) & (int(p) - 1) == 0
+
+    def test_reference_gather_is_trimmed(self, llama_params):
+        # Short prompts under a large max_model_len: the trimmed gather
+        # must touch far fewer block-table columns than the padded
+        # P_max width would.
+        eng = InferenceEngine(_ref_cfg(LCFG), llama_params, page_size=4,
+                              max_num_seqs=2, max_model_len=96)
+        assert eng.max_pages_per_seq == 24
+        eng.generate([[1, 2, 3], [5, 6, 7, 8]],
+                     SamplingParams(max_new_tokens=6))
+        stats = eng.stats()
+        decode_steps = len(stats["decode_batch_hist"])
+        untrimmed = decode_steps * 2 * eng.max_pages_per_seq
+        assert 0 < stats["gathered_pages"] < untrimmed / 2, (
+            f"{stats['gathered_pages']} columns gathered; untrimmed "
+            f"would be ~{untrimmed}")
+
+    def test_trim_never_drops_live_pages(self, llama_params):
+        # A sequence that grows past a page-bucket boundary mid-decode
+        # still sees its whole context (output == untrimmed reference
+        # via the engine-level identity tests); here just assert the
+        # bucket walk actually happened.
+        eng = InferenceEngine(_ref_cfg(LCFG), llama_params, page_size=4,
+                              max_num_seqs=1, max_model_len=64)
+        eng.generate([list(range(1, 8))],
+                     SamplingParams(max_new_tokens=12))
+        widths = {int(k.split("x")[1])
+                  for k in eng.stats()["decode_compiles"]}
+        assert len(widths) >= 2  # crossed at least one width bucket
