@@ -209,6 +209,73 @@ def _recorder_overhead(n_tasks: int = 200) -> dict:
             "recorder_tasks_measured": n_tasks}
 
 
+# -- RPC-batch overhead: per-task cost, coalescing off vs on -------------
+
+def _rpc_batch_child() -> None:
+    """Subprocess body: one-node cluster, no-op tasks, per-task µs.
+
+    A subprocess per mode because ``RAYTPU_RPC_BATCH`` is read into
+    module constants at import and the client negotiates batching once
+    at connect — neither can be flipped in a live session."""
+    n = 500
+    import raytpu
+    from raytpu.cluster import Cluster
+
+    cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 2})
+    cluster.wait_for_nodes(1)
+    raytpu.init(address=f"tcp://{cluster.address}")
+    try:
+        @raytpu.remote(num_cpus=0)
+        def _noop():
+            return None
+
+        raytpu.get([_noop.remote() for _ in range(50)])  # warm
+        t0 = time.perf_counter()
+        refs = [_noop.remote() for _ in range(n)]
+        submit_s = time.perf_counter() - t0
+        raytpu.get(refs)
+        total_s = time.perf_counter() - t0
+        print("RPCBATCH " + json.dumps(
+            {"submit_us_per_task": round(submit_s / n * 1e6, 2),
+             "us_per_task": round(total_s / n * 1e6, 2),
+             "tasks": n}))
+    finally:
+        raytpu.shutdown()
+        cluster.shutdown()
+
+
+def _rpc_batch_overhead() -> dict:
+    """Per-task wall cost of the control-plane fast path: the same
+    no-op submit->finish loop with wire batching + pipelined
+    submission off, then on (see benchmarks/bench_rpc.py for the full
+    A/B; these columns are the per-task view of its headline)."""
+    import subprocess
+
+    out: dict = {}
+    for mode in ("off", "on"):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "RAYTPU_RPC_BATCH": "1" if mode == "on" else "0"})
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--rpc-batch-child", mode],
+            env=env, capture_output=True, text=True, timeout=300)
+        row = None
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("RPCBATCH "):
+                row = json.loads(line[len("RPCBATCH "):])
+                break
+        if row is None:
+            raise RuntimeError(
+                f"rpc-batch child ({mode}) produced no result, "
+                f"rc={proc.returncode}: {proc.stderr[-500:]}")
+        out[f"rpc_batch_{mode}_submit_us_per_task"] = (
+            row["submit_us_per_task"])
+        out[f"rpc_batch_{mode}_us_per_task"] = row["us_per_task"]
+    out["rpc_batch_tasks_measured"] = 500
+    return out
+
+
 # -- (b) fabric gang: JaxTrainer with live reporting ---------------------
 
 def _trainer_loop(config):
@@ -256,6 +323,10 @@ def main() -> None:
     except Exception as e:
         recorder = {"recorder_error": f"{type(e).__name__}: {e}"}
     raytpu.shutdown()
+    try:
+        rpc_batch = _rpc_batch_overhead()
+    except Exception as e:
+        rpc_batch = {"rpc_batch_error": f"{type(e).__name__}: {e}"}
     if result.error is not None:
         print(json.dumps({"metric": "train_orchestration_overhead_pct",
                           "value": None,
@@ -274,6 +345,7 @@ def main() -> None:
                    "workers": WORKERS, "best_of": REPEATS,
                    "reference_bar_pct": REFERENCE_OVERHEAD_PCT,
                    **recorder,
+                   **rpc_batch,
                    "note": "gang time = slowest rank (max-allreduce); "
                            "per-epoch train.report live on every rank; "
                            "gang spawn/rendezvous excluded (the "
@@ -283,4 +355,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--rpc-batch-child":
+        _force_cpu()
+        _rpc_batch_child()  # mode comes via RAYTPU_RPC_BATCH in env
+    else:
+        main()

@@ -30,6 +30,9 @@ RTP010 step-loop-blocking      no raytpu.get/wait, time.sleep, or
 RTP011 cache-gather            no materializing *pages[...] gather in
                                models/ or inference/ — paged attention
                                reads KV pages in place
+RTP012 rpc-in-loop             no per-item .call()/.notify() inside a
+                               for loop in cluster hot-path modules —
+                               batch APIs or '# rpc-loop-ok: <reason>'
 ====== ======================= ====================================
 """
 
@@ -39,6 +42,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     contextvar_crossing,
     env_registry,
     jit_in_builders,
+    rpc_loop,
     seam_swallow,
     server_span,
     step_loop_blocking,

@@ -1,0 +1,87 @@
+"""RTP012: no per-item RPC fan-out loops on cluster hot paths.
+
+A ``for`` loop that issues one ``.call(...)`` / ``.notify(...)`` per
+item in ``cluster/`` hot-path modules (client / node / head) pays one
+syscall + one codec pass + one round trip per element — exactly the
+per-task overhead the batched control plane exists to amortize
+(``submit_batch``, the coalescing writer, ``report_task_events``). New
+per-item loops silently erode the fast path: each one looks cheap in
+review and costs linearly at 10k tasks/s.
+
+Loops that are *intentionally* per-item (teardown fan-outs, chaos
+fan-outs, mixed-version fallbacks) carry an inline sanction on the call
+line or the loop header line::
+
+    # rpc-loop-ok: <why per-item is correct here>
+
+``while`` loops are exempt by design — they retry one call, they don't
+fan out per item.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_RPC_ATTRS = {"call", "notify"}
+_SANCTION = "rpc-loop-ok:"
+
+
+def _line_sanctioned(mod, lineno: int) -> bool:
+    try:
+        return _SANCTION in mod.lines[lineno - 1]
+    except IndexError:
+        return False
+
+
+@register
+class RpcInLoop(Rule):
+    id = "RTP012"
+    name = "rpc-in-loop"
+    invariant = ("no per-item .call()/.notify() inside a for loop in "
+                 "cluster hot-path modules — use the batch APIs or "
+                 "sanction the loop with '# rpc-loop-ok: <reason>'")
+    rationale = ("one RPC per item is one syscall + codec pass + round "
+                 "trip per element; at 10k tasks/s every unbatched loop "
+                 "re-opens the control-plane bottleneck the batched "
+                 "fast path closed")
+    scope = ("raytpu/cluster/client.py",
+             "raytpu/cluster/node.py",
+             "raytpu/cluster/head.py")
+
+    def check(self, mod):
+        findings = []
+
+        def visit(node, loop_stack):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                # The iterator evaluates once, not per item — only the
+                # body (and else) run per iteration.
+                visit(node.iter, loop_stack)
+                inner = loop_stack + [node]
+                for child in node.body + node.orelse:
+                    visit(child, inner)
+                return
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                # A nested def/lambda runs later, not per iteration of
+                # the enclosing loop (it is usually a callback).
+                loop_stack = []
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RPC_ATTRS
+                    and loop_stack
+                    and not _line_sanctioned(mod, node.lineno)
+                    and not any(_line_sanctioned(mod, lp.lineno)
+                                for lp in loop_stack)):
+                findings.append(self.finding(
+                    mod, node,
+                    f"per-item .{node.func.attr}() inside a for loop "
+                    "on a cluster hot path — batch it (submit_batch / "
+                    "coalesced notify) or sanction the line with "
+                    "'# rpc-loop-ok: <reason>'"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, loop_stack)
+
+        visit(mod.tree, [])
+        return findings

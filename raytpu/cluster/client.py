@@ -172,6 +172,20 @@ class ClusterBackend:
             target=self._free_loop, name="cluster-free", daemon=True
         )
         self._free_thread.start()
+        # Pipelined submission fast path (RAYTPU_RPC_BATCH): plain-task
+        # specs enqueue into a bounded in-flight window (enqueue blocks
+        # past SUBMIT_WINDOW) and a submitter thread coalesces them into
+        # head submit_batch frames — only against a head that advertised
+        # the capability at connect time.
+        self._submit_queue: Optional["_q.Queue"] = None
+        self._submit_thread: Optional[threading.Thread] = None
+        if (tuning.RPC_BATCH
+                and getattr(self._head, "caps", {}).get("submit_batch")):
+            self._submit_queue = _q.Queue(maxsize=tuning.SUBMIT_WINDOW)
+            self._submit_thread = threading.Thread(
+                target=self._submit_loop, name="cluster-submit", daemon=True
+            )
+            self._submit_thread.start()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -244,7 +258,20 @@ class ClusterBackend:
                                  task_events.TaskTransition.SUBMITTED,
                                  name=spec.name, attempt=spec.attempt,
                                  parent_task_id=_ambient_task_id())
-            self._route_task(spec)
+            if (self._submit_queue is not None
+                    and spec.scheduling.kind == SchedulingKind.DEFAULT
+                    and spec.actor_id is None
+                    and not spec.is_actor_creation()):
+                # Fast path: refs return now; the submitter thread batches
+                # the window into submit_batch frames. Per-spec failures
+                # surface through ref resolution (_fail_refs), exactly
+                # like the pending-loop's asynchronous errors.
+                self._submit_queue.put(spec)
+            else:
+                # PG / affinity / actor specs keep the per-spec path: its
+                # synchronous errors (PlacementGroupError) are part of
+                # the API contract.
+                self._route_task(spec)
         return refs
 
     def _record_lineage(self, spec: TaskSpec) -> None:
@@ -355,7 +382,7 @@ class ClusterBackend:
         from raytpu.runtime_env import read_blob
 
         peer = self._peer(addr)
-        for uri in uris:
+        for uri in uris:  # rpc-loop-ok: runtime-env zips: few URIs, bulk payloads
             try:
                 if not peer.call("has_runtime_env", uri):
                     peer.call("cache_runtime_env", uri, read_blob(uri))
@@ -390,13 +417,114 @@ class ClusterBackend:
                                  name=spec.name, attempt=spec.attempt,
                                  error="node submit failed; requeued")
 
+    def _submit_loop(self) -> None:
+        """Submitter thread: drains the bounded window, coalescing up to
+        SUBMIT_BATCH_MAX specs per head round trip (FIFO preserved)."""
+        import queue as _q
+
+        q = self._submit_queue
+        while True:
+            try:
+                spec = q.get(timeout=tuning.PENDING_POLL_PERIOD_S)
+            except _q.Empty:
+                if self._shutdown_flag:
+                    return
+                continue
+            if spec is None:
+                return
+            batch = [spec]
+            while len(batch) < tuning.SUBMIT_BATCH_MAX:
+                try:
+                    nxt = q.get_nowait()
+                except _q.Empty:
+                    break
+                if nxt is None:
+                    self._flush_submit(batch)
+                    return
+                batch.append(nxt)
+            self._flush_submit(batch)
+
+    def _flush_submit(self, specs: List[TaskSpec]) -> None:
+        """One pipelined round: place the whole batch with one head RPC,
+        group placements by node, ship one submit_batch frame per node."""
+        try:
+            placements = self._head.call("submit_batch",
+                                         wire.dumps(list(specs)))
+        except Exception:
+            # Head unreachable this round: everything requeues as pending
+            # (the pending loop retries; node-death semantics unchanged).
+            with self._lock:
+                self._pending.extend(specs)
+            if task_events.enabled():
+                for spec in specs:
+                    task_events.emit(
+                        "task", spec.task_id.hex(),
+                        task_events.TaskTransition.PENDING_SCHED,
+                        name=spec.name, attempt=spec.attempt,
+                        error="submit_batch failed; requeued")
+            return
+        by_node: Dict[Tuple[str, str], List[TaskSpec]] = {}
+        for spec, p in zip(specs, placements):
+            if isinstance(p, dict) and p.get("err"):
+                self._fail_refs(spec, RuntimeError(p["err"]))
+                continue
+            if (not isinstance(p, dict) or not p.get("node_id")
+                    or not p.get("address")):
+                with self._lock:
+                    self._pending.append(spec)
+                if task_events.enabled():
+                    task_events.emit(
+                        "task", spec.task_id.hex(),
+                        task_events.TaskTransition.PENDING_SCHED,
+                        name=spec.name, attempt=spec.attempt)
+                continue
+            by_node.setdefault((p["node_id"], p["address"]),
+                               []).append(spec)
+        for (node_id, addr), group in by_node.items():
+            self._send_batch_to_node(group, node_id, addr)
+
+    def _send_batch_to_node(self, specs: List[TaskSpec], node_id: str,
+                            addr: str) -> None:
+        for spec in specs:
+            try:
+                self._ship_runtime_env(spec, addr)
+            except Exception:
+                pass
+            if self._relay is not None:
+                self._push_local_args(spec, addr)
+        with self._lock:
+            for spec in specs:
+                self._inflight[spec.task_id] = _InFlight(
+                    spec, node_id, attempts=spec.attempt)
+        try:
+            peer = self._peer(addr)
+            if getattr(peer, "caps", {}).get("submit_batch"):
+                peer.call("submit_batch", wire.dumps(list(specs)))
+            else:
+                # rpc-loop-ok: mixed-version fallback — this peer never
+                # advertised submit_batch, so each spec ships alone.
+                for spec in specs:  # rpc-loop-ok: mixed-version fallback: peer lacks submit_batch
+                    peer.call("submit_task", wire.dumps(spec))
+        except Exception:
+            with self._lock:
+                for spec in specs:
+                    self._inflight.pop(spec.task_id, None)
+                    self._pending.append(spec)
+            if task_events.enabled():
+                for spec in specs:
+                    task_events.emit(
+                        "task", spec.task_id.hex(),
+                        task_events.TaskTransition.PENDING_SCHED,
+                        name=spec.name, attempt=spec.attempt,
+                        error="node submit failed; requeued")
+
     def _push_local_args(self, spec: TaskSpec, addr: str) -> None:
         """Proxy-mode drivers host no serve endpoint, so nodes cannot pull
         argument objects from them — ship driver-local args to the
         executing node with the submission (reference contrast: ray://
         keeps the driver's objects server-side instead)."""
         peer = self._peer(addr)
-        for oid in self._arg_ref_ids(spec):
+        for oid in self._arg_ref_ids(spec):  # rpc-loop-ok: proxy-mode arg push: bulk blobs, few refs
             sv = self.store.try_get(oid)
             if sv is None:
                 continue  # produced cluster-side; node pulls normally
@@ -445,7 +573,7 @@ class ClusterBackend:
         release their submitted-arg pins + inflight records."""
         with self._lock:
             candidates = list(self._inflight.values())
-        for rec in candidates:
+        for rec in candidates:  # rpc-loop-ok: background sweep, head-gated, not submit path
             oids = rec.spec.return_ids()
             try:
                 done = all(self.store.contains(oid) or
@@ -626,7 +754,7 @@ class ClusterBackend:
             elem = ObjectID.for_task_return(task_id, count + 1)
             locs = self._head.call("locate_object", elem.hex(),
                                    timeout=tuning.CONTROL_CALL_TIMEOUT_S)
-            for loc in locs or ():
+            for loc in locs or ():  # rpc-loop-ok: stream ack to each holder of the element
                 try:
                     self._peer(loc["address"]).notify(
                         method, task_id.hex(), count)
@@ -758,7 +886,7 @@ class ClusterBackend:
             self._head.subscribe(t, _on_push)
         try:
             ready = False
-            for r in refs:
+            for r in refs:  # rpc-loop-ok: one readiness scan at wait() entry
                 try:
                     if self._head.call("locate_object", r.id.hex(), True):
                         ready = True
@@ -951,7 +1079,7 @@ class ClusterBackend:
         for idx, node_id in enumerate(placement):
             by_node.setdefault(node_id, []).append((idx, bundles[idx]))
         try:
-            for node_id, indexed in by_node.items():
+            for node_id, indexed in by_node.items():  # rpc-loop-ok: one shard RPC per PG node by design
                 addr = self._node_addr(node_id)
                 if addr is None:
                     raise PlacementGroupError(
@@ -973,7 +1101,7 @@ class ClusterBackend:
         info = pg or self._head.call("pg_info", pg_id.hex())
         if info is None:
             return
-        for node_id in set(info["nodes"]):
+        for node_id in set(info["nodes"]):  # rpc-loop-ok: PG teardown fan-out, cold path
             if node_id is None:
                 continue
             addr = self._node_addr(node_id)
@@ -1074,6 +1202,16 @@ class ClusterBackend:
                 self.kill_actor(aid, no_restart=True)
             except Exception:
                 pass
+        if self._submit_queue is not None:
+            # Sentinel rides behind any queued specs, so the submitter
+            # flushes the window before exiting.
+            try:
+                self._submit_queue.put_nowait(None)
+            except Exception:
+                pass
+            if self._submit_thread is not None:
+                self._submit_thread.join(
+                    timeout=tuning.SERVER_STOP_TIMEOUT_S)
         self._free_queue.put(None)
         try:
             if self._node is not None:

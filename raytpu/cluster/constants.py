@@ -40,6 +40,26 @@ SERVER_START_TIMEOUT_S = _f("SERVER_START_TIMEOUT_S", 10.0)
 # RpcServer.stop() waits this long for the loop thread to exit.
 SERVER_STOP_TIMEOUT_S = _f("SERVER_STOP_TIMEOUT_S", 5.0)
 
+# -- RPC batching (control-plane fast path) ----------------------------------
+
+# Master switch for the batched fast path: wire-frame coalescing in
+# RpcClient/Peer plus the driver's pipelined submit_batch window. Off by
+# default — batch-off stays byte-compatible with the pre-batch wire.
+RPC_BATCH = _i("RPC_BATCH", 0) != 0
+# A coalescing flush stops growing at this many sub-frames ...
+RPC_BATCH_MAX_FRAMES = _i("RPC_BATCH_MAX_FRAMES", 128)
+# ... or this many coalesced payload bytes, whichever comes first.
+RPC_BATCH_MAX_BYTES = _i("RPC_BATCH_MAX_BYTES", 1 << 20)
+# Extra time a non-empty flush may wait for stragglers. 0 = pure
+# group-commit: flush immediately when the link is idle, coalesce only
+# what queued while the previous write was in flight.
+RPC_BATCH_MAX_WAIT_S = _f("RPC_BATCH_MAX_WAIT_S", 0.0)
+# Driver-side pipelined submission: bounded in-flight window (specs
+# queued but not yet shipped; enqueue blocks beyond this) and the max
+# specs the submitter coalesces into one head submit_batch RPC.
+SUBMIT_WINDOW = _i("SUBMIT_WINDOW", 1024)
+SUBMIT_BATCH_MAX = _i("SUBMIT_BATCH_MAX", 256)
+
 # -- control-plane calls -----------------------------------------------------
 
 # Small metadata RPCs (heartbeat, register, locate, free, failpoint

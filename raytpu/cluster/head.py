@@ -33,6 +33,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from raytpu.cluster import constants as tuning
+from raytpu.cluster import wire
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import failpoints
 from raytpu.util import task_events
@@ -295,6 +296,10 @@ class HeadServer:
         h("kv_del", self._kv_del)
         h("kv_keys", self._kv_keys)
         h("schedule", self._schedule)
+        h("submit_batch", self._submit_batch)
+        # Advertised through rpc_caps so a driver only pipelines against
+        # a head that actually speaks the batched submit path.
+        self._rpc.capabilities["submit_batch"] = True
         h("register_actor", self._register_actor)
         h("resolve_actor", self._resolve_actor)
         h("resolve_named_actor", self._resolve_named_actor)
@@ -544,7 +549,7 @@ class HeadServer:
             with self._lock:
                 targets = [(n.node_id, n.address)
                            for n in self._nodes.values() if n.alive]
-            for node_id, address in targets:
+            for node_id, address in targets:  # rpc-loop-ok: chaos/debug fan-out to every node, cold path
                 try:
                     self._node_client(node_id, address).call(
                         "failpoint_cfg", name, spec,
@@ -564,7 +569,7 @@ class HeadServer:
             with self._lock:
                 targets = [(n.node_id, n.address)
                            for n in self._nodes.values() if n.alive]
-            for node_id, address in targets:
+            for node_id, address in targets:  # rpc-loop-ok: chaos/debug fan-out to every node, cold path
                 try:
                     self._node_client(node_id, address).call(
                         "failpoint_clear",
@@ -587,7 +592,7 @@ class HeadServer:
             with self._lock:
                 targets = [(n.node_id, n.address)
                            for n in self._nodes.values() if n.alive]
-            for node_id, address in targets:
+            for node_id, address in targets:  # rpc-loop-ok: chaos/debug fan-out to every node, cold path
                 try:
                     got = self._node_client(node_id, address).call(
                         "trace_dump",
@@ -807,7 +812,7 @@ class HeadServer:
                 entry = self._nodes.get(node_id)
                 if entry is not None and entry.alive:
                     holders.append((node_id, entry.address))
-        for node_id, address in holders:
+        for node_id, address in holders:  # rpc-loop-ok: owner free fans to each holder, head-gated
             try:
                 self._node_client(node_id, address).notify(
                     "free_object", oid_hex)
@@ -906,49 +911,98 @@ class HeadServer:
                        req_id: Optional[str] = None) -> Optional[str]:
         self._metrics.tick_schedule()
         with self._lock:
-            feasible = []
-            for entry in self._nodes.values():
-                if not entry.alive or entry.labels.get("role") == "driver":
-                    continue
-                if all(entry.available.get(k, 0.0) >= v - 1e-9
-                       for k, v in resources.items()):
-                    feasible.append(entry)
-            if not feasible:
-                import os as _os
+            return self._schedule_locked(resources, node_hint,
+                                         spread_threshold, req_id)
 
-                key = req_id or _os.urandom(8).hex()
-                self._unmet[key] = (time.monotonic(), dict(resources))
-                if len(self._unmet) > 10_000:
-                    cutoff = time.monotonic() - 10.0
-                    self._unmet = {k: v for k, v in self._unmet.items()
-                                   if v[0] >= cutoff}
-                return None
-            if req_id is not None:
-                self._unmet.pop(req_id, None)
-            if node_hint:
-                for entry in feasible:
-                    if entry.node_id == node_hint:
-                        return entry.node_id
+    def _schedule_locked(self, resources: Dict[str, float],
+                         node_hint: Optional[str] = None,
+                         spread_threshold: float = 0.5,
+                         req_id: Optional[str] = None) -> Optional[str]:
+        """One placement decision. Caller holds ``self._lock`` — the
+        batched submit path places a whole burst under one acquisition."""
+        feasible = []
+        for entry in self._nodes.values():
+            if not entry.alive or entry.labels.get("role") == "driver":
+                continue
+            if all(entry.available.get(k, 0.0) >= v - 1e-9
+                   for k, v in resources.items()):
+                feasible.append(entry)
+        if not feasible:
+            import os as _os
 
-            def utilization(e: NodeEntry) -> float:
-                fracs = [
-                    1.0 - e.available.get(k, 0.0) / t
-                    for k, t in e.total.items() if t > 0
-                ]
-                return max(fracs) if fracs else 0.0
+            key = req_id or _os.urandom(8).hex()
+            self._unmet[key] = (time.monotonic(), dict(resources))
+            if len(self._unmet) > 10_000:
+                cutoff = time.monotonic() - 10.0
+                self._unmet = {k: v for k, v in self._unmet.items()
+                               if v[0] >= cutoff}
+            return None
+        if req_id is not None:
+            self._unmet.pop(req_id, None)
+        if node_hint:
+            for entry in feasible:
+                if entry.node_id == node_hint:
+                    return entry.node_id
 
-            packed = sorted(feasible, key=lambda e: (-utilization(e),
-                                                     e.node_id))
-            best = packed[0]
-            if utilization(best) >= spread_threshold:
-                best = min(packed, key=lambda e: (utilization(e),
-                                                  e.node_id))
-            # Optimistic debit: bursts of schedule() calls between 1s
-            # heartbeats must see each other's placements or they all pack
-            # onto the same node (heartbeats overwrite with ground truth).
-            for k, v in resources.items():
-                best.available[k] = best.available.get(k, 0.0) - v
-            return best.node_id
+        def utilization(e: NodeEntry) -> float:
+            fracs = [
+                1.0 - e.available.get(k, 0.0) / t
+                for k, t in e.total.items() if t > 0
+            ]
+            return max(fracs) if fracs else 0.0
+
+        packed = sorted(feasible, key=lambda e: (-utilization(e),
+                                                 e.node_id))
+        best = packed[0]
+        if utilization(best) >= spread_threshold:
+            best = min(packed, key=lambda e: (utilization(e),
+                                              e.node_id))
+        # Optimistic debit: bursts of schedule() calls between 1s
+        # heartbeats must see each other's placements or they all pack
+        # onto the same node (heartbeats overwrite with ground truth).
+        for k, v in resources.items():
+            best.available[k] = best.available.get(k, 0.0) - v
+        return best.node_id
+
+    def _submit_batch(self, peer: Peer, blob: bytes) -> List[Any]:
+        """Pipelined submission fast path: N TaskSpecs decoded from one
+        frame, placed FIFO in one ``sched.decide`` pass under a single
+        ``_lock`` acquisition. Per spec the reply is ``{"node_id",
+        "address"}`` (placed — address included so the driver skips the
+        per-task ``list_nodes`` lookup), ``{"err": ...}`` (that spec
+        failed; the others are unaffected), or ``None`` (infeasible now,
+        driver requeues as pending)."""
+        specs = wire.loads(blob)
+        placements: List[Any] = []
+        with tracing.span("sched.decide") as attrs:
+            with self._lock:
+                for spec in specs:
+                    self._metrics.tick_schedule()
+                    try:
+                        node_id = self._schedule_locked(
+                            dict(spec.resources or {}), None, 0.5,
+                            spec.task_id.hex())
+                    except Exception as e:  # noqa: BLE001 — per-spec fault
+                        placements.append({"err": str(e)})
+                        continue
+                    if node_id is None:
+                        placements.append(None)
+                        continue
+                    entry = self._nodes.get(node_id)
+                    placements.append(
+                        {"node_id": node_id,
+                         "address": entry.address if entry else None})
+            attrs["batch"] = len(placements)
+            attrs["node"] = sum(1 for p in placements
+                                if isinstance(p, dict) and "node_id" in p)
+            if task_events.enabled():
+                for spec, p in zip(specs, placements):
+                    if isinstance(p, dict) and p.get("node_id"):
+                        task_events.emit(
+                            "task", spec.task_id.hex(),
+                            task_events.TaskTransition.SCHEDULED,
+                            node_id=p["node_id"])
+        return placements
 
     # -- actor directory ---------------------------------------------------
 

@@ -565,6 +565,8 @@ class NodeServer:
         self._rpc = RpcServer(host, 0)
         h = self._rpc.register
         h("submit_task", self._h_submit_task)
+        h("submit_batch", self._h_submit_batch)
+        self._rpc.capabilities["submit_batch"] = True
         h("submit_fn_task", self._h_submit_fn_task)
         h("create_py_actor", self._h_create_py_actor)
         h("call_py_actor", self._h_call_py_actor)
@@ -938,7 +940,7 @@ class NodeServer:
         # actors created since the last snapshot).
         with self.backend._lock:
             runtimes = list(self.backend._actors.values())
-        for rt in runtimes:
+        for rt in runtimes:  # rpc-loop-ok: re-registration replay after head restart
             if rt.dead:
                 continue
             ac = rt.creation_spec.actor_creation
@@ -951,7 +953,7 @@ class NodeServer:
             except Exception as e:
                 errors.swallow("node.reregister_actor", e)
         # Re-announce object locations.
-        for oid in self.backend.store.keys():
+        for oid in self.backend.store.keys():  # rpc-loop-ok: re-announce replay after head restart
             try:
                 head.notify("report_object", oid.hex(), self.node_id.hex())
             except Exception:
@@ -1205,6 +1207,15 @@ class NodeServer:
         self.backend._stash_task_trace(spec.task_id)
         self._ensure_args_local(spec)
         self.backend.submit_task(spec)
+
+    def _h_submit_batch(self, peer: Peer, batch_blob: bytes) -> None:
+        """Pipelined fast path: N TaskSpecs in one frame (one decode
+        pass), each then riding the normal submit path in arrival order."""
+        specs: List[TaskSpec] = wire.loads(batch_blob)
+        for spec in specs:
+            self.backend._stash_task_trace(spec.task_id)
+            self._ensure_args_local(spec)
+            self.backend.submit_task(spec)
 
     def _h_submit_fn_task(self, peer: Peer, fn_ref: str, args: list,
                           num_returns: int = 1,
@@ -1628,7 +1639,7 @@ class NodeServer:
 
                 def _locate() -> bool:
                     found = False
-                    for oh in oid_hexes:
+                    for oh in oid_hexes:  # rpc-loop-ok: one locate scan at wait() entry
                         try:
                             if head.call(
                                     "locate_object", oh, True,
@@ -1707,7 +1718,7 @@ class NodeServer:
             elem = ObjectID.for_task_return(tid, max(count, 1))
             locs = self._head.call("locate_object", elem.hex(),
                                    timeout=tuning.CONTROL_CALL_TIMEOUT_S)
-            for loc in locs or ():
+            for loc in locs or ():  # rpc-loop-ok: stream ack to the element's holder
                 if loc["address"] != self.address:
                     self._peer_client(loc["address"]).notify(
                         method, task_id_hex, count)
@@ -1794,7 +1805,7 @@ class NodeServer:
                 names = _os.listdir(self.log_dir)
             except OSError:
                 continue
-            for name in names:
+            for name in names:  # rpc-loop-ok: already batched 200 lines/notify
                 path = _os.path.join(self.log_dir, name)
                 try:
                     size = _os.path.getsize(path)
@@ -1885,7 +1896,7 @@ class NodeServer:
         with pool._lock:
             handles = {wid: h for wid, h in pool._workers.items()
                        if worker_id is None or wid.startswith(worker_id)}
-        for wid, h in handles.items():
+        for wid, h in handles.items():  # rpc-loop-ok: debug stack/trace fan-out, cold path
             client = getattr(h, "client", None)
             if client is None or client.closed:
                 out[wid] = {"pid": getattr(h, "pid", None),
@@ -1911,7 +1922,7 @@ class NodeServer:
             return dumps
         with pool._lock:
             handles = dict(pool._workers)
-        for wid, h in handles.items():
+        for wid, h in handles.items():  # rpc-loop-ok: debug stack/trace fan-out, cold path
             client = getattr(h, "client", None)
             if client is None or client.closed:
                 continue

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from raytpu.cluster import wire
 from raytpu.cluster import constants as tuning
+from raytpu.util import errors
 from raytpu.util.errors import DeadlineExceeded, RpcTimeoutError
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util import tracing
@@ -61,6 +62,39 @@ def _pack(obj: Any, allow_pickle: bool = True) -> bytes:
     return _LEN.pack(len(payload)) + payload
 
 
+def _pack_body(body: bytes) -> bytes:
+    """Length-prefix one already-encoded frame body as a plain (non-batch)
+    wire frame — byte-identical to ``_pack(frame)`` of the same frame."""
+    return _LEN.pack(len(body) + 1) + bytes([wire.WIRE_VERSION]) + body
+
+
+def _pack_batch(bodies: List[bytes]) -> bytes:
+    payload = wire.dumps_batch(bodies)
+    return _LEN.pack(len(payload)) + payload
+
+
+def _observe_batch_flush(frames: int, nbytes: int, waited_s: float) -> None:
+    """Best-effort coalescing telemetry: sub-frames per flush, coalesced
+    payload bytes, and how long the flush waited for stragglers."""
+    try:
+        from raytpu.util.resilience import _metric
+
+        m = _metric("histogram", "raytpu_rpc_batch_frames_per_flush",
+                    "sub-frames coalesced into one wire write", ())
+        if m is not None:
+            m.observe(float(frames))
+        m = _metric("histogram", "raytpu_rpc_batch_coalesced_bytes",
+                    "payload bytes per coalesced wire write", ())
+        if m is not None:
+            m.observe(float(nbytes))
+        m = _metric("histogram", "raytpu_rpc_batch_flush_wait_seconds",
+                    "time a coalescing flush spent collecting frames", ())
+        if m is not None:
+            m.observe(waited_s)
+    except Exception:
+        pass
+
+
 async def _read_frame(reader: asyncio.StreamReader,
                       allow_pickle: bool = True) -> Any:
     hdr = await reader.readexactly(_LEN.size)
@@ -79,6 +113,12 @@ class Peer:
         self._writer = writer
         self.closed = False
         self.meta: Dict[str, Any] = {}  # handler scratch (e.g. node_id)
+        # Coalescing outbox (loop-thread confined): encoded frame bodies
+        # queued for a batch-capable peer; flushed in one super-frame by
+        # a call_soon callback, so every reply/push produced in the same
+        # loop iteration rides one write.
+        self._outbox: List[bytes] = []
+        self._flush_scheduled = False
 
     def push(self, topic: str, data: Any) -> None:
         """Send an unsolicited frame (pubsub). Thread-safe."""
@@ -90,17 +130,50 @@ class Peer:
         if self.closed:
             return
         try:
-            payload = _pack(frame, self._server._allow_pickle)
-        except wire.PickleRejected:
-            return  # push not expressible on a strict wire: drop it,
-            # the connection itself is healthy
-        except Exception:
+            body = wire.dumps_body(frame, self._server._allow_pickle)
+        except wire.PickleRejected as e:
+            # push not expressible on a strict wire: drop it, the
+            # connection itself is healthy — but count the drop.
+            errors.swallow("protocol.peer_push", e)
+            return
+        except Exception as e:
+            errors.swallow("protocol.peer_push", e)
             self.closed = True
             return
+        self._send_body(body)
+
+    def _send_body(self, body: bytes) -> None:
+        """Write one encoded frame body (loop thread only). A peer that
+        negotiated batching gets it via the coalescing outbox; everyone
+        else gets today's byte-exact single frame immediately."""
+        if self.closed:
+            return
+        if self.meta.get("rpc_batch"):
+            self._outbox.append(body)
+            if not self._flush_scheduled:
+                self._flush_scheduled = True
+                self._server._loop.call_soon(self._flush)
+            return
+        try:
+            self._writer.write(_pack_body(body))
+        except Exception as e:
+            errors.swallow("protocol.peer_push", e)
+            self.closed = True
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        bodies, self._outbox = self._outbox, []
+        if not bodies or self.closed:
+            return
+        payload = (_pack_body(bodies[0]) if len(bodies) == 1
+                   else _pack_batch(bodies))
         try:
             self._writer.write(payload)
-        except Exception:
+        except Exception as e:
+            errors.swallow("protocol.peer_push", e)
             self.closed = True
+            return
+        _observe_batch_flush(len(bodies), len(payload), 0.0)
 
 
 class RpcServer:
@@ -116,6 +189,10 @@ class RpcServer:
         self._port = port
         self._allow_pickle = allow_pickle
         self._handlers: Dict[str, Callable] = {}
+        # Owner-extensible capability advertisement (e.g. the head adds
+        # "submit_batch": True); merged into every rpc_caps reply.
+        self.capabilities: Dict[str, Any] = {}
+        self._handlers["rpc_caps"] = self._h_rpc_caps
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server = None
@@ -125,6 +202,18 @@ class RpcServer:
 
     def register(self, name: str, handler: Callable) -> None:
         self._handlers[name] = handler
+
+    def _h_rpc_caps(self, peer: Peer, caps: Any = None) -> Dict[str, Any]:
+        """Capability negotiation, one round trip at connect time: the
+        client reports what it speaks, the server records it on the peer
+        and answers with its own. A peer that never calls this (an older
+        build, or batching disabled) keeps the unbatched byte-exact wire
+        — it is never sent a ``"b"`` frame."""
+        if isinstance(caps, dict) and caps.get("batch"):
+            peer.meta["rpc_batch"] = True
+        out: Dict[str, Any] = {"batch": True}
+        out.update(self.capabilities)
+        return out
 
     def on_disconnect(self, cb: Callable[[Peer], None]) -> None:
         self._on_disconnect = cb
@@ -163,6 +252,25 @@ class RpcServer:
         try:
             while True:
                 frame = await _read_frame(reader, self._allow_pickle)
+                if isinstance(frame, dict) and "b" in frame:
+                    # Batch super-frame: dispatch sub-frames in arrival
+                    # order, each in its own task (per-sub-frame deadline/
+                    # trace contextvars and failpoints, same as today's
+                    # one-task-per-frame). A sub-frame that fails decode
+                    # is dropped alone — its caller times out; the rest
+                    # of the batch is unaffected. Non-bytes entries are
+                    # tolerated (newer-peer batch extensions).
+                    for body in frame["b"]:
+                        if not isinstance(body, (bytes, bytearray)):
+                            continue
+                        try:
+                            sub = wire.loads_body(body, self._allow_pickle)
+                        except Exception as e:
+                            errors.swallow("rpc.batch_subframe", e)
+                            continue
+                        asyncio.ensure_future(
+                            self._dispatch(peer, writer, sub))
+                    continue
                 asyncio.ensure_future(self._dispatch(peer, writer, frame))
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
                 wire.WireError):
@@ -229,6 +337,23 @@ class RpcServer:
             if ttoken is not None:
                 tracing.reset_current_trace(ttoken)
         if req_id is not None and not peer.closed:
+            if peer.meta.get("rpc_batch"):
+                # Batch-capable peer: replies ride the coalescing outbox,
+                # so a burst of concurrent dispatches on one connection
+                # answers in one super-frame.
+                try:
+                    body = wire.dumps_body(reply, self._allow_pickle)
+                except wire.PickleRejected:
+                    body = wire.dumps_body(
+                        {"i": req_id,
+                         "e": RpcError("result not encodable on this "
+                                       "strict surface")},
+                        self._allow_pickle)
+                except Exception:
+                    peer.closed = True
+                    return
+                peer._send_body(body)
+                return
             try:
                 try:
                     payload = _pack(reply, self._allow_pickle)
@@ -276,7 +401,8 @@ class RpcClient:
 
     def __init__(self, address: str,
                  timeout: Optional[float] = None,
-                 allow_pickle: bool = True):
+                 allow_pickle: bool = True,
+                 batch: Optional[bool] = None):
         if timeout is None:
             timeout = tuning.RPC_CONNECT_TIMEOUT_S
         self._allow_pickle = allow_pickle
@@ -292,6 +418,16 @@ class RpcClient:
         self._subs_lock = threading.Lock()
         self._closed = False
         self.address = address
+        # Coalescing writer state. ``batch=None`` defers to the global
+        # knob; negotiation below only flips ``_batch`` on once the peer
+        # has advertised the capability, so an older peer keeps the
+        # byte-exact unbatched wire.
+        self._batch_enabled = (tuning.RPC_BATCH if batch is None
+                               else bool(batch))
+        self._batch = False
+        self.caps: Dict[str, Any] = {}
+        self._send_queue = None
+        self._batch_writer: Optional[threading.Thread] = None
         # Pushes dispatch on their own thread: a subscription callback may
         # itself issue RPCs, which would deadlock on the reader thread
         # (the reader is what completes those calls).
@@ -306,6 +442,80 @@ class RpcClient:
             target=self._read_loop, name="raytpu-rpc-client", daemon=True
         )
         self._reader.start()
+        if self._batch_enabled:
+            self._negotiate_batch()
+
+    def _negotiate_batch(self) -> None:
+        """One capability round trip; on agreement, start the coalescing
+        writer thread and route subsequent sends through it."""
+        try:
+            caps = self.call("rpc_caps", {"batch": True},
+                             timeout=tuning.RPC_CONNECT_TIMEOUT_S)
+        except Exception as e:
+            # Peer predates rpc_caps (or the probe raced a shutdown):
+            # stay on the unbatched wire, count the miss.
+            errors.swallow("rpc.caps_probe", e)
+            return
+        if isinstance(caps, dict):
+            self.caps = caps
+        if not self.caps.get("batch"):
+            return
+        import queue as _queue
+
+        self._send_queue = _queue.SimpleQueue()
+        self._batch_writer = threading.Thread(
+            target=self._write_loop, name="raytpu-rpc-writer", daemon=True
+        )
+        self._batch_writer.start()
+        self._batch = True
+
+    def _write_loop(self) -> None:
+        """Adaptive coalescing: when the link is idle the first body
+        flushes immediately; bodies that queued while a write was in
+        flight ride the next flush as one super-frame (group commit),
+        bounded by the frames/bytes caps and an optional straggler wait."""
+        q = self._send_queue
+        while True:
+            body = q.get()
+            if body is None:
+                return
+            t0 = time.perf_counter()
+            bodies = [body]
+            nbytes = len(body)
+            deadline = t0 + tuning.RPC_BATCH_MAX_WAIT_S
+            while (len(bodies) < tuning.RPC_BATCH_MAX_FRAMES
+                   and nbytes < tuning.RPC_BATCH_MAX_BYTES):
+                try:
+                    nxt = q.get_nowait()
+                except Exception:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = q.get(timeout=remaining)
+                    except Exception:
+                        break
+                if nxt is None:
+                    self._flush_bodies(bodies, nbytes,
+                                       time.perf_counter() - t0)
+                    return
+                bodies.append(nxt)
+                nbytes += len(nxt)
+            self._flush_bodies(bodies, nbytes, time.perf_counter() - t0)
+
+    def _flush_bodies(self, bodies: List[bytes], nbytes: int,
+                      waited_s: float) -> None:
+        payload = (_pack_body(bodies[0]) if len(bodies) == 1
+                   else _pack_batch(bodies))
+        with self._wlock:
+            if self._closed:
+                return
+            try:
+                self._sock.sendall(payload)
+            except OSError as e:
+                self._fail(e)
+                return
+        _observe_batch_flush(len(bodies), len(payload), waited_s)
 
     def subscribe(self, topic: str, cb: Callable[[Any], None]) -> None:
         with self._subs_lock:
@@ -440,6 +650,15 @@ class RpcClient:
         # out); raise => surfaces to the caller like a send failure.
         if failpoint("wire.send.pre") is DROP:
             return
+        if self._batch:
+            # Encode on the caller's thread (an unencodable frame raises
+            # to its caller, same as the direct path); hand the body to
+            # the coalescing writer.
+            body = wire.dumps_body(frame, self._allow_pickle)
+            if self._closed:
+                raise ConnectionLost(f"connection to {self.address} closed")
+            self._send_queue.put(body)
+            return
         data = _pack(frame, self._allow_pickle)
         with self._wlock:
             if self._closed:
@@ -451,24 +670,35 @@ class RpcClient:
                 raise ConnectionLost(str(e)) from e
 
     def _read_loop(self) -> None:
+        # bytearray + cursor, not ``bytes + chunk``: appending a chunk to
+        # a bytes object copies the whole buffer every time (O(n²) across
+        # a large frame's reassembly). Consumed prefix is compacted away
+        # only when more data must be read — amortized O(total bytes).
         try:
-            buf = b""
+            buf = bytearray()
+            pos = 0
             while True:
-                while len(buf) < _LEN.size:
+                while len(buf) - pos < _LEN.size:
+                    if pos:
+                        del buf[:pos]
+                        pos = 0
                     chunk = self._sock.recv(65536)
                     if not chunk:
                         raise ConnectionError("peer closed")
                     buf += chunk
-                (n,) = _LEN.unpack(buf[:_LEN.size])
-                buf = buf[_LEN.size:]
-                while len(buf) < n:
+                (n,) = _LEN.unpack_from(buf, pos)
+                pos += _LEN.size
+                while len(buf) - pos < n:
+                    if pos:
+                        del buf[:pos]
+                        pos = 0
                     chunk = self._sock.recv(max(65536, n - len(buf)))
                     if not chunk:
                         raise ConnectionError("peer closed")
                     buf += chunk
-                frame = wire.loads(buf[:n],
+                frame = wire.loads(bytes(memoryview(buf)[pos:pos + n]),
                                    allow_pickle=self._allow_pickle)
-                buf = buf[n:]
+                pos += n
                 self._on_frame(frame)
         except Exception as e:
             self._fail(e)
@@ -488,6 +718,21 @@ class RpcClient:
                     pass
 
     def _on_frame(self, frame: dict) -> None:
+        if isinstance(frame, dict) and "b" in frame:
+            # Batch super-frame: each sub-frame runs the normal inbound
+            # path (including its own wire.recv.pre failpoint check —
+            # the outer frame deliberately does NOT fire it, so a chaos
+            # drop hits one sub-frame's caller, not the whole batch).
+            for body in frame["b"]:
+                if not isinstance(body, (bytes, bytearray)):
+                    continue
+                try:
+                    sub = wire.loads_body(body, self._allow_pickle)
+                except Exception as e:
+                    errors.swallow("rpc.batch_subframe", e)
+                    continue
+                self._on_frame(sub)
+            return
         if failpoint("wire.recv.pre") is DROP:
             return  # inbound frame lost: reply/push never delivered
         if "p" in frame:  # pubsub push
@@ -506,6 +751,8 @@ class RpcClient:
             self._closed = True
             pending = list(self._pending.values())
             self._pending.clear()
+        if self._send_queue is not None:
+            self._send_queue.put(None)  # stop the coalescing writer
         for w in pending:
             w.set_error(ConnectionLost(str(exc)))
 
@@ -516,6 +763,8 @@ class RpcClient:
     def close(self) -> None:
         self._closed = True
         self._push_queue.put(None)
+        if self._send_queue is not None:
+            self._send_queue.put(None)
         try:
             self._sock.close()
         except Exception:

@@ -66,6 +66,9 @@ FRAME_FIELDS = {
     "r": "reply payload",
     "e": "reply error (structural exception encoding)",
     "p": "push topic (str)",
+    "b": "batch: list of codec-packed sub-frame bodies (bytes, no "
+         "version byte — the super-frame's single version byte covers "
+         "all of them)",
 }
 
 _EXT_STRUCT = 1
@@ -316,21 +319,53 @@ _TRUSTED = _Codec(allow_pickle=True)
 _STRICT = _Codec(allow_pickle=False)
 
 
-def dumps(obj: Any, allow_pickle: bool = True) -> bytes:
-    """Encode one wire frame: version byte + msgpack body."""
+def dumps_body(obj: Any, allow_pickle: bool = True) -> bytes:
+    """Codec-pack one frame body WITHOUT the version byte.
+
+    This is the per-sub-frame half of batch encoding: each sub-frame is
+    packed here (so ``wire.encode.pre`` fires once per logical frame and
+    an encode failure stays with that frame's caller), and
+    :func:`dumps_batch` wraps N bodies under one version byte.
+    """
     failpoint("wire.encode.pre")
     codec = _TRUSTED if allow_pickle else _STRICT
     try:
-        body = codec._pack(obj)
+        return codec._pack(obj)
     except (OverflowError, ValueError, TypeError) as e:
         # msgpack packs native types itself, so e.g. ints >= 2**64 raise
         # before _default can intercept. On trusted wires the whole frame
         # degrades to one pickle extension rather than failing the RPC.
         if not allow_pickle or isinstance(e, PickleRejected):
             raise
-        body = msgpack.packb(
+        return msgpack.packb(
             msgpack.ExtType(_EXT_PICKLE, cloudpickle.dumps(obj)))
-    return bytes([WIRE_VERSION]) + body
+
+
+def dumps(obj: Any, allow_pickle: bool = True) -> bytes:
+    """Encode one wire frame: version byte + msgpack body."""
+    return bytes([WIRE_VERSION]) + dumps_body(obj, allow_pickle)
+
+
+def dumps_batch(bodies: List[bytes]) -> bytes:
+    """Encode a batch super-frame: one version byte + ``{"b": [...]}``.
+
+    The bodies are already codec-packed by :func:`dumps_body`, so the
+    outer envelope is a single plain-msgpack pass over raw bytes — the
+    shared codec pass that amortizes per-frame overhead. A batch-aware
+    peer decodes the outer dict with :func:`loads` (the bodies come back
+    as ``bytes``) and each body with :func:`loads_body`.
+    """
+    return bytes([WIRE_VERSION]) + msgpack.packb(
+        {"b": list(bodies)}, use_bin_type=True)
+
+
+def loads_body(body: bytes, allow_pickle: bool = True) -> Any:
+    """Decode one batch sub-frame body (no version byte — the enclosing
+    super-frame carried it). Fires ``wire.decode.pre`` per sub-frame so
+    chaos decode faults stay scoped to one logical frame."""
+    failpoint("wire.decode.pre")
+    codec = _TRUSTED if allow_pickle else _STRICT
+    return codec._unpack(bytes(body))
 
 
 def loads(frame: bytes, allow_pickle: bool = True) -> Any:

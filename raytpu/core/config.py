@@ -137,6 +137,17 @@ declare_env("RAYTPU_HEALTH_CHECK_PERIOD_S", "head health-check sweep period (s)"
 declare_env("RAYTPU_HOST_IP", "advertised address override for this host")
 declare_env("RAYTPU_NUM_TPUS", "TPU chip count override for topology detection")
 
+# Control-plane fast path (cluster/constants.py, cluster/protocol.py,
+# cluster/client.py): wire-frame coalescing + pipelined task submission.
+declare_env("RAYTPU_RPC_BATCH",
+            "enable batched wire frames + pipelined submission (bool)")
+declare_env("RAYTPU_RPC_BATCH_MAX_FRAMES", "coalesced sub-frames per flush cap")
+declare_env("RAYTPU_RPC_BATCH_MAX_BYTES", "coalesced payload bytes per flush cap")
+declare_env("RAYTPU_RPC_BATCH_MAX_WAIT_S",
+            "extra straggler wait per non-empty flush (s; 0 = group-commit)")
+declare_env("RAYTPU_SUBMIT_WINDOW", "pipelined submission in-flight window")
+declare_env("RAYTPU_SUBMIT_BATCH_MAX", "max TaskSpecs per submit_batch RPC")
+
 # Kernels (ops/flash_attention.py, ops/paged_attention.py).
 declare_env("RAYTPU_FLASH_DOT", "force the dot-product flash-attention path (bool)")
 declare_env("RAYTPU_FLASH_BLOCK_Q", "flash-attention query tile rows")

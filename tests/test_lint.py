@@ -425,6 +425,58 @@ class TestCacheGather:  # RTP011
         """), rel="raytpu/ops/paged_attention.py") == []
 
 
+class TestRpcInLoop:  # RTP012
+    def test_planted_per_item_call_and_notify(self):
+        findings = run_rule_on_source(_rule("RTP012"), _src("""
+            def ship(self, specs):
+                for spec in specs:
+                    self._peer(addr).call("submit_task", blob(spec))
+                for loc in locs:
+                    self._peer(loc).notify("task_done", spec.task_id)
+        """), rel="raytpu/cluster/client.py")
+        assert len(findings) == 2
+        assert ".call()" in findings[0].message
+        assert "submit_batch" in findings[0].message
+        assert ".notify()" in findings[1].message
+
+    def test_sanction_on_call_line_and_loop_header(self):
+        assert run_rule_on_source(_rule("RTP012"), _src("""
+            def teardown(self, nodes):
+                for n in nodes:  # rpc-loop-ok: teardown fan-out
+                    self._client(n).call("drain_node")
+                for n in nodes:
+                    self._client(n).call("stop")  # rpc-loop-ok: cold path
+        """), rel="raytpu/cluster/head.py") == []
+
+    def test_iterator_call_and_while_retry_not_flagged(self):
+        # One list_nodes RPC feeding the loop is not per-item fan-out,
+        # and while loops retry ONE call — both are out of scope.
+        assert run_rule_on_source(_rule("RTP012"), _src("""
+            def scan(self):
+                for n in self._head.call("list_nodes"):
+                    use(n)
+                while not done:
+                    done = self._head.call("ping")
+        """), rel="raytpu/cluster/node.py") == []
+
+    def test_nested_callback_def_not_flagged(self):
+        # A def inside the loop runs later (callback), not per item.
+        assert run_rule_on_source(_rule("RTP012"), _src("""
+            def subscribe_all(self, topics):
+                for t in topics:
+                    def _cb(data):
+                        self._head.call("ack", t)
+                    self._subs[t] = _cb
+        """), rel="raytpu/cluster/client.py") == []
+
+    def test_out_of_scope_module_ignored(self):
+        assert run_rule_on_source(_rule("RTP012"), _src("""
+            def fan(self, peers):
+                for p in peers:
+                    p.call("ping")
+        """), rel="raytpu/cluster/relay.py") == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

@@ -1,0 +1,415 @@
+"""Control-plane fast path: batched wire frames + pipelined submission.
+
+Covers the PR's contracts:
+
+- batch super-frames round-trip (``"b"`` in ``wire.FRAME_FIELDS``): one
+  version byte, N codec-packed sub-frame bodies, nesting with ``"tc"``
+  trace contexts and ``"d"`` deadlines, strict ``allow_pickle=False``
+  batches, unknown-trailing-subframe tolerance;
+- ``RpcClient._read_loop`` reassembly: many small frames and one large
+  frame arriving in arbitrary chunk splits (the O(n²) ``bytes += chunk``
+  fix);
+- capability negotiation: a batch client against a batch server talks
+  super-frames; either side alone stays on the byte-exact unbatched
+  wire; batch-on and batch-off clients interoperate on one server;
+- chaos: ``wire.encode.pre`` / ``wire.recv.pre`` failpoints inside a
+  batch fail/drop only the targeted sub-frames' callers;
+- resilience per sub-frame: deadlines and trace contexts ride each
+  sub-frame independently; breakers feed from batched transports;
+- the pipelined ``submit_batch`` path: a batch-on driver against a real
+  cluster (tasks run, results resolve, FIFO within the window).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+import raytpu
+from raytpu.cluster import Cluster, wire
+from raytpu.cluster import constants as tuning
+from raytpu.cluster.protocol import _LEN, RpcClient, RpcServer
+from raytpu.util import failpoints
+from raytpu.util.errors import DeadlineExceeded, RpcTimeoutError
+from raytpu.util.resilience import CircuitBreaker, Deadline
+from raytpu.util.errors import CircuitOpenError
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+# -- batch frame round-trips -------------------------------------------------
+
+
+class TestBatchWire:
+    def test_b_registered_in_frame_fields(self):
+        assert "b" in wire.FRAME_FIELDS
+
+    def test_roundtrip_with_tc_and_d_subframes(self):
+        subs = [
+            {"m": "heartbeat", "a": ("n1",), "i": 1},
+            {"m": "schedule", "a": ({"CPU": 1.0},), "i": 2,
+             "d": 1.5, "tc": ["7f" * 8, "ab" * 4]},
+            {"i": 3, "r": [1, 2, 3]},
+        ]
+        bodies = [wire.dumps_body(s) for s in subs]
+        payload = wire.dumps_batch(bodies)
+        # One version byte covers the whole super-frame.
+        assert payload[0] == wire.WIRE_VERSION
+        outer = wire.loads(payload)
+        assert set(outer) == {"b"}
+        got = [wire.loads_body(b) for b in outer["b"]]
+        assert got == subs
+
+    def test_strict_mode_batch(self):
+        subs = [{"m": "ping", "a": (), "i": 7},
+                {"i": 8, "r": "pong"}]
+        bodies = [wire.dumps_body(s, allow_pickle=False) for s in subs]
+        payload = wire.dumps_batch(bodies)
+        outer = wire.loads(payload, allow_pickle=False)
+        assert [wire.loads_body(b, allow_pickle=False)
+                for b in outer["b"]] == subs
+
+    def test_strict_mode_rejects_pickle_subframe(self):
+        class Weird:
+            pass
+
+        with pytest.raises(wire.PickleRejected):
+            wire.dumps_body({"i": 1, "r": Weird()}, allow_pickle=False)
+
+    def test_single_frame_bytes_unchanged(self):
+        # Batch-off compatibility: dumps() is still version byte + body.
+        frame = {"m": "ping", "a": (), "i": 1}
+        assert wire.dumps(frame) == (bytes([wire.WIRE_VERSION])
+                                     + wire.dumps_body(frame))
+
+    def test_unknown_trailing_subframe_tolerated_by_client(self):
+        # A newer peer may append non-bytes batch extensions; the
+        # dispatcher skips them and still delivers the real sub-frames.
+        srv = RpcServer()
+        addr = srv.start()
+        cli = RpcClient(addr, batch=False)
+        try:
+            waiter_results = []
+            cli.subscribe("t", waiter_results.append)
+            bodies = [wire.dumps_body({"p": "t", "d": "hello"})]
+            cli._on_frame({"b": bodies + [{"future": "extension"}, 42]})
+            deadline = time.monotonic() + 5
+            while not waiter_results and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert waiter_results == ["hello"]
+        finally:
+            cli.close()
+            srv.stop()
+
+
+# -- receive-buffer reassembly ----------------------------------------------
+
+
+class TestReassembly:
+    def test_many_small_then_one_large_frame(self):
+        srv = RpcServer()
+        srv.register("echo", lambda peer, x: x)
+        addr = srv.start()
+        cli = RpcClient(addr)
+        try:
+            for i in range(200):
+                assert cli.call("echo", i) == i
+            big = b"\x5a" * (8 * 1024 * 1024)
+            assert cli.call("echo", big) == big
+            # Interleave again: the buffer compaction must not have
+            # corrupted the cursor.
+            assert cli.call("echo", "after") == "after"
+        finally:
+            cli.close()
+            srv.stop()
+
+
+# -- capability negotiation & interop ----------------------------------------
+
+
+def _mk_server():
+    srv = RpcServer()
+    srv.register("echo", lambda peer, x: x)
+    srv.register("add", lambda peer, a, b: a + b)
+    return srv, srv.start()
+
+
+class TestNegotiation:
+    def test_batch_client_negotiates_and_coalesces(self):
+        srv, addr = _mk_server()
+        cli = RpcClient(addr, batch=True)
+        try:
+            assert cli.caps.get("batch") is True
+            assert cli._batch is True
+            # Concurrent calls ride the coalescing writer and all answer.
+            results = [None] * 32
+            def worker(i):
+                results[i] = cli.call("add", i, 1)
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(32)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert results == [i + 1 for i in range(32)]
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_batch_off_client_stays_unbatched(self):
+        srv, addr = _mk_server()
+        cli = RpcClient(addr, batch=False)
+        try:
+            assert cli._batch is False
+            assert cli.call("echo", "x") == "x"
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_mixed_clients_one_server(self):
+        srv, addr = _mk_server()
+        on = RpcClient(addr, batch=True)
+        off = RpcClient(addr, batch=False)
+        try:
+            for i in range(20):
+                assert on.call("add", i, 10) == i + 10
+                assert off.call("add", i, 20) == i + 20
+        finally:
+            on.close()
+            off.close()
+            srv.stop()
+
+    def test_client_against_capless_server_degrades(self):
+        # A server whose rpc_caps handler is gone (older build) never
+        # negotiates; the client silently stays on the unbatched wire.
+        srv, addr = _mk_server()
+        del srv._handlers["rpc_caps"]
+        cli = RpcClient(addr, batch=True)
+        try:
+            assert cli._batch is False
+            assert cli.call("echo", 5) == 5
+        finally:
+            cli.close()
+            srv.stop()
+
+
+# -- hand-built super-frames against a live server ---------------------------
+
+
+def _raw_conn(addr):
+    host, port = addr.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=5)
+    s.settimeout(5)
+    return s
+
+
+def _read_reply(sock):
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        hdr += sock.recv(_LEN.size - len(hdr))
+    (n,) = struct.unpack("<I", hdr)
+    body = b""
+    while len(body) < n:
+        body += sock.recv(n - len(body))
+    return wire.loads(body)
+
+
+class TestServerBatchDispatch:
+    def test_subframes_dispatch_in_order_with_replies(self):
+        srv, addr = _mk_server()
+        sock = _raw_conn(addr)
+        try:
+            bodies = [wire.dumps_body({"m": "add", "a": (i, 100), "i": i})
+                      for i in range(5)]
+            payload = wire.dumps_batch(bodies)
+            sock.sendall(_LEN.pack(len(payload)) + payload)
+            got = {}
+            for _ in range(5):
+                reply = _read_reply(sock)
+                got[reply["i"]] = reply["r"]
+            assert got == {i: i + 100 for i in range(5)}
+        finally:
+            sock.close()
+            srv.stop()
+
+    def test_per_subframe_deadline(self):
+        # An expired "d" on one sub-frame fails THAT call server-side;
+        # its batchmate is unaffected.
+        srv, addr = _mk_server()
+        sock = _raw_conn(addr)
+        try:
+            bodies = [
+                wire.dumps_body({"m": "add", "a": (1, 1), "i": 1,
+                                 "d": -0.5}),
+                wire.dumps_body({"m": "add", "a": (2, 2), "i": 2,
+                                 "d": 30.0}),
+            ]
+            payload = wire.dumps_batch(bodies)
+            sock.sendall(_LEN.pack(len(payload)) + payload)
+            replies = {}
+            for _ in range(2):
+                r = _read_reply(sock)
+                replies[r["i"]] = r
+            assert isinstance(replies[1]["e"], DeadlineExceeded)
+            assert replies[2]["r"] == 4
+        finally:
+            sock.close()
+            srv.stop()
+
+    def test_per_subframe_trace_context(self):
+        from raytpu.util import tracing
+
+        srv = RpcServer()
+        srv.register("has_trace",
+                     lambda peer: tracing.current_trace() is not None)
+        addr = srv.start()
+        sock = _raw_conn(addr)
+        try:
+            bodies = [
+                wire.dumps_body({"m": "has_trace", "a": (), "i": 1,
+                                 "tc": ["00" * 8, "11" * 4, 1]}),
+                wire.dumps_body({"m": "has_trace", "a": (), "i": 2}),
+            ]
+            payload = wire.dumps_batch(bodies)
+            sock.sendall(_LEN.pack(len(payload)) + payload)
+            replies = {}
+            for _ in range(2):
+                r = _read_reply(sock)
+                replies[r["i"]] = r.get("r")
+            # Traced sub-frame anchors a context; its batchmate does not
+            # inherit it (contextvars are per dispatch task).
+            assert replies == {1: True, 2: False}
+        finally:
+            sock.close()
+            srv.stop()
+
+    def test_corrupt_subframe_drops_alone(self):
+        srv, addr = _mk_server()
+        sock = _raw_conn(addr)
+        try:
+            bodies = [b"\xc1\xc1not-msgpack",
+                      wire.dumps_body({"m": "add", "a": (3, 4), "i": 9})]
+            payload = wire.dumps_batch(bodies)
+            sock.sendall(_LEN.pack(len(payload)) + payload)
+            reply = _read_reply(sock)
+            assert reply["i"] == 9 and reply["r"] == 7
+        finally:
+            sock.close()
+            srv.stop()
+
+
+# -- chaos: failpoints inside a batch ----------------------------------------
+
+
+class TestBatchChaos:
+    def test_encode_pre_hits_only_targeted_caller(self):
+        srv, addr = _mk_server()
+        cli = RpcClient(addr, batch=True)
+        try:
+            failpoints.cfg("wire.encode.pre", "1*raise(ValueError,boom)")
+            with pytest.raises(ValueError, match="boom"):
+                cli.call("echo", "doomed")
+            # Exhausted after one fire: the next caller is untouched.
+            assert cli.call("echo", "fine") == "fine"
+            st = failpoints.stat("wire.encode.pre")
+            assert st["fires"] == 1 and st["exhausted"]
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_recv_pre_drops_only_targeted_subframe(self):
+        srv, addr = _mk_server()
+        cli = RpcClient(addr, batch=False)
+        try:
+            # Feed one super-frame holding two replies for two real
+            # waiters; the armed drop eats exactly the FIRST sub-frame.
+            from raytpu.cluster.protocol import _Waiter
+
+            w1, w2 = _Waiter("a", addr), _Waiter("b", addr)
+            cli._pending[101] = w1
+            cli._pending[102] = w2
+            failpoints.cfg("wire.recv.pre", "1*drop")
+            cli._on_frame({"b": [
+                wire.dumps_body({"i": 101, "r": "first"}),
+                wire.dumps_body({"i": 102, "r": "second"}),
+            ]})
+            with pytest.raises(RpcTimeoutError):
+                w1.wait(0.05)  # dropped: its caller times out
+            assert w2.wait(5) == "second"
+            st = failpoints.stat("wire.recv.pre")
+            assert st["fires"] == 1
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_breaker_feeds_from_batched_transport(self):
+        srv, addr = _mk_server()
+        cli = RpcClient(addr, batch=True)
+        try:
+            br = CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0)
+            srv.stop()
+            with pytest.raises(Exception):
+                cli.call("echo", 1, timeout=0.5, breaker=br)
+            with pytest.raises(CircuitOpenError):
+                cli.call("echo", 2, breaker=br)
+        finally:
+            cli.close()
+
+
+# -- pipelined submission against a real cluster -----------------------------
+
+
+@pytest.fixture(scope="module")
+def batch_cluster():
+    c = Cluster(num_nodes=1, node_resources={"num_cpus": 2})
+    c.wait_for_nodes(1)
+    yield c
+    c.shutdown()
+
+
+class TestPipelinedSubmission:
+    def test_batch_on_driver_mixed_with_batch_off_daemons(
+            self, batch_cluster, monkeypatch):
+        # The daemons were spawned batch-off; only this driver flips the
+        # knob — mixed-version peers must interoperate.
+        monkeypatch.setattr(tuning, "RPC_BATCH", True)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{batch_cluster.address}")
+        try:
+            from raytpu.runtime import api as _api
+
+            assert _api._backend._submit_queue is not None  # pipeline armed
+
+            @raytpu.remote(num_cpus=0)
+            def f(x):
+                return x * 2
+
+            refs = [f.remote(i) for i in range(100)]
+            assert raytpu.get(refs) == [i * 2 for i in range(100)]
+        finally:
+            raytpu.shutdown()
+
+    def test_batch_off_driver_unaffected(self, batch_cluster):
+        assert tuning.RPC_BATCH is False  # monkeypatch restored
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{batch_cluster.address}")
+        try:
+            from raytpu.runtime import api as _api
+
+            assert _api._backend._submit_queue is None
+
+            @raytpu.remote(num_cpus=0)
+            def g(x):
+                return x + 5
+
+            refs = [g.remote(i) for i in range(20)]
+            assert raytpu.get(refs) == [i + 5 for i in range(20)]
+        finally:
+            raytpu.shutdown()
