@@ -33,6 +33,9 @@ RTP011 cache-gather            no materializing *pages[...] gather in
 RTP012 rpc-in-loop             no per-item .call()/.notify() inside a
                                for loop in cluster hot-path modules —
                                batch APIs or '# rpc-loop-ok: <reason>'
+RTP013 scheduler-purity        no RPC/socket/file I/O while the head's
+                               placement lock is held — side effects
+                               defer to after the lock release
 ====== ======================= ====================================
 """
 
@@ -43,6 +46,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     env_registry,
     jit_in_builders,
     rpc_loop,
+    sched_purity,
     seam_swallow,
     server_span,
     step_loop_blocking,

@@ -1,0 +1,88 @@
+"""RTP013: scheduler purity — no I/O while the placement lock is held.
+
+Every placement decision in the cluster serializes through the head's
+``self._lock``: ``_schedule_locked`` runs under it, and the pipelined
+``_submit_batch`` path places a whole burst under one acquisition. One
+``.call()``/``.notify()``/socket/file touch inside that critical section
+stalls the entire control plane for a round trip — a slow peer turns the
+scheduler into the cluster's convoy. Side effects a decision wants (the
+locality scorer's eager arg pushes) must be queued on the ``deferred``
+list and fired by the caller AFTER the lock is released.
+
+Checked regions: the whole body of ``_schedule_locked`` (its contract is
+"caller holds the lock"), and every ``with self._lock:`` block inside
+``_submit_batch`` / ``_schedule_impl``. Flagged calls: ``.call``,
+``.notify``, ``.push``, ``.send``/``.sendall``/``.recv``/``.connect``/
+``.accept``, and builtin ``open``. There is no inline sanction — a
+violation is a design error; restructure it onto ``deferred``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_SCHED_FUNCS = {"_schedule_locked", "_submit_batch", "_schedule_impl"}
+_IO_ATTRS = {"call", "notify", "push", "send", "sendall", "recv",
+             "connect", "accept"}
+_IO_NAMES = {"open"}
+
+
+def _is_self_lock(expr) -> bool:
+    return (isinstance(expr, ast.Attribute) and expr.attr == "_lock"
+            and isinstance(expr.value, ast.Name) and expr.value.id == "self")
+
+
+@register
+class SchedulerPurity(Rule):
+    id = "RTP013"
+    name = "scheduler-purity"
+    invariant = ("no .call()/.notify()/.push()/socket/file I/O inside "
+                 "_schedule_locked or the lock-held region of "
+                 "_submit_batch/_schedule_impl — defer side effects "
+                 "past the lock release")
+    rationale = ("every placement in the cluster serializes through the "
+                 "head's scheduler lock; one RPC or disk touch inside it "
+                 "stalls the whole control plane for a round trip, and a "
+                 "slow peer turns the scheduler into the cluster's convoy")
+    scope = ("raytpu/cluster/head.py",)
+
+    def check(self, mod):
+        findings = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name not in _SCHED_FUNCS:
+                continue
+            if fn.name == "_schedule_locked":
+                regions = list(fn.body)
+            else:
+                regions = []
+                for node in ast.walk(fn):
+                    if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                            _is_self_lock(item.context_expr)
+                            for item in node.items):
+                        regions.extend(node.body)
+            for stmt in regions:
+                for node in ast.walk(stmt):
+                    label = self._io_call(node)
+                    if label:
+                        findings.append(self.finding(
+                            mod, node,
+                            f"{label} inside the scheduler's lock-held "
+                            f"region ({fn.name}) — queue the side effect "
+                            "on `deferred` and fire it after the lock "
+                            "is released"))
+        return findings
+
+    @staticmethod
+    def _io_call(node):
+        if not isinstance(node, ast.Call):
+            return None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _IO_ATTRS:
+            return f".{node.func.attr}()"
+        if isinstance(node.func, ast.Name) and node.func.id in _IO_NAMES:
+            return f"{node.func.id}()"
+        return None

@@ -54,7 +54,7 @@ from raytpu.core.ids import (
 )
 from raytpu.runtime.object_ref import ObjectRef
 from raytpu.runtime.serialization import SerializedValue, serialize
-from raytpu.runtime.task_spec import ArgKind, SchedulingKind, TaskSpec
+from raytpu.runtime.task_spec import SchedulingKind, TaskSpec
 
 import logging
 
@@ -221,10 +221,7 @@ class ClusterBackend:
     # -- task submission ---------------------------------------------------
 
     def _arg_ref_ids(self, spec: TaskSpec) -> List[ObjectID]:
-        ids = [ObjectRef.from_binary(a.data).id for a in spec.args
-               if a.kind == ArgKind.REF]
-        ids.extend(ObjectRef.from_binary(rb).id for rb in spec.inline_refs)
-        return ids
+        return spec.arg_ref_oids()
 
     def _pin_args(self, spec: TaskSpec) -> None:
         """Hold submitted-task refs on the driver so argument objects can't
@@ -362,9 +359,11 @@ class ClusterBackend:
             idx = sched.bundle_index if sched.bundle_index >= 0 else 0
             node_id = pg["nodes"][idx]
             return node_id
+        # Arg oids let the head score feasible nodes by the bytes they
+        # already hold (appended param — older heads ignore it).
         return self._head.call(
             "schedule", self._required_resources(spec), None, 0.5,
-            spec.task_id.hex())
+            spec.task_id.hex(), [o.hex() for o in spec.arg_ref_oids()])
 
     def _ship_runtime_env(self, spec: TaskSpec, addr: str) -> None:
         """Push packaged zip:// URIs to the executing node's cache before

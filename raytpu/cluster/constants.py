@@ -60,6 +60,28 @@ RPC_BATCH_MAX_WAIT_S = _f("RPC_BATCH_MAX_WAIT_S", 0.0)
 SUBMIT_WINDOW = _i("SUBMIT_WINDOW", 1024)
 SUBMIT_BATCH_MAX = _i("SUBMIT_BATCH_MAX", 256)
 
+# -- locality-aware scheduling -----------------------------------------------
+
+# Master switch: among feasible nodes, prefer the one already holding
+# the most argument bytes before applying the pack/spread policy.
+# Advisory only — with LOCALITY=0 placement decisions are byte-identical
+# to the plain pack/spread scheduler.
+LOCALITY = _i("LOCALITY", 1) != 0
+# Local-bytes totals below this never steer a placement: shipping a few
+# KiB is cheaper than packing against the utilization policy.
+LOCALITY_MIN_BYTES = _i("LOCALITY_MIN_BYTES", 64 * 1024)
+# Bound on the head's oid -> size map feeding the locality scorer;
+# beyond it the oldest sizes are evicted (the scorer merely loses
+# signal for them — locations and correctness are unaffected).
+LOCALITY_DIR_MAX = _i("LOCALITY_DIR_MAX", 100_000)
+# When locality loses (resources force a remote placement), the head
+# asks a holder to eagerly push args >= LOCALITY_MIN_BYTES to the
+# chosen node so the transfer overlaps queueing. 0 disables.
+LOCALITY_EAGER_PUSH = _i("LOCALITY_EAGER_PUSH", 1) != 0
+# Node-side bound on buffered object-location deltas ("+"/"-" per oid)
+# awaiting a coalesced report_objects flush or heartbeat piggyback.
+OBJ_REPORT_BUFFER_MAX = _i("OBJ_REPORT_BUFFER_MAX", 8192)
+
 # -- control-plane calls -----------------------------------------------------
 
 # Small metadata RPCs (heartbeat, register, locate, free, failpoint

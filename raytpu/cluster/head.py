@@ -231,6 +231,11 @@ class HeadServer:
         self._named: Dict[Tuple[str, str], str] = {}
         # object_id(hex) -> set of node_ids that hold it
         self._objects: Dict[str, Set[str]] = {}
+        # object_id(hex) -> wire bytes, feeding the locality scorer.
+        # Bounded FIFO (LOCALITY_DIR_MAX): beyond the cap the oldest
+        # sizes are evicted and the scorer just loses their signal;
+        # entries also drop with the locations on free / node death.
+        self._object_sizes: Dict[str, int] = {}
         # Borrower protocol (reference: reference_count.h borrowers +
         # WaitForRefRemoved, SURVEY A1): oid -> {"node:worker", ...}. The
         # head is the authority so an owner's free cannot race a borrow
@@ -306,6 +311,7 @@ class HeadServer:
         h("actor_dead", self._actor_dead)
         h("object_unavailable", self._object_unavailable)
         h("report_object", self._report_object)
+        h("report_objects", self._h_report_objects)
         h("forget_object", self._forget_object)
         h("locate_object", self._locate_object)
         h("borrow_added", self._borrow_added)
@@ -500,7 +506,8 @@ class HeadServer:
     def _heartbeat(self, peer: Peer, node_id: str,
                    available: Dict[str, float], seq: int = 0,
                    events: Optional[List[dict]] = None,
-                   dropped: int = 0) -> None:
+                   dropped: int = 0,
+                   obj_deltas: Optional[List[list]] = None) -> None:
         # drop => the head never saw this heartbeat; enough consecutive
         # drops and the health loop declares the node dead. The node
         # requeues the piggybacked event batch on call failure, so a
@@ -519,6 +526,10 @@ class HeadServer:
                     entry.avail_seq = max(entry.avail_seq, seq)
         if events or dropped:
             self._task_event_store.add_batch(events or [], dropped)
+        if obj_deltas:
+            # Location deltas a node failed to flush directly ride the
+            # liveness beat, exactly like the flight-recorder batches.
+            self._apply_object_deltas(peer, node_id, obj_deltas)
 
     def _resource_update(self, peer: Peer, node_id: str,
                          available: Dict[str, float],
@@ -652,6 +663,7 @@ class HeadServer:
                 self._objects[oid].discard(node_id)
                 if not self._objects[oid]:
                     del self._objects[oid]
+                    self._object_sizes.pop(oid, None)
             # Free PG bundles placed on the dead node.
             for pg in self._pgs.values():
                 pg["nodes"] = [
@@ -807,6 +819,11 @@ class HeadServer:
     def _do_free(self, oid_hex: str) -> None:
         with self._lock:
             self._pending_free.discard(oid_hex)
+            # The locations themselves are retired by each holder's "-"
+            # delta after it deletes its copy; the size entry can go now
+            # (bounded-memory eviction on free — a freed oid must not
+            # occupy a LOCALITY_DIR_MAX slot until the deltas land).
+            self._object_sizes.pop(oid_hex, None)
             holders = []
             for node_id in self._objects.get(oid_hex, set()):
                 entry = self._nodes.get(node_id)
@@ -886,16 +903,21 @@ class HeadServer:
     def _schedule(self, peer: Peer, resources: Dict[str, float],
                   node_hint: Optional[str] = None,
                   spread_threshold: float = 0.5,
-                  req_id: Optional[str] = None) -> Optional[str]:
+                  req_id: Optional[str] = None,
+                  arg_oids: Optional[List[str]] = None) -> Optional[str]:
         """Pick a node for a task/actor of this shape. Hybrid policy
         (reference: hybrid_scheduling_policy.h:50): prefer the hinted /
         most-utilized feasible node until utilization crosses the spread
-        threshold, then pick the least-utilized feasible node."""
+        threshold, then pick the least-utilized feasible node.
+        ``arg_oids`` (appended param, older clients omit it) lets the
+        locality scorer steer the decision toward the feasible node
+        already holding the most argument bytes."""
         # The decision span links a driver's submit span to the chosen
         # node's execution span; the outcome rides as an attribute.
         with tracing.span("sched.decide") as attrs:
             node_id = self._schedule_impl(peer, resources, node_hint,
-                                          spread_threshold, req_id)
+                                          spread_threshold, req_id,
+                                          arg_oids, attrs)
             attrs["node"] = node_id
             # req_id IS the task id (clients key their schedule requests
             # by it), so the decision lands on the task's timeline.
@@ -908,18 +930,31 @@ class HeadServer:
     def _schedule_impl(self, peer: Peer, resources: Dict[str, float],
                        node_hint: Optional[str] = None,
                        spread_threshold: float = 0.5,
-                       req_id: Optional[str] = None) -> Optional[str]:
+                       req_id: Optional[str] = None,
+                       arg_oids: Optional[List[str]] = None,
+                       attrs: Optional[dict] = None) -> Optional[str]:
         self._metrics.tick_schedule()
+        deferred: List[tuple] = []
         with self._lock:
-            return self._schedule_locked(resources, node_hint,
-                                         spread_threshold, req_id)
+            node_id = self._schedule_locked(resources, node_hint,
+                                            spread_threshold, req_id,
+                                            arg_oids, attrs, deferred)
+        self._run_eager_pushes(deferred)
+        return node_id
 
     def _schedule_locked(self, resources: Dict[str, float],
                          node_hint: Optional[str] = None,
                          spread_threshold: float = 0.5,
-                         req_id: Optional[str] = None) -> Optional[str]:
+                         req_id: Optional[str] = None,
+                         arg_oids: Optional[List[str]] = None,
+                         attrs: Optional[dict] = None,
+                         deferred: Optional[List[tuple]] = None
+                         ) -> Optional[str]:
         """One placement decision. Caller holds ``self._lock`` — the
-        batched submit path places a whole burst under one acquisition."""
+        batched submit path places a whole burst under one acquisition.
+        Pure compute by contract (lint rule RTP013): side effects the
+        decision wants (eager arg pushes) are appended to ``deferred``
+        for the caller to fire after the lock is released."""
         feasible = []
         for entry in self._nodes.values():
             if not entry.alive or entry.labels.get("role") == "driver":
@@ -928,9 +963,7 @@ class HeadServer:
                    for k, v in resources.items()):
                 feasible.append(entry)
         if not feasible:
-            import os as _os
-
-            key = req_id or _os.urandom(8).hex()
+            key = req_id or os.urandom(8).hex()
             self._unmet[key] = (time.monotonic(), dict(resources))
             if len(self._unmet) > 10_000:
                 cutoff = time.monotonic() - 10.0
@@ -944,6 +977,14 @@ class HeadServer:
                 if entry.node_id == node_hint:
                     return entry.node_id
 
+        # Locality: narrow the candidate pool to the feasible nodes
+        # already holding the most argument bytes. Advisory only — a
+        # miss (tie, unknown sizes, total under the floor) leaves the
+        # pool untouched, and an infeasible holder was never in it.
+        pool = feasible
+        if tuning.LOCALITY and arg_oids:
+            pool = self._locality_filter(feasible, arg_oids, attrs)
+
         def utilization(e: NodeEntry) -> float:
             fracs = [
                 1.0 - e.available.get(k, 0.0) / t
@@ -951,8 +992,8 @@ class HeadServer:
             ]
             return max(fracs) if fracs else 0.0
 
-        packed = sorted(feasible, key=lambda e: (-utilization(e),
-                                                 e.node_id))
+        packed = sorted(pool, key=lambda e: (-utilization(e),
+                                             e.node_id))
         best = packed[0]
         if utilization(best) >= spread_threshold:
             best = min(packed, key=lambda e: (utilization(e),
@@ -962,7 +1003,85 @@ class HeadServer:
         # onto the same node (heartbeats overwrite with ground truth).
         for k, v in resources.items():
             best.available[k] = best.available.get(k, 0.0) - v
+        if deferred is not None and arg_oids and tuning.LOCALITY and \
+                tuning.LOCALITY_EAGER_PUSH:
+            self._queue_eager_pushes(best.node_id, arg_oids, deferred)
         return best.node_id
+
+    def _locality_filter(self, feasible: List["NodeEntry"],
+                         arg_oids: List[str],
+                         attrs: Optional[dict]) -> List["NodeEntry"]:
+        """Caller holds ``self._lock``. Score each feasible node by the
+        wire bytes of the task's arguments it already holds and return
+        the top-scoring subset — pack/spread then runs inside it, so
+        utilization still breaks ties among equally-local nodes. A hit
+        requires the best score to clear ``LOCALITY_MIN_BYTES`` AND to
+        actually discriminate (a proper subset); otherwise the full pool
+        comes back and the decision matches the locality-blind policy."""
+        scores: Dict[str, int] = {}
+        for oh in arg_oids:
+            holders = self._objects.get(oh)
+            if not holders:
+                continue
+            size = self._object_sizes.get(oh, 0)
+            if size <= 0:
+                continue
+            for nid in holders:
+                scores[nid] = scores.get(nid, 0) + size
+        top = max((scores.get(e.node_id, 0) for e in feasible), default=0)
+        winners = [e for e in feasible if scores.get(e.node_id, 0) == top]
+        hit = (top >= max(1, tuning.LOCALITY_MIN_BYTES)
+               and len(winners) < len(feasible))
+        if attrs is not None:
+            # Accumulating, so one submit_batch span reads as hit count
+            # + total steered bytes across the burst.
+            attrs["locality_hit"] = int(attrs.get("locality_hit") or 0) + \
+                (1 if hit else 0)
+            attrs["locality_bytes"] = \
+                int(attrs.get("locality_bytes") or 0) + (top if hit else 0)
+        return winners if hit else feasible
+
+    def _queue_eager_pushes(self, chosen: str, arg_oids: List[str],
+                            deferred: List[tuple]) -> None:
+        """Caller holds ``self._lock``. Locality lost (or partially lost):
+        for each large argument the chosen node does not hold, pick a live
+        holder and record a push directive. The caller fires them after
+        releasing the lock, so the transfer overlaps the task's trip
+        through submit/queue instead of serializing with execute."""
+        target = self._nodes.get(chosen)
+        if target is None:
+            return
+        for oh in arg_oids:
+            if self._object_sizes.get(oh, 0) < \
+                    max(1, tuning.LOCALITY_MIN_BYTES):
+                continue
+            holders = self._objects.get(oh)
+            if not holders or chosen in holders:
+                continue
+            for nid in sorted(holders):
+                src = self._nodes.get(nid)
+                if src is not None and src.alive:
+                    deferred.append((nid, oh, target.address))
+                    break
+
+    def _run_eager_pushes(self, deferred: List[tuple]) -> None:
+        """Fire the push directives the scheduler queued under the lock,
+        reusing the demand-push plumbing: the holder node is told to
+        stream the object to the chosen node (``push_requests`` topic,
+        received by ``NodeServer._on_push_request``)."""
+        for nid, oh, target_addr in deferred:  # rpc-loop-ok: eager-push directives, fired after the sched lock is released
+            with self._lock:
+                src = self._nodes.get(nid)
+                address = src.address if src is not None and src.alive \
+                    else None
+            if address is None:
+                continue
+            try:
+                self._node_client(nid, address).notify(
+                    "push_request", {"object_id": oh,
+                                     "targets": [target_addr]})
+            except Exception as e:
+                errors.swallow("head.eager_push", e)
 
     def _submit_batch(self, peer: Peer, blob: bytes) -> List[Any]:
         """Pipelined submission fast path: N TaskSpecs decoded from one
@@ -974,14 +1093,16 @@ class HeadServer:
         driver requeues as pending)."""
         specs = wire.loads(blob)
         placements: List[Any] = []
+        deferred: List[tuple] = []
         with tracing.span("sched.decide") as attrs:
             with self._lock:
                 for spec in specs:
                     self._metrics.tick_schedule()
                     try:
+                        arg_oids = [o.hex() for o in spec.arg_ref_oids()]
                         node_id = self._schedule_locked(
                             dict(spec.resources or {}), None, 0.5,
-                            spec.task_id.hex())
+                            spec.task_id.hex(), arg_oids, attrs, deferred)
                     except Exception as e:  # noqa: BLE001 — per-spec fault
                         placements.append({"err": str(e)})
                         continue
@@ -992,6 +1113,7 @@ class HeadServer:
                     placements.append(
                         {"node_id": node_id,
                          "address": entry.address if entry else None})
+            self._run_eager_pushes(deferred)
             attrs["batch"] = len(placements)
             attrs["node"] = sum(1 for p in placements
                                 if isinstance(p, dict) and "node_id" in p)
@@ -1173,10 +1295,12 @@ class HeadServer:
     # -- object directory --------------------------------------------------
 
     def _report_object(self, peer: Peer, object_id: str,
-                       node_id: str) -> None:
+                       node_id: str, size_bytes: int = 0) -> None:
         with self._lock:
             first_copy = object_id not in self._objects
             self._objects.setdefault(object_id, set()).add(node_id)
+            if size_bytes:
+                self._record_object_size(object_id, int(size_bytes))
             waiters = self._object_waiters.pop(object_id, [])
             entry = self._nodes.get(node_id)
             address = entry.address if entry else None
@@ -1204,6 +1328,39 @@ class HeadServer:
                 locs.discard(node_id)
                 if not locs:
                     del self._objects[object_id]
+                    self._object_sizes.pop(object_id, None)
+
+    def _h_report_objects(self, peer: Peer, node_id: str,
+                          deltas: List[list]) -> None:
+        """Coalesced location deltas from one node: ``["+", oid_hex,
+        size_bytes]`` adds a holder (size feeds the locality scorer),
+        ``["-", oid_hex, 0]`` removes one. Replaces the per-object
+        ``report_object``/``forget_object`` notify storm — one frame per
+        node-side flush; a failed flush requeues and rides the next
+        heartbeat (the legacy per-object handlers stay for old nodes)."""
+        self._apply_object_deltas(peer, node_id, deltas)
+
+    def _apply_object_deltas(self, peer: Peer, node_id: str,
+                             deltas: List[list]) -> None:
+        for d in deltas:
+            try:
+                op, oid_hex = d[0], d[1]
+                size = int(d[2]) if len(d) > 2 and d[2] else 0
+            except Exception:
+                continue  # malformed delta: skip, don't poison the batch
+            if op == "+":
+                self._report_object(peer, oid_hex, node_id, size)
+            elif op == "-":
+                self._forget_object(peer, oid_hex, node_id)
+
+    def _record_object_size(self, object_id: str, size_bytes: int) -> None:
+        """Caller holds ``self._lock``. Re-inserting refreshes the FIFO
+        position so live objects survive the LOCALITY_DIR_MAX eviction."""
+        self._object_sizes.pop(object_id, None)
+        self._object_sizes[object_id] = size_bytes
+        cap = max(1, tuning.LOCALITY_DIR_MAX)
+        while len(self._object_sizes) > cap:
+            self._object_sizes.pop(next(iter(self._object_sizes)))
 
     def _locate_object(self, peer: Peer, object_id: str,
                        wait: bool = False) -> List[dict]:

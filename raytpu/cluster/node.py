@@ -583,6 +583,7 @@ class NodeServer:
         h("push_object_chunk", self._h_push_object_chunk)
         h("push_object_end", self._h_push_object_end)
         h("push_object_abort", self._h_push_object_abort)
+        h("push_request", self._h_push_request)
         h("free_object", self._h_free_object)
         h("cache_runtime_env", self._h_cache_runtime_env)
         h("has_runtime_env", self._h_has_runtime_env)
@@ -635,6 +636,17 @@ class NodeServer:
         self._notify_buffer = _deque(
             maxlen=max(1, tuning.HEAD_NOTIFY_BUFFER_MAX))
         self._notify_buffer_lock = threading.Lock()
+        # Object-location deltas (["+"|"-", oid_hex, size_bytes]) awaiting
+        # a coalesced report_objects flush. Group commit: the first
+        # reporter becomes the flusher and drains whatever accumulates
+        # while its notify is in flight, so a put storm becomes a few
+        # batched frames (riding the wire coalescer when negotiated)
+        # instead of one notify per object; a failed flush leaves the
+        # batch here to ride the next liveness heartbeat.
+        self._obj_deltas = _deque(
+            maxlen=max(1, tuning.OBJ_REPORT_BUFFER_MAX))
+        self._obj_delta_lock = threading.Lock()
+        self._obj_flush_lock = threading.Lock()
         self._fetching: set = set()
         self._fetch_lock = threading.Lock()
         # oid_hex -> [(loop, future), ...]: workers blocked in
@@ -651,6 +663,10 @@ class NodeServer:
         self.push_rx_completed = 0
         self.push_tx_completed = 0
         self.pull_rounds = 0
+        # Cross-node ingress byte counters (bench_locality reads these
+        # off debug_state to measure what locality placement saved).
+        self.pull_bytes = 0
+        self.push_rx_bytes = 0
         self.address: Optional[str] = None
         # Per-process log files live under the session dir (reference:
         # /tmp/ray/session_*/logs with one file per worker).
@@ -848,26 +864,35 @@ class NodeServer:
                 if failpoint("node.heartbeat.emit") is DROP:
                     continue
                 avail, seq = self._snapshot_avail()
+                # Piggyback both deferred queues on the liveness beat
+                # (reference: task events ride the raylet's existing GCS
+                # traffic): the flight-recorder batch, and any object
+                # location deltas whose direct report_objects flush
+                # failed. A failed call requeues both so records and
+                # directory updates survive a head bounce.
+                obj_deltas = self._drain_obj_deltas()
                 if task_events.enabled():
-                    # Piggyback the flight-recorder batch on the liveness
-                    # beat (reference: task events ride the raylet's
-                    # existing GCS traffic). A failed call requeues the
-                    # batch so records survive a head bounce.
                     batch, dropped = task_events.drain()
                     try:
                         self._head.call(
                             "heartbeat", self.node_id.hex(), avail, seq,
-                            batch, dropped,
+                            batch, dropped, obj_deltas,
                             timeout=tuning.CONTROL_CALL_TIMEOUT_S,
                         )
                     except Exception:
                         task_events.requeue(batch, dropped)
+                        self._requeue_obj_deltas(obj_deltas)
                         raise
                 else:
-                    self._head.call(
-                        "heartbeat", self.node_id.hex(), avail, seq,
-                        timeout=tuning.CONTROL_CALL_TIMEOUT_S,
-                    )
+                    try:
+                        self._head.call(
+                            "heartbeat", self.node_id.hex(), avail, seq,
+                            [], 0, obj_deltas,
+                            timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                        )
+                    except Exception:
+                        self._requeue_obj_deltas(obj_deltas)
+                        raise
                 backoff = 0.0
             except Exception:
                 if self._stop.is_set():
@@ -952,10 +977,14 @@ class NodeServer:
                 )
             except Exception as e:
                 errors.swallow("node.reregister_actor", e)
-        # Re-announce object locations.
-        for oid in self.backend.store.keys():  # rpc-loop-ok: re-announce replay after head restart
+        # Re-announce object locations as batched deltas, sizes included
+        # so the reloaded directory can score locality immediately.
+        replay = [["+", oid.hex(), self._object_wire_size(oid)]
+                  for oid in self.backend.store.keys()]
+        for i in range(0, len(replay), 512):  # rpc-loop-ok: re-announce replay after head restart, 512 deltas per frame
             try:
-                head.notify("report_object", oid.hex(), self.node_id.hex())
+                head.notify("report_objects", self.node_id.hex(),
+                            replay[i:i + 512])
             except Exception:
                 break
         # Replay control-plane notifications buffered while the head was
@@ -975,6 +1004,9 @@ class NodeServer:
                 with self._notify_buffer_lock:
                     self._notify_buffer.appendleft((method, args))
                 break
+        # Location deltas stranded by a failed flush ride now (duplicates
+        # against the full replay above are idempotent re-adds).
+        self._flush_obj_deltas()
         # The store the old head held is gone; dump this node's flight
         # record to disk so the window around the bounce stays debuggable.
         if task_events.enabled() and self.log_dir:
@@ -1009,7 +1041,76 @@ class NodeServer:
         self._wake_obj_waiters(oid.hex())
         if self._head is None:
             return
-        self._head_notify("report_object", oid.hex(), self.node_id.hex())
+        self._queue_obj_delta(["+", oid.hex(), self._object_wire_size(oid)])
+
+    def _object_wire_size(self, oid: ObjectID) -> int:
+        """Wire bytes of a locally-held object, for the head's locality
+        scorer. Spilled entries are stat()ed (the spill file IS the wire
+        layout); 0 means unknown — the scorer ignores the entry."""
+        store = self.backend.store
+        try:
+            size = store.spilled_wire_size(oid)
+            if size is not None:
+                return int(size)
+            sv = store.try_get(oid)
+            if sv is None:
+                return 0
+            from raytpu.cluster.transfer import wire_size
+
+            return wire_size(sv)
+        except Exception:
+            return 0
+
+    def _queue_obj_delta(self, delta: list) -> None:
+        """Queue one location delta and kick a coalescing flush."""
+        with self._obj_delta_lock:
+            self._obj_deltas.append(delta)
+        self._flush_obj_deltas()
+
+    def _flush_obj_deltas(self) -> None:
+        """Group-commit flush: one thread drains the buffer into batched
+        ``report_objects`` notifies; concurrent reporters just enqueue
+        (their delta is picked up by the active flusher's drain loop).
+        Idle store -> one delta per frame at zero added latency; a put
+        storm -> few frames with hundreds of deltas each. On failure the
+        batch is requeued at the FRONT so ordering holds ("-" after "+")
+        and the next heartbeat ships it — the same survive-a-head-bounce
+        contract as the flight-recorder event batches."""
+        if not self._obj_flush_lock.acquire(blocking=False):
+            return
+        try:
+            while True:
+                batch = self._drain_obj_deltas()
+                if not batch:
+                    return
+                head = self._head
+                try:
+                    if head is None or head.closed:
+                        raise ConnectionLost("head connection closed")
+                    head.notify("report_objects", self.node_id.hex(),
+                                batch)
+                except Exception:
+                    self._requeue_obj_deltas(batch)
+                    return
+        finally:
+            self._obj_flush_lock.release()
+
+    def _drain_obj_deltas(self) -> list:
+        with self._obj_delta_lock:
+            batch = list(self._obj_deltas)
+            self._obj_deltas.clear()
+        return batch
+
+    def _requeue_obj_deltas(self, batch: list) -> None:
+        with self._obj_delta_lock:
+            self._obj_deltas.extendleft(reversed(batch))
+
+    def _h_push_request(self, peer: Peer, data: dict) -> None:
+        """Head-directed eager push: the scheduler placed a task whose
+        large args live here onto another node — stream them over now so
+        the transfer overlaps the task's queueing (same receive path as
+        the demand-driven ``push_requests`` topic)."""
+        self._on_push_request(data)
 
     def _on_push_request(self, data: dict) -> None:
         """Head push: nodes listed in ``targets`` demanded an object that
@@ -1072,19 +1173,8 @@ class NodeServer:
             return c
 
     def _ensure_args_local(self, spec: TaskSpec) -> None:
-        from raytpu.runtime.task_spec import ArgKind
-        from raytpu.runtime.object_ref import ObjectRef
-
-        missing = []
-        for arg in spec.args:
-            if arg.kind == ArgKind.REF:
-                oid = ObjectRef.from_binary(arg.data).id
-                if not self.backend.store.contains(oid):
-                    missing.append(oid)
-        for rb in spec.inline_refs:
-            oid = ObjectRef.from_binary(rb).id
-            if not self.backend.store.contains(oid):
-                missing.append(oid)
+        missing = [oid for oid in spec.arg_ref_oids()
+                   if not self.backend.store.contains(oid)]
         for oid in missing:
             with self._fetch_lock:
                 if oid in self._fetching:
@@ -1169,6 +1259,7 @@ class NodeServer:
                     except Exception:
                         continue
                     if blob is not None:
+                        self.pull_bytes += len(blob)
                         self.backend.store.put(
                             oid, SerializedValue.from_buffer(blob))
                         if task_events.enabled():
@@ -1427,6 +1518,7 @@ class NodeServer:
             return False
 
     def _h_put_object(self, peer: Peer, oid_hex: str, blob: bytes) -> None:
+        self.push_rx_bytes += len(blob)
         self.backend.store.put(ObjectID.from_hex(oid_hex),
                                SerializedValue.from_buffer(blob))
 
@@ -1489,6 +1581,7 @@ class NodeServer:
             self.backend.store.put(
                 oid, SerializedValue.from_buffer(bytes(buf)))
         self.push_rx_completed += 1
+        self.push_rx_bytes += size
         if task_events.enabled():
             task_events.emit("object", oid_hex,
                              task_events.TaskTransition.TRANSFERRED,
@@ -1503,7 +1596,7 @@ class NodeServer:
         """Owner-directed free (the owner's refcount hit zero)."""
         oid = ObjectID.from_hex(oid_hex)
         self.backend.store.delete([oid])
-        self._head_notify("forget_object", oid.hex(), self.node_id.hex())
+        self._queue_obj_delta(["-", oid.hex(), 0])
 
     def _h_cache_runtime_env(self, peer: Peer, uri: str,
                              blob: bytes) -> None:
@@ -1686,8 +1779,7 @@ class NodeServer:
             if not self.backend.store.contains(oid):
                 break
             self.backend.store.delete([oid])
-            self._head_notify("forget_object", oid.hex(),
-                              self.node_id.hex())
+            self._queue_obj_delta(["-", oid.hex(), 0])
             i += 1
 
     def _route_stream(self, method: str, task_id_hex: str,
@@ -1872,6 +1964,8 @@ class NodeServer:
                 "push_rx_completed": self.push_rx_completed,
                 "push_tx_completed": self.push_tx_completed,
                 "pull_rounds": self.pull_rounds,
+                "pull_bytes": self.pull_bytes,
+                "push_rx_bytes": self.push_rx_bytes,
             }
 
     def _h_worker_stacks(self, peer: Peer,

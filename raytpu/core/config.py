@@ -148,6 +148,20 @@ declare_env("RAYTPU_RPC_BATCH_MAX_WAIT_S",
 declare_env("RAYTPU_SUBMIT_WINDOW", "pipelined submission in-flight window")
 declare_env("RAYTPU_SUBMIT_BATCH_MAX", "max TaskSpecs per submit_batch RPC")
 
+# Locality-aware scheduling (cluster/constants.py, cluster/head.py,
+# cluster/node.py): the head's size-aware object directory steers
+# placements toward the node already holding a task's argument bytes.
+declare_env("RAYTPU_LOCALITY",
+            "prefer the node holding the most argument bytes (bool)")
+declare_env("RAYTPU_LOCALITY_MIN_BYTES",
+            "local-bytes floor below which locality never steers a placement")
+declare_env("RAYTPU_LOCALITY_DIR_MAX",
+            "head-side oid->size map bound (oldest sizes evicted beyond it)")
+declare_env("RAYTPU_LOCALITY_EAGER_PUSH",
+            "push large args to a remote placement at schedule time (bool)")
+declare_env("RAYTPU_OBJ_REPORT_BUFFER_MAX",
+            "node-side buffered object-location deltas cap")
+
 # Kernels (ops/flash_attention.py, ops/paged_attention.py).
 declare_env("RAYTPU_FLASH_DOT", "force the dot-product flash-attention path (bool)")
 declare_env("RAYTPU_FLASH_BLOCK_Q", "flash-attention query tile rows")

@@ -107,6 +107,17 @@ class TaskSpec:
         return [ObjectID.for_task_return(self.task_id, i)
                 for i in range(self.num_returns)]
 
+    def arg_ref_oids(self) -> List[ObjectID]:
+        """ObjectIDs this task must resolve before running: positional REF
+        args plus refs nested inside inline args. Argument pinning, node-side
+        prefetch, and the head's locality scorer all key off this set."""
+        from raytpu.runtime.object_ref import ObjectRef
+
+        ids = [ObjectRef.from_binary(a.data).id for a in self.args
+               if a.kind == ArgKind.REF]
+        ids.extend(ObjectRef.from_binary(rb).id for rb in self.inline_refs)
+        return ids
+
     def is_actor_creation(self) -> bool:
         return self.actor_creation is not None
 

@@ -492,6 +492,81 @@ class TestChaosRecovery:
             cluster.shutdown()
             failpoints.clear()
 
+    @pytest.mark.slow
+    def test_holder_death_purges_directory_and_reroutes(self, tmp_path,
+                                                        monkeypatch):
+        """Locality chaos: the node holding a task's argument bytes dies
+        between ``report_object`` and placement. The head's NODE_DIED
+        sweep must drop the dead holder's directory entries, the locality
+        scorer must never steer a placement onto the corpse, and the
+        consuming task still completes — lineage re-executes the
+        producer on the survivor."""
+        monkeypatch.setenv("RAYTPU_HEARTBEAT_TIMEOUT_S", "2.0")
+        cluster = Cluster(num_nodes=2, node_resources={"num_cpus": 1})
+        cluster.wait_for_nodes(2)
+        raytpu.shutdown()
+        raytpu.init(address=f"tcp://{cluster.address}")
+        marker = str(tmp_path / "runs.txt")
+        head = RpcClient(cluster.address)
+        try:
+            @raytpu.remote
+            def produce():
+                with open(marker, "a") as f:
+                    f.write("run\n")
+                return bytes(1 << 20)
+
+            ref = produce.remote()
+            oid = ref.id.hex()
+            # Completion observed via the head's directory — no driver
+            # get, so the producer node holds the ONLY copy.
+            deadline = time.monotonic() + 30
+            locs = []
+            while time.monotonic() < deadline:
+                locs = head.call("locate_object", oid) or []
+                if locs:
+                    break
+                time.sleep(0.05)
+            assert locs, "task output never reported"
+            holder_id = locs[0]["node_id"]
+            # Cluster handles carry the banner's truncated id.
+            handle = next(h for h in cluster.nodes
+                          if holder_id.startswith(h.node_id))
+            survivor = next(
+                n["node_id"] for n in head.call("list_nodes")
+                if n["labels"].get("role") != "driver"
+                and n["node_id"] != holder_id)
+            cluster.kill_node(handle)
+            # Heartbeat timeout declares the node dead; its directory
+            # entries (locations AND sizes) go with it.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if not head.call("locate_object", oid):
+                    break
+                time.sleep(0.05)
+            assert not head.call("locate_object", oid), \
+                "dead holder still registered in the object directory"
+            # A placement keyed on the dead holder's bytes must land on
+            # the survivor — the directory no longer vouches for the
+            # corpse, so locality cannot steer toward it.
+            assert head.call("schedule", {"CPU": 1.0}, None, 0.5,
+                             "chaos-probe", [oid]) == survivor
+            # And the data path recovers end to end: the consumer finds
+            # no replica, lineage re-executes the producer.
+            @raytpu.remote
+            def consume(arg):
+                return len(arg)
+
+            assert raytpu.get(consume.remote(ref), timeout=90) == 1 << 20
+            with open(marker) as f:
+                runs = f.readlines()
+            assert len(runs) >= 2, \
+                "producer was not re-executed after holder death"
+        finally:
+            head.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+            failpoints.clear()
+
     # -- control plane -----------------------------------------------------
 
     @pytest.mark.slow

@@ -477,6 +477,67 @@ class TestRpcInLoop:  # RTP012
         """), rel="raytpu/cluster/relay.py") == []
 
 
+class TestSchedulerPurity:  # RTP013
+    def test_planted_rpc_in_schedule_locked(self):
+        # _schedule_locked's whole body is the critical section (its
+        # contract is "caller holds self._lock").
+        findings = run_rule_on_source(_rule("RTP013"), _src("""
+            def _schedule_locked(self, resources, arg_oids=None):
+                entry = self._pick(resources)
+                self._node_client(entry.node_id).notify("push_request", {})
+                return entry.node_id
+        """), rel="raytpu/cluster/head.py")
+        assert len(findings) == 1
+        assert ".notify()" in findings[0].message
+        assert "deferred" in findings[0].message
+
+    def test_planted_io_under_lock_in_submit_batch(self):
+        findings = run_rule_on_source(_rule("RTP013"), _src("""
+            def _submit_batch(self, peer, blob):
+                specs = wire.loads(blob)
+                with self._lock:
+                    for spec in specs:
+                        peer.push("push_requests", {"oid": spec.task_id})
+                        open("/tmp/sched.log", "a")
+                return []
+        """), rel="raytpu/cluster/head.py")
+        assert len(findings) == 2
+        assert ".push()" in findings[0].message
+        assert "open()" in findings[1].message
+
+    def test_clean_deferred_after_lock_release(self):
+        # The shipped pattern: pure compute under the lock, side effects
+        # queued on `deferred` and fired after release.
+        assert run_rule_on_source(_rule("RTP013"), _src("""
+            def _schedule_locked(self, resources, deferred=None):
+                best = sorted(self._nodes.values())[0]
+                if deferred is not None:
+                    deferred.append((best.node_id, "oid", best.address))
+                return best.node_id
+
+            def _schedule_impl(self, peer, resources):
+                deferred = []
+                with self._lock:
+                    node_id = self._schedule_locked(resources, deferred)
+                for nid, oh, addr in deferred:
+                    self._node_client(nid, addr).notify("push_request", {})
+                return node_id
+        """), rel="raytpu/cluster/head.py") == []
+
+    def test_out_of_scope_module_ignored(self):
+        # Only the head hosts the placement lock; other modules may hold
+        # their own _lock around RPCs.
+        assert run_rule_on_source(_rule("RTP013"), _src("""
+            def _submit_batch(self, peer, blob):
+                with self._lock:
+                    self._head.call("submit_batch", blob)
+        """), rel="raytpu/cluster/client.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP013"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 
