@@ -1,0 +1,335 @@
+"""Zero-copy data plane bench: same-node gets, device-buffer puts, and
+streaming cross-process transfer — zero-copy on vs off.
+
+Three measurements, one JSON (subprocess per mode so RAYTPU_ZEROCOPY is
+read at import time, exactly like a real process tree):
+
+- **Same-node get** (subprocess per mode): a ~100 MB array is put into
+  the shm arena once; each iteration gets + deserializes it. Default
+  mode returns a pinned read-only view of the mapping (µs); legacy mode
+  copies the bytes out (ms). Acceptance: >= 50x.
+
+- **Device-buffer put** (child, zero-copy only): a ~100 MB jax array is
+  put via ``measure()`` → serialize-into-place. ``copy_stats`` must
+  report EXACTLY ONE host-visible copy (the shm write): the CPU jax
+  buffer is aliased via dlpack, never materialized to a host ndarray
+  first.
+
+- **Streaming transfer** (receiver child per mode + a sender process
+  serving chunk RPCs off one RangeReader): a ~512 MB object crosses a
+  socket. Zero-copy mode streams chunks straight into the receive
+  region (``fetch_object``); legacy assembles a heap blob
+  (``fetch_blob``) then puts it. Peak receiver RSS is sampled minus the
+  arena mapping's own resident pages (the object lands there in both
+  modes — the question is what ELSE the receive holds). Acceptance:
+  zero-copy non-arena RSS delta < 2x RAYTPU_TRANSFER_WINDOW_BYTES, at
+  >= legacy throughput.
+
+Writes BENCH_r11.json at the repo root and prints the same object as
+one JSON line.
+
+Env: RAYTPU_BENCH_GET_MB (default 100), RAYTPU_BENCH_XFER_MB (default
+512), RAYTPU_BENCH_GET_ITERS (default 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+GET_MB = float(os.environ.get("RAYTPU_BENCH_GET_MB", "100"))
+XFER_MB = float(os.environ.get("RAYTPU_BENCH_XFER_MB", "512"))
+GET_ITERS = int(os.environ.get("RAYTPU_BENCH_GET_ITERS", "5"))
+
+
+# -- children -----------------------------------------------------------------
+
+
+def child_get():
+    """Median same-node get+deserialize latency for a ~GET_MB array."""
+    import numpy as np
+
+    from raytpu.core.ids import ObjectID
+    from raytpu.runtime.serialization import deserialize, serialize
+    from raytpu.runtime.shm_store import SharedMemoryStore
+
+    n = int(GET_MB * (1 << 20) // 8)
+    store = SharedMemoryStore(capacity=int(GET_MB * 3) << 20,
+                              name=f"/raytpu-bench-get-{os.getpid()}")
+    try:
+        oid = ObjectID.from_random()
+        store.put(oid, serialize(np.arange(n, dtype=np.float64)))
+        times = []
+        checksum = 0.0
+        for _ in range(GET_ITERS):
+            t0 = time.perf_counter()
+            arr = deserialize(store.get(oid))
+            times.append(time.perf_counter() - t0)
+            checksum = float(arr[n // 2])  # touch it; defeat laziness
+            del arr
+        times.sort()
+        print(json.dumps({
+            "zerocopy": os.environ.get("RAYTPU_ZEROCOPY", "1"),
+            "get_s": times[len(times) // 2],
+            "checksum": checksum,
+        }))
+    finally:
+        store.close(unlink=True)
+
+
+def child_jaxput():
+    """Host-visible copy count for a ~GET_MB jax-array put."""
+    import jax.numpy as jnp
+
+    from raytpu.core.ids import ObjectID
+    from raytpu.runtime import serialization
+    from raytpu.runtime.serialization import measure, reset_copy_stats
+    from raytpu.runtime.shm_store import SharedMemoryStore
+
+    # float32: jax's default precision, so the put path sees exactly what
+    # real workloads hand it (and the size stays an honest GET_MB).
+    n = int(GET_MB * (1 << 20) // 4)
+    x = jnp.arange(n, dtype=jnp.float32)
+    x.block_until_ready()
+    store = SharedMemoryStore(capacity=int(GET_MB * 3) << 20,
+                              name=f"/raytpu-bench-jax-{os.getpid()}")
+    try:
+        reset_copy_stats()
+        t0 = time.perf_counter()
+        store.put(ObjectID.from_random(), measure(x))
+        elapsed = time.perf_counter() - t0
+        print(json.dumps({
+            "put_s": elapsed,
+            "bytes": n * 4,
+            **serialization.copy_stats,
+        }))
+    finally:
+        store.close(unlink=True)
+
+
+def child_sender():
+    """Serve a ~XFER_MB object's chunk RPCs; prints ADDR, exits on stdin
+    EOF (receiver done)."""
+    import numpy as np
+
+    from raytpu.cluster.protocol import RpcServer
+    from raytpu.cluster.transfer import RangeReader, wire_size
+    from raytpu.runtime.serialization import serialize
+
+    sv = serialize(np.arange(int(XFER_MB * (1 << 20) // 8),
+                             dtype=np.float64))
+    reader = RangeReader.for_value(sv)
+    srv = RpcServer()
+    srv.register("fetch_object_meta",
+                 lambda peer, oid: {"size": wire_size(sv)})
+    srv.register("fetch_object_chunk",
+                 lambda peer, oid, off, ln: reader.read(off, ln))
+    srv.register("fetch_object", lambda peer, oid: sv.to_bytes())
+    print(f"ADDR {srv.start()}", flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    srv.stop()
+
+
+def _rss_minus_arena(arena_tag: str) -> int:
+    """Resident bytes of this process EXCLUDING the shm arena mapping
+    (the object lands in the arena in both modes — the bench measures
+    what else the receive path holds)."""
+    total = 0
+    arena = 0
+    current_is_arena = False
+    with open("/proc/self/smaps") as f:
+        for line in f:
+            if line[0].isdigit() or line[0] in "abcdef":
+                current_is_arena = arena_tag in line
+            elif line.startswith("Rss:"):
+                kb = int(line.split()[1])
+                total += kb
+                if current_is_arena:
+                    arena += kb
+    return (total - arena) * 1024
+
+
+def child_receiver():
+    """Pull the sender's object; report elapsed + peak non-arena RSS."""
+    from raytpu.cluster.protocol import RpcClient
+    from raytpu.core.ids import ObjectID
+    from raytpu.runtime.object_store import MemoryStore
+    from raytpu.runtime.serialization import SerializedValue
+    from raytpu.runtime.shm_store import SharedMemoryStore
+
+    addr = os.environ["RAYTPU_BENCH_SENDER_ADDR"]
+    zerocopy = os.environ.get("RAYTPU_ZEROCOPY", "1") != "0"
+    arena_name = f"raytpu-bench-rx-{os.getpid()}"
+    shm = SharedMemoryStore(capacity=int(XFER_MB * 1.5) << 20,
+                            name=f"/{arena_name}")
+    store = MemoryStore(shm=shm)
+    cli = RpcClient(addr)
+    oid = ObjectID.from_random()
+
+    peak = [0]
+    stop = threading.Event()
+
+    def sample():
+        base = _rss_minus_arena(arena_name)
+        while not stop.is_set():
+            peak[0] = max(peak[0], _rss_minus_arena(arena_name) - base)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=sample, daemon=True)
+    t.start()
+    time.sleep(0.1)  # let the sampler take its baseline first
+    try:
+        t0 = time.perf_counter()
+        if zerocopy:
+            from raytpu.cluster.transfer import fetch_object
+
+            assert fetch_object(cli, oid.hex(), store, timeout=300)
+        else:
+            from raytpu.cluster.transfer import fetch_blob
+
+            blob = fetch_blob(cli, oid.hex(), timeout=300)
+            assert blob is not None
+            store.put(oid, SerializedValue.from_buffer(blob))
+            del blob
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        t.join(2)
+        assert store.contains(oid)
+        print(json.dumps({
+            "zerocopy": int(zerocopy),
+            "transfer_s": elapsed,
+            "throughput_mb_s": XFER_MB / elapsed,
+            "peak_rss_minus_arena_bytes": peak[0],
+        }))
+    finally:
+        cli.close()
+        shm.close(unlink=True)
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def _env(zerocopy: str, **extra) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAYTPU_ZEROCOPY"] = zerocopy
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+def _last_json(out: subprocess.CompletedProcess, what: str) -> dict:
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"{what} produced no result:\n"
+                       f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+
+
+def _spawn(mode: str, zerocopy: str, **extra) -> dict:
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), f"--{mode}"],
+        env=_env(zerocopy, **extra), capture_output=True, text=True,
+        timeout=900)
+    return _last_json(out, f"{mode} (zerocopy={zerocopy})")
+
+
+def _run_transfers() -> dict:
+    """Both modes against ONE sender, receivers interleaved on/off/on/…
+    so machine drift lands on both sides; best-of-3 per mode for
+    throughput (the fastest run measures the code, not the neighbors),
+    worst-of-3 for peak RSS (the honest observation)."""
+    sender = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--sender"],
+        env=_env("1"), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        text=True)
+    try:
+        addr = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = sender.stdout.readline()
+            if line.startswith("ADDR "):
+                addr = line.split(None, 1)[1].strip()
+                break
+        if addr is None:
+            raise RuntimeError("sender never published its address")
+        runs = {"1": [], "0": []}
+        for _ in range(3):
+            for mode in ("1", "0"):
+                runs[mode].append(_spawn("receiver", mode,
+                                         RAYTPU_BENCH_SENDER_ADDR=addr))
+        out = {}
+        for mode, key in (("1", "on"), ("0", "off")):
+            best = min(runs[mode], key=lambda r: r["transfer_s"])
+            best["peak_rss_minus_arena_bytes"] = max(
+                r["peak_rss_minus_arena_bytes"] for r in runs[mode])
+            out[key] = best
+        return out
+    finally:
+        try:
+            sender.stdin.close()
+            sender.wait(timeout=10)
+        except Exception:
+            sender.kill()
+
+
+def main():
+    if "--get" in sys.argv:
+        return child_get()
+    if "--jaxput" in sys.argv:
+        return child_jaxput()
+    if "--sender" in sys.argv:
+        return child_sender()
+    if "--receiver" in sys.argv:
+        return child_receiver()
+
+    from raytpu.cluster import constants as tuning
+
+    get_on = _spawn("get", "1")
+    get_off = _spawn("get", "0")
+    jaxput = _spawn("jaxput", "1")
+    xfer = _run_transfers()
+    xfer_on, xfer_off = xfer["on"], xfer["off"]
+
+    speedup = get_off["get_s"] / max(get_on["get_s"], 1e-9)
+    window = int(tuning.TRANSFER_WINDOW_BYTES)
+    result = {
+        "bench": "zero_copy_dataplane",
+        "workload": {"get_mb": GET_MB, "transfer_mb": XFER_MB,
+                     "get_iters": GET_ITERS,
+                     "transfer_window_bytes": window},
+        "same_node_get": {
+            "on_s": get_on["get_s"], "off_s": get_off["get_s"],
+            "speedup_x": round(speedup, 1),
+            "pass_50x": speedup >= 50,
+        },
+        "jax_put": {
+            **jaxput,
+            "pass_one_copy": jaxput["copies"] == 1
+            and jaxput["materialize_bytes"] == 0,
+        },
+        "transfer": {
+            "on": xfer_on, "off": xfer_off,
+            "pass_rss": xfer_on["peak_rss_minus_arena_bytes"] < 2 * window,
+            "pass_throughput": (xfer_on["throughput_mb_s"]
+                                >= xfer_off["throughput_mb_s"]),
+        },
+    }
+    path = os.path.join(REPO_ROOT, "BENCH_r11.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,10 +36,14 @@ RTP012 rpc-in-loop             no per-item .call()/.notify() inside a
 RTP013 scheduler-purity        no RPC/socket/file I/O while the head's
                                placement lock is held — side effects
                                defer to after the lock release
+RTP014 no-blob-materialization data-plane modules never flatten an
+                               object into one blob (.to_bytes(),
+                               bytes join, whole-value pickle.dumps)
 ====== ======================= ====================================
 """
 
 from raytpu.analysis.rules import (  # noqa: F401
+    blob_materialization,
     blocking_in_async,
     cache_gather,
     contextvar_crossing,

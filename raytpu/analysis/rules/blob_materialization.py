@@ -1,0 +1,94 @@
+"""RTP014: the data plane never materializes a whole object as one blob.
+
+The zero-copy data plane moves objects as ``[4-byte header len][header]
+[buffers…]`` segments end to end: puts serialize straight into the shm
+mapping, senders serve chunk reads as memoryview slices of their own
+storage, receivers write chunks into a final-size region sealed
+atomically. One careless ``sv.to_bytes()`` (flatten the whole value),
+``b"".join(parts)`` (assemble a transfer on the heap), or whole-value
+``pickle.dumps`` on these paths silently reintroduces the 2× peak
+memory and the extra memcpy the plane was built to remove — and it
+looks harmless in review because it is one short line.
+
+Flagged in the data-plane modules (transfer, object store, node
+push/pull handlers):
+
+- zero-argument ``.to_bytes()`` calls (``int.to_bytes(4, "little")``
+  takes arguments and is the wire framing itself — not flagged);
+- ``join`` called on a ``bytes``/``bytearray`` literal or on
+  ``bytes()``/``bytearray()``;
+- ``pickle.dumps`` / ``cloudpickle.dumps`` (serialization belongs in
+  ``runtime/serialization.py``, which hands out out-of-band buffers).
+
+Sanctioned sites (small objects that fit one wire frame by contract,
+compat shims) carry the reason inline on the call line::
+
+    # blob-ok: <why a one-shot blob is correct here>
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_SANCTION = "blob-ok:"
+
+
+def _line_sanctioned(mod, lineno: int) -> bool:
+    try:
+        return _SANCTION in mod.lines[lineno - 1]
+    except IndexError:
+        return False
+
+
+def _is_bytes_joiner(node: ast.expr) -> bool:
+    """``b""``-style literal or a ``bytes(...)``/``bytearray(...)`` call."""
+    if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                    (bytes, bytearray)):
+        return True
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("bytes", "bytearray"))
+
+
+@register
+class BlobMaterialization(Rule):
+    id = "RTP014"
+    name = "no-blob-materialization"
+    invariant = ("data-plane modules never flatten a whole object into "
+                 "one blob — no zero-arg .to_bytes(), no b''.join of "
+                 "transfer parts, no whole-value pickle.dumps; sanctioned "
+                 "sites carry '# blob-ok: <reason>'")
+    rationale = ("one flatten doubles peak memory and adds a full-object "
+                 "memcpy on the exact paths the zero-copy plane exists "
+                 "to keep segment-based; each violation looks like one "
+                 "harmless line")
+    scope = ("raytpu/cluster/transfer.py",
+             "raytpu/runtime/object_store.py",
+             "raytpu/cluster/node.py")
+
+    def check(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            msg = None
+            if (isinstance(f, ast.Attribute) and f.attr == "to_bytes"
+                    and not node.args and not node.keywords):
+                msg = ("zero-arg .to_bytes() flattens the whole object — "
+                       "serialize into place / serve memoryview slices, "
+                       "or sanction with '# blob-ok: <reason>'")
+            elif (isinstance(f, ast.Attribute) and f.attr == "join"
+                    and _is_bytes_joiner(f.value)):
+                msg = ("bytes join assembles a transfer on the heap — "
+                       "write chunks into a final-size receive region, "
+                       "or sanction with '# blob-ok: <reason>'")
+            elif (isinstance(f, ast.Attribute) and f.attr == "dumps"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("pickle", "cloudpickle")):
+                msg = ("whole-value pickle.dumps on the data plane — go "
+                       "through runtime/serialization (out-of-band "
+                       "buffers), or sanction with '# blob-ok: <reason>'")
+            if msg is None or _line_sanctioned(mod, node.lineno):
+                continue
+            yield self.finding(mod, node, msg)

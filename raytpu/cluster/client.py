@@ -530,8 +530,14 @@ class ClusterBackend:
             try:
                 if peer.call("has_object", oid.hex()):
                     continue
-                peer.call("put_object", oid.hex(), sv.to_bytes(),
-                          timeout=None)
+                from raytpu.cluster.transfer import push_blob
+
+                # Small args ride one put_object frame; large ones stream
+                # as windowed chunks read off the driver's own buffers —
+                # the arg is never flattened into a second driver-side
+                # copy.
+                if not push_blob(peer, oid.hex(), sv):
+                    raise ConnectionError("push did not complete")
             except Exception as e:
                 # The task will fail node-side with a missing-object pull
                 # error; leave a trail pointing at the real cause.
@@ -816,10 +822,13 @@ class ClusterBackend:
                 except CircuitOpenError:
                     continue
                 try:
-                    from raytpu.cluster.transfer import fetch_blob
+                    from raytpu.cluster.transfer import fetch_object
 
-                    blob = fetch_blob(self._peer(loc["address"]),
-                                      ref.id.hex())
+                    # Streams chunk replies straight into the driver
+                    # store's receive region — the object is never held
+                    # as one heap blob on the way in.
+                    got = fetch_object(self._peer(loc["address"]),
+                                       ref.id.hex(), self.store)
                 except (ConnectionLost, RpcTimeoutError, ConnectionError,
                         OSError):
                     src.record_failure()
@@ -828,10 +837,10 @@ class ClusterBackend:
                     src.record_success()  # peer answered; fetch just failed
                     continue
                 src.record_success()
-                if blob is not None:
-                    sv = SerializedValue.from_buffer(blob)
-                    self.store.put(ref.id, sv)
-                    return sv
+                if got:
+                    sv = self.store.try_get(ref.id)
+                    if sv is not None:
+                        return sv
             if not locs:
                 # No copy anywhere. If the creating task is not running
                 # and we hold its lineage, re-execute it (reference:

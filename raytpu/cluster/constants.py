@@ -100,6 +100,13 @@ FETCH_TIMEOUT_S = _f("FETCH_TIMEOUT_S", 60.0)
 WORKER_FETCH_TIMEOUT_S = _f("WORKER_FETCH_TIMEOUT_S", 30.0)
 # Cap on one blocking wait_objects_any poll (server-side hold).
 WAIT_POLL_CAP_S = _f("WAIT_POLL_CAP_S", 300.0)
+# Process-wide in-flight transfer payload budget in BYTES, shared by push
+# and pull (replaces the count-only chunk semaphore: N chunks ballooned
+# with the chunk-size knob; a bytes window is invariant to it).
+TRANSFER_WINDOW_BYTES = _i("TRANSFER_WINDOW_BYTES", 64 * 1024 * 1024)
+# Sender-side chunk-serving RangeReader cache TTL: the reader (and the
+# store pin backing it) lives this long past the last chunk request.
+TX_READER_TTL_S = _f("TX_READER_TTL_S", 30.0)
 
 # -- actors / placement ------------------------------------------------------
 

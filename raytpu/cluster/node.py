@@ -507,7 +507,7 @@ def _xlang_args(args: list) -> list:
     from raytpu.runtime.serialization import serialize
     from raytpu.runtime.task_spec import ArgKind, TaskArg
 
-    return [TaskArg(ArgKind.INLINE, serialize(a).to_bytes())
+    return [TaskArg(ArgKind.INLINE, serialize(a).to_bytes())  # blob-ok: INLINE args are small by contract (spec-embedded)
             for a in args]
 
 
@@ -655,10 +655,21 @@ class NodeServer:
         self._obj_wait: Dict[str, list] = {}
         self._obj_wait_lock = threading.Lock()
         # Inbound push assembly (reference: push_manager receiver side):
-        # oid_hex -> [buffer, last_activity, expected_size, bytes_got].
-        # Published to the store only on a complete push_object_end.
+        # oid_hex -> [receive, last_activity, expected_size,
+        # {offset: length}]. The receive is a store-owned destination
+        # (shm region or heap buffer) created at final size on
+        # push_object_begin; chunks write straight into it and only a
+        # complete push_object_end seals it. Every drop path aborts it so
+        # a half-written region is reclaimed, never published.
         self._push_rx: Dict[str, list] = {}
         self._push_rx_lock = threading.Lock()
+        # Outbound chunk serving: oid_hex -> [RangeReader, last_access].
+        # Built once per transfer (prefix-sum index over the wire
+        # segments, pinning the value); each fetch_object_chunk is a
+        # bisect + memoryview slice instead of an O(segments) walk and a
+        # bytearray per chunk. Swept by TTL.
+        self._tx_readers: Dict[str, list] = {}
+        self._tx_readers_lock = threading.Lock()
         self._push_tx_pool = None  # lazy; bounds concurrent outbound pushes
         self.push_rx_completed = 0
         self.push_tx_completed = 0
@@ -1233,8 +1244,10 @@ class NodeServer:
                         <= float(cfg.object_push_rx_ttl_s))
                     if ent is not None and not inbound:
                         # Producer died mid-push and nothing else pushed
-                        # since: drop the orphan so pull can proceed.
+                        # since: drop the orphan (reclaiming its region)
+                        # so pull can proceed.
                         del self._push_rx[oid.hex()]
+                        ent[0].abort()
                 if inbound:
                     # A producer is already streaming it here; don't pull
                     # the same bytes in parallel.
@@ -1250,18 +1263,20 @@ class NodeServer:
                     if loc["address"] == self.address:
                         continue
                     try:
-                        from raytpu.cluster.transfer import fetch_blob
+                        from raytpu.cluster.transfer import fetch_object
 
+                        # Streams straight into the local store: the
+                        # receive region is created at final size and
+                        # chunk replies land in place — no blob.
                         self.pull_rounds += 1
-                        blob = fetch_blob(
+                        got = fetch_object(
                             self._peer_client(loc["address"]), oid.hex(),
+                            self.backend.store,
                             timeout=tuning.FETCH_TIMEOUT_S)
                     except Exception:
                         continue
-                    if blob is not None:
-                        self.pull_bytes += len(blob)
-                        self.backend.store.put(
-                            oid, SerializedValue.from_buffer(blob))
+                    if got:
+                        self.pull_bytes += self._object_wire_size(oid)
                         if task_events.enabled():
                             task_events.emit(
                                 "object", oid.hex(),
@@ -1463,7 +1478,7 @@ class NodeServer:
         oid = ObjectID.from_hex(oid_hex)
         sv = self.backend.store.try_get(oid)
         if sv is not None:
-            return sv.to_bytes()
+            return sv.to_bytes()  # blob-ok: whole-object RPC reply, used for sub-chunk objects only
         # Miss: kick a bounded cross-node pull so a worker's retry loop can
         # reach objects produced on other nodes (e.g. results of nested
         # actor calls routed elsewhere; reference: PullManager).
@@ -1476,33 +1491,56 @@ class NodeServer:
                              args=(oid, 120.0), daemon=True).start()
         return None
 
-    def _h_fetch_object_meta(self, peer: Peer, oid_hex: str):
-        oid = ObjectID.from_hex(oid_hex)
-        size = self.backend.store.spilled_wire_size(oid)
-        if size is not None:
-            return {"size": size}
-        sv = self.backend.store.try_get(oid)
-        if sv is None:
-            return None
-        from raytpu.cluster.transfer import wire_size
+    def _tx_reader(self, oid: ObjectID):
+        """TTL-cached RangeReader for serving chunk reads of a local
+        object. The reader pins the value (shm refcount / spill-file
+        mapping), so an in-flight transfer survives a concurrent local
+        delete; the pin drops when the TTL sweep closes the reader."""
+        from raytpu.cluster.transfer import RangeReader
 
-        return {"size": wire_size(sv)}
+        now = time.monotonic()
+        ttl = tuning.TX_READER_TTL_S
+        with self._tx_readers_lock:
+            for k in [k for k, ent in self._tx_readers.items()
+                      if now - ent[1] > ttl]:
+                self._tx_readers.pop(k)[0].close()
+            ent = self._tx_readers.get(oid.hex())
+            if ent is not None:
+                ent[1] = now
+                return ent[0]
+        path = self.backend.store.spilled_path(oid)
+        if path is not None:
+            try:
+                reader = RangeReader.for_file(path)
+            except OSError:
+                reader = None
+        else:
+            sv = self.backend.store.try_get(oid)
+            reader = RangeReader.for_value(sv) if sv is not None else None
+        if reader is None:
+            return None
+        with self._tx_readers_lock:
+            ent = self._tx_readers.setdefault(oid.hex(), [reader, now])
+            if ent[0] is not reader:  # lost a build race; keep the first
+                reader.close()
+                ent[1] = now
+            return ent[0]
+
+    def _h_fetch_object_meta(self, peer: Peer, oid_hex: str):
+        reader = self._tx_reader(ObjectID.from_hex(oid_hex))
+        if reader is None:
+            return None
+        return {"size": reader.size}
 
     def _h_fetch_object_chunk(self, peer: Peer, oid_hex: str,
                               offset: int, length: int) -> Optional[bytes]:
-        oid = ObjectID.from_hex(oid_hex)
-        # Spilled values serve straight from the file — never rebuild the
-        # whole object per chunk.
-        piece = self.backend.store.spilled_wire_range(
-            oid, int(offset), int(length))
-        if piece is not None:
-            return piece
-        sv = self.backend.store.try_get(oid)
-        if sv is None:
+        # One prefix-sum reader per transfer; each chunk reply is a
+        # memoryview slice of the sender's own shm/heap value (or spill
+        # mmap) riding into the codec — no per-chunk bytearray.
+        reader = self._tx_reader(ObjectID.from_hex(oid_hex))
+        if reader is None:
             return None
-        from raytpu.cluster.transfer import read_range
-
-        return read_range(sv, int(offset), int(length))
+        return reader.read(int(offset), int(length))
 
     def _h_has_object(self, peer: Peer, oid_hex: str) -> bool:
         """Local store, falling back to the cluster directory — worker
@@ -1526,7 +1564,8 @@ class NodeServer:
 
     def _h_push_object_begin(self, peer: Peer, oid_hex: str,
                              size: int) -> bool:
-        if self.backend.store.contains(ObjectID.from_hex(oid_hex)):
+        oid = ObjectID.from_hex(oid_hex)
+        if self.backend.store.contains(oid):
             return False
         ttl = float(cfg.object_push_rx_ttl_s)
         now = time.monotonic()
@@ -1534,15 +1573,18 @@ class NodeServer:
             stale = [k for k, ent in self._push_rx.items()
                      if now - ent[1] > ttl]
             for k in stale:
-                del self._push_rx[k]
+                self._push_rx.pop(k)[0].abort()
             if oid_hex in self._push_rx:
                 return False  # another push already inbound
-            # [buf, last_activity, size, {offset: length}] — explicit
+            # [receive, last_activity, size, {offset: length}] — explicit
             # coverage ranges, not a byte counter: a duplicated or
             # overlapping chunk must never make "complete" true while
-            # the buffer has zero-filled holes.
-            self._push_rx[oid_hex] = [bytearray(int(size)), now,
-                                      int(size), {}]
+            # the destination has zero-filled holes. The receive is the
+            # final-size destination (shm region when large) — chunks
+            # land in place, seal publishes atomically.
+            self._push_rx[oid_hex] = [
+                self.backend.store.begin_receive(oid, int(size)), now,
+                int(size), {}]
         return True
 
     def _h_push_object_chunk(self, peer: Peer, oid_hex: str, offset: int,
@@ -1551,13 +1593,14 @@ class NodeServer:
             ent = self._push_rx.get(oid_hex)
             if ent is None:
                 return False
-            buf, _, size, ranges = ent
+            rx, _, size, ranges = ent
             off = int(offset)
             end = off + len(data)
             if off < 0 or end > size:
                 del self._push_rx[oid_hex]
+                rx.abort()  # poisoned transfer: reclaim, never publish
                 return False
-            buf[off:end] = data
+            rx.write(off, data)
             ranges[off] = len(data)
             ent[1] = time.monotonic()
         return True
@@ -1567,19 +1610,18 @@ class NodeServer:
             ent = self._push_rx.pop(oid_hex, None)
         if ent is None:
             return False
-        buf, _, size, ranges = ent
+        rx, _, size, ranges = ent
         # Complete means gap-free, overlap-free coverage of [0, size).
         pos = 0
         for off in sorted(ranges):
             if off != pos:
+                rx.abort()
                 return False  # hole or overlap: never published
             pos = off + ranges[off]
         if pos != size:
+            rx.abort()
             return False  # incomplete: never published as stored
-        oid = ObjectID.from_hex(oid_hex)
-        if not self.backend.store.contains(oid):
-            self.backend.store.put(
-                oid, SerializedValue.from_buffer(bytes(buf)))
+        rx.seal()
         self.push_rx_completed += 1
         self.push_rx_bytes += size
         if task_events.enabled():
@@ -1590,7 +1632,9 @@ class NodeServer:
 
     def _h_push_object_abort(self, peer: Peer, oid_hex: str) -> None:
         with self._push_rx_lock:
-            self._push_rx.pop(oid_hex, None)
+            ent = self._push_rx.pop(oid_hex, None)
+        if ent is not None:
+            ent[0].abort()
 
     def _h_free_object(self, peer: Peer, oid_hex: str) -> None:
         """Owner-directed free (the owner's refcount hit zero)."""

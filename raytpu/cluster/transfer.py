@@ -2,7 +2,7 @@
 
 Reference analogue: the chunked pull path of
 ``src/ray/object_manager/object_manager.cc`` (objects move as
-``chunk_size`` pieces with bounded in-flight chunks, so one multi-GiB
+``chunk_size`` pieces with bounded in-flight bytes, so one multi-GiB
 object cannot monopolize a connection or buffer whole in memory at the
 sender). Wire surface: three RPCs served by every node —
 
@@ -10,8 +10,21 @@ sender). Wire surface: three RPCs served by every node —
 - ``fetch_object_meta(oid)``   → {"size": wire_bytes} or None
 - ``fetch_object_chunk(oid, off, len)`` → bytes or None (vanished)
 
-A process-wide semaphore caps concurrent chunk fetches (reference:
-``max_bytes_in_flight`` in the pull manager).
+Flow control is a process-wide BYTES-based window
+(``RAYTPU_TRANSFER_WINDOW_BYTES``), shared by push and pull: aggregate
+chunk payload in flight stays bounded at wire speed — the reference's
+``max_bytes_in_flight`` in the pull manager — where the old count-only
+semaphore let N big chunks balloon with the chunk-size knob.
+
+Zero-copy paths (RAYTPU_ZEROCOPY, default on): :func:`fetch_object`
+streams a pull straight into the local store — the receive region is
+created at final size from the meta, every chunk RPC writes its range
+directly into the shm mapping, and sealing publishes atomically (chunks
+never accumulate in a parts list). Senders serve chunk reads through a
+:class:`RangeReader` — a prefix-sum index over the wire segments built
+once per transfer, returning memoryview slices of the sender's own
+shm/heap buffers (or spill-file mapping) instead of a bytearray per
+chunk.
 
 Push path (reference: ``src/ray/object_manager/push_manager.h:30`` —
 eager producer-to-requester streaming with bounded in-flight chunks):
@@ -24,10 +37,13 @@ dying.
 
 from __future__ import annotations
 
+import bisect
+import mmap
 import threading
-from typing import List, Optional
+from typing import List, Optional, Union
 
 from raytpu.core.config import cfg
+from raytpu.core.ids import ObjectID
 from raytpu.cluster import constants as tuning
 from raytpu.runtime.serialization import SerializedValue
 from raytpu.util import errors
@@ -35,17 +51,133 @@ from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.resilience import Deadline
 
-_sem: Optional[threading.Semaphore] = None
-_sem_lock = threading.Lock()
+
+class ByteWindow:
+    """Bytes-based in-flight budget (the reference pull manager's
+    ``max_bytes_in_flight``). ``acquire(n)`` blocks until ``n`` more
+    payload bytes fit; a request larger than the whole budget is admitted
+    alone (never deadlocks a jumbo chunk), and ``release`` wakes all
+    waiters so small chunks can pack the window densely."""
+
+    def __init__(self, budget: int):
+        self.budget = max(1, int(budget))
+        self._used = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            while self._used > 0 and self._used + n > self.budget:
+                self._cv.wait()
+            self._used += n
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._used -= n
+            self._cv.notify_all()
+
+    def in_flight(self) -> int:
+        with self._cv:
+            return self._used
 
 
-def _semaphore() -> threading.Semaphore:
-    global _sem
-    with _sem_lock:
-        if _sem is None:
-            _sem = threading.Semaphore(
-                max(1, int(cfg.object_transfer_max_concurrency)))
-        return _sem
+_win: Optional[ByteWindow] = None
+_win_lock = threading.Lock()
+
+
+def _window() -> ByteWindow:
+    """Process-wide window shared by every concurrent transfer, both
+    directions — aggregate, not per-object, like the reference."""
+    global _win
+    with _win_lock:
+        if _win is None:
+            _win = ByteWindow(tuning.TRANSFER_WINDOW_BYTES)
+        return _win
+
+
+class RangeReader:
+    """Random-access reads over an object's wire layout
+    ``[4-byte header len][header][buffers…]`` without materializing it.
+
+    The segment list and its prefix-sum offset index are built ONCE (the
+    old ``read_range`` rebuilt and walked them per chunk — O(segments)
+    every call); each read is a bisect plus, in the overwhelmingly common
+    case of a range inside one segment, a zero-copy memoryview slice.
+    """
+
+    __slots__ = ("_segments", "_starts", "size", "_owner", "_mm")
+
+    def __init__(self, segments: List, owner=None, mm=None):
+        self._segments: List[memoryview] = []
+        for s in segments:
+            v = s if isinstance(s, memoryview) else memoryview(s)
+            if v.format != "B":
+                v = v.cast("B")
+            self._segments.append(v)
+        self._starts: List[int] = []
+        pos = 0
+        for v in self._segments:
+            self._starts.append(pos)
+            pos += v.nbytes
+        self.size = pos
+        self._owner = owner  # keeps the backing object (sv) alive
+        self._mm = mm  # spill-file mapping to close()
+
+    @classmethod
+    def for_value(cls, sv: SerializedValue) -> "RangeReader":
+        return cls(
+            [len(sv.header).to_bytes(4, "little"), sv.header, *sv.buffers],
+            owner=sv,
+        )
+
+    @classmethod
+    def for_file(cls, path: str) -> "RangeReader":
+        """Map a spill file (the file IS the wire layout) — chunk reads
+        become slices of the mapping, one open per transfer instead of an
+        open+seek+read per chunk."""
+        with open(path, "rb") as f:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        return cls([memoryview(mm)], mm=mm)
+
+    def read(self, offset: int, length: int) -> Union[memoryview, bytes]:
+        """Bytes of ``[offset, offset+length)`` clamped to the object —
+        a zero-copy memoryview when the range lives in one segment."""
+        end = min(offset + length, self.size)
+        if offset < 0 or offset >= end:
+            return b""
+        i = bisect.bisect_right(self._starts, offset) - 1
+        seg = self._segments[i]
+        seg_off = offset - self._starts[i]
+        want = end - offset
+        if seg_off + want <= seg.nbytes:
+            return seg[seg_off : seg_off + want]
+        out = bytearray(want)
+        pos = 0
+        while pos < want:
+            seg = self._segments[i]
+            seg_off = offset + pos - self._starts[i]
+            take = min(seg.nbytes - seg_off, want - pos)
+            out[pos : pos + take] = seg[seg_off : seg_off + take]
+            pos += take
+            i += 1
+        return bytes(out)
+
+    def close(self) -> None:
+        # Best-effort: a chunk slice handed to the codec may still be in
+        # flight — releasing under it raises, and the GC of the last
+        # slice frees the mapping anyway.
+        for v in self._segments:
+            try:
+                v.release()
+            except BufferError:
+                pass
+        self._segments = []
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except (BufferError, ValueError):
+                pass
+            self._mm = None
+        self._owner = None
 
 
 def wire_size(sv: SerializedValue) -> int:
@@ -54,27 +186,14 @@ def wire_size(sv: SerializedValue) -> int:
 
 
 def read_range(sv: SerializedValue, offset: int, length: int) -> bytes:
-    """Slice the flattened layout WITHOUT materializing the whole blob —
-    walks the [len][header][buffers...] segments."""
-    out = bytearray()
-    segments: List[memoryview] = [
-        memoryview(len(sv.header).to_bytes(4, "little")),
-        memoryview(sv.header),
-        *[memoryview(b) for b in sv.buffers],
-    ]
-    pos = 0
-    remaining = length
-    for seg in segments:
-        seg_len = len(seg)
-        if remaining <= 0:
-            break
-        if offset < pos + seg_len:
-            lo = max(0, offset - pos)
-            take = min(seg_len - lo, remaining)
-            out += seg[lo:lo + take]
-            remaining -= take
-        pos += seg_len
-    return bytes(out)
+    """Slice the flattened layout WITHOUT materializing the whole blob.
+    Legacy single-shot form — a sender serving many chunks should build
+    one :class:`RangeReader` and reuse it."""
+    return bytes(RangeReader.for_value(sv).read(offset, length))
+
+
+def _chunk_bytes() -> int:
+    return max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
 
 
 def fetch_blob(client, oid_hex: str, timeout: Optional[float] = None,
@@ -85,6 +204,9 @@ def fetch_blob(client, oid_hex: str, timeout: Optional[float] = None,
     peer no longer holds the object. ``timeout`` bounds each chunk RPC;
     ``deadline`` bounds the whole transfer (every chunk call checks and
     shrinks to the remaining budget).
+
+    Materializes the blob on the heap — callers that own a store should
+    prefer :func:`fetch_object`, which streams into final storage.
     """
     with tracing.span("object.transfer.pull") as attrs:
         if tracing.enabled():
@@ -102,7 +224,7 @@ def _fetch_blob_impl(client, oid_hex: str, timeout: Optional[float],
         return None
     if timeout is None:
         timeout = tuning.FETCH_TIMEOUT_S
-    chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
+    chunk = _chunk_bytes()
     meta = client.call("fetch_object_meta", oid_hex, timeout=timeout,
                        deadline=deadline)
     if meta is None:
@@ -111,21 +233,108 @@ def _fetch_blob_impl(client, oid_hex: str, timeout: Optional[float],
     if size <= chunk:
         return client.call("fetch_object", oid_hex, timeout=timeout,
                            deadline=deadline)
-    parts: List[bytes] = []
+    # One final-size buffer written in place — never a parts list joined
+    # at the end (that held the object twice at the worst moment).
+    buf = bytearray(size)
+    win = _window()
     off = 0
-    sem = _semaphore()
     while off < size:
         want = min(chunk, size - off)
-        with sem:
+        win.acquire(want)
+        try:
             piece = client.call("fetch_object_chunk", oid_hex, off, want,
                                 timeout=timeout, deadline=deadline)
+        finally:
+            win.release(want)
         if piece is None:
             return None  # holder dropped it mid-transfer; caller re-locates
-        parts.append(piece)
+        buf[off : off + len(piece)] = piece
         off += len(piece)
         if len(piece) < want:
             return None  # truncated: object changed under us
-    return b"".join(parts)
+    return bytes(buf)
+
+
+def fetch_object(client, oid_hex: str, store, timeout: Optional[float] = None,
+                 deadline: Optional[Deadline] = None) -> bool:
+    """Pull one object from a peer STRAIGHT INTO the local store.
+
+    The zero-copy receive path: the destination (shm region or heap
+    buffer) is created at final size from the peer's meta, concurrent
+    windowed chunk RPCs write their ranges directly into it, and sealing
+    publishes atomically. Returns True when the object is in the store.
+    A failed or interrupted transfer aborts the half-written region —
+    it is reclaimed, never sealed, and a retry starts clean.
+    """
+    with tracing.span("object.transfer.pull") as attrs:
+        if tracing.enabled():
+            attrs["oid"] = oid_hex
+            attrs["peer"] = getattr(client, "address", "")
+        return _fetch_object_impl(client, oid_hex, store, timeout, deadline)
+
+
+def _fetch_object_impl(client, oid_hex: str, store,
+                       timeout: Optional[float],
+                       deadline: Optional[Deadline]) -> bool:
+    if failpoint("transfer.fetch.pre") is DROP:
+        return False
+    if timeout is None:
+        timeout = tuning.FETCH_TIMEOUT_S
+    chunk = _chunk_bytes()
+    meta = client.call("fetch_object_meta", oid_hex, timeout=timeout,
+                       deadline=deadline)
+    if meta is None:
+        return False
+    size = int(meta["size"])
+    oid = ObjectID.from_hex(oid_hex)
+    if size <= chunk:
+        blob = client.call("fetch_object", oid_hex, timeout=timeout,
+                           deadline=deadline)
+        if blob is None:
+            return False
+        store.put(oid, SerializedValue.from_buffer(blob))
+        return True
+    rx = store.begin_receive(oid, size)
+    win = _window()
+    workers = max(1, min(8, int(cfg.object_transfer_max_concurrency)))
+    failure: List[BaseException] = []
+
+    def pull(off: int) -> bool:
+        want = min(chunk, size - off)
+        win.acquire(want)
+        try:
+            failpoint("transfer.fetch.chunk")
+            piece = client.call("fetch_object_chunk", oid_hex, off, want,
+                                timeout=timeout, deadline=deadline)
+            if piece is None or len(piece) != want:
+                return False  # vanished or truncated at the sender
+            rx.write(off, piece)
+            return True
+        finally:
+            win.release(want)
+
+    ok = True
+    from concurrent.futures import ThreadPoolExecutor
+
+    try:
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="raytpu-pull") as ex:
+            for fut in [ex.submit(pull, off)
+                        for off in range(0, size, chunk)]:
+                try:
+                    if not fut.result():
+                        ok = False
+                except BaseException as e:
+                    ok = False
+                    failure.append(e)
+        if ok:
+            rx.seal()
+            return True
+        return False
+    finally:
+        rx.abort()  # no-op after seal; reclaims the region otherwise
+        if failure:
+            raise failure[0]  # callers key breakers off the original error
 
 
 def push_blob(client, oid_hex: str, sv: SerializedValue,
@@ -153,11 +362,11 @@ def _push_blob_impl(client, oid_hex: str, sv: SerializedValue,
         return False  # push lost; receiver's pull fallback takes over
     if timeout is None:
         timeout = tuning.FETCH_TIMEOUT_S
-    chunk = max(64 * 1024, int(cfg.object_transfer_chunk_bytes))
+    chunk = _chunk_bytes()
     size = wire_size(sv)
     if size <= chunk:
-        client.call("put_object", oid_hex, sv.to_bytes(), timeout=timeout,
-                    deadline=deadline)
+        client.call("put_object", oid_hex, sv.to_bytes(),  # blob-ok: small object, single wire frame by definition
+                    timeout=timeout, deadline=deadline)
         return True
     if not client.call("push_object_begin", oid_hex, size, timeout=timeout,
                        deadline=deadline):
@@ -165,27 +374,33 @@ def _push_blob_impl(client, oid_hex: str, sv: SerializedValue,
     window = max(1, min(8, int(cfg.object_transfer_max_concurrency)))
     from concurrent.futures import ThreadPoolExecutor
 
-    sem = _semaphore()  # same process-wide in-flight cap as the pull path
+    reader = RangeReader.for_value(sv)  # one index for the whole transfer
+    win = _window()  # process-wide in-flight BYTES across all transfers
 
     def send(off: int) -> bool:
         want = min(chunk, size - off)
-        # read_range runs in the worker thread under the shared
-        # semaphore: aggregate in-flight chunks across ALL transfers
-        # (push and pull) stay bounded.
-        with sem:
+        win.acquire(want)
+        try:
+            # A memoryview slice of the sender's own storage rides into
+            # the codec — no per-chunk bytearray.
             return client.call("push_object_chunk", oid_hex, off,
-                               read_range(sv, off, want),
+                               reader.read(off, want),
                                timeout=timeout, deadline=deadline) is True
+        finally:
+            win.release(want)
 
     ok = True
-    with ThreadPoolExecutor(max_workers=window,
-                            thread_name_prefix="raytpu-push") as ex:
-        for fut in [ex.submit(send, off) for off in range(0, size, chunk)]:
-            try:
-                if not fut.result():
+    try:
+        with ThreadPoolExecutor(max_workers=window,
+                                thread_name_prefix="raytpu-push") as ex:
+            for fut in [ex.submit(send, off) for off in range(0, size, chunk)]:
+                try:
+                    if not fut.result():
+                        ok = False
+                except Exception:
                     ok = False
-            except Exception:
-                ok = False
+    finally:
+        reader.close()
     if not ok:
         try:
             client.notify("push_object_abort", oid_hex)

@@ -257,12 +257,33 @@ class _WorkerHost:
     def get_serialized(self, oid: ObjectID,
                        timeout: Optional[float] = None) -> SerializedValue:
         """Local/shm store first; miss → pull from the daemon."""
+        from raytpu.runtime.serialization import ZEROCOPY
+
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = tuning.OBJECT_POLL_MIN_S
         while True:
             sv = self.store.try_get(oid)
             if sv is not None:
                 return sv
+            if ZEROCOPY:
+                # Stream the daemon's copy straight into the SHARED shm
+                # arena (both processes map it): chunks land in the final
+                # region, and the retry try_get returns a pinned view —
+                # the value never exists as a worker-heap blob. A create
+                # collision (daemon landed it first) just falls back to
+                # the heap receive inside begin_receive.
+                try:
+                    from raytpu.cluster.transfer import (
+                        fetch_object as _stream_fetch,
+                    )
+
+                    if _stream_fetch(self.node, oid.hex(), self.store,
+                                     timeout=tuning.WORKER_FETCH_TIMEOUT_S):
+                        continue
+                except Exception as e:
+                    errors.swallow("worker.stream_fetch", e)
+            # Whole-blob fallback; a daemon-side miss also kicks the
+            # daemon's bounded cross-node pull.
             blob = self.node.call("fetch_object", oid.hex(),
                                   timeout=tuning.WORKER_FETCH_TIMEOUT_S)
             if blob is not None:

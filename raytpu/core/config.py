@@ -162,6 +162,13 @@ declare_env("RAYTPU_LOCALITY_EAGER_PUSH",
 declare_env("RAYTPU_OBJ_REPORT_BUFFER_MAX",
             "node-side buffered object-location deltas cap")
 
+# Zero-copy data plane (runtime/serialization.py, runtime/object_store.py,
+# cluster/transfer.py): serialize-into-shm puts, pinned shared-memory
+# views on get, streaming receives into final storage.
+declare_env("RAYTPU_ZEROCOPY",
+            "zero-copy data plane: pinned shm views + serialize-into-place "
+            "(bool, default on; off is byte-identical to the legacy layout)")
+
 # Kernels (ops/flash_attention.py, ops/paged_attention.py).
 declare_env("RAYTPU_FLASH_DOT", "force the dot-product flash-attention path (bool)")
 declare_env("RAYTPU_FLASH_BLOCK_Q", "flash-attention query tile rows")

@@ -17,7 +17,9 @@ from typing import Dict, List, Optional
 from raytpu.core.config import cfg
 from raytpu.core.errors import GetTimeoutError
 from raytpu.core.ids import ObjectID
-from raytpu.runtime.serialization import SerializedValue
+from raytpu.runtime.serialization import (
+    ZEROCOPY, SerializedPlan, SerializedValue,
+)
 from raytpu.util.failpoints import failpoint
 
 
@@ -62,11 +64,19 @@ class MemoryStore:
                register: bool = True) -> Optional[str]:
         """Write the wire bytes to disk; returns the path (or None on I/O
         failure). ``register=False`` lets the evictor defer the _spilled
-        entry until it has re-checked the object wasn't deleted meanwhile."""
+        entry until it has re-checked the object wasn't deleted meanwhile.
+
+        Segments stream sequentially — [len][header][buffers…] — never a
+        flattened to_bytes() blob: spilling happens exactly when memory is
+        scarce, and doubling the peak right then is how an evictor OOMs
+        the process it is trying to save."""
         try:
             path = self._spill_path(oid)
             with open(path, "wb") as f:
-                f.write(value.to_bytes())
+                f.write(len(value.header).to_bytes(4, "little"))
+                f.write(value.header)
+                for b in value.buffers:
+                    f.write(b.cast("B") if b.format != "B" else b)
         except OSError:
             return None
         if register:
@@ -123,13 +133,20 @@ class MemoryStore:
                     except OSError:
                         pass
 
-    def put(self, oid: ObjectID, value: SerializedValue) -> None:
+    def put(self, oid: ObjectID, value) -> None:
+        """Store a SerializedValue — or a SerializedPlan, in which case a
+        large object is serialized INTO the shm mapping (create at exact
+        wire size, write header+buffers in place, seal) with no
+        intermediate flattened blob."""
         failpoint("object.put.pre")
+        plan = value if isinstance(value, SerializedPlan) else None
+        if plan is not None:
+            value = plan.sv
         big = value.total_bytes() > cfg.max_direct_call_object_size
         stored = False
         if self._shm is not None and big:
             try:
-                self._shm.put(oid, value)
+                self._shm.put(oid, plan if plan is not None else value)
                 with self._cv:
                     self._cv.notify_all()
                 stored = True
@@ -157,6 +174,14 @@ class MemoryStore:
         if self.on_put is not None:
             self.on_put(oid)
 
+    def begin_receive(self, oid: ObjectID, size: int) -> "_Receive":
+        """Open a streaming receive destination of known wire size: each
+        chunk writes its range directly into the final location (the shm
+        mapping when the object is large and the arena has room, a heap
+        bytearray otherwise). ``seal()`` publishes atomically; ``abort()``
+        reclaims a half-written region — nothing is visible in between."""
+        return _Receive(self, oid, size)
+
     def contains(self, oid: ObjectID) -> bool:
         with self._cv:
             if oid in self._objects or oid in self._spilled:
@@ -164,34 +189,36 @@ class MemoryStore:
         return self._shm is not None and self._shm.contains(oid)
 
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> SerializedValue:
+        # One flat retry loop (an unreadable spill file loops back to
+        # waiting, same deadline) — the old tail-recursive retry could, in
+        # principle, recurse once per raced delete until the stack went.
         deadline = None if timeout is None else time.monotonic() + timeout
-        spilled = False
-        with self._cv:
-            while True:
-                sv = self._objects.get(oid)
+        while True:
+            spilled = False
+            with self._cv:
+                while True:
+                    sv = self._objects.get(oid)
+                    if sv is not None:
+                        return sv
+                    if oid in self._spilled:
+                        spilled = True
+                        break  # restore outside the lock
+                    if self._shm is not None and self._shm.contains(oid):
+                        break  # fetch outside the lock
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise GetTimeoutError(f"object {oid.hex()} not ready")
+                    self._cv.wait(timeout=remaining if remaining is None else min(remaining, 0.5))
+            if spilled:
+                sv = self._restore(oid)
                 if sv is not None:
                     return sv
-                if oid in self._spilled:
-                    spilled = True
-                    break  # restore outside the lock
-                if self._shm is not None and self._shm.contains(oid):
-                    break  # fetch outside the lock
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    raise GetTimeoutError(f"object {oid.hex()} not ready")
-                self._cv.wait(timeout=remaining if remaining is None else min(remaining, 0.5))
-        if spilled:
-            sv = self._restore(oid)
-            if sv is not None:
-                return sv
-            # Unreadable file (raced with delete / lost disk): drop the
-            # stale entry so the retry can't loop on the same branch.
-            with self._cv:
-                self._spilled.pop(oid, None)
-            remaining = (None if deadline is None
-                         else max(0.0, deadline - time.monotonic()))
-            return self.get(oid, timeout=remaining)
-        return self._shm.get(oid)
+                # Unreadable file (raced with delete / lost disk): drop the
+                # stale entry so the retry can't loop on the same branch.
+                with self._cv:
+                    self._spilled.pop(oid, None)
+                continue  # re-enter the wait with the original deadline
+            return self._shm.get(oid)
 
     def try_get(self, oid: ObjectID) -> Optional[SerializedValue]:
         with self._cv:
@@ -228,6 +255,13 @@ class MemoryStore:
                     self._shm.delete(oid)
                 except Exception:
                     pass
+
+    def spilled_path(self, oid: ObjectID) -> Optional[str]:
+        """Path of a spilled object's wire file (the file IS the wire
+        layout) — lets the transfer sender mmap it and serve chunk reads
+        as slices instead of a read() per chunk."""
+        with self._cv:
+            return self._spilled.get(oid)
 
     def spilled_wire_size(self, oid: ObjectID) -> Optional[int]:
         """Wire-layout size of a spilled object, without reading it (the
@@ -290,3 +324,80 @@ class MemoryStore:
     def used_bytes(self) -> int:
         with self._cv:
             return sum(v.total_bytes() for v in self._objects.values())
+
+
+class _Receive:
+    """A streaming receive in flight (see MemoryStore.begin_receive).
+
+    Lifecycle mirrors the shm create→seal protocol: the destination is
+    allocated at final size up front, chunk writes land in place, and only
+    ``seal()`` publishes. ``abort()`` (idempotent, also safe after seal)
+    returns a half-written shm region to the free list — a receiver dying
+    mid-transfer leaks nothing and the key is immediately creatable again.
+    """
+
+    __slots__ = ("_store", "oid", "size", "_dst", "_buf", "_done", "in_shm")
+
+    def __init__(self, store: MemoryStore, oid: ObjectID, size: int):
+        self._store = store
+        self.oid = oid
+        self.size = size
+        self._dst: Optional[memoryview] = None
+        self._buf: Optional[bytearray] = None
+        self._done = False
+        shm = store._shm
+        if (ZEROCOPY and shm is not None
+                and size > cfg.max_direct_call_object_size):
+            try:
+                self._dst = shm.create(oid, size)
+            except Exception:
+                self._dst = None  # full / key exists: heap fallback
+        if self._dst is None:
+            self._buf = bytearray(size)
+        self.in_shm = self._dst is not None
+
+    def write(self, offset: int, data) -> int:
+        """Write one chunk's range straight into the destination."""
+        n = len(data)
+        if offset < 0 or offset + n > self.size:
+            raise ValueError(
+                f"chunk [{offset}, {offset + n}) outside object of "
+                f"{self.size} bytes")
+        if self._dst is not None:
+            self._dst[offset : offset + n] = data
+        else:
+            self._buf[offset : offset + n] = data
+        return n
+
+    def seal(self) -> None:
+        """Publish atomically (store waiters wake, on_put fires)."""
+        if self._done:
+            return
+        self._done = True
+        store = self._store
+        if self._dst is not None:
+            self._dst.release()
+            self._dst = None
+            store._shm.seal(self.oid)
+            with store._cv:
+                store._cv.notify_all()
+            if store.on_put is not None:
+                store.on_put(self.oid)
+        else:
+            buf = self._buf
+            self._buf = None
+            store.put(self.oid, SerializedValue.from_buffer(buf))
+
+    def abort(self) -> None:
+        """Reclaim the destination; the object was never visible."""
+        if self._done:
+            return
+        self._done = True
+        if self._dst is not None:
+            self._dst.release()
+            self._dst = None
+            try:
+                self._store._shm.abort(self.oid)
+            except Exception:
+                pass  # arena already closed (shutdown)
+        self._buf = None

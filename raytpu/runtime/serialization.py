@@ -11,11 +11,25 @@ Wire format: msgpack of
   {"t": kind, "d": inline-data, "b": [buffer descriptors], "r": [refs]}
 followed by concatenated raw buffers. Numpy arrays (and jax arrays on host)
 ride as raw buffers — deserialization views them without copy.
+
+Two-phase API for serialize-into-place (the zero-copy put path):
+``measure(value)`` does the full dispatch once — header built, zero-copy
+buffer views collected, exact wire size known — and ``serialize_into``
+writes ``[4-byte header len][header][buffers]`` straight into a
+caller-provided destination (a shm mapping view). The only host-visible
+copy of a put is that single write; ``copy_stats`` counts it so the bench
+can assert "exactly one".
+
+``RAYTPU_ZEROCOPY`` (default on) gates the behavioral deltas: the
+jax-array dlpack host view on serialize, and pinned shared-memory views on
+deserialize. With it off, every path is byte-identical to the legacy
+wire/store layout and deserialize copies out of shared memory.
 """
 
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import threading
 from typing import Any, List, Tuple
@@ -23,6 +37,26 @@ from typing import Any, List, Tuple
 import cloudpickle
 import msgpack
 import numpy as np
+
+# Master switch for the zero-copy data plane (declare_env'd in
+# core/config.py). Layout is identical either way — the flag only governs
+# whether values VIEW shared memory (pinned) or copy out of it, and
+# whether jax arrays reach the wire via a dlpack host view or np.asarray.
+ZEROCOPY = os.environ.get("RAYTPU_ZEROCOPY", "1").lower() not in (
+    "0", "false", "no")
+
+# Host-visible copy accounting for the put path (bench_dataplane asserts a
+# 100 MB jax-array put is exactly one copy). ``copies`` counts memcpy
+# passes; ``copy_bytes`` their volume; ``materialize_bytes`` device→host
+# materializations that a zero-copy view avoided taking.
+copy_stats = {"copies": 0, "copy_bytes": 0, "materialize_bytes": 0}
+
+
+def reset_copy_stats() -> None:
+    copy_stats["copies"] = 0
+    copy_stats["copy_bytes"] = 0
+    copy_stats["materialize_bytes"] = 0
+
 
 # Active ref-capture context: while a serialize() call is pickling, every
 # ObjectRef.__reduce__ appends its binary here — exact containment tracking
@@ -43,13 +77,20 @@ _KIND_EXCEPTION = 3  # pickled exception
 
 
 class SerializedValue:
-    """A serialized object: a metadata header plus zero-copy buffers."""
+    """A serialized object: a metadata header plus zero-copy buffers.
 
-    __slots__ = ("header", "buffers", "__weakref__")
+    ``pin`` is set only on shared-memory-backed values (see
+    ``shm_store.SharedMemoryStore.get``): calling ``pin(obj)`` takes one
+    more store refcount, released when ``obj`` is garbage collected — how
+    deserialized views outlive this SerializedValue.
+    """
+
+    __slots__ = ("header", "buffers", "pin", "__weakref__")
 
     def __init__(self, header: bytes, buffers: List[memoryview]):
         self.header = header
         self.buffers = buffers
+        self.pin = None
 
     def total_bytes(self) -> int:
         return len(self.header) + sum(b.nbytes for b in self.buffers)
@@ -71,6 +112,53 @@ class SerializedValue:
         return cls(header, [mv[4 + hlen :]])
 
 
+class SerializedPlan:
+    """``measure()`` output: the serialized form (header + zero-copy buffer
+    views) plus its exact wire size — everything ``serialize_into`` needs
+    to write the object into a pre-allocated destination in one pass."""
+
+    __slots__ = ("sv", "size")
+
+    def __init__(self, sv: SerializedValue, size: int):
+        self.sv = sv
+        self.size = size
+
+
+def wire_size_of(value) -> int:
+    """Exact ``[4][header][buffers]`` wire size of a SerializedValue or
+    SerializedPlan."""
+    if isinstance(value, SerializedPlan):
+        return value.size
+    return 4 + len(value.header) + sum(b.nbytes for b in value.buffers)
+
+
+def measure(value: Any) -> SerializedPlan:
+    """Phase one of serialize-into-place: dispatch once, build the header,
+    collect zero-copy buffer views, and return the exact wire size. No
+    flattened blob exists at any point."""
+    sv = serialize(value)
+    return SerializedPlan(sv, wire_size_of(sv))
+
+
+def serialize_into(value, dst: memoryview) -> int:
+    """Phase two: write the wire layout straight into ``dst`` (typically a
+    shm mapping view sized by ``measure``). Returns bytes written. This is
+    the put path's single host-visible copy."""
+    sv = value.sv if isinstance(value, SerializedPlan) else value
+    hl = len(sv.header)
+    dst[:4] = hl.to_bytes(4, "little")
+    dst[4 : 4 + hl] = sv.header
+    pos = 4 + hl
+    for b in sv.buffers:
+        bb = b.cast("B") if b.format != "B" else b
+        n = bb.nbytes
+        dst[pos : pos + n] = bb
+        pos += n
+    copy_stats["copies"] += 1
+    copy_stats["copy_bytes"] += pos
+    return pos
+
+
 def _pack_ndarray(value: np.ndarray) -> Tuple[dict, List[memoryview]]:
     if not value.flags.c_contiguous:
         value = np.ascontiguousarray(value)
@@ -78,6 +166,25 @@ def _pack_ndarray(value: np.ndarray) -> Tuple[dict, List[memoryview]]:
         {"dtype": value.dtype.str, "shape": list(value.shape)},
         [memoryview(value).cast("B")],
     )
+
+
+def _jax_host_view(value: Any) -> np.ndarray:
+    """Host ndarray for a jax array with as few copies as the backend
+    allows: on CPU backends dlpack / __array_interface__ alias the device
+    buffer (zero copies — the shm write is then the only one); elsewhere
+    np.asarray performs the one device→host materialization."""
+    if ZEROCOPY:
+        try:
+            arr = np.from_dlpack(value)
+            if arr.flags.c_contiguous:
+                return arr
+        except Exception:
+            pass
+    arr = np.asarray(value)
+    copy_stats["copies"] += 1
+    copy_stats["copy_bytes"] += arr.nbytes
+    copy_stats["materialize_bytes"] += arr.nbytes
+    return arr
 
 
 def serialize(value: Any) -> SerializedValue:
@@ -89,10 +196,11 @@ def serialize(value: Any) -> SerializedValue:
         header = msgpack.packb({"t": _KIND_NUMPY, "d": meta, "r": []})
         return SerializedValue(header, buffers)
 
-    # jax arrays → host numpy (single device copy), keep zero-copy onward.
+    # jax arrays → host numpy; with ZEROCOPY a CPU-backed array serializes
+    # straight from the device buffer (no host materialization at all).
     if type(value).__module__.startswith("jaxlib") or type(value).__name__ == "ArrayImpl":
         try:
-            arr = np.asarray(value)
+            arr = _jax_host_view(value)
             meta, buffers = _pack_ndarray(arr)
             header = msgpack.packb({"t": _KIND_NUMPY, "d": meta, "r": []})
             return SerializedValue(header, buffers)
@@ -144,19 +252,43 @@ def serialize(value: Any) -> SerializedValue:
     return SerializedValue(header, [m if m.contiguous else memoryview(bytes(m)) for m in raw])
 
 
-def deserialize(sv: SerializedValue) -> Any:
+def _pinned_view(sv: SerializedValue, mv: memoryview) -> np.ndarray:
+    """Wrap a shm-backed buffer slice as a read-only uint8 array carrying
+    its own store pin — the array (and anything reconstructed on top of
+    it) stays valid for its whole lifetime, across producer delete/evict."""
+    arr = np.frombuffer(mv, dtype=np.uint8)
+    arr.flags.writeable = False
+    sv.pin(arr)
+    return arr
+
+
+def deserialize(sv: SerializedValue, copy: bool = False) -> Any:
+    """Reconstruct a value. For shared-memory-backed values the default is
+    a pinned zero-copy READ-ONLY view (µs for a 100 MB array); pass
+    ``copy=True`` to receive a private writable copy instead (the opt-out
+    for callers that mutate)."""
     meta = msgpack.unpackb(sv.header)
     kind = meta["t"]
+    pinned = getattr(sv, "pin", None) is not None
     if kind == _KIND_MSGPACK:
         return meta["d"]
     if kind == _KIND_NUMPY:
         d = meta["d"]
         buf = sv.buffers[0]
         n = int(np.prod(d["shape"])) * np.dtype(d["dtype"]).itemsize
-        return np.frombuffer(buf[:n], dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+        if pinned and (copy or not ZEROCOPY):
+            # Legacy/opt-out: a private heap copy, decoupled from the arena.
+            return np.frombuffer(
+                buf[:n], dtype=np.dtype(d["dtype"])
+            ).reshape(d["shape"]).copy()
+        arr = np.frombuffer(buf[:n], dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+        if pinned:
+            arr.flags.writeable = False
+            sv.pin(arr)
+        return arr
     # pickle kinds: reconstruct out-of-band buffer list by slicing.
     lens = meta.get("bl", [])
-    bufs: List[memoryview] = []
+    bufs: List = []
     if len(sv.buffers) == len(lens):
         bufs = list(sv.buffers)
     elif sv.buffers:
@@ -164,11 +296,17 @@ def deserialize(sv: SerializedValue) -> Any:
         for ln in lens:
             bufs.append(mv[off : off + ln])
             off += ln
+    if pinned and bufs:
+        if copy or not ZEROCOPY:
+            bufs = [bytes(b) for b in bufs]
+        else:
+            # Each out-of-band buffer rides into pickle as a pinned
+            # read-only array; arrays reconstructed from it keep it (and
+            # hence the store pin) alive via their .base chain.
+            bufs = [_pinned_view(sv, memoryview(b)) for b in bufs]
     return pickle.loads(meta["d"], buffers=bufs)
 
 
 def contained_refs(sv: SerializedValue) -> List[bytes]:
     """ObjectRef binaries embedded in this value (for borrower tracking)."""
     return msgpack.unpackb(sv.header).get("r", [])
-
-

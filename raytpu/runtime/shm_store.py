@@ -5,7 +5,16 @@ client.cc``) — but our store is a passive shm arena (see
 ``src/store/shm_store.cc`` header comment), so the "client" is just the
 mapping plus a handful of O(1) calls. Reads are zero-copy: ``get`` returns
 a SerializedValue whose buffer is a memoryview into the mapping, pinned by
-the store refcount until the view is garbage collected.
+the store refcount until the view is garbage collected; ``sv.pin`` lets the
+deserializer extend that pin to the arrays it hands out (see
+``serialization.deserialize``), so a view outlives even a producer-side
+delete (the C side defers the free until the last release).
+
+Writes are serialize-into-place: ``create(oid, size)`` returns a memoryview
+of the final-size region, the caller writes the wire bytes directly into
+the mapping (``serialization.serialize_into``), and ``seal`` publishes
+atomically. ``abort`` reclaims a created-but-unsealed region when a
+receive/transfer dies half-way — the region was never visible.
 """
 
 from __future__ import annotations
@@ -19,7 +28,9 @@ from typing import Optional
 
 from raytpu.core.errors import ObjectStoreFullError
 from raytpu.core.ids import ObjectID
-from raytpu.runtime.serialization import SerializedValue
+from raytpu.runtime.serialization import (
+    SerializedValue, serialize_into, wire_size_of,
+)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "_native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libshmstore.so")
@@ -59,10 +70,17 @@ def _load():
         lib.shm_store_create.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
         lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shm_store_get.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.shm_store_get2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
         lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_release_gen.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
         lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shm_store_delete.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
@@ -118,38 +136,78 @@ class SharedMemoryStore:
 
     # -- object plane ---------------------------------------------------------
 
-    def put(self, oid: ObjectID, value: SerializedValue) -> None:
-        blob_len = 4 + len(value.header) + sum(b.nbytes for b in value.buffers)
-        off = self._lib.shm_store_create(self._handle, oid.binary(), blob_len)
+    def create(self, oid: ObjectID, size: int) -> memoryview:
+        """Allocate a final-size region for in-place writes; returns the
+        writable mapping view. Nothing is visible until :meth:`seal`."""
+        off = self._lib.shm_store_create(self._handle, oid.binary(), size)
         if off < 0:
             raise ObjectStoreFullError(
-                f"shm store cannot fit object of {blob_len} bytes "
+                f"shm store cannot fit object of {size} bytes "
                 f"(used {self.used_bytes()}/{self.capacity()})"
             )
-        dst = self._mv[off : off + blob_len]
-        hl = len(value.header)
-        dst[:4] = hl.to_bytes(4, "little")
-        dst[4 : 4 + hl] = value.header
-        pos = 4 + hl
-        for b in value.buffers:
-            dst[pos : pos + b.nbytes] = b.cast("B") if b.format != "B" else b
-            pos += b.nbytes
+        return self._mv[off : off + size]
+
+    def seal(self, oid: ObjectID) -> None:
+        """Publish a created region atomically (create→write→seal)."""
         if self._lib.shm_store_seal(self._handle, oid.binary()) != 0:
-            raise ObjectStoreFullError("seal failed")
+            raise ObjectStoreFullError(f"seal failed for {oid.hex()}")
+
+    def abort(self, oid: ObjectID) -> bool:
+        """Reclaim a created-but-unsealed region (failed receive). The
+        region was never visible; its bytes return to the free list."""
+        return self._lib.shm_store_abort(self._handle, oid.binary()) == 0
+
+    def put(self, oid: ObjectID, value) -> None:
+        """Serialize into place: allocate the exact wire size, write
+        ``[4-byte header len][header][buffers]`` straight into the mapping,
+        seal. ``value`` is a SerializedValue or SerializedPlan — no
+        intermediate flattened blob either way."""
+        blob_len = wire_size_of(value)
+        dst = self.create(oid, blob_len)
+        try:
+            serialize_into(value, dst)
+        except BaseException:
+            dst.release()
+            self.abort(oid)
+            raise
+        dst.release()
+        self.seal(oid)
 
     def get(self, oid: ObjectID) -> SerializedValue:
         off = ctypes.c_int64()
         size = ctypes.c_uint64()
-        rc = self._lib.shm_store_get(
-            self._handle, oid.binary(), ctypes.byref(off), ctypes.byref(size)
+        gen = ctypes.c_uint64()
+        rc = self._lib.shm_store_get2(
+            self._handle, oid.binary(), ctypes.byref(off), ctypes.byref(size),
+            ctypes.byref(gen),
         )
         if rc != 0:
             raise KeyError(f"object {oid.hex()} not in shm store")
         view = self._mv[off.value : off.value + size.value]
         sv = SerializedValue.from_buffer(view)
-        # Keep the object pinned while any deserialized view is alive.
-        lib, handle, key = self._lib, self._handle, oid.binary()
-        weakref.finalize(sv, _release, lib, handle, key)
+        # Keep the object pinned while this SerializedValue is alive; the
+        # release names the generation it pinned, so a stale finalize can
+        # never unpin a successor object reusing the key. Releases go
+        # through a weakref to this store so finalizers firing after
+        # close() (interpreter shutdown with live views) are no-ops
+        # instead of calls on a freed handle.
+        store_ref = weakref.ref(self)
+        key = oid.binary()
+        weakref.finalize(sv, _release, store_ref, key, gen.value)
+
+        def _pin(obj) -> None:
+            """Extend the pin to ``obj`` (e.g. a deserialized array view):
+            takes one more store ref, released when ``obj`` dies."""
+            st = store_ref()
+            if st is None or st._closed:
+                raise KeyError(f"shm store closed; cannot pin {oid.hex()}")
+            o2, s2, g2 = ctypes.c_int64(), ctypes.c_uint64(), ctypes.c_uint64()
+            if st._lib.shm_store_get2(st._handle, key, ctypes.byref(o2),
+                                      ctypes.byref(s2), ctypes.byref(g2)) != 0:
+                raise KeyError(f"object {oid.hex()} vanished from shm store")
+            weakref.finalize(obj, _release, store_ref, key, g2.value)
+
+        sv.pin = _pin
         return sv
 
     def contains(self, oid: ObjectID) -> bool:
@@ -193,9 +251,12 @@ class SharedMemoryStore:
             pass
 
 
-def _release(lib, handle, key: bytes) -> None:
+def _release(store_ref, key: bytes, gen: int) -> None:
     try:
-        lib.shm_store_release(handle, key)
+        st = store_ref()
+        if st is None or st._closed:
+            return
+        st._lib.shm_store_release_gen(st._handle, key, gen)
     except BaseException:
         pass
 

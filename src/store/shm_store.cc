@@ -36,6 +36,11 @@ enum SlotState : uint32_t {
   kCreated = 1,
   kSealed = 2,
   kTombstone = 3,
+  // Deleted-while-pinned: invisible to lookups (get/contains/create see
+  // through it), bytes freed on the LAST release. Plasma's deferred
+  // deletion — a zero-copy reader must never have its mapping recycled
+  // under it because the owner freed the object first.
+  kDoomed = 4,
 };
 
 struct Slot {
@@ -45,6 +50,11 @@ struct Slot {
   uint32_t state;
   uint32_t refcount;
   uint64_t last_access;  // lru clock value
+  // Monotonic creation stamp. Release is addressed by (key, gen), not key
+  // alone: after doom + re-create of the same key (possibly at the same
+  // offset), a stale reader's release must hit ITS generation, never
+  // unpin the successor.
+  uint64_t gen;
 };
 
 // Free block header lives inside the data region at the block's offset.
@@ -111,9 +121,24 @@ Slot* find_slot(Store* s, const uint8_t* key, bool for_insert) {
       if (first_tomb == nullptr) first_tomb = slot;
       continue;
     }
+    if (slot->state == kDoomed) continue;  // invisible: freed on last release
     if (memcmp(slot->key, key, kKeySize) == 0) return slot;
   }
   return for_insert ? first_tomb : nullptr;
+}
+
+// Locate a specific generation of a key — doomed slots included. Only the
+// release path needs this (a pin always names the generation it took).
+Slot* find_gen(Store* s, const uint8_t* key, uint64_t gen) {
+  uint64_t mask = s->hdr->table_slots - 1;
+  uint64_t idx = hash_key(key) & mask;
+  for (uint64_t probe = 0; probe <= mask; probe++, idx = (idx + 1) & mask) {
+    Slot* slot = &s->table[idx];
+    if (slot->state == kEmpty) return nullptr;
+    if (slot->state == kTombstone) continue;
+    if (slot->gen == gen && memcmp(slot->key, key, kKeySize) == 0) return slot;
+  }
+  return nullptr;
 }
 
 // --- allocator: address-ordered first-fit free list with coalescing --------
@@ -351,9 +376,31 @@ int64_t shm_store_create(void* handle, const uint8_t* key, uint64_t size) {
   slot->state = kCreated;
   slot->refcount = 1;  // creator holds a pin until seal/abort
   slot->last_access = s->hdr->lru_clock++;
+  slot->gen = s->hdr->lru_clock++;
   s->hdr->num_objects++;
   unlock(s);
   return static_cast<int64_t>(s->hdr->data_offset + off);
+}
+
+// Discard a created-but-unsealed object (creator gave up: failed receive,
+// aborted transfer). The region returns to the free list; the key becomes
+// creatable again. No effect on sealed objects. Partial-write audit: a
+// kCreated region is never visible to get/contains/evict, so a half-written
+// buffer can only ever be reclaimed here or published by seal — there is no
+// path that reads it.
+int shm_store_abort(void* handle, const uint8_t* key) {
+  Store* s = static_cast<Store*>(handle);
+  lock(s);
+  Slot* slot = find_slot(s, key, false);
+  if (slot == nullptr || slot->state != kCreated) {
+    unlock(s);
+    return -1;
+  }
+  free_block(s, slot->offset, slot->size);
+  slot->state = kTombstone;
+  s->hdr->num_objects--;
+  unlock(s);
+  return 0;
 }
 
 int shm_store_seal(void* handle, const uint8_t* key) {
@@ -388,6 +435,26 @@ int shm_store_get(void* handle, const uint8_t* key, int64_t* offset,
   return 0;
 }
 
+// Pin + locate, returning the slot generation as well — the release token
+// for zero-copy readers (see Slot::gen).
+int shm_store_get2(void* handle, const uint8_t* key, int64_t* offset,
+                   uint64_t* size, uint64_t* gen) {
+  Store* s = static_cast<Store*>(handle);
+  lock(s);
+  Slot* slot = find_slot(s, key, false);
+  if (slot == nullptr || slot->state != kSealed) {
+    unlock(s);
+    return -1;
+  }
+  slot->refcount++;
+  slot->last_access = s->hdr->lru_clock++;
+  *offset = static_cast<int64_t>(s->hdr->data_offset + slot->offset);
+  *size = slot->size;
+  *gen = slot->gen;
+  unlock(s);
+  return 0;
+}
+
 int shm_store_release(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   lock(s);
@@ -401,6 +468,26 @@ int shm_store_release(void* handle, const uint8_t* key) {
   return 0;
 }
 
+// Generation-addressed unpin. Drops the bytes of a doomed object on its
+// last release; a stale release (generation long gone) is a no-op, never a
+// mispin of the key's successor.
+int shm_store_release_gen(void* handle, const uint8_t* key, uint64_t gen) {
+  Store* s = static_cast<Store*>(handle);
+  lock(s);
+  Slot* slot = find_gen(s, key, gen);
+  if (slot == nullptr || slot->refcount == 0) {
+    unlock(s);
+    return -1;
+  }
+  slot->refcount--;
+  if (slot->refcount == 0 && slot->state == kDoomed) {
+    free_block(s, slot->offset, slot->size);
+    slot->state = kTombstone;  // num_objects already dropped at doom time
+  }
+  unlock(s);
+  return 0;
+}
+
 int shm_store_contains(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   lock(s);
@@ -410,7 +497,11 @@ int shm_store_contains(void* handle, const uint8_t* key) {
   return found;
 }
 
-// Delete a sealed, unpinned object (refcount must be 0 unless force).
+// Delete an object. A pinned object (live zero-copy readers) is DOOMED
+// instead of freed: it vanishes from lookups immediately, but its bytes
+// survive until the last shm_store_release_gen — the reader's view stays
+// valid across the producer's delete (churn safety). `force` frees
+// immediately regardless of pins (shutdown path).
 int shm_store_delete(void* handle, const uint8_t* key, int force) {
   Store* s = static_cast<Store*>(handle);
   lock(s);
@@ -420,8 +511,14 @@ int shm_store_delete(void* handle, const uint8_t* key, int force) {
     return -1;
   }
   if (slot->refcount > 0 && !force) {
+    if (slot->state == kCreated) {
+      unlock(s);
+      return -2;  // mid-create: the creator's pin; abort() is the tool
+    }
+    slot->state = kDoomed;
+    s->hdr->num_objects--;
     unlock(s);
-    return -2;  // pinned
+    return 0;  // deferred: bytes freed on last release
   }
   free_block(s, slot->offset, slot->size);
   slot->state = kTombstone;

@@ -538,6 +538,57 @@ class TestSchedulerPurity:  # RTP013
         assert res.findings == []
 
 
+class TestBlobMaterialization:  # RTP014
+    def test_planted_to_bytes(self):
+        findings = run_rule_on_source(_rule("RTP014"), _src("""
+            def _h_fetch_object(self, peer, oid_hex):
+                sv = self.store.try_get(oid_hex)
+                return sv.to_bytes()
+        """), rel="raytpu/cluster/transfer.py")
+        assert len(findings) == 1
+        assert ".to_bytes()" in findings[0].message
+
+    def test_planted_bytes_join_and_dumps(self):
+        findings = run_rule_on_source(_rule("RTP014"), _src("""
+            import pickle
+
+            def assemble(parts, value):
+                blob = b"".join(parts)
+                alt = bytes().join(parts)
+                payload = pickle.dumps(value)
+                return blob, alt, payload
+        """), rel="raytpu/runtime/object_store.py")
+        assert len(findings) == 3
+        assert "join" in findings[0].message
+        assert "join" in findings[1].message
+        assert "pickle.dumps" in findings[2].message
+
+    def test_wire_framing_to_bytes_not_flagged(self):
+        # int.to_bytes(4, "little") IS the segment framing, not a flatten.
+        assert run_rule_on_source(_rule("RTP014"), _src("""
+            def frame(header):
+                return len(header).to_bytes(4, "little")
+        """), rel="raytpu/cluster/transfer.py") == []
+
+    def test_sanctioned_line_passes(self):
+        assert run_rule_on_source(_rule("RTP014"), _src("""
+            def push_small(client, oid_hex, sv):
+                client.call("put_object", oid_hex, sv.to_bytes())  # blob-ok: small object, single wire frame
+        """), rel="raytpu/cluster/transfer.py") == []
+
+    def test_out_of_scope_module_ignored(self):
+        # serialization.py legitimately flattens (to_bytes is defined
+        # there); only the transfer/store/node paths are policed.
+        assert run_rule_on_source(_rule("RTP014"), _src("""
+            def to_wire(sv):
+                return sv.to_bytes()
+        """), rel="raytpu/runtime/serialization.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP014"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

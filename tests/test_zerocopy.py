@@ -1,0 +1,368 @@
+"""Zero-copy data plane: serialize-into-shm, pinned views, streaming
+receives, and the legacy-layout escape hatch.
+
+Covers the plane end to end at the unit level:
+
+- ``ByteWindow`` — the bytes-based in-flight transfer budget;
+- ``RangeReader`` — prefix-sum chunk serving over wire segments / spill
+  files, zero-copy for single-segment ranges;
+- ``MemoryStore.begin_receive`` — create-at-size receive regions with
+  atomic seal and abort-reclaims semantics;
+- pinning under churn — views handed out by deserialize stay valid
+  across producer delete/overwrite, and the arena bytes come back only
+  when the last view dies (finalize ordering);
+- a chaos scenario killing a streaming fetch mid begin→end: the
+  half-written region is reclaimed, never sealed, and the retry
+  succeeds;
+- ``RAYTPU_ZEROCOPY=0`` byte-identity with the default-on mode
+  (subprocess per mode, hash comparison).
+"""
+
+import gc
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raytpu.core.config import cfg
+from raytpu.core.ids import ObjectID
+from raytpu.runtime.object_store import MemoryStore
+from raytpu.runtime.serialization import (
+    SerializedValue,
+    deserialize,
+    measure,
+    serialize,
+    serialize_into,
+    wire_size_of,
+)
+from raytpu.runtime.shm_store import SharedMemoryStore
+
+
+@pytest.fixture
+def shm():
+    s = SharedMemoryStore(capacity=64 * 1024 * 1024,
+                          name=f"/raytpu-zc-{os.getpid()}")
+    yield s
+    s.close(unlink=True)
+
+
+class TestByteWindow:
+    def test_accounting(self):
+        from raytpu.cluster.transfer import ByteWindow
+
+        w = ByteWindow(100)
+        w.acquire(60)
+        w.acquire(40)
+        assert w.in_flight() == 100
+        w.release(60)
+        assert w.in_flight() == 40
+        w.release(40)
+        assert w.in_flight() == 0
+
+    def test_oversize_request_admitted_alone(self):
+        from raytpu.cluster.transfer import ByteWindow
+
+        w = ByteWindow(10)
+        w.acquire(1000)  # must not deadlock: idle window admits any size
+        assert w.in_flight() == 1000
+        w.release(1000)
+
+    def test_full_window_blocks_until_release(self):
+        from raytpu.cluster.transfer import ByteWindow
+
+        w = ByteWindow(100)
+        w.acquire(80)
+        admitted = threading.Event()
+
+        def second():
+            w.acquire(50)  # 80 + 50 > 100: must wait
+            admitted.set()
+            w.release(50)
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        assert not admitted.wait(0.1), "window over-admitted"
+        w.release(80)
+        assert admitted.wait(2), "release did not wake the waiter"
+        t.join(2)
+
+
+class TestRangeReader:
+    def test_matches_flattened_layout(self):
+        from raytpu.cluster.transfer import RangeReader
+
+        sv = serialize({"a": np.arange(20000, dtype=np.float64),
+                        "b": b"y" * 3000})
+        blob = sv.to_bytes()
+        r = RangeReader.for_value(sv)
+        assert r.size == len(blob)
+        for off, ln in [(0, 10), (2, 100), (len(blob) - 7, 7),
+                        (1000, 100000), (0, len(blob)), (len(blob), 5)]:
+            assert bytes(r.read(off, ln)) == blob[off:off + ln]
+        r.close()
+
+    def test_single_segment_read_is_zero_copy(self):
+        from raytpu.cluster.transfer import RangeReader
+
+        arr = np.arange(50000, dtype=np.float64)
+        sv = serialize(arr)  # one big raw buffer segment
+        r = RangeReader.for_value(sv)
+        hlen = 4 + len(sv.header)
+        piece = r.read(hlen + 8, 4096)  # interior of the array segment
+        assert isinstance(piece, memoryview), "interior read copied"
+        assert bytes(piece) == sv.to_bytes()[hlen + 8: hlen + 8 + 4096]
+        r.close()
+
+    def test_for_file_serves_spill_layout(self, tmp_path):
+        from raytpu.cluster.transfer import RangeReader
+
+        sv = serialize(np.arange(10000, dtype=np.float32))
+        blob = sv.to_bytes()
+        path = tmp_path / "spilled"
+        path.write_bytes(blob)
+        r = RangeReader.for_file(str(path))
+        assert r.size == len(blob)
+        assert bytes(r.read(0, len(blob))) == blob
+        assert bytes(r.read(17, 999)) == blob[17:17 + 999]
+        r.close()
+
+
+class TestSerializeIntoPlace:
+    def test_measure_matches_flattened_size(self):
+        for value in [np.arange(1000), {"k": [1, 2, np.ones(10)]},
+                      "plain", Exception("boom")]:
+            plan = measure(value)
+            assert plan.size == len(plan.sv.to_bytes())
+            assert wire_size_of(plan) == plan.size
+
+    def test_serialize_into_writes_wire_layout(self):
+        value = {"a": np.arange(5000, dtype=np.int64), "b": "zz"}
+        plan = measure(value)
+        dst = bytearray(plan.size)
+        n = serialize_into(plan, memoryview(dst))
+        assert n == plan.size
+        assert bytes(dst) == plan.sv.to_bytes()
+
+    def test_shm_put_is_in_place(self, shm):
+        oid = ObjectID.from_random()
+        x = np.arange(200000, dtype=np.float64)
+        shm.put(oid, measure(x))
+        out = deserialize(shm.get(oid))
+        np.testing.assert_array_equal(out, x)
+        assert not out.flags.owndata  # view of the mapping, not a copy
+
+
+class TestBeginReceive:
+    def _stream(self, store, oid, blob, chunk=64 * 1024, order=None):
+        rx = store.begin_receive(oid, len(blob))
+        offs = list(range(0, len(blob), chunk))
+        for off in (order(offs) if order else offs):
+            rx.write(off, blob[off:off + chunk])
+        return rx
+
+    def test_streamed_chunks_seal_into_shm(self, shm):
+        store = MemoryStore(shm=shm)
+        x = np.arange(300000, dtype=np.float64)  # ~2.4 MB: shm-sized
+        blob = serialize(x).to_bytes()
+        oid = ObjectID.from_random()
+        rx = self._stream(store, oid, blob, order=lambda o: o[::-1])
+        assert rx.in_shm
+        assert not store.contains(oid), "visible before seal"
+        rx.seal()
+        assert store.contains(oid)
+        np.testing.assert_array_equal(deserialize(store.get(oid)), x)
+
+    def test_abort_reclaims_region_and_key(self, shm):
+        store = MemoryStore(shm=shm)
+        blob = serialize(np.arange(250000, dtype=np.float64)).to_bytes()
+        oid = ObjectID.from_random()
+        rx = self._stream(store, oid, blob[: len(blob) // 2])  # half only
+        rx.abort()
+        assert not store.contains(oid)
+        assert shm.used_bytes() == 0, "aborted region leaked arena bytes"
+        # The key is immediately creatable again and a full retry works.
+        rx2 = self._stream(store, oid, blob)
+        rx2.seal()
+        assert store.contains(oid)
+
+    def test_small_object_receives_on_heap(self, shm):
+        store = MemoryStore(shm=shm)
+        blob = serialize(list(range(50))).to_bytes()
+        oid = ObjectID.from_random()
+        rx = self._stream(store, oid, blob)
+        assert not rx.in_shm
+        rx.seal()
+        assert deserialize(store.get(oid)) == list(range(50))
+
+    def test_out_of_bounds_write_rejected(self, shm):
+        store = MemoryStore(shm=shm)
+        rx = store.begin_receive(ObjectID.from_random(), 10)
+        with pytest.raises(ValueError):
+            rx.write(8, b"xxxx")
+        rx.abort()
+
+
+class TestPinnedViewsUnderChurn:
+    def test_view_survives_producer_delete_and_overwrite(self, shm):
+        oid = ObjectID.from_random()
+        x = np.arange(100000, dtype=np.float64)
+        shm.put(oid, serialize(x))
+        view = deserialize(shm.get(oid))
+        assert not view.flags.owndata and not view.flags.writeable
+
+        # Producer deletes while the consumer still holds the view: the
+        # object disappears from lookups immediately, but the bytes stay
+        # pinned under the view (deferred free).
+        assert shm.delete(oid)
+        assert not shm.contains(oid)
+        np.testing.assert_array_equal(view, x)
+
+        # The key is immediately reusable; the successor object must not
+        # be confused with the doomed one.
+        y = np.full(50000, 7, dtype=np.float64)
+        shm.put(oid, serialize(y))
+        np.testing.assert_array_equal(deserialize(shm.get(oid)), y)
+        np.testing.assert_array_equal(view, x)  # old view untouched
+
+    def test_bytes_freed_only_after_last_view_dies(self, shm):
+        oid = ObjectID.from_random()
+        shm.put(oid, serialize(np.arange(100000, dtype=np.float64)))
+        sv = shm.get(oid)
+        view = deserialize(sv)
+        shm.delete(oid)
+        # Release order: sv first, then the deserialized view — the pin
+        # travels with the view, so bytes free only at the very end.
+        del sv
+        gc.collect()
+        assert shm.used_bytes() > 0, "freed while a view was live"
+        assert view[0] == 0.0  # still readable
+        del view
+        gc.collect()
+        assert shm.used_bytes() == 0, "last release did not free the bytes"
+
+    def test_pickled_pytree_views_pin_too(self, shm):
+        oid = ObjectID.from_random()
+        tree = {"a": np.arange(30000, dtype=np.float32), "b": [1, "s"]}
+        shm.put(oid, serialize(tree))
+        out = deserialize(shm.get(oid))
+        shm.delete(oid)
+        gc.collect()
+        np.testing.assert_array_equal(out["a"], tree["a"])
+        assert out["b"] == [1, "s"]
+        del out
+        gc.collect()
+        assert shm.used_bytes() == 0
+
+    def test_copy_opt_out_returns_private_writable_array(self, shm):
+        oid = ObjectID.from_random()
+        x = np.arange(50000, dtype=np.float64)
+        shm.put(oid, serialize(x))
+        arr = deserialize(shm.get(oid), copy=True)
+        assert arr.flags.writeable
+        arr += 1  # mutating callers get their own storage
+        np.testing.assert_array_equal(deserialize(shm.get(oid)), x)
+
+
+class TestChaosMidFetch:
+    def test_receiver_dies_mid_transfer_then_retries(self, shm):
+        """A chunk failure between begin and end must leave NO trace: the
+        half-written region is reclaimed, nothing is sealed, and a clean
+        retry lands the object."""
+        from raytpu.cluster.protocol import RpcClient, RpcServer
+        from raytpu.cluster.transfer import (
+            RangeReader, fetch_object, wire_size,
+        )
+        from raytpu.util import failpoints
+
+        sv = serialize(np.arange(400000, dtype=np.float64))  # ~3.2 MB
+        reader = RangeReader.for_value(sv)
+        srv = RpcServer()
+        srv.register("fetch_object_meta",
+                     lambda peer, oid: {"size": wire_size(sv)})
+        srv.register("fetch_object_chunk",
+                     lambda peer, oid, off, ln: reader.read(off, ln))
+        addr = srv.start()
+        cli = RpcClient(addr)
+        store = MemoryStore(shm=shm)
+        oid = ObjectID.from_random()
+        old = cfg.object_transfer_chunk_bytes
+        cfg.set("object_transfer_chunk_bytes", 128 * 1024)
+        try:
+            failpoints.cfg("transfer.fetch.chunk",
+                           "1*raise(ConnectionError,mid-transfer death)")
+            with pytest.raises(ConnectionError):
+                fetch_object(cli, oid.hex(), store, timeout=30)
+            assert not store.contains(oid), "half transfer was sealed"
+            assert shm.used_bytes() == 0, "half-written region leaked"
+            # Failpoint consumed — the retry must succeed from scratch.
+            assert fetch_object(cli, oid.hex(), store, timeout=30)
+            np.testing.assert_array_equal(
+                deserialize(store.get(oid)),
+                np.arange(400000, dtype=np.float64))
+        finally:
+            failpoints.clear()
+            cfg.set("object_transfer_chunk_bytes", old)
+            reader.close()
+            cli.close()
+            srv.stop()
+
+
+_IDENTITY_CHILD = r"""
+import hashlib, json, os, sys
+import numpy as np
+from raytpu.core.ids import ObjectID
+from raytpu.runtime.serialization import deserialize, serialize
+from raytpu.runtime.shm_store import SharedMemoryStore
+
+hashes = {}
+values = {
+    "numpy": np.arange(100000, dtype=np.float64),
+    "pytree": {"a": np.ones(5000, dtype=np.float32), "b": [1, 2, "x"]},
+    "msgpack": {"k": 1, "l": "two"},
+}
+for name, v in sorted(values.items()):
+    hashes[name] = hashlib.sha256(serialize(v).to_bytes()).hexdigest()
+
+# Stored shm bytes: the arena layout must be identical too.
+s = SharedMemoryStore(capacity=32 * 1024 * 1024,
+                      name=f"/raytpu-ident-{os.getpid()}")
+try:
+    oid = ObjectID(b"\x01" * 16)
+    s.put(oid, serialize(values["numpy"]))
+    sv = s.get(oid)
+    hashes["shm_stored"] = hashlib.sha256(sv.to_bytes()).hexdigest()
+    out = deserialize(sv)
+    hashes["roundtrip_ok"] = bool(np.array_equal(out, values["numpy"]))
+    hashes["owndata"] = bool(out.flags.owndata)
+    del out, sv
+finally:
+    s.close(unlink=True)
+print(json.dumps(hashes))
+"""
+
+
+class TestZerocopyOffIsByteIdentical:
+    def _run(self, zerocopy: str) -> dict:
+        env = dict(os.environ, RAYTPU_ZEROCOPY=zerocopy,
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", _IDENTITY_CHILD],
+                             capture_output=True, text=True, env=env,
+                             timeout=120)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    def test_wire_and_store_bytes_identical_across_modes(self):
+        on, off = self._run("1"), self._run("0")
+        for key in ("numpy", "pytree", "msgpack", "shm_stored"):
+            assert on[key] == off[key], \
+                f"{key}: ZEROCOPY=0 layout diverged from default"
+        assert on["roundtrip_ok"] and off["roundtrip_ok"]
+        # Behavioral delta is exactly the view-vs-copy choice:
+        assert not on["owndata"], "default mode copied out of shm"
+        assert off["owndata"], "legacy mode returned a shm view"
