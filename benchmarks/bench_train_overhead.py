@@ -209,6 +209,45 @@ def _recorder_overhead(n_tasks: int = 200) -> dict:
             "recorder_tasks_measured": n_tasks}
 
 
+# -- metrics-shipping overhead: same submit path, shipping off vs on -----
+
+def _metrics_ship_overhead(n_tasks: int = 200) -> dict:
+    """Per-task wall cost of the cluster metrics pipeline on the live
+    submit→finish path, shipping off then on. The off column is the
+    disabled-cost contract (ONE ``metrics.enabled()`` flag check per
+    ship site); the delta is what ``RAYTPU_METRICS_SHIP=1`` buys into —
+    registry delta snapshots riding heartbeats into the head TSDB."""
+    import raytpu
+    from raytpu.util import metrics
+
+    @raytpu.remote
+    def _noop():
+        return None
+
+    def timed() -> float:
+        raytpu.get([_noop.remote() for _ in range(n_tasks)])  # warm
+        t0 = time.perf_counter()
+        raytpu.get([_noop.remote() for _ in range(n_tasks)])
+        return (time.perf_counter() - t0) / n_tasks
+
+    was_enabled = metrics.enabled()
+    try:
+        metrics.disable_metrics_ship()
+        off_s = timed()
+        metrics.enable_metrics_ship()
+        on_s = timed()
+    finally:
+        if was_enabled:
+            metrics.enable_metrics_ship()
+        else:
+            metrics.disable_metrics_ship()
+    return {"metrics_ship_off_us_per_task": round(off_s * 1e6, 2),
+            "metrics_ship_on_us_per_task": round(on_s * 1e6, 2),
+            "metrics_ship_delta_us_per_task":
+                round((on_s - off_s) * 1e6, 2),
+            "metrics_ship_tasks_measured": n_tasks}
+
+
 # -- RPC-batch overhead: per-task cost, coalescing off vs on -------------
 
 def _rpc_batch_child() -> None:
@@ -322,6 +361,10 @@ def main() -> None:
         recorder = _recorder_overhead()
     except Exception as e:
         recorder = {"recorder_error": f"{type(e).__name__}: {e}"}
+    try:
+        mship = _metrics_ship_overhead()
+    except Exception as e:
+        mship = {"metrics_ship_error": f"{type(e).__name__}: {e}"}
     raytpu.shutdown()
     try:
         rpc_batch = _rpc_batch_overhead()
@@ -345,6 +388,7 @@ def main() -> None:
                    "workers": WORKERS, "best_of": REPEATS,
                    "reference_bar_pct": REFERENCE_OVERHEAD_PCT,
                    **recorder,
+                   **mship,
                    **rpc_batch,
                    "note": "gang time = slowest rank (max-allreduce); "
                            "per-epoch train.report live on every rank; "

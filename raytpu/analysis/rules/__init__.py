@@ -39,6 +39,9 @@ RTP013 scheduler-purity        no RPC/socket/file I/O while the head's
 RTP014 no-blob-materialization data-plane modules never flatten an
                                object into one blob (.to_bytes(),
                                bytes join, whole-value pickle.dumps)
+RTP015 metric-registry         every Counter/Gauge/Histogram name is
+                               a literal declared in
+                               metrics.DECLARED_METRICS
 ====== ======================= ====================================
 """
 
@@ -49,6 +52,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     contextvar_crossing,
     env_registry,
     jit_in_builders,
+    metric_registry,
     rpc_loop,
     sched_purity,
     seam_swallow,

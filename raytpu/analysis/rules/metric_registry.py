@@ -1,0 +1,141 @@
+"""RTP015: every metric constructed under ``raytpu/`` is declared in
+``metrics.DECLARED_METRICS``.
+
+The metrics pipeline ships every series to the head TSDB, exports it
+from one Prometheus endpoint, and lets alert rules reference it by
+name. A metric constructed with a name missing from the registry is
+invisible to that contract: no operator can discover it, dashboards
+and alert specs typo-check against nothing, and two subsystems
+inevitably invent near-identical names for the same signal
+(``..._tasks_total`` vs ``..._task_count``). The registry is
+append-only — renaming a shipped metric silently breaks recorded
+dashboards.
+
+Detected constructions: ``Counter(...)`` / ``Gauge(...)`` /
+``Histogram(...)`` where the callable is imported from
+``raytpu.util.metrics`` (bare or aliased), and the
+``metrics.Counter(...)`` attribute form where ``metrics`` is the
+``raytpu.util.metrics`` module. The name must be a string literal —
+dynamically-built metric names defeat the registry and are violations
+outright.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Optional, Set
+
+from raytpu.analysis.core import ParsedModule, Rule, register
+
+_REGISTRY_REL = "raytpu/util/metrics.py"
+_CTORS = ("Counter", "Gauge", "Histogram")
+
+
+def declared_metric_names(modules=()) -> Set[str]:
+    """The string keys of the ``DECLARED_METRICS`` dict literal in
+    util/metrics.py (reusing an already-parsed module when the scan
+    includes it)."""
+    by_rel = {m.rel: m for m in modules}
+    mod = by_rel.get(_REGISTRY_REL)
+    if mod is not None:
+        tree = mod.tree
+    else:
+        pkg = pathlib.Path(__file__).resolve().parents[2]
+        tree = ast.parse((pkg / "util" / "metrics.py").read_text())
+    out: Set[str] = set()
+    for node in tree.body:
+        value = getattr(node, "value", None)
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            names = [node.target.id]
+        else:
+            continue
+        if "DECLARED_METRICS" in names and isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.add(k.value)
+    return out
+
+
+def _metric_bindings(tree):
+    """How this module can reach the constructors: a map of bare-name
+    aliases (``from raytpu.util.metrics import Counter [as C]``) and
+    the set of names bound to the metrics module itself."""
+    ctors = {}
+    mods = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "raytpu.util.metrics":
+                for a in node.names:
+                    if a.name in _CTORS:
+                        ctors[a.asname or a.name] = a.name
+            elif node.module == "raytpu.util":
+                for a in node.names:
+                    if a.name == "metrics":
+                        mods.add(a.asname or "metrics")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "raytpu.util.metrics":
+                    mods.add(a.asname or "metrics")
+    return ctors, mods
+
+
+@register
+class MetricRegistry(Rule):
+    id = "RTP015"
+    name = "metric-registry"
+    invariant = ("every Counter/Gauge/Histogram constructed under "
+                 "raytpu/ uses a literal name declared in "
+                 "metrics.DECLARED_METRICS")
+    rationale = ("an undeclared metric never reaches dashboards, alert "
+                 "specs, or operator docs, and invites near-duplicate "
+                 "names for the same signal")
+    scope = ("raytpu/",)
+    exempt = (_REGISTRY_REL,)  # the registry itself (defines the ctors)
+
+    def __init__(self):
+        self._declared: Optional[Set[str]] = None
+
+    def check(self, mod: ParsedModule):
+        ctors, mods = _metric_bindings(mod.tree)
+        if not ctors and not mods:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            ctor = None
+            if isinstance(f, ast.Name) and f.id in ctors:
+                ctor = ctors[f.id]
+            elif (isinstance(f, ast.Attribute) and f.attr in _CTORS
+                    and isinstance(f.value, ast.Name) and f.value.id in mods):
+                ctor = f.attr
+            if ctor is None:
+                continue
+            name_node = node.args[0] if node.args else None
+            if name_node is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_node = kw.value
+            if name_node is None:
+                continue
+            if not (isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)):
+                yield self.finding(
+                    mod, node,
+                    f"dynamically-built {ctor} name — metric names must be "
+                    f"string literals declared in metrics.DECLARED_METRICS "
+                    f"(put variability in tags, not the name)")
+                continue
+            if self._declared is None:
+                self._declared = declared_metric_names()
+            name = name_node.value
+            if name not in self._declared:
+                yield self.finding(
+                    mod, node,
+                    f"metric {name!r} constructed but not declared — add it "
+                    f"to DECLARED_METRICS in raytpu/util/metrics.py "
+                    f"(append-only)")

@@ -41,6 +41,7 @@ from raytpu.util.errors import (
     PlacementInfeasibleError,
     RpcTimeoutError,
 )
+from raytpu.util import metrics as _metrics
 from raytpu.util import task_events
 from raytpu.util import tracing
 from raytpu.util.resilience import Deadline, RetryPolicy, breaker_for
@@ -1221,6 +1222,19 @@ class ClusterBackend:
                 self._submit_thread.join(
                     timeout=tuning.SERVER_STOP_TIMEOUT_S)
         self._free_queue.put(None)
+        # Final metrics flush: the driver's pending delta frames would
+        # otherwise die with the embedded node's heartbeat loop. Pushed
+        # straight to the head (one flag check when shipping is off).
+        if _metrics.enabled():
+            try:
+                _metrics.collect(force=True)
+                frames, dropped = _metrics.drain()
+                if frames or dropped:
+                    self._head.call(
+                        "metrics_push", frames, dropped,
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S)
+            except Exception as e:
+                errors.swallow("client.metrics_final_flush", e)
         try:
             if self._node is not None:
                 self._node.stop()

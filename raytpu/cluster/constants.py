@@ -139,6 +139,11 @@ OBJECT_POLL_MIN_S = _f("OBJECT_POLL_MIN_S", 0.005)
 OBJECT_POLL_MAX_S = _f("OBJECT_POLL_MAX_S", 0.1)
 # Node-side wait for an already-inbound push to land before pulling.
 PUSH_WAIT_POLL_PERIOD_S = _f("PUSH_WAIT_POLL_PERIOD_S", 0.02)
+# Metric snapshot/ship cadence: every process folds its registry deltas
+# into a pending frame at most this often (frames then ride the next
+# heartbeat / worker notify, so the effective ship period is
+# max(this, the carrier's period)).
+METRICS_SHIP_PERIOD_S = _f("METRICS_SHIP_PERIOD_S", 2.0)
 
 # -- node → head reconnect ---------------------------------------------------
 

@@ -36,8 +36,10 @@ from raytpu.cluster import constants as tuning
 from raytpu.cluster import wire
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import failpoints
+from raytpu.util import metrics
 from raytpu.util import task_events
 from raytpu.util import tracing
+from raytpu.util import tsdb
 from raytpu.util import errors
 from raytpu.util.errors import PlacementInfeasibleError
 from raytpu.util.failpoints import DROP, failpoint
@@ -142,7 +144,7 @@ class _HeadMetrics:
     def __init__(self):
         self.nodes = self.actors = self.pgs = None
         self.resources = self.available = None
-        self.schedules = self.tasks_done = None
+        self.schedules = self.tasks_done = self.tasks_submitted = None
         try:
             from raytpu.util.metrics import Counter, Gauge
 
@@ -167,6 +169,9 @@ class _HeadMetrics:
             self.tasks_done = Counter(
                 "raytpu_tasks_done_total",
                 "Task completions reported to the head")
+            self.tasks_submitted = Counter(
+                "raytpu_tasks_submitted_total",
+                "Task specs accepted for scheduling")
         except Exception:  # pragma: no cover — metrics are best-effort
             self.nodes = None
 
@@ -204,6 +209,7 @@ class _HeadMetrics:
 
     def tick_schedule(self) -> None:
         self._inc(self.schedules)
+        self._inc(self.tasks_submitted)
 
     def tick_task_done(self) -> None:
         self._inc(self.tasks_done)
@@ -266,6 +272,32 @@ class HeadServer:
         self._task_event_store = task_events.TaskEventStore(
             per_kind=_cfg.task_event_store_per_kind,
             events_per_entity=_cfg.task_event_store_events_per_entity)
+        # Cluster TSDB (reference: the stats/exporter aggregation path):
+        # shipped metric deltas from every process fold in here, behind
+        # the metrics_query/metrics_push RPC surface.
+        self._metric_store = tsdb.MetricStore(
+            max_bytes=int(_cfg.metrics_store_max_bytes),
+            fine_step_s=float(_cfg.metrics_fine_step_s),
+            fine_slots=int(_cfg.metrics_fine_slots),
+            coarse_step_s=float(_cfg.metrics_coarse_step_s),
+            coarse_slots=int(_cfg.metrics_coarse_slots))
+        metrics.set_shipper_identity("head")
+        # SLO alerts: threshold/duration rules over the TSDB, evaluated
+        # on the health-loop cadence, fired into the ops-event ring. A
+        # malformed rule string must not take the control plane down —
+        # it degrades to no rules plus a loud ERROR event.
+        try:
+            rules = tsdb.parse_alert_rules(str(_cfg.metrics_alert_rules))
+        except ValueError as e:
+            from raytpu.util.events import record_event as _rec
+
+            self._events.append(_rec(
+                "ERROR", "SLO_ALERT_CONFIG",
+                f"ignoring metrics_alert_rules: {e}"))
+            rules = []
+        self._alerts = tsdb.AlertEvaluator(
+            self._metric_store, rules,
+            on_fire=self._on_alert_fire, on_resolve=self._on_alert_resolve)
         self._object_waiters: Dict[str, List[Peer]] = {}
         # Push-path demand (reference: push_manager.h): object -> nodes
         # whose pull loops asked for it before any copy existed. When the
@@ -329,6 +361,16 @@ class HeadServer:
         h("state_summary", self._state_summary)
         h("state_timeline", self._state_timeline)
         h("task_events_stats", self._task_events_stats)
+        # Metrics pipeline surface: delta ingest off the notify path
+        # (heartbeats piggyback instead), cluster-aggregated queries,
+        # series listing, prometheus text, and alert-rule management.
+        h("metrics_push", self._h_metrics_push)
+        h("metrics_query", self._h_metrics_query)
+        h("metrics_series", self._h_metrics_series)
+        h("metrics_prometheus", self._h_metrics_prometheus)
+        h("metrics_stats", self._h_metrics_stats)
+        h("metrics_set_alert_rules", self._h_metrics_set_alert_rules)
+        h("metrics_alerts", self._h_metrics_alerts)
         h("create_pg", self._create_pg)
         h("remove_pg", self._remove_pg)
         h("pg_info", self._pg_info)
@@ -495,6 +537,9 @@ class HeadServer:
             peer.meta["node_id"] = node_id
             self._nodes[node_id] = entry
             snap = [n.snapshot() for n in self._nodes.values() if n.alive]
+        # A (re-)registered node sheds any metric tombstone so shipping
+        # resumes after a head bounce or transient partition.
+        self._metric_store.revive_proc(node_id[:12])
         if task_events.enabled():
             task_events.emit("node", node_id,
                              task_events.TaskTransition.NODE_ADDED,
@@ -507,7 +552,9 @@ class HeadServer:
                    available: Dict[str, float], seq: int = 0,
                    events: Optional[List[dict]] = None,
                    dropped: int = 0,
-                   obj_deltas: Optional[List[list]] = None) -> None:
+                   obj_deltas: Optional[List[list]] = None,
+                   mframes: Optional[List[list]] = None,
+                   mdropped: int = 0) -> None:
         # drop => the head never saw this heartbeat; enough consecutive
         # drops and the health loop declares the node dead. The node
         # requeues the piggybacked event batch on call failure, so a
@@ -530,6 +577,11 @@ class HeadServer:
             # Location deltas a node failed to flush directly ride the
             # liveness beat, exactly like the flight-recorder batches.
             self._apply_object_deltas(peer, node_id, obj_deltas)
+        if mframes or mdropped:
+            # Metric delta frames (node's own + relayed worker frames)
+            # ride the same beat into the TSDB.
+            self._metric_store.note_upstream_drops(int(mdropped or 0))
+            self._metric_store.push(mframes or [])
 
     def _resource_update(self, peer: Peer, node_id: str,
                          available: Dict[str, float],
@@ -636,6 +688,7 @@ class HeadServer:
     def _health_loop(self) -> None:
         while not self._stop.wait(CHECK_PERIOD_S):
             self._ingest_local_events()
+            self._ingest_local_metrics()
             now = time.monotonic()
             dead = []
             with self._lock:
@@ -647,6 +700,10 @@ class HeadServer:
                                       self._actors, self._pgs)
             for node_id in dead:
                 self._mark_dead(node_id, reason="heartbeat timeout")
+            try:
+                self._alerts.tick()
+            except Exception as e:
+                errors.swallow("head.alerts.tick", e)
 
     def _mark_dead(self, node_id: str, reason: str) -> None:
         with self._lock:
@@ -683,6 +740,10 @@ class HeadServer:
                 f"node {node_id[:8]} removed: {reason}",
                 node_id=node_id, reason=reason))
         self._drop_borrower_prefix(node_id)
+        # Tombstone the dead node's metric procs (daemon + its workers):
+        # their series drop and any late frame is rejected, so the death
+        # can't resurrect stale series.
+        self._metric_store.mark_proc_dead(node_id[:12])
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id} {reason}",
                                    no_restart=False)
@@ -798,6 +859,79 @@ class HeadServer:
     def _task_events_stats(self, peer: Peer) -> dict:
         self._ingest_local_events()
         return self._task_event_store.stats()
+
+    # -- metrics pipeline ---------------------------------------------------
+
+    def _ingest_local_metrics(self) -> None:
+        """Fold the head's OWN registry deltas (cluster gauges, schedule
+        counters) into the TSDB. Runs from the health loop and lazily
+        before every metrics query, so head-side series are never staler
+        than one query. One flag check when shipping is disabled."""
+        if not metrics.enabled():
+            return
+        metrics.collect(min_interval_s=tuning.METRICS_SHIP_PERIOD_S)
+        frames, dropped = metrics.drain()
+        if dropped:
+            self._metric_store.note_upstream_drops(dropped)
+        if frames:
+            self._metric_store.push(frames)
+
+    def _h_metrics_push(self, peer: Peer, frames: List[list],
+                        dropped: int = 0) -> int:
+        if dropped:
+            self._metric_store.note_upstream_drops(int(dropped))
+        return self._metric_store.push(frames or [])
+
+    def _h_metrics_query(self, peer: Peer, name: str,
+                         tags: Optional[Dict[str, str]] = None,
+                         agg: str = "sum", since_s: float = 600.0,
+                         step: Optional[float] = None) -> dict:
+        self._ingest_local_metrics()
+        return self._metric_store.query(name, tags=tags, agg=agg,
+                                        since_s=float(since_s), step=step)
+
+    def _h_metrics_series(self, peer: Peer,
+                          prefix: Optional[str] = None) -> List[dict]:
+        self._ingest_local_metrics()
+        return self._metric_store.series(prefix)
+
+    def _h_metrics_prometheus(self, peer: Peer) -> str:
+        self._ingest_local_metrics()
+        return self._metric_store.prometheus_text()
+
+    def _h_metrics_stats(self, peer: Peer) -> dict:
+        return self._metric_store.stats()
+
+    def _h_metrics_set_alert_rules(self, peer: Peer,
+                                   spec: str) -> List[str]:
+        rules = tsdb.parse_alert_rules(spec)  # malformed -> RPC error
+        self._alerts.set_rules(rules)
+        return [r.name for r in rules]
+
+    def _h_metrics_alerts(self, peer: Peer) -> dict:
+        return {"rules": [r.name for r in self._alerts.rules],
+                "firing": self._alerts.firing()}
+
+    def _on_alert_fire(self, rule: "tsdb.AlertRule", value: float) -> None:
+        from raytpu.util.events import record_event
+
+        ev = record_event(
+            "ERROR", "SLO_ALERT",
+            f"alert firing: {rule.name} (value {value:.6g})",
+            rule=rule.name, metric=rule.metric, value=float(value))
+        with self._lock:
+            self._events.append(ev)
+
+    def _on_alert_resolve(self, rule: "tsdb.AlertRule",
+                          value: float) -> None:
+        from raytpu.util.events import record_event
+
+        ev = record_event(
+            "INFO", "SLO_ALERT_RESOLVED",
+            f"alert resolved: {rule.name} (value {value:.6g})",
+            rule=rule.name, metric=rule.metric, value=float(value))
+        with self._lock:
+            self._events.append(ev)
 
     def _borrow_info(self, peer: Peer) -> dict:
         with self._lock:

@@ -30,6 +30,7 @@ from raytpu.cluster import constants as tuning
 from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
 from raytpu.core.config import cfg
 from raytpu.util import failpoints
+from raytpu.util import metrics
 from raytpu.util import task_events
 from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
@@ -511,6 +512,55 @@ def _xlang_args(args: list) -> list:
             for a in args]
 
 
+class _NodeMetrics:
+    """Node-local health gauges, refreshed on the heartbeat cadence and
+    shipped with everything else (reference: raylet resource/stats
+    reports riding its GCS heartbeat). Counters feed off the daemon's
+    monotonic transfer byte totals so the TSDB sees true increments."""
+
+    def __init__(self):
+        self.rss = metrics.Gauge(
+            "raytpu_node_rss_bytes", "node daemon resident set size")
+        self.shm_used = metrics.Gauge(
+            "raytpu_node_shm_used_bytes", "shared-memory arena bytes in use")
+        self.shm_capacity = metrics.Gauge(
+            "raytpu_node_shm_capacity_bytes", "shared-memory arena capacity")
+        self.pending = metrics.Gauge(
+            "raytpu_node_pending_tasks", "tasks queued on the node")
+        self.running = metrics.Gauge(
+            "raytpu_node_running_tasks", "tasks executing on the node")
+        self.pull_bytes = metrics.Counter(
+            "raytpu_node_pull_bytes_total", "object bytes pulled from peers")
+        self.push_rx_bytes = metrics.Counter(
+            "raytpu_node_push_rx_bytes_total",
+            "object bytes received via push")
+        self._last_pull = 0
+        self._last_push_rx = 0
+
+    def refresh(self, node: "NodeServer") -> None:
+        try:
+            from raytpu.util.memprofile import _rss_kb
+
+            rss_kb = _rss_kb()
+            if rss_kb is not None:
+                self.rss.set(rss_kb * 1024.0)
+            if node.shm is not None:
+                self.shm_used.set(float(node.shm.used_bytes()))
+                self.shm_capacity.set(float(node.shm.capacity()))
+            with node.backend._lock:
+                self.pending.set(float(len(node.backend._tasks)))
+                self.running.set(float(len(node.backend._running)))
+            if node.pull_bytes > self._last_pull:
+                self.pull_bytes.inc(node.pull_bytes - self._last_pull)
+                self._last_pull = node.pull_bytes
+            if node.push_rx_bytes > self._last_push_rx:
+                self.push_rx_bytes.inc(
+                    node.push_rx_bytes - self._last_push_rx)
+                self._last_push_rx = node.push_rx_bytes
+        except Exception as e:  # a sick gauge must not stop the heartbeat
+            errors.swallow("node.metrics.refresh", e)
+
+
 class NodeServer:
     def __init__(self, head_address: str, *,
                  num_cpus: Optional[float] = None,
@@ -609,6 +659,11 @@ class NodeServer:
         # after each task; the batches relay head-ward on the next
         # heartbeat (one ship path, no extra connections).
         h("report_task_events", self._h_report_task_events)
+        # Metrics pipeline: pool workers drain their delta-frame buffers
+        # here; the frames relay head-ward on the next heartbeat (same
+        # single ship path as task events).
+        h("report_metrics", self._h_report_metrics)
+        h("metrics_query", self._h_metrics_query)
         # Worker-process plane
         h("register_worker", self._h_register_worker)
         h("task_blocked", self._h_task_blocked)
@@ -678,6 +733,7 @@ class NodeServer:
         # off debug_state to measure what locality placement saved).
         self.pull_bytes = 0
         self.push_rx_bytes = 0
+        self._node_metrics: Optional[_NodeMetrics] = None
         self.address: Optional[str] = None
         # Per-process log files live under the session dir (reference:
         # /tmp/ray/session_*/logs with one file per worker).
@@ -709,6 +765,9 @@ class NodeServer:
             "driver" if self.labels.get("role") == "driver" else "node",
             self.node_id.hex()[:12])
         task_events.set_emitter_identity(node_id=self.node_id.hex())
+        metrics.set_shipper_identity(
+            ("driver:" if self.labels.get("role") == "driver" else "node:")
+            + self.node_id.hex()[:12])
         if self._worker_processes:
             from raytpu.cluster.worker_pool import WorkerPool
 
@@ -861,6 +920,11 @@ class NodeServer:
             self._avail_seq += 1
             return self.backend.node.available.to_dict(), self._avail_seq
 
+    def _refresh_node_metrics(self) -> None:
+        if self._node_metrics is None:
+            self._node_metrics = _NodeMetrics()
+        self._node_metrics.refresh(self)
+
     def _heartbeat_loop(self) -> None:
         # Reconnect attempts back off exponentially while the head stays
         # unreachable (a bounced head must not be greeted by every node
@@ -884,26 +948,30 @@ class NodeServer:
                 obj_deltas = self._drain_obj_deltas()
                 if task_events.enabled():
                     batch, dropped = task_events.drain()
-                    try:
-                        self._head.call(
-                            "heartbeat", self.node_id.hex(), avail, seq,
-                            batch, dropped, obj_deltas,
-                            timeout=tuning.CONTROL_CALL_TIMEOUT_S,
-                        )
-                    except Exception:
-                        task_events.requeue(batch, dropped)
-                        self._requeue_obj_deltas(obj_deltas)
-                        raise
                 else:
-                    try:
-                        self._head.call(
-                            "heartbeat", self.node_id.hex(), avail, seq,
-                            [], 0, obj_deltas,
-                            timeout=tuning.CONTROL_CALL_TIMEOUT_S,
-                        )
-                    except Exception:
-                        self._requeue_obj_deltas(obj_deltas)
-                        raise
+                    batch, dropped = [], 0
+                # Metric deltas ride the same beat: refresh the node
+                # gauges, fold registry deltas into a frame (rate-limited
+                # internally), and take everything pending. One flag
+                # check pins the disabled-and-idle cost.
+                if metrics.enabled():
+                    self._refresh_node_metrics()
+                    metrics.collect(
+                        min_interval_s=tuning.METRICS_SHIP_PERIOD_S)
+                    mframes, mdropped = metrics.drain()
+                else:
+                    mframes, mdropped = [], 0
+                try:
+                    self._head.call(
+                        "heartbeat", self.node_id.hex(), avail, seq,
+                        batch, dropped, obj_deltas, mframes, mdropped,
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                    )
+                except Exception:
+                    task_events.requeue(batch, dropped)
+                    self._requeue_obj_deltas(obj_deltas)
+                    metrics.requeue(mframes, mdropped)
+                    raise
                 backoff = 0.0
             except Exception:
                 if self._stop.is_set():
@@ -1047,6 +1115,23 @@ class NodeServer:
         """Fold a pool worker's flushed event batch into this daemon's
         ring; the next heartbeat relays it to the head's store."""
         task_events.ingest(events or [], dropped)
+
+    def _h_report_metrics(self, peer: Peer, frames: List[list],
+                          dropped: int = 0) -> None:
+        """Fold a pool worker's drained metric frames into this daemon's
+        buffer; the next heartbeat relays them to the head's TSDB."""
+        metrics.ingest(frames or [], dropped or 0)
+
+    def _h_metrics_query(self, peer: Peer, name: str, tags=None,
+                         agg: str = "sum", since_s: float = 600.0,
+                         step=None):
+        """Relay a worker-side TSDB query to the head (workers have no
+        head connection; actors like the serve controller read
+        cluster-aggregated pressure through their daemon)."""
+        if self._head is None:
+            return None
+        return self._head.call("metrics_query", name, tags, agg, since_s,
+                               step, timeout=tuning.CONTROL_CALL_TIMEOUT_S)
 
     def _report_object(self, oid: ObjectID) -> None:
         self._wake_obj_waiters(oid.hex())

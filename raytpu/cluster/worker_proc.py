@@ -36,6 +36,7 @@ from raytpu.cluster import wire
 
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import errors
+from raytpu.util import metrics
 from raytpu.util import task_events, tracing
 from raytpu.util.failpoints import failpoint
 from raytpu.core.errors import ActorDiedError, TaskError
@@ -363,6 +364,7 @@ class _WorkerHost:
         # kill_process here is the canonical "worker dies mid-task" chaos
         # scenario: the task was accepted but no result ever comes back.
         failpoint("worker.task.run")
+        _tick_worker_task()
         if task_events.enabled():
             task_events.emit("task", spec.task_id.hex(),
                              task_events.TaskTransition.RUNNING,
@@ -398,6 +400,20 @@ class _WorkerHost:
         except Exception:
             task_events.requeue(batch, dropped)
 
+    def flush_metrics(self) -> None:
+        """Ship this worker's metric delta frames to the node daemon
+        (which relays them on its next heartbeat — same single ship path
+        as task events). collect() rate-limits the registry snapshot;
+        a failed notify requeues so frames survive a daemon hiccup."""
+        metrics.collect(min_interval_s=tuning.METRICS_SHIP_PERIOD_S)
+        frames, dropped = metrics.drain()
+        if not frames and not dropped:
+            return
+        try:
+            self.node.notify("report_metrics", frames, dropped)
+        except Exception:
+            metrics.requeue(frames, dropped)
+
     def create_actor(self, spec: TaskSpec) -> dict:
         self.actor_spec = spec
         try:
@@ -424,6 +440,7 @@ class _WorkerHost:
 
     def execute_actor_task(self, spec: TaskSpec) -> dict:
         failpoint("worker.actor_task.run")
+        _tick_worker_task()
         if self.actor_instance is None:
             err: BaseException = ActorDiedError(
                 spec.actor_id.hex() if spec.actor_id else "?",
@@ -512,6 +529,24 @@ class _WorkerHost:
         return None
 
 
+_worker_tasks_counter = None
+
+
+def _tick_worker_task() -> None:
+    """Per-worker task throughput, shipped with the metric pipeline so
+    the head can break cluster tasks/s down by worker proc. Lazy: the
+    counter registers on the first executed task, not at import."""
+    global _worker_tasks_counter
+    try:
+        if _worker_tasks_counter is None:
+            _worker_tasks_counter = metrics.Counter(
+                "raytpu_worker_tasks_total",
+                "tasks executed by the worker process")
+        _worker_tasks_counter.inc()
+    except Exception:  # pragma: no cover - metrics never fail a task
+        pass
+
+
 def main() -> None:  # pragma: no cover - runs as a subprocess
     import argparse
 
@@ -525,6 +560,8 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     tracing.set_process_identity("worker", args.worker_id[:12])
     task_events.set_emitter_identity(node_id=args.node_id,
                                      worker_id=args.worker_id)
+    metrics.set_shipper_identity(
+        f"worker:{args.node_id[:12]}.{args.worker_id[:12]}")
 
     host = _WorkerHost(
         args.node, args.shm or None,
@@ -630,8 +667,13 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
     host.node.call("register_worker", args.worker_id, addr, os.getpid())
 
     # Die with the daemon: if the control connection drops, exit.
+    # Between liveness polls, ship any pending metric deltas to the
+    # daemon (collect() rate-limits the snapshot; one flag check pins
+    # the disabled cost of this loop).
     while not host.node.closed:
         time.sleep(tuning.PENDING_POLL_PERIOD_S)
+        if metrics.enabled():
+            host.flush_metrics()
     os._exit(0)
 
 

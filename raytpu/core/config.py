@@ -184,6 +184,15 @@ declare_env("RAYTPU_ALLOW_PIP", "allow pip-install runtime envs (bool)")
 # Workflows (workflow/storage.py).
 declare_env("RAYTPU_WORKFLOW_ROOT", "workflow checkpoint storage root")
 
+# Metrics pipeline (util/metrics.py): read at import so the registry and
+# shipping buffer are bounded before any cluster config exists.
+declare_env("RAYTPU_METRICS_SHIP",
+            "ship metric deltas to the head TSDB (bool, default on)")
+declare_env("RAYTPU_METRIC_MAX_SERIES",
+            "distinct tag-sets per metric before folding into <other>")
+declare_env("RAYTPU_METRICS_BUFFER_MAX",
+            "per-process pending metric-frame buffer cap")
+
 # --- Declared knobs (reference: ray_config_def.h) ----------------------------
 
 # Scheduling. Hybrid policy packs nodes until utilization crosses this
@@ -274,3 +283,16 @@ declare("memory_monitor_refresh_ms", 250)
 # agent port, metrics_agent.py). 0 = disabled; scrape config for it via
 # `raytpu metrics export-config`.
 declare("head_metrics_port", 0)
+
+# Head TSDB (util/tsdb.py): bounded cluster time-series store fed by
+# shipped metric deltas. Fine ring 120 x 5 s = 10 min sharp history,
+# coarse ring 120 x 30 s = 1 h downsampled, all under a hard byte cap.
+declare("metrics_store_max_bytes", 8 * 1024 * 1024)
+declare("metrics_fine_step_s", 5.0)
+declare("metrics_fine_slots", 120)
+declare("metrics_coarse_step_s", 30.0)
+declare("metrics_coarse_slots", 120)
+# SLO alert rules evaluated on the head over the TSDB, ';'-separated,
+# e.g. "raytpu_infer_ttft_seconds:p95 > 2.0 for 30s". Fires into the
+# ops-event log (state.list_events / post-mortem dumps).
+declare("metrics_alert_rules", "")

@@ -196,6 +196,20 @@ class DashboardServer:
                 if n.get("Alive")
                 and n.get("Labels", {}).get("role") != "driver"]
 
+    def _cluster_prometheus(self) -> Optional[str]:
+        """Cluster-aggregated exposition text from the head TSDB; None
+        when not in cluster mode or the head is unreachable (callers
+        fall back to the per-process registry)."""
+        from raytpu.runtime import api as rt_api
+
+        b = rt_api._backend
+        if b is None or type(b).__name__ != "ClusterBackend":
+            return None
+        try:
+            return b._head.call("metrics_prometheus")
+        except Exception:
+            return None
+
     _LOG_CHUNK = 1 << 20
     _LOG_MAX_BYTES = 8 << 20  # full-file reads cap here, flagged
 
@@ -302,6 +316,17 @@ class DashboardServer:
                          "attachment; filename=trace.json"})
 
         async def metrics(request):
+            """Prometheus exposition. Default is the head TSDB's
+            cluster-aggregated view — every process's shipped series
+            behind one scrape target. ``?local=1`` keeps the legacy
+            per-process prometheus_client registry."""
+            if request.query.get("local") != "1":
+                loop = asyncio.get_running_loop()
+                text = await loop.run_in_executor(
+                    None, self._cluster_prometheus)
+                if text is not None:
+                    return web.Response(text=text,
+                                        content_type="text/plain")
             try:
                 import prometheus_client
 
@@ -309,6 +334,54 @@ class DashboardServer:
             except Exception:
                 text = "# prometheus_client unavailable\n"
             return web.Response(text=text, content_type="text/plain")
+
+        async def api_metrics_query(request):
+            """Cluster-aggregated time series from the head TSDB.
+            ?name= (required), ?agg=sum|max|min|avg|rate|p50..p99,
+            ?since=<seconds>, ?step=<seconds>, ?tag.<key>=<val>."""
+            from raytpu.state import api as state
+
+            q = request.query
+            name = q.get("name")
+            if not name:
+                return web.Response(status=400, text="name is required")
+            try:
+                since_s = float(q.get("since", 600.0))
+                step = float(q["step"]) if q.get("step") else None
+            except ValueError:
+                return web.Response(status=400,
+                                    text="since/step must be numbers")
+            tags = {k[4:]: v for k, v in q.items()
+                    if k.startswith("tag.")} or None
+            loop = asyncio.get_running_loop()
+            try:
+                data = await loop.run_in_executor(
+                    None, lambda: state.query_metrics(
+                        name, tags=tags, agg=q.get("agg", "sum"),
+                        since_s=since_s, step=step))
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=503)
+            if data is None:
+                return web.Response(status=503, text="head unreachable")
+            return web.json_response(data)
+
+        async def api_metrics_series(request):
+            """Every live (name, tags, kind) series the head TSDB holds;
+            ?prefix= filters by metric-name prefix."""
+            from raytpu.state import api as state
+
+            prefix = request.query.get("prefix") or None
+            loop = asyncio.get_running_loop()
+            try:
+                data = await loop.run_in_executor(
+                    None, state.list_metric_series, prefix)
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=503)
+            if data is None:
+                return web.Response(status=503, text="head unreachable")
+            return web.json_response(data)
 
         async def logs_index(request):
             """Per-node log file listing (reference: the dashboard's log
@@ -536,6 +609,8 @@ class DashboardServer:
         # /api/{section} wildcard or the section handler would 404 them
         # as unknown snapshot keys.
         app.router.add_get("/api/trace", api_trace)
+        app.router.add_get("/api/metrics/query", api_metrics_query)
+        app.router.add_get("/api/metrics/series", api_metrics_series)
         app.router.add_get("/api/state/summary/{kind}", api_state_summary)
         app.router.add_get("/api/state/timeline/{entity_id}",
                            api_state_timeline)

@@ -73,6 +73,106 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _cmd_top(args) -> int:
+    """Live cluster metrics terminal view over the head TSDB's
+    ``metrics_query`` RPC (reference: ``ray status`` crossed with
+    ``htop``). Redraws every --interval seconds until Ctrl-C; -n bounds
+    the redraw count for scripts and tests."""
+    import time as _time
+
+    from raytpu.cluster.protocol import RpcClient
+
+    cli = RpcClient(args.address)
+
+    def latest(name, agg, tags=None, since=90.0):
+        """Last non-empty bucket of one aggregated query; None when the
+        series doesn't exist yet (metric never shipped)."""
+        try:
+            res = cli.call("metrics_query", name, tags, agg, since, None)
+        except Exception:
+            return None
+        if not res or not res.get("series_matched"):
+            return None
+        pts = [p for p in res.get("points") or [] if p[1] is not None]
+        return pts[-1][1] if pts else None
+
+    def fmt(v, spec="{:.1f}", scale=1.0):
+        return "-" if v is None else spec.format(v * scale)
+
+    def draw() -> None:
+        lines = [
+            f"raytpu top — {args.address} — "
+            f"{_time.strftime('%H:%M:%S')}",
+            "",
+            "  tasks/s   submitted "
+            + fmt(latest("raytpu_tasks_submitted_total", "rate"))
+            + "   finished "
+            + fmt(latest("raytpu_tasks_done_total", "rate"))
+            + "   queue depth "
+            + fmt(latest("raytpu_node_pending_tasks", "sum"), "{:.0f}"),
+            "  transfer  pull "
+            + fmt(latest("raytpu_node_pull_bytes_total", "rate"),
+                  "{:.2f}", 1 / 2**20)
+            + " MB/s   push-rx "
+            + fmt(latest("raytpu_node_push_rx_bytes_total", "rate"),
+                  "{:.2f}", 1 / 2**20) + " MB/s",
+        ]
+        kv = latest("raytpu_infer_kv_page_utilization", "max")
+        ttft = latest("raytpu_infer_ttft_seconds", "p95")
+        if kv is not None or ttft is not None:
+            lines.append(
+                "  infer     kv util " + fmt(kv, "{:.2f}")
+                + "   ttft p95 " + fmt(ttft, "{:.0f}", 1e3) + " ms"
+                + "   waiting "
+                + fmt(latest("raytpu_infer_waiting_requests", "sum"),
+                      "{:.0f}")
+                + "   running "
+                + fmt(latest("raytpu_infer_running_requests", "sum"),
+                      "{:.0f}"))
+        try:
+            series = cli.call("metrics_series", "raytpu_node_rss_bytes")
+        except Exception:
+            series = None
+        procs = sorted({s["tags"].get("proc") for s in series or []
+                        if s["tags"].get("proc")})
+        if procs:
+            lines += ["", "  proc                 rss MB   shm MB "
+                          "(used/cap)   running  pending"]
+            for proc in procs:
+                t = {"proc": proc}
+                shm_u = latest("raytpu_node_shm_used_bytes", "max", t)
+                shm_c = latest("raytpu_node_shm_capacity_bytes", "max", t)
+                lines.append(
+                    f"  {proc:<20} "
+                    + fmt(latest("raytpu_node_rss_bytes", "max", t),
+                          "{:>7.0f}", 1 / 2**20)
+                    + f"   {fmt(shm_u, '{:.0f}', 1 / 2**20)}"
+                      f"/{fmt(shm_c, '{:.0f}', 1 / 2**20)}".ljust(17)
+                    + "  "
+                    + fmt(latest("raytpu_node_running_tasks", "max", t),
+                          "{:>6.0f}")
+                    + "  "
+                    + fmt(latest("raytpu_node_pending_tasks", "max", t),
+                          "{:>6.0f}"))
+        if not args.no_clear:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print("\n".join(lines), flush=True)
+
+    shown = 0
+    try:
+        while True:
+            draw()
+            shown += 1
+            if args.iterations and shown >= args.iterations:
+                break
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cli.close()
+    return 0
+
+
 def _cmd_timeline(args) -> int:
     import raytpu
     from raytpu.util.tracing import timeline
@@ -490,6 +590,17 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("status", help="cluster status")
     s.add_argument("--address", required=True)
     s.set_defaults(fn=_cmd_status)
+
+    s = sub.add_parser("top", help="live cluster metrics view "
+                                   "(head TSDB aggregation)")
+    s.add_argument("--address", required=True)
+    s.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between redraws")
+    s.add_argument("-n", "--iterations", type=int, default=0,
+                   help="stop after N redraws (0 = until Ctrl-C)")
+    s.add_argument("--no-clear", action="store_true",
+                   help="append instead of clearing the screen")
+    s.set_defaults(fn=_cmd_top)
 
     s = sub.add_parser("timeline", help="dump chrome-trace timeline")
     s.add_argument("--address", default=None)

@@ -327,6 +327,45 @@ class ServeController(LongPollHost):
         # but any fresh report counts as at least one waiting request.
         return max(sum(n for _, n in fresh) / 2.0, 1.0)
 
+    def _tsdb_engine_pressure(self):
+        """Cluster-aggregated engine pressure from the head TSDB — one
+        query through this worker's daemon replaces the O(replicas)
+        ``get_metrics`` fan-out. Engine series are untagged, so this is
+        cluster-wide pressure; with one engine deployment per cluster
+        (the common shape) it equals the per-deployment view. Returns
+        ``(EnginePressure, running)`` or ``(None, 0.0)`` when the TSDB
+        has no fresh infer series (shipping off, local mode, engines not
+        exporting) — callers then fall back to polling replicas."""
+        from raytpu.runtime import api as rt_api
+        from raytpu.util import metrics
+
+        if not metrics.enabled():
+            return None, 0.0
+        host = getattr(rt_api._backend, "_host", None)
+        if host is None:
+            return None, 0.0
+
+        def latest(name: str, agg: str):
+            try:
+                res = host.node.call("metrics_query", name, None, agg,
+                                     30.0, None, timeout=2.0)
+            except Exception:
+                return None
+            if not res or not res.get("series_matched"):
+                return None
+            pts = [p for p in res.get("points") or [] if p[1] is not None]
+            return pts[-1][1] if pts else None
+
+        waiting = latest("raytpu_infer_waiting_requests", "sum")
+        if waiting is None:
+            return None, 0.0
+        return EnginePressure(
+            waiting_requests=waiting,
+            kv_utilization=latest(
+                "raytpu_infer_kv_page_utilization", "max") or 0.0,
+            ttft_p95_s=latest("raytpu_infer_ttft_seconds", "p95") or 0.0,
+        ), latest("raytpu_infer_running_requests", "sum") or 0.0
+
     async def _autoscale(self, state: DeploymentState):
         if state.autoscaler is None:
             return
@@ -334,6 +373,26 @@ class ServeController(LongPollHost):
         # Engine pressure aggregates: queue depths SUM (total unmet
         # demand), occupancy and latency take the WORST replica (one
         # saturated engine is a problem even if its peers are idle).
+        # Preferred source is the head TSDB (already cluster-merged, one
+        # query); the per-replica fan-out below is the fallback.
+        try:
+            pressure, running = await asyncio.get_event_loop() \
+                .run_in_executor(None, self._tsdb_engine_pressure)
+        except Exception:
+            pressure, running = None, 0.0
+        if pressure is not None:
+            total += running
+            decision = state.autoscaler.get_decision_num_replicas(
+                total, state.target_num_replicas, engine_pressure=pressure
+            )
+            if decision is not None and decision != state.target_num_replicas:
+                logger.info(
+                    "autoscaling %s: %d -> %d (load=%.1f, tsdb)",
+                    state.full_name, state.target_num_replicas, decision,
+                    total,
+                )
+                state.target_num_replicas = decision
+            return
         waiting = kv_util = ttft = 0.0
         saw_pressure = False
         for rep in list(state.replicas.values()):

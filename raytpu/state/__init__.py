@@ -5,17 +5,20 @@ from raytpu.state.api import (
     list_actors,
     list_events,
     list_nodes,
+    list_metric_series,
     list_objects,
     list_placement_groups,
     list_tasks,
     object_summary,
+    query_metrics,
     summarize_tasks,
     summary_actors,
     summary_tasks,
 )
 
 __all__ = [
-    "get_timeline", "list_actors", "list_events", "list_nodes",
-    "list_objects", "list_placement_groups", "list_tasks",
-    "object_summary", "summarize_tasks", "summary_actors", "summary_tasks",
+    "get_timeline", "list_actors", "list_events", "list_metric_series",
+    "list_nodes", "list_objects", "list_placement_groups", "list_tasks",
+    "object_summary", "query_metrics", "summarize_tasks", "summary_actors",
+    "summary_tasks",
 ]

@@ -291,6 +291,62 @@ def list_events(severity: Optional[str] = None,
     return events.recent_events(severity, label)[-int(limit):]
 
 
+# -- metrics ------------------------------------------------------------------
+
+
+_local_mstore = None
+
+
+def _local_metric_store():
+    """Single-process mode has no head TSDB; fold the in-process metric
+    registry's pending delta frames into a module-lifetime store so
+    repeated queries see accumulated history, not just the last delta."""
+    global _local_mstore
+    from raytpu.util import metrics
+    from raytpu.util import tsdb
+
+    if _local_mstore is None:
+        _local_mstore = tsdb.MetricStore()
+    metrics.collect(force=True)
+    frames, dropped = metrics.drain()
+    if dropped:
+        _local_mstore.note_upstream_drops(dropped)
+    if frames:
+        _local_mstore.push(frames)
+    return _local_mstore
+
+
+def query_metrics(name: str, tags: Optional[Dict[str, str]] = None,
+                  agg: str = "sum", since_s: float = 600.0,
+                  step: Optional[float] = None) -> Optional[Dict[str, Any]]:
+    """Aggregate one metric across the cluster from the head TSDB
+    (``{"name", "kind", "agg", "step", "series_matched", "points"}``).
+    Local mode folds the in-process registry; ``None`` when the head is
+    unreachable."""
+    b = _backend()
+    if _is_cluster(b):
+        try:
+            return b._head.call("metrics_query", name, tags, agg,
+                                since_s, step)
+        except Exception:
+            return None
+    return _local_metric_store().query(name, tags=tags, agg=agg,
+                                       since_s=since_s, step=step)
+
+
+def list_metric_series(prefix: Optional[str] = None) -> \
+        Optional[List[Dict[str, Any]]]:
+    """Every live series (name, tags, kind) the head TSDB currently
+    holds, optionally filtered by name prefix."""
+    b = _backend()
+    if _is_cluster(b):
+        try:
+            return b._head.call("metrics_series", prefix)
+        except Exception:
+            return None
+    return _local_metric_store().series(prefix)
+
+
 # -- summaries & timelines ----------------------------------------------------
 
 

@@ -1,4 +1,5 @@
-"""User-defined metrics: Counter / Gauge / Histogram.
+"""User-defined metrics: Counter / Gauge / Histogram — plus the
+cluster shipping pipeline.
 
 Reference analogue: ``python/ray/util/metrics.py:137,262,187`` — the
 user-facing metric API whose samples flow to Prometheus. The reference
@@ -7,12 +8,39 @@ with ``prometheus_client`` (in-process registry) and expose the scrape
 endpoint via :func:`start_metrics_server` — one fewer hop, same exposition
 format. Without ``prometheus_client`` installed, metrics degrade to
 in-memory counters (observable via ``.value``/tests, nothing exported).
+
+Cluster shipping (reference: ``src/ray/stats/metric_exporter.h:36`` —
+per-process collectors drained to a cluster aggregation point): every
+process periodically snapshots its registry *deltas* (counter increments,
+gauge last-values, histogram bucket increments) into primitive-only
+frames that ride the existing liveness paths (node heartbeat,
+worker→node notify) to the head's :class:`raytpu.util.tsdb.MetricStore`.
+Same bounded-buffer / requeue-on-failure contract as task-event shipping
+(``util/task_events.py``). ``RAYTPU_METRICS_SHIP=0`` turns the whole
+pipeline off; disabled-and-idle cost at each ship site is a single flag
+check (:func:`enabled`).
+
+Tag-cardinality bound: each metric holds at most ``_MAX_SERIES``
+(``RAYTPU_METRIC_MAX_SERIES``) distinct tag-sets; overflow folds into a
+``{"tag": "<other>"}`` series and bumps
+``raytpu_metrics_series_dropped_total`` so a tag explosion can't bloat
+the shipping frames or the head store.
+
+Every built-in metric name must be declared in the append-only
+:data:`DECLARED_METRICS` table (lint rule RTP015, mirroring the
+``declare_env`` registry); user code outside ``raytpu/`` may mint
+ad-hoc names freely.
 """
 
 from __future__ import annotations
 
+import bisect
+import os
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+import weakref
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 try:
     import prometheus_client as _prom
@@ -23,6 +51,56 @@ _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                     5.0, 10.0, 30.0, 60.0)
 _registry_lock = threading.Lock()
 _registered: Dict[str, object] = {}
+_instances: "weakref.WeakSet[_Metric]" = weakref.WeakSet()
+
+# Append-only registry of every metric name the runtime itself constructs
+# (lint rule RTP015 walks Counter/Gauge/Histogram call sites under
+# ``raytpu/`` and cross-checks against this table, exactly like RTP008
+# does for env vars). Keep alphabetized within each section; never
+# remove an entry — renames append the new name and leave the old one.
+DECLARED_METRICS: Dict[str, str] = {
+    # -- head / cluster state ------------------------------------------
+    "raytpu_actors": "live actor count by state",
+    "raytpu_cluster_nodes": "cluster node count by liveness state",
+    "raytpu_placement_groups": "placement group count",
+    "raytpu_resources_available": "available resource units by kind",
+    "raytpu_resources_total": "total resource units by kind",
+    "raytpu_schedule_requests_total": "scheduling requests handled",
+    "raytpu_tasks_done_total": "tasks finished cluster-wide",
+    "raytpu_tasks_submitted_total": "task specs accepted for scheduling",
+    # -- inference serving ---------------------------------------------
+    "raytpu_infer_decode_tokens_per_s": "decode throughput",
+    "raytpu_infer_decode_tokens_total": "decode tokens generated",
+    "raytpu_infer_kv_page_utilization": "KV page pool utilization 0..1",
+    "raytpu_infer_prefill_tokens_per_s": "prefill throughput",
+    "raytpu_infer_prefill_tokens_total": "prefill tokens processed",
+    "raytpu_infer_prefix_evictions_total": "prefix cache evictions",
+    "raytpu_infer_prefix_hit_tokens_total": "prefix cache tokens reused",
+    "raytpu_infer_prefix_hits_total": "prefix cache lookup hits",
+    "raytpu_infer_prefix_lookups_total": "prefix cache lookups",
+    "raytpu_infer_running_requests": "requests in the running batch",
+    "raytpu_infer_ttft_seconds": "time-to-first-token distribution",
+    "raytpu_infer_waiting_requests": "requests queued for admission",
+    # -- node daemon ---------------------------------------------------
+    "raytpu_node_pending_tasks": "tasks queued on the node",
+    "raytpu_node_pull_bytes_total": "object bytes pulled from peers",
+    "raytpu_node_push_rx_bytes_total": "object bytes received via push",
+    "raytpu_node_rss_bytes": "node daemon resident set size",
+    "raytpu_node_running_tasks": "tasks executing on the node",
+    "raytpu_node_shm_capacity_bytes": "shared-memory arena capacity",
+    "raytpu_node_shm_used_bytes": "shared-memory arena bytes in use",
+    # -- metrics pipeline itself ---------------------------------------
+    "raytpu_metrics_series_dropped_total":
+        "tag-sets folded into <other> by the cardinality cap",
+    # -- worker --------------------------------------------------------
+    "raytpu_worker_tasks_total": "tasks executed by the worker process",
+}
+
+# Tag-cardinality cap: distinct tag-sets per metric before folding into
+# the ``<other>`` series. Module global so tests can patch it.
+ENV_MAX_SERIES = "RAYTPU_METRIC_MAX_SERIES"
+_MAX_SERIES = int(os.environ.get(ENV_MAX_SERIES, "") or 128)
+OTHER_TAG_VALUE = "<other>"
 
 
 def _sanitize(name: str) -> str:
@@ -38,7 +116,10 @@ class _Metric:
         self._default_tags: Dict[str, str] = {}
         self._values: Dict[Tuple, float] = {}
         self._lock = threading.Lock()
+        self._ship_state: Dict[Tuple, object] = {}
         self._prom = self._make_prom() if _prom is not None else None
+        with _registry_lock:
+            _instances.add(self)
 
     def _make_prom(self):
         raise NotImplementedError
@@ -75,6 +156,17 @@ class _Metric:
             raise ValueError(f"missing tag values for {sorted(missing)}")
         return tuple(merged[k] for k in self._tag_keys)
 
+    def _fold(self, key: Tuple, table: Dict) -> Tuple[Tuple, bool]:
+        """Cardinality cap (caller holds ``self._lock``): a key beyond
+        ``_MAX_SERIES`` distinct tag-sets folds into the ``<other>``
+        series so one runaway tag can't bloat frames or the head store."""
+        if not self._tag_keys or key in table or len(table) < _MAX_SERIES:
+            return key, False
+        return (OTHER_TAG_VALUE,) * len(self._tag_keys), True
+
+    def _delta_rows(self) -> List[list]:
+        raise NotImplementedError
+
     @property
     def info(self) -> dict:
         return {"name": self._name, "description": self._description,
@@ -95,7 +187,10 @@ class Counter(_Metric):
             raise ValueError("counters only increase")
         key = self._tag_tuple(tags)
         with self._lock:
+            key, folded = self._fold(key, self._values)
             self._values[key] = self._values.get(key, 0.0) + value
+        if folded:
+            _note_series_drop(self._name)
         if self._prom is not None:
             (self._prom.labels(*key) if key else self._prom).inc(value)
 
@@ -103,6 +198,17 @@ class Counter(_Metric):
     def value(self) -> float:
         with self._lock:
             return sum(self._values.values())
+
+    def _delta_rows(self) -> List[list]:
+        rows: List[list] = []
+        with self._lock:
+            for key, val in self._values.items():
+                inc = val - self._ship_state.get(key, 0.0)
+                if inc > 0:
+                    rows.append(["c", self._name, list(self._tag_keys),
+                                 list(key), inc])
+                    self._ship_state[key] = val
+        return rows
 
 
 class Gauge(_Metric):
@@ -117,7 +223,10 @@ class Gauge(_Metric):
             tags: Optional[Dict[str, str]] = None) -> None:
         key = self._tag_tuple(tags)
         with self._lock:
+            key, folded = self._fold(key, self._values)
             self._values[key] = value
+        if folded:
+            _note_series_drop(self._name)
         if self._prom is not None:
             (self._prom.labels(*key) if key else self._prom).set(value)
 
@@ -137,6 +246,14 @@ class Gauge(_Metric):
         """Per-tag-tuple snapshot (keys ordered by ``tag_keys``)."""
         with self._lock:
             return dict(self._values)
+
+    def _delta_rows(self) -> List[list]:
+        # Gauges ship every live tag-set each interval (not just on
+        # change) so steady values still produce points — a flat-lined
+        # KV-utilization gauge must not read as a vanished series.
+        with self._lock:
+            return [["g", self._name, list(self._tag_keys), list(key), val]
+                    for key, val in self._values.items()]
 
 
 class Histogram(_Metric):
@@ -162,8 +279,11 @@ class Histogram(_Metric):
                 tags: Optional[Dict[str, str]] = None) -> None:
         key = self._tag_tuple(tags)
         with self._lock:
+            key, folded = self._fold(key, self._by_key)
             self._observations.append(value)
             self._by_key.setdefault(key, []).append(value)
+        if folded:
+            _note_series_drop(self._name)
         if self._prom is not None:
             (self._prom.labels(*key) if key else self._prom).observe(value)
 
@@ -179,6 +299,207 @@ class Histogram(_Metric):
         """Observations keyed by tag tuple (ordered by ``tag_keys``)."""
         with self._lock:
             return {k: list(v) for k, v in self._by_key.items()}
+
+    def _delta_rows(self) -> List[list]:
+        rows: List[list] = []
+        with self._lock:
+            for key, obs in self._by_key.items():
+                idx = self._ship_state.get(key, 0)
+                new = obs[idx:]
+                if not new:
+                    continue
+                counts = [0] * (len(self._boundaries) + 1)
+                for v in new:
+                    counts[bisect.bisect_left(self._boundaries, v)] += 1
+                rows.append(["h", self._name, list(self._tag_keys),
+                             list(key), list(self._boundaries), counts,
+                             float(sum(new)), len(new)])
+                self._ship_state[key] = len(obs)
+        return rows
+
+
+# The fold counter is created lazily (the class must exist first) and
+# never reports on itself: its own key space is bounded by the set of
+# metric names, but self-reporting could recurse through ``inc``.
+_series_dropped: Optional[Counter] = None
+_series_dropped_lock = threading.Lock()
+
+
+def _note_series_drop(metric_name: str) -> None:
+    global _series_dropped
+    if metric_name == "raytpu_metrics_series_dropped_total":
+        return
+    with _series_dropped_lock:
+        if _series_dropped is None:
+            _series_dropped = Counter(
+                "raytpu_metrics_series_dropped_total",
+                "tag-sets folded into <other> by the cardinality cap",
+                tag_keys=("metric",))
+    try:
+        _series_dropped.inc(tags={"metric": metric_name})
+    except Exception:  # pragma: no cover - never break the caller
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Cluster shipping: registry deltas -> primitive frames -> head TSDB.
+#
+# Frame shape (strict-wire primitives only):
+#   [proc_id, seq, ts, rows]
+# with rows one of
+#   ["c", name, [tag_keys], [tag_vals], increment]
+#   ["g", name, [tag_keys], [tag_vals], last_value]
+#   ["h", name, [tag_keys], [tag_vals], [boundaries], [bucket_incs],
+#    sum_inc, count_inc]
+# ``seq`` is per-origin monotonic; the head drops seq <= last-applied so
+# a requeued-and-reshipped frame merges idempotently.
+# ---------------------------------------------------------------------------
+
+ENV_SHIP = "RAYTPU_METRICS_SHIP"
+ENV_BUFFER_MAX = "RAYTPU_METRICS_BUFFER_MAX"
+
+_BUFFER_MAX = int(os.environ.get(ENV_BUFFER_MAX, "") or 256)
+_ship_enabled = os.environ.get(ENV_SHIP, "") not in ("0", "false", "False")
+_ship_lock = threading.Lock()
+_frames: Deque[list] = deque()
+_frames_dropped_total = 0
+_frames_dropped_shipped = 0  # watermark: drops already reported downstream
+_ship_seq = 0
+_last_collect = [0.0]
+_proc_id = [""]
+
+
+def enabled() -> bool:
+    """THE flag check: every ship site guards with exactly this call, so
+    ``RAYTPU_METRICS_SHIP=0`` costs one boolean read per tick."""
+    return _ship_enabled
+
+
+def enable_metrics_ship(env: bool = False) -> None:
+    global _ship_enabled
+    _ship_enabled = True
+    if env:
+        os.environ[ENV_SHIP] = "1"
+
+
+def disable_metrics_ship(env: bool = False) -> None:
+    """Default is ON, so (unlike task events) disabling for children
+    must *set* the env var to ``0`` rather than unset it."""
+    global _ship_enabled
+    _ship_enabled = False
+    if env:
+        os.environ[ENV_SHIP] = "0"
+
+
+def set_shipper_identity(proc_id: str) -> None:
+    """Stamp outgoing frames with this process's stable identity
+    (``head`` / ``node:<hex12>`` / ``driver:<hex12>`` /
+    ``worker:<nodehex12>.<workerhex12>``). The head tombstones dead
+    procs by this id, so the convention is load-bearing."""
+    _proc_id[0] = str(proc_id)
+
+
+def shipper_identity() -> str:
+    return _proc_id[0] or f"pid:{os.getpid()}"
+
+
+def collect(min_interval_s: float = 0.0, force: bool = False,
+            now: Optional[float] = None) -> bool:
+    """Snapshot registry deltas into one pending frame. Rate-limited by
+    ``min_interval_s`` so a fast heartbeat loop can call it every beat.
+    Returns True iff a frame was produced."""
+    if not _ship_enabled:
+        return False
+    if now is None:
+        now = time.time()
+    with _ship_lock:
+        if not force and min_interval_s > 0 and \
+                now - _last_collect[0] < min_interval_s:
+            return False
+        _last_collect[0] = now
+    with _registry_lock:
+        insts = list(_instances)
+    rows: List[list] = []
+    for m in insts:
+        try:
+            rows.extend(m._delta_rows())
+        except Exception:  # pragma: no cover - one bad metric != no ship
+            pass
+    if not rows:
+        return False
+    global _ship_seq, _frames_dropped_total
+    with _ship_lock:
+        _ship_seq += 1
+        frame = [shipper_identity(), _ship_seq, now, rows]
+        if len(_frames) >= _BUFFER_MAX:
+            _frames.popleft()
+            _frames_dropped_total += 1
+        _frames.append(frame)
+    return True
+
+
+def drain() -> Tuple[List[list], int]:
+    """Take everything pending plus the not-yet-reported drop delta.
+    On ship failure hand both back via :func:`requeue` — the watermark
+    arithmetic keeps drop counts exact across retries."""
+    global _frames_dropped_shipped
+    with _ship_lock:
+        frames = list(_frames)
+        _frames.clear()
+        dropped_delta = _frames_dropped_total - _frames_dropped_shipped
+        _frames_dropped_shipped = _frames_dropped_total
+    return frames, dropped_delta
+
+
+def requeue(frames: List[list], dropped: int = 0) -> None:
+    """Put a failed ship back at the FRONT of the buffer (oldest-first
+    order preserved); overflow drops the oldest of the requeued batch."""
+    if not frames and not dropped:
+        return
+    global _frames_dropped_total, _frames_dropped_shipped
+    with _ship_lock:
+        _frames_dropped_shipped -= dropped
+        space = _BUFFER_MAX - len(_frames)
+        if len(frames) > space:
+            lost = len(frames) - max(space, 0)
+            frames = frames[lost:]
+            _frames_dropped_total += lost
+        _frames.extendleft(reversed(frames))
+
+
+def ingest(frames: List[list], dropped: int = 0) -> None:
+    """Relay path: a node daemon absorbs a worker's drained frames into
+    its own buffer; they ride the next heartbeat to the head."""
+    global _frames_dropped_total
+    with _ship_lock:
+        _frames_dropped_total += int(dropped or 0)
+        for f in frames or ():
+            if len(_frames) >= _BUFFER_MAX:
+                _frames.popleft()
+                _frames_dropped_total += 1
+            _frames.append(f)
+
+
+def pending_frames() -> int:
+    with _ship_lock:
+        return len(_frames)
+
+
+def reset_shipping() -> None:
+    """Test isolation: clear the buffer, counters, and every metric's
+    per-instance ship watermarks (so totals re-ship as fresh deltas)."""
+    global _frames_dropped_total, _frames_dropped_shipped, _ship_seq
+    with _ship_lock:
+        _frames.clear()
+        _frames_dropped_total = 0
+        _frames_dropped_shipped = 0
+        _ship_seq = 0
+        _last_collect[0] = 0.0
+    with _registry_lock:
+        insts = list(_instances)
+    for m in insts:
+        with m._lock:
+            m._ship_state.clear()
 
 
 _servers: Dict[int, tuple] = {}  # port -> (wsgi_server, thread)

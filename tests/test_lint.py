@@ -589,6 +589,64 @@ class TestBlobMaterialization:  # RTP014
         assert res.findings == []
 
 
+class TestMetricRegistry:  # RTP015
+    def test_planted_undeclared_name(self):
+        findings = run_rule_on_source(_rule("RTP015"), _src("""
+            from raytpu.util.metrics import Counter
+
+            c = Counter("raytpu_bogus_total", "not in the registry")
+        """))
+        assert len(findings) == 1
+        assert "raytpu_bogus_total" in findings[0].message
+        assert "DECLARED_METRICS" in findings[0].message
+
+    def test_planted_attribute_form_with_alias(self):
+        findings = run_rule_on_source(_rule("RTP015"), _src("""
+            from raytpu.util import metrics as m
+
+            g = m.Gauge("raytpu_nope", "undeclared")
+        """))
+        assert len(findings) == 1
+        assert "raytpu_nope" in findings[0].message
+
+    def test_planted_dynamic_name(self):
+        findings = run_rule_on_source(_rule("RTP015"), _src("""
+            from raytpu.util.metrics import Histogram
+
+            def make(suffix):
+                return Histogram(f"raytpu_{suffix}_seconds", "dyn")
+        """))
+        assert len(findings) == 1
+        assert "dynamically-built" in findings[0].message
+
+    def test_declared_name_clean(self):
+        assert run_rule_on_source(_rule("RTP015"), _src("""
+            from raytpu.util import metrics
+
+            done = metrics.Counter("raytpu_tasks_done_total", "ok")
+        """)) == []
+
+    def test_collections_counter_not_flagged(self):
+        # Only constructors traceably bound to raytpu.util.metrics count.
+        assert run_rule_on_source(_rule("RTP015"), _src("""
+            from collections import Counter
+
+            c = Counter()
+            c["raytpu_whatever_total"] += 1
+        """)) == []
+
+    def test_registry_file_is_exempt(self):
+        assert run_rule_on_source(_rule("RTP015"), _src("""
+            from raytpu.util.metrics import Counter
+
+            c = Counter("raytpu_self_total", "the registry defines these")
+        """), rel="raytpu/util/metrics.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP015"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

@@ -42,16 +42,12 @@ class TestPrometheusConfig:
 
 
 class TestGrafanaDashboard:
-    def test_panel_exprs_reference_only_registered_series(self):
-        from raytpu.cluster.head import _HeadMetrics
-
-        hm = _HeadMetrics()
-        registered = set()
-        for attr in ("nodes", "actors", "pgs", "resources", "available",
-                     "schedules", "tasks_done"):
-            m = getattr(hm, attr)
-            assert m is not None, f"_HeadMetrics.{attr} failed to build"
-            registered.add(m.info["name"])
+    def test_panel_exprs_reference_only_declared_series(self):
+        """The /metrics endpoint the panels scrape is now the head
+        TSDB's cluster aggregation, so every series an expr references
+        must be in the append-only DECLARED_METRICS registry (histogram
+        exprs may use the _bucket/_sum/_count exposition suffixes)."""
+        from raytpu.util.metrics import DECLARED_METRICS
 
         dash = metrics_export.grafana_dashboard()
         referenced = set()
@@ -60,10 +56,27 @@ class TestGrafanaDashboard:
                 referenced.update(
                     re.findall(r"raytpu_[a-z0-9_]+", target["expr"]))
         assert referenced, "dashboard must query at least one series"
-        unknown = referenced - registered
+        unknown = set()
+        for name in referenced:
+            candidates = [name] + [
+                name[: -len(sfx)] for sfx in ("_bucket", "_sum", "_count")
+                if name.endswith(sfx)]
+            if not any(c in DECLARED_METRICS for c in candidates):
+                unknown.add(name)
         assert not unknown, (
-            f"grafana panels query unregistered series {sorted(unknown)}; "
-            f"head publishes only {sorted(registered)}")
+            f"grafana panels query undeclared series {sorted(unknown)}; "
+            f"declare them in metrics.DECLARED_METRICS")
+
+    def test_head_metrics_build_and_are_declared(self):
+        from raytpu.cluster.head import _HeadMetrics
+        from raytpu.util.metrics import DECLARED_METRICS
+
+        hm = _HeadMetrics()
+        for attr in ("nodes", "actors", "pgs", "resources", "available",
+                     "schedules", "tasks_done", "tasks_submitted"):
+            m = getattr(hm, attr)
+            assert m is not None, f"_HeadMetrics.{attr} failed to build"
+            assert m.info["name"] in DECLARED_METRICS
 
     def test_dashboard_is_json_serializable_with_panels(self):
         dash = metrics_export.grafana_dashboard()

@@ -1,0 +1,748 @@
+"""Cluster metrics pipeline: shipping, head TSDB, alerts, E2E, chaos.
+
+Covers the PR's contracts:
+
+- shipping: registry deltas become primitive frames with per-origin
+  monotonic seq; drain/requeue/ingest keep drop accounting exact across
+  failed ships and relay hops (the task-event buffer contract);
+- delta-merge idempotence: a requeued-and-reshipped frame applies to
+  the head store exactly once (seq dedup);
+- TSDB bounds under a fake clock: fine-ring wrap folds into the coarse
+  ring (staircase downsampling, ~10 min survives the memory cap), FIFO
+  eviction keeps the byte estimate under ``max_bytes``;
+- histogram-merge percentiles agree with a single-process oracle to
+  bucket resolution;
+- tag-cardinality cap folds runaway tag-sets into ``<other>`` and
+  counts them in ``raytpu_metrics_series_dropped_total``;
+- disabled cost: each ship site executes exactly ONE
+  ``metrics.enabled()`` flag check (asserted at runtime and by AST);
+- SLO alerts: rule parsing, sustained-duration firing and resolving;
+- E2E (slow): a 2-node cluster answers ``metrics_query`` with series
+  from head + node + worker procs, ``raytpu top`` renders them, and an
+  alert rule fires into the ops-event log;
+- chaos (slow): a node killed mid-ship cannot resurrect stale series; a
+  bounced head sees shipping resume after re-registration.
+"""
+
+import ast
+import inspect
+import subprocess
+import sys
+import time
+
+import pytest
+
+import raytpu
+from raytpu.util import metrics
+from raytpu.util import tsdb
+
+
+@pytest.fixture
+def shipper():
+    """Armed shipper with a clean buffer and identity; restores on exit."""
+    metrics.reset_shipping()
+    metrics.enable_metrics_ship()
+    old_id = metrics._proc_id[0]
+    metrics.set_shipper_identity("node:aaaaaaaaaaaa")
+    yield metrics
+    metrics.reset_shipping()
+    metrics.enable_metrics_ship()
+    metrics._proc_id[0] = old_id
+
+
+def _store(**over):
+    """Fake-clock store with small rings unless overridden."""
+    t = over.pop("t", [1000.0])
+    kw = dict(max_bytes=1_000_000, fine_step_s=1.0, fine_slots=4,
+              coarse_step_s=2.0, coarse_slots=100, clock=lambda: t[0])
+    kw.update(over)
+    return tsdb.MetricStore(**kw), t
+
+
+def _cframe(proc, seq, ts, name, inc, keys=(), vals=()):
+    return [proc, seq, ts, [["c", name, list(keys), list(vals), inc]]]
+
+
+def _gframe(proc, seq, ts, name, val):
+    return [proc, seq, ts, [["g", name, [], [], val]]]
+
+
+# -- shipping ----------------------------------------------------------------
+
+
+class TestShipping:
+    def test_collect_builds_frames_with_monotonic_seq(self, shipper):
+        c = metrics.Counter("tp_ship_seq_total", "t")
+        c.inc(3)
+        assert metrics.collect(force=True)
+        c.inc(2)
+        assert metrics.collect(force=True)
+        frames, dropped = metrics.drain()
+        assert dropped == 0
+        ours = [f for f in frames
+                if any(r[1] == "tp_ship_seq_total" for r in f[3])]
+        assert len(ours) == 2
+        assert ours[0][0] == "node:aaaaaaaaaaaa"
+        assert ours[1][1] > ours[0][1]  # per-origin monotonic seq
+        incs = [r[4] for f in ours for r in f[3]
+                if r[1] == "tp_ship_seq_total"]
+        assert incs == [3.0, 2.0]  # deltas, not totals
+
+    def test_rate_limit_skips_inside_interval(self, shipper):
+        c = metrics.Counter("tp_ship_rl_total", "t")
+        c.inc()
+        assert metrics.collect(min_interval_s=10.0, now=1000.0)
+        c.inc()
+        # Inside the min interval: skipped, the delta stays pending.
+        assert not metrics.collect(min_interval_s=10.0, now=1005.0)
+        assert metrics.collect(min_interval_s=10.0, now=1011.0)
+        frames, _ = metrics.drain()
+        incs = [r[4] for f in frames for r in f[3]
+                if r[1] == "tp_ship_rl_total"]
+        assert sum(incs) == 2.0  # the skipped beat's delta shipped later
+
+    def test_requeue_preserves_order_and_drop_accounting(self, shipper):
+        c = metrics.Counter("tp_ship_rq_total", "t")
+        for _ in range(3):
+            c.inc()
+            metrics.collect(force=True)
+        frames, dropped = metrics.drain()
+        assert len(frames) >= 3 and dropped == 0
+        metrics.requeue(frames, dropped)
+        again, dropped2 = metrics.drain()
+        assert again == frames  # oldest-first order preserved
+        assert dropped2 == 0
+
+    def test_buffer_overflow_drops_oldest_and_counts(self, shipper,
+                                                     monkeypatch):
+        monkeypatch.setattr(metrics, "_BUFFER_MAX", 2)
+        c = metrics.Counter("tp_ship_ovf_total", "t")
+        for _ in range(4):
+            c.inc()
+            metrics.collect(force=True)
+        frames, dropped = metrics.drain()
+        assert len(frames) == 2
+        assert dropped == 2
+        # A failed ship hands the drop count back too; the next drain
+        # re-reports it exactly once.
+        metrics.requeue(frames, dropped)
+        _, dropped2 = metrics.drain()
+        assert dropped2 == 2
+
+    def test_ingest_relays_foreign_frames(self, shipper):
+        metrics.ingest([_cframe("worker:aaaaaaaaaaaa.bbbbbbbbbbbb", 1,
+                                1000.0, "tp_ship_ing_total", 1.0)],
+                       dropped=3)
+        frames, dropped = metrics.drain()
+        assert any(f[0].startswith("worker:") for f in frames)
+        assert dropped == 3
+
+    def test_disabled_mode_is_inert(self, shipper):
+        metrics.disable_metrics_ship()
+        try:
+            assert not metrics.enabled()
+            c = metrics.Counter("tp_ship_off_total", "t")
+            c.inc()
+            assert not metrics.collect(force=True)
+            assert metrics.pending_frames() == 0
+        finally:
+            metrics.enable_metrics_ship()
+
+    def test_disable_for_children_sets_env_to_zero(self, shipper,
+                                                   monkeypatch):
+        import os
+
+        monkeypatch.delenv(metrics.ENV_SHIP, raising=False)
+        # Default is ON, so the child-visible disable must WRITE "0",
+        # not unset the variable.
+        metrics.disable_metrics_ship(env=True)
+        try:
+            assert os.environ[metrics.ENV_SHIP] == "0"
+        finally:
+            metrics.enable_metrics_ship(env=True)
+            monkeypatch.delenv(metrics.ENV_SHIP, raising=False)
+
+
+# -- delta-merge idempotence --------------------------------------------------
+
+
+class TestDeltaMergeIdempotence:
+    def test_duplicate_frame_applies_once(self):
+        store, _ = _store()
+        f = _cframe("node:aaaaaaaaaaaa", 1, 1000.0, "m_total", 5.0)
+        assert store.push([f]) == 1
+        assert store.push([f]) == 0  # reshipped duplicate
+        res = store.query("m_total", since_s=60, now=1001.0)
+        assert sum(v for _, v in res["points"]) == 5.0
+        assert store.stats()["frames_deduped"] == 1
+
+    def test_requeued_then_reshipped_batch_merges_once(self, shipper):
+        """The full contract: collect -> drain -> failed ship -> requeue
+        -> drain -> ship twice. The store must count every increment
+        exactly once."""
+        store, _ = _store(fine_step_s=5.0, fine_slots=120)
+        c = metrics.Counter("tp_idem_total", "t")
+        c.inc(7)
+        metrics.collect(force=True)
+        frames, dropped = metrics.drain()
+        metrics.requeue(frames, dropped)          # ship failed
+        frames2, dropped2 = metrics.drain()       # retry drains same batch
+        store.push(frames)                        # late first attempt lands
+        store.push(frames2)                       # retry lands too
+        res = store.query("tp_idem_total", since_s=600)
+        total = sum(v for _, v in res["points"])
+        assert total == 7.0
+
+    def test_out_of_order_origins_are_independent(self):
+        store, _ = _store()
+        store.push([_cframe("node:aaaaaaaaaaaa", 5, 1000.0, "m_total", 1.0)])
+        # A different origin with a lower seq is NOT a duplicate.
+        store.push([_cframe("node:bbbbbbbbbbbb", 1, 1000.0, "m_total", 1.0)])
+        res = store.query("m_total", since_s=60, now=1001.0)
+        assert sum(v for _, v in res["points"]) == 2.0
+        assert res["series_matched"] == 2  # distinct proc tag per origin
+
+
+# -- rings, downsampling, eviction (fake clock) -------------------------------
+
+
+class TestStoreRings:
+    def test_fine_wrap_folds_into_coarse_without_loss(self):
+        store, t = _store()  # fine: 4 x 1s, coarse: 2s
+        for i in range(20):
+            ts = 1000.0 + i
+            store.push([_cframe("node:aaaaaaaaaaaa", i + 1, ts,
+                                "m_total", 1.0)])
+        t[0] = 1020.0
+        res = store.query("m_total", since_s=60, step=1.0)
+        # Staircase: every increment survives, in exactly one ring.
+        assert sum(v for _, v in res["points"]) == 20.0
+
+    def test_ten_minutes_survive_under_memory_cap(self):
+        store, t = _store(max_bytes=64_000, fine_step_s=5.0, fine_slots=12,
+                          coarse_step_s=30.0, coarse_slots=40)
+        start = 10_020.0  # coarse-aligned so the first fold stays in-window
+        for i in range(120):                       # one inc / 5s for 10 min
+            store.push([_cframe("node:aaaaaaaaaaaa", i + 1,
+                                start + i * 5.0, "m_total", 1.0)])
+        t[0] = start + 600.0
+        res = store.query("m_total", since_s=600.0)
+        assert sum(v for _, v in res["points"]) == 120.0
+        # History spans ~10 minutes: the earliest surviving bucket is
+        # old, even though the fine ring only holds the last minute.
+        assert res["points"][0][0] <= t[0] - 540.0
+        assert store.stats()["bytes"] <= 64_000
+
+    def test_gauge_latest_wins_across_rings_and_regrid(self):
+        store, t = _store()
+        for i in range(10):
+            store.push([_gframe("node:aaaaaaaaaaaa", i + 1,
+                                1000.0 + i, "g", float(i))])
+        t[0] = 1010.0
+        res = store.query("g", agg="max", since_s=60, step=20.0)
+        # One output bucket; the latest source bucket's value wins the
+        # regrid (not the first fold touched).
+        assert res["points"][-1][1] == 9.0
+
+    def test_stale_write_older_than_window_is_dropped(self):
+        store, t = _store()
+        store.push([_cframe("node:aaaaaaaaaaaa", 1, 1000.0, "m_total", 1.0)])
+        store.push([_cframe("node:aaaaaaaaaaaa", 2, 1050.0, "m_total", 1.0)])
+        # ts 996 maps to the slot now owned by a newer bucket: dropped,
+        # never double-counted.
+        store.push([_cframe("node:aaaaaaaaaaaa", 3, 996.0, "m_total", 9.0)])
+        t[0] = 1051.0
+        res = store.query("m_total", since_s=600)
+        assert sum(v for _, v in res["points"]) == 2.0
+
+    def test_fifo_eviction_same_kind_first(self):
+        store, t = _store(max_bytes=6_000)
+        n = 0
+        while store.stats()["series_evicted"] == 0 and n < 200:
+            n += 1
+            store.push([_cframe("node:aaaaaaaaaaaa", n, 1000.0,
+                                f"m{n}_total", 1.0)])
+        st = store.stats()
+        assert st["series_evicted"] > 0
+        assert st["bytes"] <= 6_000
+        # FIFO: the first-created series is the first victim.
+        assert store.query("m1_total", since_s=600,
+                           now=1001.0)["series_matched"] == 0
+        assert store.query(f"m{n}_total", since_s=600,
+                           now=1001.0)["series_matched"] == 1
+
+    def test_oversized_series_is_rejected_not_wedged(self):
+        store, _ = _store(max_bytes=100)
+        store.push([_cframe("node:aaaaaaaaaaaa", 1, 1000.0, "m_total", 1.0)])
+        assert store.stats()["rows_dropped"] == 1
+        assert store.stats()["series"] == 0
+
+
+# -- histogram merge ----------------------------------------------------------
+
+
+class TestHistogramMerge:
+    BOUNDS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def _ship(self, store, obs_by_proc, ts=1000.0):
+        h = metrics.Histogram("tp_hist_merge_seconds", "t",
+                              boundaries=self.BOUNDS)
+        for i, (proc, obs) in enumerate(sorted(obs_by_proc.items())):
+            with h._lock:
+                h._observations = list(obs)
+                h._by_key = {(): list(obs)}
+                h._ship_state = {}
+            rows = h._delta_rows()
+            store.push([[proc, 1, ts, rows]])
+        return h
+
+    def test_percentiles_match_single_process_oracle(self):
+        """Merged-bucket p50/p95 across two procs vs the quantile of the
+        pooled raw observations, to bucket resolution."""
+        a = [0.02 + 0.001 * i for i in range(50)]    # 0.02..0.07
+        b = [0.3 + 0.01 * i for i in range(50)]      # 0.3..0.8
+        store, t = _store(fine_step_s=5.0, fine_slots=120)
+        self._ship(store, {"worker:aaaaaaaaaaaa.01": a,
+                           "worker:bbbbbbbbbbbb.02": b})
+        t[0] = 1001.0
+        pooled = sorted(a + b)
+        for agg, q in (("p50", 0.50), ("p95", 0.95)):
+            res = store.query("tp_hist_merge_seconds", agg=agg,
+                              since_s=600)
+            assert res["series_matched"] == 2
+            est = res["points"][-1][1]
+            oracle = pooled[int(q * len(pooled)) - 1]
+            # The estimate interpolates inside the oracle's bucket.
+            import bisect
+
+            bi = bisect.bisect_left(self.BOUNDS, oracle)
+            lo = self.BOUNDS[bi - 1] if bi > 0 else 0.0
+            hi = self.BOUNDS[min(bi, len(self.BOUNDS) - 1)]
+            assert lo <= est <= hi, (agg, est, oracle, lo, hi)
+
+    def test_avg_rate_and_sum_from_merged_sum_count(self):
+        store, t = _store(fine_step_s=5.0, fine_slots=120)
+        self._ship(store, {"worker:aaaaaaaaaaaa.01": [1.0, 2.0, 3.0]})
+        t[0] = 1001.0
+        avg = store.query("tp_hist_merge_seconds", agg="avg",
+                          since_s=600)["points"][-1][1]
+        assert avg == pytest.approx(2.0)
+        rate = store.query("tp_hist_merge_seconds", agg="rate",
+                           since_s=600)["points"][-1][1]
+        assert rate == pytest.approx(3 / 5.0)
+
+    def test_bucket_quantile_overflow_clamps(self):
+        # All mass in +Inf: clamp to the highest boundary, never crash.
+        assert tsdb._bucket_quantile([0, 0, 5], (0.1, 1.0), 0.95) == 1.0
+        assert tsdb._bucket_quantile([0, 0, 0], (0.1, 1.0), 0.5) is None
+
+    def test_boundary_mismatch_row_dropped(self):
+        store, _ = _store()
+        row = ["h", "hh", [], [], [0.1, 1.0], [1, 0, 0], 0.05, 1]
+        store.push([["node:aaaaaaaaaaaa", 1, 1000.0, [row]]])
+        bad = ["h", "hh", [], [], [0.5, 2.0], [1, 0, 0], 0.05, 1]
+        store.push([["node:aaaaaaaaaaaa", 2, 1000.0, [bad]]])
+        assert store.stats()["rows_dropped"] == 1
+
+
+# -- cardinality cap ----------------------------------------------------------
+
+
+class TestCardinalityCap:
+    def test_overflow_folds_into_other_and_counts_drops(self, shipper,
+                                                        monkeypatch):
+        monkeypatch.setattr(metrics, "_MAX_SERIES", 2)
+        c = metrics.Counter("tp_card_total", "t", tag_keys=("user",))
+        before = (metrics._series_dropped.value
+                  if metrics._series_dropped else 0.0)
+        for i in range(5):
+            c.inc(tags={"user": f"u{i}"})
+        with c._lock:
+            keys = set(c._values)
+        assert (metrics.OTHER_TAG_VALUE,) in keys
+        assert len(keys) == 3  # u0, u1, <other>
+        assert c.value == 5.0  # folding never loses increments
+        assert metrics._series_dropped is not None
+        assert metrics._series_dropped.value == before + 3
+
+    def test_drop_counter_never_reports_itself(self, shipper,
+                                               monkeypatch):
+        monkeypatch.setattr(metrics, "_MAX_SERIES", 1)
+        g = metrics.Gauge("tp_card_g", "t", tag_keys=("k",))
+        g.set(1.0, tags={"k": "a"})
+        g.set(1.0, tags={"k": "b"})   # folds; must not recurse
+        assert metrics._series_dropped is not None
+
+
+# -- one-flag-check disabled cost (AST) ---------------------------------------
+
+
+def _count_enabled_calls(obj, modname="metrics"):
+    src = inspect.getsource(obj)
+    tree = ast.parse("if 1:\n" + src if src[0] in " \t" else src)
+    return sum(
+        1 for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "enabled"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == modname)
+
+
+class TestOneFlagCheck:
+    def test_node_heartbeat_loop_has_exactly_one_check(self):
+        from raytpu.cluster.node import NodeServer
+
+        assert _count_enabled_calls(NodeServer._heartbeat_loop) == 1
+
+    def test_worker_keepalive_has_exactly_one_check(self):
+        from raytpu.cluster import worker_proc
+
+        assert _count_enabled_calls(worker_proc.main) == 1
+
+    def test_head_local_ingest_has_exactly_one_check(self):
+        from raytpu.cluster.head import HeadServer
+
+        assert _count_enabled_calls(
+            HeadServer._ingest_local_metrics) == 1
+
+    def test_client_shutdown_flush_has_exactly_one_check(self):
+        from raytpu.cluster.client import ClusterBackend
+
+        assert _count_enabled_calls(ClusterBackend.shutdown,
+                                    modname="_metrics") == 1
+
+
+# -- dead procs ---------------------------------------------------------------
+
+
+class TestDeadProcs:
+    def test_mark_dead_drops_node_driver_and_worker_series(self):
+        store, _ = _store()
+        for i, proc in enumerate(("node:aaaaaaaaaaaa",
+                                  "worker:aaaaaaaaaaaa.cccccccccccc",
+                                  "driver:aaaaaaaaaaaa",
+                                  "node:bbbbbbbbbbbb")):
+            store.push([_cframe(proc, 1, 1000.0, "m_total", 1.0)])
+        assert store.mark_proc_dead("aaaaaaaaaaaa") == 3
+        res = store.query("m_total", since_s=600, now=1001.0)
+        assert res["series_matched"] == 1  # only node:bbb... survives
+        # A late frame from the dead node is rejected, not resurrected.
+        store.push([_cframe("node:aaaaaaaaaaaa", 2, 1000.5, "m_total", 9.0)])
+        assert store.stats()["frames_rejected"] == 1
+        assert store.query("m_total", since_s=600,
+                           now=1001.0)["series_matched"] == 1
+
+    def test_revive_allows_shipping_again(self):
+        store, _ = _store()
+        store.push([_cframe("node:aaaaaaaaaaaa", 1, 1000.0, "m_total", 1.0)])
+        store.mark_proc_dead("aaaaaaaaaaaa")
+        store.revive_proc("aaaaaaaaaaaa")
+        store.push([_cframe("node:aaaaaaaaaaaa", 1, 1000.5, "m_total", 2.0)])
+        res = store.query("m_total", since_s=600, now=1001.0)
+        assert sum(v for _, v in res["points"]) == 2.0
+
+
+# -- exposition ---------------------------------------------------------------
+
+
+class TestPrometheusText:
+    def test_counter_gauge_histogram_exposition(self):
+        store, _ = _store()
+        store.push([
+            ["node:aaaaaaaaaaaa", 1, 1000.0, [
+                ["c", "c_total", ["k"], ["v"], 3.0],
+                ["g", "g1", [], [], 7.5],
+                ["h", "h1", [], [], [0.1, 1.0], [1, 2, 1], 2.3, 4],
+            ]]])
+        text = store.prometheus_text()
+        assert "# TYPE c_total counter" in text
+        assert 'c_total{k="v",proc="node:aaaaaaaaaaaa"} 3' in text
+        assert 'g1{proc="node:aaaaaaaaaaaa"} 7.5' in text
+        assert 'h1_bucket{proc="node:aaaaaaaaaaaa",le="0.1"} 1' in text
+        assert 'h1_bucket{proc="node:aaaaaaaaaaaa",le="+Inf"} 4' in text
+        assert 'h1_count{proc="node:aaaaaaaaaaaa"} 4' in text
+
+
+# -- alerts -------------------------------------------------------------------
+
+
+class TestAlerts:
+    def test_parse_rules(self):
+        rules = tsdb.parse_alert_rules(
+            "raytpu_infer_ttft_seconds:p95 > 2.0 for 30s; "
+            "raytpu_node_pending_tasks:sum >= 100")
+        assert len(rules) == 2
+        assert rules[0].agg == "p95" and rules[0].for_s == 30.0
+        assert rules[1].op == ">=" and rules[1].for_s == 0.0
+        assert tsdb.parse_alert_rules("") == []
+
+    def test_parse_malformed_raises(self):
+        with pytest.raises(ValueError):
+            tsdb.parse_alert_rules("what even is this")
+        with pytest.raises(ValueError):
+            tsdb.parse_alert_rules("m:notanagg > 1")
+
+    def test_fire_after_sustained_breach_then_resolve(self):
+        store, t = _store(fine_step_s=1.0, fine_slots=120)
+        fired, resolved = [], []
+        ev = tsdb.AlertEvaluator(
+            store, tsdb.parse_alert_rules("g:max > 5 for 10s"),
+            on_fire=lambda r, v: fired.append((r.name, v)),
+            on_resolve=lambda r, v: resolved.append((r.name, v)))
+        seq = [0]
+
+        def g(val, ts):
+            seq[0] += 1
+            store.push([_gframe("node:aaaaaaaaaaaa", seq[0], ts, "g", val)])
+
+        g(9.0, 1000.0)
+        t[0] = 1000.0
+        ev.tick()
+        assert not fired            # breached but not yet sustained
+        for dt in range(1, 11):
+            g(9.0, 1000.0 + dt)
+            t[0] = 1000.0 + dt
+            ev.tick()
+        assert len(fired) == 1      # fires once, not every tick
+        assert ev.firing()
+        g(1.0, 1012.0)
+        t[0] = 1012.0
+        ev.tick()
+        assert len(resolved) == 1
+        assert not ev.firing()
+
+    def test_missing_series_never_fires(self):
+        store, _ = _store()
+        fired = []
+        ev = tsdb.AlertEvaluator(
+            store, tsdb.parse_alert_rules("nope:sum > 0"),
+            on_fire=lambda r, v: fired.append(r))
+        ev.tick()
+        assert not fired
+
+
+# -- E2E: 2-node cluster ------------------------------------------------------
+
+
+TTFT_BOUNDS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _poll(fn, timeout=60.0, period=0.25):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(period)
+    return last
+
+
+@pytest.mark.slow
+class TestClusterMetricsE2E:
+    def test_cluster_aggregated_series_and_alert(self, tmp_path):
+        from raytpu.cluster.cluster_utils import Cluster
+        from raytpu.cluster.protocol import RpcClient
+
+        metrics.enable_metrics_ship(env=True)
+        cluster = Cluster()
+        head = None
+        try:
+            cluster.add_node(num_cpus=2, num_tpus=0)
+            cluster.add_node(num_cpus=2, num_tpus=0)
+            cluster.wait_for_nodes(2)
+            raytpu.init(address=cluster.address)
+            head = RpcClient(cluster.address)
+            assert head.call(
+                "metrics_set_alert_rules",
+                "raytpu_tasks_done_total:sum > 0 for 0s")
+
+            @raytpu.remote
+            def bump(x):
+                return x + 1
+
+            out = raytpu.get([bump.remote(i) for i in range(20)],
+                             timeout=60)
+            assert out == list(range(1, 21))
+            # Move ~1 MB through the data plane so transfer counters
+            # tick: the driver holds the bytes, the task runs on a
+            # worker node, the node must pull.
+            blob = raytpu.put(b"x" * (1 << 20))
+
+            @raytpu.remote
+            def size(b):
+                return len(b)
+
+            assert raytpu.get(size.remote(blob), timeout=60) == 1 << 20
+            # A histogram shipped from the driver's embedded node.
+            h = metrics.Histogram("raytpu_infer_ttft_seconds", "",
+                                  boundaries=TTFT_BOUNDS)
+            for v in (0.02, 0.07, 0.3, 0.6, 1.4):
+                h.observe(v)
+
+            def agg(name, a="sum", since=600.0):
+                res = head.call("metrics_query", name, None, a, since,
+                                None)
+                return sum(v for _, v in res["points"])
+
+            # Submit/finish counters reached the TSDB.
+            assert _poll(lambda: agg("raytpu_tasks_done_total") >= 21,
+                         timeout=60)
+            assert agg("raytpu_tasks_submitted_total") >= 21
+            # Node gauges (queue depth present, shm capacity nonzero).
+            assert _poll(lambda: head.call(
+                "metrics_query", "raytpu_node_pending_tasks", None,
+                "sum", 600.0, None)["series_matched"] >= 2, timeout=60)
+            assert agg("raytpu_node_shm_capacity_bytes", "max") > 0
+            # Transfer bytes from the put-arg pull.
+            assert _poll(
+                lambda: agg("raytpu_node_pull_bytes_total") >= (1 << 20),
+                timeout=60)
+            # Histogram percentile across the cluster.
+            p95 = _poll(lambda: (head.call(
+                "metrics_query", "raytpu_infer_ttft_seconds", None,
+                "p95", 600.0, None)["points"] or [[0, None]])[-1][1],
+                timeout=60)
+            assert p95 is not None and 0.0 < p95 <= 10.0
+            # Series arrived from every layer: head, nodes, workers.
+            procs = _poll(lambda: (lambda ps: ps if (
+                "head" in ps
+                and any(p.startswith("node:") for p in ps)
+                and any(p.startswith("worker:") for p in ps)) else None)(
+                {s["tags"].get("proc", "")
+                 for s in head.call("metrics_series", None)}), timeout=60)
+            assert procs, "missing a layer in shipped series"
+            # The SLO alert fired into the ops-event log.
+            fired = _poll(lambda: [
+                e for e in head.call("list_events", "ERROR")
+                if e.get("label") == "SLO_ALERT"], timeout=60)
+            assert fired, "alert rule never fired"
+            assert head.call("metrics_alerts")["firing"]
+            # State-API wrappers see the same data.
+            from raytpu.state import api as state
+
+            q = state.query_metrics("raytpu_tasks_done_total")
+            assert q and q["series_matched"] >= 1
+            assert state.list_metric_series("raytpu_node_")
+            # Cluster-aggregated exposition text.
+            text = head.call("metrics_prometheus")
+            assert "# TYPE raytpu_tasks_done_total counter" in text
+            assert 'proc="head"' in text
+        finally:
+            if head is not None:
+                head.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+
+    def test_raytpu_top_renders(self):
+        from raytpu.cluster.cluster_utils import Cluster
+
+        metrics.enable_metrics_ship(env=True)
+        cluster = Cluster()
+        try:
+            cluster.add_node(num_cpus=2, num_tpus=0)
+            cluster.wait_for_nodes(1)
+            raytpu.init(address=cluster.address)
+
+            @raytpu.remote
+            def one():
+                return 1
+
+            assert raytpu.get([one.remote() for _ in range(5)],
+                              timeout=60) == [1] * 5
+            time.sleep(3.0)  # one ship period so node gauges land
+            out = subprocess.run(
+                [sys.executable, "-m", "raytpu", "top",
+                 "--address", cluster.address, "-n", "1", "--no-clear"],
+                capture_output=True, text=True, timeout=60)
+            assert out.returncode == 0, out.stderr
+            assert "raytpu top" in out.stdout
+            assert "tasks/s" in out.stdout
+            assert "queue depth" in out.stdout
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+
+# -- chaos --------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestChaosMetrics:
+    def test_node_death_drops_series_without_resurrection(self):
+        from raytpu.cluster.cluster_utils import Cluster
+        from raytpu.cluster.protocol import RpcClient
+
+        metrics.enable_metrics_ship(env=True)
+        cluster = Cluster()
+        head = None
+        try:
+            doomed = cluster.add_node(num_cpus=1, num_tpus=0)
+            cluster.add_node(num_cpus=1, num_tpus=0)
+            cluster.wait_for_nodes(2)
+            raytpu.init(address=cluster.address)
+            head = RpcClient(cluster.address)
+
+            def node_procs():
+                return {s["tags"].get("proc", "")
+                        for s in head.call("metrics_series",
+                                           "raytpu_node_rss_bytes")}
+
+            dead_proc = f"node:{doomed.node_id}"
+            assert _poll(lambda: dead_proc in node_procs() or None,
+                         timeout=60), "victim node never shipped"
+            cluster.kill_node(doomed)
+            # The head tombstones the proc when the heartbeat timeout
+            # declares it dead; its series must vanish...
+            assert _poll(lambda: dead_proc not in node_procs() or None,
+                         timeout=90), "dead node's series survived"
+            # ...and STAY gone (no late-frame resurrection).
+            time.sleep(3.0)
+            assert dead_proc not in node_procs()
+            assert head.call("metrics_stats")["dead_procs"] >= 1
+        finally:
+            if head is not None:
+                head.close()
+            raytpu.shutdown()
+            cluster.shutdown()
+
+    def test_head_bounce_shipping_resumes(self, tmp_path):
+        from raytpu.cluster.cluster_utils import Cluster
+        from raytpu.cluster.protocol import RpcClient
+
+        metrics.enable_metrics_ship(env=True)
+        cluster = Cluster(head_storage=str(tmp_path / "gcs"))
+        head = None
+        try:
+            node = cluster.add_node(num_cpus=1, num_tpus=0)
+            cluster.wait_for_nodes(1)
+            head = RpcClient(cluster.address)
+            proc = f"node:{node.node_id}"
+
+            def has_series(cli):
+                return any(
+                    s["tags"].get("proc") == proc
+                    for s in cli.call("metrics_series",
+                                      "raytpu_node_rss_bytes"))
+
+            assert _poll(lambda: has_series(head) or None, timeout=60)
+            head.close()
+            head = None
+            cluster.restart_head()
+            head = RpcClient(cluster.address)
+            # The node reconnects, re-registers (shedding any tombstone),
+            # and its heartbeats refill the fresh TSDB.
+            def resumed():
+                try:
+                    return has_series(head) or None
+                except Exception:
+                    return None
+
+            assert _poll(resumed, timeout=90), \
+                "shipping never resumed after head bounce"
+        finally:
+            if head is not None:
+                head.close()
+            cluster.shutdown()
