@@ -1,0 +1,290 @@
+"""Elastic-cluster recovery bench: head-bounce MTTR and gang re-form,
+elastic vs fixed.
+
+Three measurements, one JSON, each in its own child process (``--child
+<mode>``) so env knobs are read at import time and a crashed cluster
+can't poison the next mode:
+
+- **head_bounce**: a 1-node cluster with durable head storage. First an
+  in-flight ``get()`` rides across a head SIGKILL + restart (the task
+  keeps executing on the node throughout; the number reported is the
+  latency the bounce ADDED on top of the task's own runtime). Then the
+  head is bounced again while idle and MTTR is the time from restart
+  until a fresh submit round-trips — covering head reload-from-sqlite,
+  node re-registration, and the driver's reconnect path.
+
+- **gang-elastic / gang-fixed**: a 2-node cluster runs a 2-worker gang
+  (one CPU each, rank 0 timestamps every step to a marker file). One
+  node is SIGKILLed mid-run; replacement capacity arrives a fixed
+  ``RESTORE_DELAY`` later. The elastic trainer (``min_workers=1``)
+  re-forms at world size 1 from the latest checkpoint and keeps
+  stepping through the outage, then scales back to 2 at a checkpoint
+  boundary; the fixed trainer can only retry at full strength, so its
+  first post-kill step waits for the replacement node. The A/B is
+  time-to-first-report-after-kill and steps completed during the
+  outage window.
+
+Writes BENCH_r14.json at the repo root and prints the same object as
+one JSON line.
+
+Env: RAYTPU_BENCH_STEPS (default 60), RAYTPU_BENCH_RESTORE_DELAY_S
+(default 5), RAYTPU_BENCH_SLOW_TASK_S (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+STEPS = int(os.environ.get("RAYTPU_BENCH_STEPS", "60"))
+RESTORE_DELAY_S = float(
+    os.environ.get("RAYTPU_BENCH_RESTORE_DELAY_S", "5"))
+SLOW_TASK_S = float(os.environ.get("RAYTPU_BENCH_SLOW_TASK_S", "3"))
+
+
+# -- head-bounce MTTR (child) -------------------------------------------------
+
+
+def run_head_bounce() -> dict:
+    import tempfile
+
+    import raytpu
+    from raytpu.cluster.cluster_utils import Cluster
+
+    storage = os.path.join(tempfile.mkdtemp(), "gcs.db")
+    cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1},
+                      head_storage=storage)
+    cluster.wait_for_nodes(1)
+    raytpu.init(address=cluster.address)
+    try:
+        sleep_s = SLOW_TASK_S
+
+        @raytpu.remote
+        def echo(x):
+            return x
+
+        @raytpu.remote
+        def slow_echo(x):
+            import time as _t
+            _t.sleep(sleep_s)
+            return x
+
+        assert raytpu.get(echo.remote(1), timeout=60) == 1  # warm path
+
+        # In-flight get across the bounce: the node keeps executing the
+        # task the whole time, so everything beyond the task's own
+        # sleep is reconnect + re-locate cost.
+        t0 = time.monotonic()
+        ref = slow_echo.remote(7)
+        time.sleep(0.5)
+        cluster.kill_head()
+        cluster.restart_head()
+        assert raytpu.get(ref, timeout=120) == 7
+        inflight_total = time.monotonic() - t0
+
+        # MTTR: bounce an idle cluster, time restart -> first fresh
+        # round-trip (head reload + node re-register + driver redial).
+        cluster.kill_head()
+        cluster.restart_head()
+        t_restart = time.monotonic()
+        deadline = t_restart + 120
+        while True:
+            try:
+                if raytpu.get(echo.remote(99), timeout=10) == 99:
+                    break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        mttr = time.monotonic() - t_restart
+        return {
+            "mode": "head_bounce",
+            "inflight_get_total_s": round(inflight_total, 3),
+            "inflight_task_sleep_s": sleep_s,
+            "bounce_added_latency_s": round(
+                inflight_total - sleep_s, 3),
+            "mttr_s": round(mttr, 3),
+        }
+    finally:
+        raytpu.shutdown()
+        cluster.shutdown()
+
+
+# -- gang re-form, elastic vs fixed (child) -----------------------------------
+
+
+def run_gang(elastic: bool) -> dict:
+    import tempfile
+
+    import raytpu
+    from raytpu.cluster.cluster_utils import Cluster
+    from raytpu.train import (
+        Checkpoint,
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+        get_checkpoint,
+        get_context,
+        report,
+    )
+
+    cluster = Cluster(num_nodes=2, node_resources={"num_cpus": 1})
+    cluster.wait_for_nodes(2)
+    raytpu.init(address=cluster.address)
+    tmp = tempfile.mkdtemp()
+    marker = os.path.join(tmp, "marker.txt")
+
+    def loop(config):
+        import os as _os
+        import tempfile as _tf
+        import time as _t
+
+        ctx = get_context()
+        ckpt = get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(_os.path.join(ckpt.path, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, config["steps"]):
+            _t.sleep(0.1)
+            d = _tf.mkdtemp()
+            with open(_os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            if ctx.get_world_rank() == 0:
+                with open(config["marker"], "a") as f:
+                    f.write("%f %d %d\n"
+                            % (_t.time(), step, ctx.world_size))
+            report({"step": step, "world": ctx.world_size},
+                   checkpoint=Checkpoint(d))
+
+    def lines():
+        try:
+            with open(marker) as f:
+                return [(float(t), int(s), int(w))
+                        for t, s, w in
+                        (line.split() for line in f if line.strip())]
+        except FileNotFoundError:
+            return []
+
+    try:
+        trainer = JaxTrainer(
+            loop, train_loop_config={"marker": marker, "steps": STEPS},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                min_workers=1 if elastic else None,
+                elastic=elastic,
+                resources_per_worker={"CPU": 1.0},
+                placement_strategy="PACK"),
+            run_config=RunConfig(
+                storage_path=os.path.join(tmp, "run"),
+                failure_config=FailureConfig(max_failures=8)))
+        box = {}
+        th = threading.Thread(
+            target=lambda: box.update(r=trainer.fit()))
+        t_start = time.time()
+        th.start()
+        deadline = time.time() + 120
+        while time.time() < deadline and len(lines()) < 5:
+            time.sleep(0.1)
+        assert len(lines()) >= 5, "gang never reached steady state"
+
+        t_kill = time.time()
+        cluster.kill_node(cluster.nodes[-1], graceful=False)
+        time.sleep(RESTORE_DELAY_S)
+        cluster.add_node(num_cpus=1)
+        th.join(timeout=300)
+        assert not th.is_alive(), "fit() never finished"
+        total = time.time() - t_start
+        result = box["r"]
+
+        log = lines()
+        # Training stall: rank 0 timestamps every step, so the longest
+        # gap between consecutive reports IS the re-form outage (the
+        # surviving rank keeps reporting until teardown, then nothing
+        # until the next incarnation's first step).
+        ts = [t for (t, _, _) in log]
+        gaps = [b - a for a, b in zip(ts, ts[1:])]
+        stall = max(gaps) if gaps else None
+        outage_steps = len([1 for (t, _, _) in log
+                            if t_kill < t < t_kill + RESTORE_DELAY_S])
+        return {
+            "mode": "gang-elastic" if elastic else "gang-fixed",
+            "ok": result.error is None,
+            "steps": STEPS,
+            "restore_delay_s": RESTORE_DELAY_S,
+            "total_fit_s": round(total, 3),
+            "stall_s": round(stall, 3) if stall is not None else None,
+            "steps_during_outage": outage_steps,
+            "worlds_seen": sorted({w for (_, _, w) in log}),
+            "final_world": log[-1][2] if log else None,
+        }
+    finally:
+        raytpu.shutdown()
+        cluster.shutdown()
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def _spawn(mode: str) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAYTPU_HEARTBEAT_TIMEOUT_S"] = "2.0"
+    env["RAYTPU_HEALTH_CHECK_PERIOD_S"] = "0.5"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", mode],
+        env=env, capture_output=True, text=True, timeout=600)
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"child ({mode}) produced no result:\n"
+        f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+
+
+def main():
+    if "--child" in sys.argv:
+        mode = sys.argv[sys.argv.index("--child") + 1]
+        if mode == "head_bounce":
+            print(json.dumps(run_head_bounce()))
+        elif mode == "gang-elastic":
+            print(json.dumps(run_gang(elastic=True)))
+        elif mode == "gang-fixed":
+            print(json.dumps(run_gang(elastic=False)))
+        else:
+            raise SystemExit(f"unknown child mode {mode!r}")
+        return
+
+    bounce = _spawn("head_bounce")
+    el = _spawn("gang-elastic")
+    fx = _spawn("gang-fixed")
+    result = {
+        "bench": "elastic_recovery",
+        "head_bounce": bounce,
+        "gang_elastic": el,
+        "gang_fixed": fx,
+        # The elastic trainer steps through the outage; the fixed one
+        # waits it out. Both numbers in seconds of training stall.
+        "stall_elastic_s": el["stall_s"],
+        "stall_fixed_s": fx["stall_s"],
+    }
+    path = os.path.join(REPO_ROOT, "BENCH_r14.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,9 @@ RTP014 no-blob-materialization data-plane modules never flatten an
 RTP015 metric-registry         every Counter/Gauge/Histogram name is
                                a literal declared in
                                metrics.DECLARED_METRICS
+RTP016 persist-coverage        every mutation of a persisted head
+                               table pairs with its _persist_* call
+                               in the same function
 ====== ======================= ====================================
 """
 
@@ -53,6 +56,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     env_registry,
     jit_in_builders,
     metric_registry,
+    persist_coverage,
     rpc_loop,
     sched_purity,
     seam_swallow,

@@ -1,0 +1,120 @@
+"""RTP016: every mutation of a persisted head table is paired with its
+persist call in the same function.
+
+The head's durable tables (``GcsStore``, write-after-mutation
+discipline) only survive a head SIGKILL if every in-memory mutation is
+followed by the matching ``_persist_*`` write — the store is not a
+write-through dict, the pairing is a convention, and a missed pairing
+is invisible until a failover loses exactly that record. This rule
+makes the convention mechanical: a function that assigns into, deletes
+from, ``pop``s, ``update``s, ``setdefault``s, or ``clear``s one of the
+persisted tables must also call that table's persist function somewhere
+in the same ``def`` (before or after — write-after-mutation sites
+legitimately defer the persist until a lock is released, see RTP013).
+
+Exempt functions (by name): ``__init__`` (tables are being created),
+``_reload`` (tables are being rebuilt FROM the store), ``_snapshot``
+(write-behind path — it writes whole tables via ``snapshot_table``),
+and the ``_persist_*`` helpers themselves.
+
+Derived state (object directory, borrow sets, event tail) is snapshotted
+write-behind instead and deliberately NOT covered: per-mutation rows
+are too hot there, and a snapshot gap loses only restorable hints.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from raytpu.analysis.core import Rule, register
+
+# table attribute -> required persist method (both on the head object).
+PERSISTED_TABLES = {
+    "_kv": "_persist_kv",
+    "_actors": "_persist_actor",
+    "_pgs": "_persist_pg",
+    "_named": "_persist_named",
+    "_pending_specs": "_persist_pending_task",
+}
+
+_MUTATORS = {"pop", "update", "setdefault", "clear", "popitem"}
+
+_EXEMPT_FUNCS = {"__init__", "_reload", "_snapshot"} | \
+    set(PERSISTED_TABLES.values())
+
+
+def _self_attr(node) -> Optional[str]:
+    """``self.<attr>`` -> attr name, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutated_table(stmt) -> Optional[str]:
+    """Table name if this expression/statement directly mutates a
+    persisted ``self._<table>``, else None."""
+    if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                name = _self_attr(t.value)
+                if name in PERSISTED_TABLES:
+                    return name
+    if isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            if isinstance(t, ast.Subscript):
+                name = _self_attr(t.value)
+                if name in PERSISTED_TABLES:
+                    return name
+    if isinstance(stmt, ast.Call) \
+            and isinstance(stmt.func, ast.Attribute) \
+            and stmt.func.attr in _MUTATORS:
+        name = _self_attr(stmt.func.value)
+        if name in PERSISTED_TABLES:
+            return name
+    return None
+
+
+@register
+class PersistCoverage(Rule):
+    id = "RTP016"
+    name = "persist-coverage"
+    invariant = ("every function mutating a persisted head table "
+                 "(_kv/_actors/_pgs/_named/_pending_specs) calls the "
+                 "table's _persist_* somewhere in the same function")
+    rationale = ("the durable-head tables are write-after-mutation by "
+                 "convention; one missed pairing silently loses exactly "
+                 "that record on the next head failover")
+    scope = ("raytpu/cluster/head.py",)
+
+    def check(self, mod) -> Iterable:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name in _EXEMPT_FUNCS:
+                continue
+            mutations = []   # (node, table)
+            persisted = set()
+            for node in ast.walk(fn):
+                tbl = _mutated_table(node)
+                if tbl is not None:
+                    mutations.append((node, tbl))
+                if isinstance(node, ast.Call):
+                    attr = node.func.attr \
+                        if isinstance(node.func, ast.Attribute) else None
+                    if attr in set(PERSISTED_TABLES.values()):
+                        persisted.add(attr)
+            for node, tbl in mutations:
+                want = PERSISTED_TABLES[tbl]
+                if want not in persisted:
+                    yield self.finding(
+                        mod, node,
+                        f"self.{tbl} mutated without {want}() in "
+                        f"{fn.name}() — the record is lost on head "
+                        f"failover; pair the mutation or persist after "
+                        f"the lock releases")

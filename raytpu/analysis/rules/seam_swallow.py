@@ -2,16 +2,22 @@
 
 A ``try`` whose body issues a cross-process call (``.call(...)`` /
 ``.notify(...)`` — the :class:`~raytpu.cluster.protocol.RpcClient`
-surface) and whose handler catches everything with a bare ``pass``
-erases the only evidence of a sick peer: retries look like hangs,
-breakers never learn, and post-mortems have nothing to show. Tolerating
-the failure is usually *correct* at these seams (best-effort notifies,
-teardown paths) — the rule only demands the swallow be recorded:
-``except Exception as e: errors.swallow("seam.name", e)`` (a never-
-raising debug-log + counter in :mod:`raytpu.util.errors`), a log call,
-or any other handling statement. Bare ``except:`` is flagged anywhere
-in ``raytpu/cluster/`` regardless of the try body — it eats
-``KeyboardInterrupt``/``SystemExit``.
+surface — or the driver-side actor/task surface: ``.remote(...)``,
+``raytpu.kill``, ``raytpu.remove_placement_group``) and whose handler
+catches everything with a bare ``pass`` erases the only evidence of a
+sick peer: retries look like hangs, breakers never learn, and
+post-mortems have nothing to show. Tolerating the failure is usually
+*correct* at these seams (best-effort notifies, teardown paths) — the
+rule only demands the swallow be recorded: ``except Exception as e:
+errors.swallow("seam.name", e)`` (a never-raising debug-log + counter
+in :mod:`raytpu.util.errors`), a log call, or any other handling
+statement. Bare ``except:`` is flagged anywhere in scope regardless of
+the try body — it eats ``KeyboardInterrupt``/``SystemExit``.
+
+Scope covers ``raytpu/cluster/`` and ``raytpu/train/``: gang teardown
+in the trainer kills workers and removes placement groups across
+exactly the same process boundary, and a swallowed teardown failure
+there leaks the worker the next gang then can't place around.
 """
 
 from __future__ import annotations
@@ -20,7 +26,8 @@ import ast
 
 from raytpu.analysis.core import Rule, register
 
-_RPC_ATTRS = {"call", "notify"}
+_RPC_ATTRS = {"call", "notify", "remote", "kill",
+              "remove_placement_group"}
 
 
 def _body_has_rpc(try_node: ast.Try) -> bool:
@@ -49,12 +56,13 @@ def _swallows(handler: ast.ExceptHandler) -> bool:
 class SeamSwallow(Rule):
     id = "RTP009"
     name = "seam-swallow"
-    invariant = ("no bare except in raytpu/cluster/; broad handlers "
-                 "around RpcClient calls must record the swallowed "
-                 "failure (errors.swallow / logging), not pass")
+    invariant = ("no bare except in raytpu/cluster/ or raytpu/train/; "
+                 "broad handlers around RpcClient or actor-surface calls "
+                 "must record the swallowed failure (errors.swallow / "
+                 "logging), not pass")
     rationale = ("a swallowed RPC failure erases the only evidence of a "
                  "sick peer — post-mortems and breaker tuning go blind")
-    scope = ("raytpu/cluster/",)
+    scope = ("raytpu/cluster/", "raytpu/train/")
 
     def check(self, mod):
         for node in ast.walk(mod.tree):

@@ -10,6 +10,12 @@ from raytpu.autoscaler.autoscaler import (
     ResourceDemand,
     StandardAutoscaler,
 )
+from raytpu.autoscaler.bridge import (
+    GROUP_LABEL,
+    DrainingProvider,
+    HeadDemandFeed,
+    connect_autoscaler,
+)
 from raytpu.autoscaler.launcher import (
     cluster_down,
     cluster_up,
@@ -27,9 +33,11 @@ from raytpu.autoscaler.node_provider import (
 from raytpu.autoscaler.sdk import request_resources
 
 __all__ = [
-    "AutoscalerConfig", "AutoscalerMonitor", "FakeSliceProvider",
-    "GceTpuSliceProvider", "K8sSliceProvider",
+    "AutoscalerConfig", "AutoscalerMonitor", "DrainingProvider",
+    "FakeSliceProvider", "GROUP_LABEL", "GceTpuSliceProvider",
+    "HeadDemandFeed", "K8sSliceProvider",
     "NodeGroup", "NodeGroupSpec", "NodeProvider", "ResourceDemand",
     "StandardAutoscaler", "cluster_down", "cluster_up",
-    "load_cluster_spec", "load_cluster_state", "request_resources",
+    "connect_autoscaler", "load_cluster_spec", "load_cluster_state",
+    "request_resources",
 ]

@@ -1,0 +1,216 @@
+"""Head ↔ autoscaler bridge: pressure-driven scaling off the head's
+``resource_demands`` feed.
+
+Reference analogue: ``autoscaler/_private/monitor.py`` — the monitor
+process polls GCS for cluster resource state
+(``GcsAutoscalerStateManager::GetClusterResourceState``) and hands the
+aggregated demand to the scaler. Here the head exports one RPC
+(``resource_demands``) carrying three things at once:
+
+* aggregated queued-infeasible demand — unschedulable task bundle
+  shapes, pending (infeasible) placement-group bundles, and explicit
+  ``request_resources`` hints;
+* a per-node busy/idle census (labels included, so nodes launched by a
+  provider group can be mapped back to it via the ``group_id`` label);
+* the count of head-queued task specs.
+
+:class:`HeadDemandFeed` turns that into the two callables
+:class:`~raytpu.autoscaler.autoscaler.AutoscalerMonitor` wants
+(``demand_fn`` / ``busy_fn``), and :class:`DrainingProvider` closes the
+scale-down loop: before a surplus-idle group is terminated at the
+cloud, every cluster node it hosts is drained through the head
+(``drain_node(force=False)``) — and if the head refuses because the
+node still hosts a live actor, the termination is aborted rather than
+silently burning an actor restart. The busy census should prevent that
+case from ever being selected; the drain refusal covers the race where
+an actor lands between the census read and the terminate call.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from raytpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerMonitor,
+    ResourceDemand,
+    StandardAutoscaler,
+)
+from raytpu.autoscaler.node_provider import NodeProvider
+from raytpu.cluster.protocol import ConnectionLost, RpcClient
+from raytpu.util import errors
+
+# Node label that maps a cluster node back to the provider group that
+# launched it. Providers (or whatever boots the node process on a fresh
+# slice) set it; the bridge's busy census and drain path key on it.
+GROUP_LABEL = "group_id"
+
+
+class HeadDemandFeed:
+    """One ``resource_demands`` call per tick, fanned out to the three
+    consumers (demand_fn, busy_fn, drain path) from a short-lived cache
+    so the monitor's ``demand_fn()``/``busy_fn()`` pair costs one RPC,
+    not two. Survives a head bounce: a lost connection is re-dialed
+    once per call; while the head is down the feed reports no demand
+    (scale decisions pause rather than act on stale state)."""
+
+    def __init__(self, head_address: str,
+                 cache_ttl_s: float = 0.25):
+        self.head_address = head_address
+        self._cache_ttl_s = cache_ttl_s
+        self._lock = threading.Lock()
+        self._client: Optional[RpcClient] = None
+        self._snapshot: Optional[dict] = None
+        self._snapshot_ts = 0.0
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call(self, method: str, *args):
+        with self._lock:
+            if self._client is None:
+                self._client = RpcClient(self.head_address)
+            client = self._client
+        try:
+            return client.call(method, *args)
+        except ConnectionLost:
+            # Head bounce: drop the dead client, re-dial once. A second
+            # failure propagates — the monitor loop logs and retries
+            # next tick.
+            with self._lock:
+                if self._client is client:
+                    self._client = None
+            try:
+                client.close()
+            except Exception as e:
+                errors.swallow("autoscaler.feed_close", e)
+            with self._lock:
+                if self._client is None:
+                    self._client = RpcClient(self.head_address)
+                retry = self._client
+            return retry.call(method, *args)
+
+    def _state(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            snap, ts = self._snapshot, self._snapshot_ts
+        if snap is not None and now - ts < self._cache_ttl_s:
+            return snap
+        fresh = self._call("resource_demands")
+        with self._lock:
+            self._snapshot, self._snapshot_ts = fresh, time.monotonic()
+        return fresh
+
+    # -- the monitor-facing surface ----------------------------------------
+
+    def demands(self) -> List[ResourceDemand]:
+        state = self._state()
+        return [ResourceDemand(dict(d["bundle"]), int(d["count"]))
+                for d in state.get("demands", [])]
+
+    def busy_group_ids(self) -> Set[str]:
+        """Provider groups hosting at least one busy node. Busy =
+        running a live actor or holding allocated task resources (the
+        head computes it; see ``_resource_demands``)."""
+        busy: Set[str] = set()
+        for n in self._state().get("nodes", []):
+            gid = (n.get("labels") or {}).get(GROUP_LABEL)
+            if gid and n.get("alive") and n.get("busy"):
+                busy.add(gid)
+        return busy
+
+    def nodes_in_group(self, group_id: str) -> List[dict]:
+        return [n for n in self._state().get("nodes", [])
+                if n.get("alive")
+                and (n.get("labels") or {}).get(GROUP_LABEL) == group_id]
+
+    def drain_node(self, node_id: str, force: bool = False) -> dict:
+        return self._call("drain_node", node_id, force)
+
+    def close(self) -> None:
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            try:
+                client.close()
+            except Exception as e:
+                errors.swallow("autoscaler.feed_close", e)
+
+
+class DrainingProvider(NodeProvider):
+    """Terminate-through-drain proxy. Every call except
+    ``terminate_node_group`` delegates verbatim; termination first
+    drains the group's cluster nodes at the head (``force=False``) so
+    the head stops scheduling onto them and reroutes their state, and
+    ABORTS (raises) if any node refuses the drain because it hosts a
+    live actor. The instance manager records the raised reason on the
+    instance's audit trail and the group survives to the next
+    reconcile tick — where the busy census will keep it alive."""
+
+    def __init__(self, inner: NodeProvider, feed: HeadDemandFeed):
+        self.inner = inner
+        self.feed = feed
+
+    def create_node_group(self, spec):
+        return self.inner.create_node_group(spec)
+
+    def non_terminated_groups(self):
+        return self.inner.non_terminated_groups()
+
+    def poll(self) -> None:
+        self.inner.poll()
+
+    def terminate_node_group(self, group_id: str) -> None:
+        for n in self.feed.nodes_in_group(group_id):
+            verdict = self.feed.drain_node(n["node_id"], False)
+            if not verdict.get("drained"):
+                raise RuntimeError(
+                    f"drain refused for node {n['node_id'][:12]} in "
+                    f"group {group_id}: {verdict.get('actors', 0)} live "
+                    f"actor(s) — aborting terminate")
+        self.inner.terminate_node_group(group_id)
+
+
+def connect_autoscaler(head_address: str,
+                       config: AutoscalerConfig,
+                       provider: NodeProvider,
+                       period_s: float = 1.0,
+                       on_update: Optional[
+                           Callable[[Dict[str, int]], None]] = None,
+                       ) -> AutoscalerMonitor:
+    """Wire a head to an autoscaler: returns a started-when-you-say-so
+    :class:`AutoscalerMonitor` whose demand comes from the head's
+    ``resource_demands`` RPC and whose provider is wrapped in
+    :class:`DrainingProvider` (drain-before-terminate). The feed is
+    attached as ``monitor.feed`` and the draining provider as
+    ``monitor.autoscaler.provider``; call ``monitor.start()`` to begin
+    ticking and ``monitor.stop(); monitor.feed.close()`` to tear down.
+
+    ``on_update`` (optional) observes each tick's launch counts —
+    tests and dashboards hook it; errors inside it are swallowed so an
+    observer can never stall scaling."""
+    feed = HeadDemandFeed(head_address)
+    draining = DrainingProvider(provider, feed)
+    autoscaler = StandardAutoscaler(config, draining)
+    if on_update is not None:
+        inner_update = autoscaler.update
+
+        def update(demands, busy_group_ids=None):
+            launched = inner_update(demands, busy_group_ids)
+            try:
+                on_update(launched)
+            except Exception as e:
+                errors.swallow("autoscaler.on_update", e)
+            return launched
+
+        autoscaler.update = update  # type: ignore[method-assign]
+    monitor = AutoscalerMonitor(autoscaler, demand_fn=feed.demands,
+                                busy_fn=feed.busy_group_ids,
+                                period_s=period_s)
+    monitor.feed = feed  # teardown handle for callers
+    return monitor
+
+
+__all__ = ["DrainingProvider", "GROUP_LABEL", "HeadDemandFeed",
+           "connect_autoscaler"]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import copy
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -31,6 +32,7 @@ from raytpu.cluster.protocol import ConnectionLost, RpcClient
 from raytpu.core.errors import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectLostError,
     PlacementGroupError,
     WorkerCrashedError,
 )
@@ -118,20 +120,10 @@ class ClusterBackend:
         self.store = self._driver_backend.store
         self.worker = self._driver_backend.worker
         self.worker.job_id = job_id
+        self._head_address = address
+        self._head_lock = threading.Lock()
         self._head = self._connect(address)
-        self._head.subscribe("nodes", self._on_node_event)
-        self._head.subscribe("actors", self._on_actor_event)
-        self._head.subscribe("objects", self._on_object_event)
-        self._head.subscribe("tasks", self._on_task_event)
-        self._head.call("subscribe", "nodes")
-        self._head.call("subscribe", "actors")
-        self._head.call("subscribe", "objects")
-        self._head.call("subscribe", "tasks")
-        from raytpu.core.config import cfg as _cfg
-
-        if _cfg.log_to_driver:
-            self._head.subscribe("logs", self._on_log_event)
-            self._head.call("subscribe", "logs")
+        self._subscribe_head(self._head)
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
         self._lock = threading.RLock()
@@ -146,6 +138,12 @@ class ClusterBackend:
         # whose only copy died with its node can be re-executed (reference:
         # ObjectRecoveryManager + lineage pinning, reference_count.h:61).
         self._lineage: Dict[ObjectID, Tuple[TaskSpec, int]] = {}
+        # Completed-producer memory: return oids whose producing task
+        # finished (inflight record released on the done event / sweep)
+        # but whose value this driver never fetched. If the holding node
+        # then dies, nothing else ties the ref to its fate — this map is
+        # what lets the owner fail the ref instead of polling forever.
+        self._done_returns: "OrderedDict[ObjectID, Tuple[Optional[ActorID], str]]" = OrderedDict()
         self._lineage_bytes = 0
         self._reconstructions: Dict[ObjectID, int] = {}
         self._reconstructing: set = set()  # TaskIDs being re-routed
@@ -190,6 +188,77 @@ class ClusterBackend:
 
     # -- plumbing ----------------------------------------------------------
 
+    def _subscribe_head(self, head: RpcClient) -> None:
+        """Install this driver's event subscriptions on a head connection
+        — at first connect AND on every reconnect (subscriptions are
+        per-connection state on both sides; a restarted head knows
+        nothing about the old incarnation's subscribers)."""
+        head.subscribe("nodes", self._on_node_event)
+        head.subscribe("actors", self._on_actor_event)
+        head.subscribe("objects", self._on_object_event)
+        head.subscribe("tasks", self._on_task_event)
+        head.call("subscribe", "nodes")
+        head.call("subscribe", "actors")
+        head.call("subscribe", "objects")
+        head.call("subscribe", "tasks")
+        from raytpu.core.config import cfg as _cfg
+
+        if _cfg.log_to_driver:
+            head.subscribe("logs", self._on_log_event)
+            head.call("subscribe", "logs")
+
+    def _head_call(self, method: str, *args, **kw):
+        """Head RPC with bounce recovery (resilience-policy seam for the
+        driver): a lost connection re-dials the head address — the
+        restarted head reloads its durable tables while nodes re-register
+        and replay their delta buffers — then retries against the new
+        incarnation. A call that raced the crash may have applied at the
+        old head; every method routed through here is idempotent at the
+        head or retried by a higher layer, the same contract the
+        node-side reconnect already holds."""
+        while True:
+            head = self._head
+            try:
+                return head.call(method, *args, **kw)
+            except ConnectionLost:
+                if self._shutdown_flag:
+                    raise
+                self._reconnect_head(head)
+
+    def _reconnect_head(self, dead: RpcClient) -> None:
+        """Single-flight head re-dial with exponential backoff under a
+        hard deadline. Raises WorkerCrashedError when the head stays gone
+        — the old terminal outcome, now only after the budget expires."""
+        with self._head_lock:
+            if self._head is not dead and not self._head.closed:
+                return  # another caller already swapped in a live head
+            deadline = Deadline.after(tuning.HEAD_RECONNECT_TIMEOUT_S)
+            delay = tuning.RECONNECT_BASE_DELAY_S
+            while True:
+                if self._shutdown_flag:
+                    raise WorkerCrashedError("shutdown during head "
+                                             "reconnect")
+                try:
+                    head = self._connect(self._head_address)
+                    self._subscribe_head(head)
+                except Exception:
+                    if deadline.expired:
+                        raise WorkerCrashedError(
+                            f"lost connection to cluster head; re-dial of "
+                            f"{self._head_address} did not succeed within "
+                            f"{tuning.HEAD_RECONNECT_TIMEOUT_S:g}s")
+                    time.sleep(delay)
+                    delay = min(delay * 2, tuning.RECONNECT_MAX_DELAY_S)
+                    continue
+                old, self._head = self._head, head
+                try:
+                    old.close()
+                except Exception:
+                    pass
+                logger.info("reconnected to cluster head at %s",
+                            self._head_address)
+                return
+
     def _peer(self, address: str) -> RpcClient:
         with self._peers_lock:
             c = self._peers.get(address)
@@ -198,7 +267,7 @@ class ClusterBackend:
             return c
 
     def _node_addr(self, node_id: str) -> Optional[str]:
-        for n in self._head.call("list_nodes"):
+        for n in self._head_call("list_nodes"):
             if n["node_id"] == node_id and n["alive"]:
                 return n["address"]
         return None
@@ -353,7 +422,7 @@ class ClusterBackend:
         sched = spec.scheduling
         if sched.kind == SchedulingKind.PLACEMENT_GROUP and sched.pg_id:
             pg = self._pgs.get(sched.pg_id) or \
-                self._head.call("pg_info", sched.pg_id.hex())
+                self._head_call("pg_info", sched.pg_id.hex())
             if pg is None:
                 raise PlacementGroupError(
                     f"placement group {sched.pg_id.hex()} gone")
@@ -362,7 +431,7 @@ class ClusterBackend:
             return node_id
         # Arg oids let the head score feasible nodes by the bytes they
         # already hold (appended param — older heads ignore it).
-        return self._head.call(
+        return self._head_call(
             "schedule", self._required_resources(spec), None, 0.5,
             spec.task_id.hex(), [o.hex() for o in spec.arg_ref_oids()])
 
@@ -467,6 +536,17 @@ class ClusterBackend:
         for spec, p in zip(specs, placements):
             if isinstance(p, dict) and p.get("err"):
                 self._fail_refs(spec, RuntimeError(p["err"]))
+                continue
+            if isinstance(p, dict) and p.get("queued"):
+                # The head owns this spec now (durably when storage is
+                # on): its pending scheduler dispatches it when capacity
+                # appears — even if this driver spends the whole wait
+                # blocked in get() across a head bounce. Track it in
+                # flight (no node yet) so the completion sweep still
+                # releases the submitted-arg pins.
+                with self._lock:
+                    self._inflight[spec.task_id] = _InFlight(
+                        spec, "", attempts=spec.attempt)
                 continue
             if (not isinstance(p, dict) or not p.get("node_id")
                     or not p.get("address")):
@@ -600,6 +680,8 @@ class ClusterBackend:
                         lst = self._actor_inflight.get(rec.spec.actor_id)
                         if lst and rec.spec in lst:
                             lst.remove(rec.spec)
+                    if popped is not None:
+                        self._record_done_return(rec.spec, rec.node_id)
                 if popped is not None:
                     self._unpin_args(popped.spec)
 
@@ -658,7 +740,7 @@ class ClusterBackend:
             # submissions buffer while GCS restarts an actor).
             deadline = Deadline.after(tuning.ACTOR_RESOLVE_TIMEOUT_S)
             while True:
-                info = self._head.call("resolve_actor", spec.actor_id.hex())
+                info = self._head_call("resolve_actor", spec.actor_id.hex())
                 if info is not None and info.get("state") == "alive":
                     break
                 with self._lock:
@@ -696,10 +778,10 @@ class ClusterBackend:
         return refs
 
     def get_actor_handle_info(self, name: str, namespace: str):
-        info = self._head.call("resolve_named_actor", name, namespace)
+        info = self._head_call("resolve_named_actor", name, namespace)
         if info is None:
             raise ValueError(f"no actor named {name!r} in {namespace!r}")
-        blob = self._head.call(
+        blob = self._head_call(
             "kv_get", f"__actor_spec__::{info['actor_id']}")
         if blob is None:
             raise ValueError(f"actor {name!r} spec not found")
@@ -717,7 +799,7 @@ class ClusterBackend:
         with self._lock:
             node_id = self._actor_nodes.get(actor_id)
         if node_id is None:
-            info = self._head.call("resolve_actor", actor_id.hex())
+            info = self._head_call("resolve_actor", actor_id.hex())
             if info is None:
                 return
             node_id = info["node_id"]
@@ -806,11 +888,15 @@ class ClusterBackend:
         while True:
             sv = self.store.try_get(ref.id)
             if sv is not None:
+                if ref.id in self._done_returns:
+                    with self._lock:
+                        self._done_returns.pop(ref.id, None)
                 return sv
-            try:
-                locs = self._head.call("locate_object", ref.id.hex())
-            except ConnectionLost:
-                raise WorkerCrashedError("lost connection to cluster head")
+            # The bounce seam: a get() blocked here while the head is
+            # SIGKILLed rides _head_call's reconnect — the restarted head
+            # reloads its object-directory snapshot and nodes re-announce,
+            # so the locate resumes instead of failing the driver.
+            locs = self._head_call("locate_object", ref.id.hex())
             for loc in locs or ():
                 if loc["address"] == self._serve_address:
                     continue
@@ -852,11 +938,31 @@ class ClusterBackend:
                 elif now - empty_since > 0.5:
                     empty_since = now
                     with self._lock:
-                        producing = any(
-                            ref.id in rec.spec.return_ids()
-                            for rec in self._inflight.values())
-                    if not producing:
-                        self._reconstruct(ref.id)
+                        producing = None
+                        for rec in self._inflight.values():
+                            if ref.id in rec.spec.return_ids():
+                                producing = rec.spec
+                                break
+                        dead = (self._dead_actors.get(producing.actor_id)
+                                if producing is not None
+                                and producing.actor_id is not None
+                                else None)
+                    if producing is None:
+                        if not self._reconstruct(ref.id):
+                            # No lineage (or its retry budget is spent).
+                            # If the producer completed on a node that
+                            # has since died, the value is unrecoverable.
+                            self._fail_if_producer_gone(ref.id)
+                    elif dead is not None:
+                        # Stale-location race on actor death: the actor
+                        # announced this result from its dying node
+                        # (so _mark_actor_dead skipped the ref — it
+                        # looked located), then the location purged with
+                        # the node. An actor-call return has no lineage;
+                        # nothing will ever reproduce it. Fail the ref
+                        # or this getter waits forever.
+                        self._fail_refs(producing, ActorDiedError(
+                            producing.actor_id.hex(), dead))
             else:
                 empty_since = None
             if deadline is not None and deadline.expired:
@@ -925,6 +1031,50 @@ class ClusterBackend:
                     log_dir, f"task {spec.name} failed terminally "
                     f"(attempt {spec.attempt}): {type(err).__name__}")
 
+    def _record_done_return(self, spec: TaskSpec, node_id: str) -> None:
+        """Caller holds self._lock. Remember where a finished task left
+        its still-unfetched returns so their loss is attributable later."""
+        for oid in spec.return_ids():
+            if self.store.contains(oid):
+                continue
+            self._done_returns[oid] = (spec.actor_id, node_id)
+            self._done_returns.move_to_end(oid)
+        while len(self._done_returns) > tuning.DONE_RETURN_MEMORY:
+            self._done_returns.popitem(last=False)
+
+    def _fail_if_producer_gone(self, oid: ObjectID) -> bool:
+        """Called when an object has no copy anywhere, no in-flight
+        producer, and no lineage. If its producing task is known to have
+        completed on a node that is no longer alive, the value died with
+        the node and nothing will ever reproduce it (actor returns carry
+        no lineage) — fail the ref so blocked getters raise instead of
+        polling forever."""
+        with self._lock:
+            entry = self._done_returns.get(oid)
+        if entry is None:
+            return False
+        actor_id, node_id = entry
+        if node_id and self._node_addr(node_id) is not None:
+            # Producer's node is alive: the empty directory is a
+            # transient miss (e.g. head mid-reload), not a loss.
+            return False
+        if actor_id is not None:
+            with self._lock:
+                reason = self._dead_actors.get(actor_id, "its node died")
+            err: BaseException = ActorDiedError(
+                actor_id.hex(),
+                f"call completed but its result was lost with the "
+                f"node ({reason})")
+        else:
+            err = ObjectLostError(
+                f"object {oid.hex()} completed on node "
+                f"{(node_id or '?')[:12]}, which died before any copy "
+                f"was fetched")
+        self.store.put(oid, serialize(err))
+        with self._lock:
+            self._done_returns.pop(oid, None)
+        return True
+
     def _on_node_event(self, data: dict) -> None:
         if data.get("event") != "removed":
             return
@@ -967,7 +1117,7 @@ class ClusterBackend:
 
     def _safe_located(self, oid: ObjectID) -> bool:
         try:
-            return bool(self._head.call(
+            return bool(self._head_call(
                 "locate_object", oid.hex(),
                 timeout=tuning.CONTROL_CALL_TIMEOUT_S))
         except Exception:
@@ -997,7 +1147,9 @@ class ClusterBackend:
             return
         with self._lock:
             rec = self._inflight.get(tid)
-            if rec is None or (data.get("node_id")
+            # Empty node_id = head-queued spec (the head picked the node;
+            # this driver never knew it) — any node's done event counts.
+            if rec is None or (data.get("node_id") and rec.node_id
                                and rec.node_id != data["node_id"]):
                 return
             self._inflight.pop(tid, None)
@@ -1005,6 +1157,8 @@ class ClusterBackend:
                 lst = self._actor_inflight.get(rec.spec.actor_id)
                 if lst and rec.spec in lst:
                     lst.remove(rec.spec)
+            self._record_done_return(
+                rec.spec, data.get("node_id") or rec.node_id)
         self._unpin_args(rec.spec)
 
     def _on_object_event(self, data: dict) -> None:
@@ -1017,7 +1171,8 @@ class ClusterBackend:
         except Exception:
             return
         if not self.store.contains(oid):
-            self._reconstruct(oid)
+            if not self._reconstruct(oid):
+                self._fail_if_producer_gone(oid)
 
     def _on_actor_event(self, data: dict) -> None:
         event = data.get("event")
@@ -1075,7 +1230,7 @@ class ClusterBackend:
         deadline = Deadline.after(tuning.PG_CREATE_TIMEOUT_S)
         while True:
             try:
-                result = self._head.call("create_pg", pg_id.hex(), bundles,
+                result = self._head_call("create_pg", pg_id.hex(), bundles,
                                          strategy)
                 break
             except PlacementInfeasibleError:
@@ -1097,7 +1252,7 @@ class ClusterBackend:
                     "create_pg_shard", pg_id.binary(), indexed, strategy,
                     len(bundles))
         except Exception:
-            self._head.call("remove_pg", pg_id.hex())
+            self._head_call("remove_pg", pg_id.hex())
             raise
         with self._lock:
             self._pgs[pg_id] = {"nodes": placement, "bundles": bundles,
@@ -1107,7 +1262,7 @@ class ClusterBackend:
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
-        info = pg or self._head.call("pg_info", pg_id.hex())
+        info = pg or self._head_call("pg_info", pg_id.hex())
         if info is None:
             return
         for node_id in set(info["nodes"]):  # rpc-loop-ok: PG teardown fan-out, cold path
@@ -1119,13 +1274,13 @@ class ClusterBackend:
                     self._peer(addr).call("remove_pg_shard", pg_id.binary())
                 except Exception as e:
                     errors.swallow("client.remove_pg_shard", e)
-        self._head.call("remove_pg", pg_id.hex())
+        self._head_call("remove_pg", pg_id.hex())
 
     def placement_group_info(self, pg_id: PlacementGroupID) -> Optional[dict]:
         with self._lock:
             pg = self._pgs.get(pg_id)
         if pg is None:
-            info = self._head.call("pg_info", pg_id.hex())
+            info = self._head_call("pg_info", pg_id.hex())
             if info is None:
                 return None
             pg = info | {"state": "created"}
@@ -1150,7 +1305,7 @@ class ClusterBackend:
 
     def available_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
-        for n in self._head.call("list_nodes"):
+        for n in self._head_call("list_nodes"):
             if n["alive"] and n["labels"].get("role") != "driver":
                 for k, v in n["available"].items():
                     out[k] = out.get(k, 0.0) + v
@@ -1158,7 +1313,7 @@ class ClusterBackend:
 
     def cluster_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
-        for n in self._head.call("list_nodes"):
+        for n in self._head_call("list_nodes"):
             if n["alive"] and n["labels"].get("role") != "driver":
                 for k, v in n["resources"].items():
                     out[k] = out.get(k, 0.0) + v
@@ -1174,7 +1329,7 @@ class ClusterBackend:
                 "Address": n["address"],
                 "Labels": n["labels"],
             }
-            for n in self._head.call("list_nodes")
+            for n in self._head_call("list_nodes")
         ]
 
     def task_events(self) -> List[dict]:
@@ -1191,13 +1346,13 @@ class ClusterBackend:
     # -- kv (used by job submission / function shipping) -------------------
 
     def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
-        return self._head.call("kv_put", key, value, overwrite)
+        return self._head_call("kv_put", key, value, overwrite)
 
     def kv_get(self, key: str) -> Optional[bytes]:
-        return self._head.call("kv_get", key)
+        return self._head_call("kv_get", key)
 
     def kv_del(self, key: str) -> bool:
-        return self._head.call("kv_del", key)
+        return self._head_call("kv_del", key)
 
     def shutdown(self) -> None:
         self._shutdown_flag = True

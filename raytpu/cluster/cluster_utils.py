@@ -107,7 +107,8 @@ class Cluster:
         raise RuntimeError("head failed to restart on its old port")
 
     def add_node(self, num_cpus: float = 2, num_tpus: int = 0,
-                 resources: Optional[Dict[str, float]] = None
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None
                  ) -> ClusterNodeHandle:
         proc = subprocess.Popen(
             [sys.executable, "-m", "raytpu.cluster.node",
@@ -115,6 +116,7 @@ class Cluster:
              "--num-cpus", str(num_cpus),
              "--num-tpus", str(num_tpus),
              "--resources", json.dumps(resources or {}),
+             "--labels", json.dumps(labels or {}),
              "--host", self._host],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=self._env,

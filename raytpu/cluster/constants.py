@@ -145,6 +145,37 @@ PUSH_WAIT_POLL_PERIOD_S = _f("PUSH_WAIT_POLL_PERIOD_S", 0.02)
 # max(this, the carrier's period)).
 METRICS_SHIP_PERIOD_S = _f("METRICS_SHIP_PERIOD_S", 2.0)
 
+# -- durable head / elastic cluster ------------------------------------------
+
+# Head-side write-behind snapshot cadence for the derived tables (object
+# directory, borrow sets, flight-recorder tail) — per-mutation rows are
+# too hot for those; everything else persists write-after-mutation.
+HEAD_SNAPSHOT_PERIOD_S = _f("HEAD_SNAPSHOT_PERIOD_S", 10.0)
+# Head-side queued-infeasible TaskSpec re-schedule scan.
+HEAD_PENDING_SCHED_PERIOD_S = _f("HEAD_PENDING_SCHED_PERIOD_S", 0.2)
+# Driver-side budget to re-dial a bounced head before an in-flight
+# get()/schedule() fails with WorkerCrashedError.
+HEAD_RECONNECT_TIMEOUT_S = _f("HEAD_RECONNECT_TIMEOUT_S", 30.0)
+# A pending (infeasible) placement group feeds autoscaler demand for
+# this long past its last create attempt; the client retry loop
+# refreshes the entry while the caller still wants the PG.
+PG_DEMAND_TTL_S = _f("PG_DEMAND_TTL_S", 30.0)
+# Elastic gang training: budget for the post-failure capacity probe
+# (how long fit() waits for ANY feasible world size >= min_workers),
+# the probe's poll period, and how often a running gang checks whether
+# replacement capacity arrived so it can scale back up at the next
+# checkpoint boundary.
+ELASTIC_PROBE_TIMEOUT_S = _f("ELASTIC_PROBE_TIMEOUT_S", 30.0)
+ELASTIC_PROBE_PERIOD_S = _f("ELASTIC_PROBE_PERIOD_S", 0.5)
+ELASTIC_UPSCALE_CHECK_PERIOD_S = _f("ELASTIC_UPSCALE_CHECK_PERIOD_S", 2.0)
+# Driver-side memory of completed-but-unfetched return objects (oid ->
+# producing actor/node). Consulted when a get() finds no copy anywhere:
+# if the producer finished on a node that then died, the value is gone
+# for good (actor returns carry no lineage) and the ref is failed
+# instead of polled forever. FIFO-bounded; eviction only narrows the
+# hang protection for very old refs.
+DONE_RETURN_MEMORY = _i("DONE_RETURN_MEMORY", 4096)
+
 # -- node → head reconnect ---------------------------------------------------
 
 # Exponential backoff bounds for a node whose head is unreachable

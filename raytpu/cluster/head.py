@@ -58,11 +58,16 @@ class GcsStore:
     ``src/ray/gcs/gcs_server/gcs_table_storage.cc`` over a StoreClient;
     our store client is sqlite — single head process, WAL mode).
 
-    Persisted tables: ``kv`` (incl. actor creation specs), ``actors``
-    (directory + restart counters), ``pgs``. Node entries are ephemeral by
-    design — nodes re-register when the head comes back, exactly the
-    reference's GCS-restart story (``in_memory_store_client.h:31`` +
-    node re-registration, SURVEY A3).
+    Persisted tables — write-after-mutation: ``kv`` (incl. actor
+    creation specs), ``actors`` (directory + restart counters), ``pgs``,
+    ``named`` (named-actor index), ``pending_tasks`` (queued-infeasible
+    TaskSpec blobs, so a bounce re-schedules instead of orphaning).
+    Write-behind snapshots (health-loop cadence + shutdown): ``objects``
+    (location/size directory), ``borrows``, ``task_events`` (flight
+    recorder tail). Node entries are ephemeral by design — nodes
+    re-register when the head comes back, exactly the reference's
+    GCS-restart story (``in_memory_store_client.h:31`` + node
+    re-registration, SURVEY A3).
     """
 
     def __init__(self, path: str):
@@ -95,6 +100,30 @@ class GcsStore:
                 "SELECT key, value FROM tables WHERE tbl = ?",
                 (table,)).fetchall()
         return {k: v for k, v in rows}
+
+    def snapshot_table(self, table: str, mapping: Dict[str, bytes]) -> None:
+        """Replace every row of ``table`` in one transaction. The
+        write-behind tables (object directory, borrows, event tail) are
+        too hot for per-mutation rows; a periodic whole-table snapshot
+        is their durability contract, and the single transaction means a
+        crash mid-snapshot leaves the previous snapshot intact."""
+        with self._lock:
+            self._conn.execute("BEGIN")
+            self._conn.execute(
+                "DELETE FROM tables WHERE tbl = ?", (table,))
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO tables (tbl, key, value) "
+                "VALUES (?, ?, ?)",
+                [(table, k, v) for k, v in mapping.items()])
+            self._conn.commit()
+
+    def compact(self) -> None:
+        """Fold the WAL back into the main database file (reload-on-start
+        and shutdown both compact, so the WAL never grows unbounded
+        across bounce cycles)."""
+        with self._lock:
+            self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+            self._conn.commit()
 
     def close(self) -> None:
         with self._lock:
@@ -314,6 +343,19 @@ class HeadServer:
         # Explicit request_resources() hint (autoscaler sdk); replaced
         # wholesale on each call, merged into _get_demand's output.
         self._requested_resources: List[Dict[str, float]] = []
+        # Queued-infeasible TaskSpecs: task_id(hex) -> single-spec wire
+        # blob. The head owns these until capacity appears (the pending
+        # scheduler thread pushes them to a node), and they persist so a
+        # bounce re-schedules instead of orphaning a driver blocked in
+        # get(). Semantics are at-least-once across a bounce: a driver
+        # whose submit_batch call died mid-flight may resubmit a spec
+        # the head also recovered.
+        self._pending_specs: Dict[str, bytes] = {}
+        # Pending (infeasible) placement groups feed the autoscaler's
+        # demand export until the client's retry loop succeeds or gives
+        # up; TTL-pruned in _get_demand, never persisted.
+        self._pg_demand: Dict[str, Tuple[float, List[Dict[str, float]]]] = {}
+        self._last_snapshot = time.monotonic()
         # Built-in runtime metrics (reference: the core metric defs the
         # per-node metrics agent exports to Prometheus, e.g.
         # ray_cluster_active_nodes / ray_actors; metric_defs.cc). Gauges
@@ -377,6 +419,7 @@ class HeadServer:
         h("subscribe", self._subscribe)
         h("publish_logs", self._publish_logs)
         h("get_demand", self._get_demand)
+        h("resource_demands", self._resource_demands)
         h("request_resources", self._request_resources)
         h("next_job_id", self._next_job_id)
         h("ping", lambda peer: "pong")
@@ -412,8 +455,47 @@ class HeadServer:
             self._actors[aid] = info
             if info.get("name"):
                 self._named[(info["namespace"], info["name"])] = aid
+        # Explicit named-index rows overlay the rebuild above (they are
+        # the write-after-mutation ground truth; the rebuild covers rows
+        # written before the "named" table existed).
+        for key, blob in self._store.load_all("named").items():
+            ns, _, name = key.partition("\x1f")
+            self._named[(ns, name)] = blob.decode()
         for pg_id, blob in self._store.load_all("pgs").items():
             self._pgs[pg_id] = _json.loads(blob)
+        # Queued-infeasible specs: the pending scheduler thread replays
+        # them once nodes re-register.
+        self._pending_specs = dict(self._store.load_all("pending_tasks"))
+        # Object directory snapshot: locations for nodes that never
+        # re-register are filtered by the alive check in _locate_object
+        # and dropped by _mark_dead / the next snapshot; meanwhile a
+        # driver blocked in get() across the bounce resolves immediately
+        # instead of waiting out every node's re-announce.
+        snap = self._store.load_all("objects").get("snapshot")
+        if snap:
+            d = _json.loads(snap)
+            self._objects = {oh: set(nids)
+                             for oh, nids in d.get("locations", {}).items()}
+            self._object_sizes = {oh: int(s)
+                                  for oh, s in d.get("sizes", {}).items()}
+        snap = self._store.load_all("borrows").get("snapshot")
+        if snap:
+            d = _json.loads(snap)
+            self._borrows = {oh: set(bs)
+                             for oh, bs in d.get("borrows", {}).items()}
+            self._pending_free = set(d.get("pending_free", ()))
+        tail = self._store.load_all("task_events").get("tail")
+        if tail:
+            try:
+                self._task_event_store.add_batch(_json.loads(tail), 0)
+            except Exception as e:
+                errors.swallow("head.reload_task_events", e)
+        # Reload is the new baseline: fold the WAL away so bounce cycles
+        # never grow it unbounded.
+        try:
+            self._store.compact()
+        except Exception as e:
+            errors.swallow("head.reload_compact", e)
 
     def _persist_kv(self, key: str, value: Optional[bytes]) -> None:
         if self._store is None:
@@ -446,6 +528,57 @@ class HeadServer:
         else:
             self._store.put("pgs", pg_id, _json.dumps(pg).encode())
 
+    def _persist_named(self, key: Tuple[str, str]) -> None:
+        if self._store is None:
+            return
+        aid = self._named.get(key)
+        skey = f"{key[0]}\x1f{key[1]}"
+        if aid is None:
+            self._store.delete("named", skey)
+        else:
+            self._store.put("named", skey, aid.encode())
+
+    def _persist_pending_task(self, task_id: str) -> None:
+        if self._store is None:
+            return
+        blob = self._pending_specs.get(task_id)
+        if blob is None:
+            self._store.delete("pending_tasks", task_id)
+        else:
+            self._store.put("pending_tasks", task_id, blob)
+
+    def _snapshot(self) -> None:
+        """Write-behind durability for the derived/hot tables: the object
+        location+size directory, the borrow sets, and the flight-recorder
+        tail. Per-mutation rows would put sqlite on the data-plane hot
+        path; a whole-table snapshot on the health-loop cadence (and at
+        shutdown) bounds the loss window to one period instead."""
+        if self._store is None:
+            return
+        import json as _json
+
+        with self._lock:
+            objects = {oh: sorted(nids)
+                       for oh, nids in self._objects.items()}
+            sizes = dict(self._object_sizes)
+            borrows = {oh: sorted(bs) for oh, bs in self._borrows.items()}
+            pending_free = sorted(self._pending_free)
+        tail: List[dict] = []
+        for kind in ("task", "actor", "node"):
+            for ent in self._task_event_store.list(kind, limit=500,
+                                                   detail=True):
+                tail.extend(ent.get("events") or ())
+        try:
+            self._store.snapshot_table("objects", {"snapshot": _json.dumps(
+                {"locations": objects, "sizes": sizes}).encode()})
+            self._store.snapshot_table("borrows", {"snapshot": _json.dumps(
+                {"borrows": borrows, "pending_free": pending_free}).encode()})
+            self._store.snapshot_table("task_events", {
+                "tail": _json.dumps(tail).encode()})
+            self._last_snapshot = time.monotonic()
+        except Exception as e:
+            errors.swallow("head.snapshot", e)
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> str:
@@ -470,6 +603,10 @@ class HeadServer:
             target=self._restart_loop, name="head-actor-restart", daemon=True
         )
         self._restarter.start()
+        self._pending_sched = threading.Thread(
+            target=self._pending_sched_loop, name="head-pending-sched",
+            daemon=True)
+        self._pending_sched.start()
         if self._store is not None:
             # Recover reloaded actors: re-enqueue interrupted restarts now;
             # after a node-re-registration grace period, declare actors at
@@ -511,6 +648,14 @@ class HeadServer:
             stop_metrics_server(self._metrics_port)
             self._metrics_port = None
         if self._store is not None:
+            # Snapshot-on-shutdown: the write-behind tables are current
+            # as of this instant, and the compaction folds the WAL away
+            # so the next start reloads one clean file.
+            try:
+                self._snapshot()
+                self._store.compact()
+            except Exception as e:
+                errors.swallow("head.stop_snapshot", e)
             try:
                 self._store.close()
             except Exception:
@@ -591,8 +736,20 @@ class HeadServer:
         node must never be declared dead between heartbeats."""
         self._heartbeat(peer, node_id, available, seq)
 
-    def _drain_node(self, peer: Peer, node_id: str) -> None:
+    def _drain_node(self, peer: Peer, node_id: str,
+                    force: bool = True) -> dict:
+        """Graceful removal. ``force=False`` (the autoscaler's idle
+        scale-down path) refuses while the node hosts live actors — a
+        node that looks idle by resource math can still be somebody's
+        actor home, and reclaiming it would silently burn a restart."""
+        with self._lock:
+            actors = sum(1 for info in self._actors.values()
+                         if info["node_id"] == node_id
+                         and info["state"] == "alive")
+        if not force and actors:
+            return {"drained": False, "actors": actors}
         self._mark_dead(node_id, reason="drained")
+        return {"drained": True, "actors": actors}
 
     def _list_nodes(self, peer: Peer) -> List[dict]:
         with self._lock:
@@ -704,6 +861,9 @@ class HeadServer:
                 self._alerts.tick()
             except Exception as e:
                 errors.swallow("head.alerts.tick", e)
+            if self._store is not None and \
+                    now - self._last_snapshot > tuning.HEAD_SNAPSHOT_PERIOD_S:
+                self._snapshot()
 
     def _mark_dead(self, node_id: str, reason: str) -> None:
         with self._lock:
@@ -716,22 +876,35 @@ class HeadServer:
                 aid for aid, info in self._actors.items()
                 if info["node_id"] == node_id and info["state"] == "alive"
             ]
+            lost_objects = []
             for oid in list(self._objects):
                 self._objects[oid].discard(node_id)
                 if not self._objects[oid]:
                     del self._objects[oid]
                     self._object_sizes.pop(oid, None)
-            # Free PG bundles placed on the dead node.
-            for pg in self._pgs.values():
-                pg["nodes"] = [
-                    (None if n == node_id else n) for n in pg["nodes"]
-                ]
+                    lost_objects.append(oid)
+            # Free PG bundles placed on the dead node; the nulled
+            # placement is durable state (a reloaded head must not
+            # believe a bundle still sits on a node that died).
+            for pg_id, pg in self._pgs.items():
+                if node_id in pg["nodes"]:
+                    pg["nodes"] = [
+                        (None if n == node_id else n) for n in pg["nodes"]
+                    ]
+                    self._persist_pg(pg_id)
         if task_events.enabled():
             task_events.emit("node", node_id,
                              task_events.TaskTransition.NODE_DIED,
                              error=reason, node_id=node_id)
         self._publish("nodes", {"event": "removed", "node_id": node_id,
                                 "reason": reason})
+        # Owners of objects whose last copy just died find out now, not
+        # at their next poll: lineage owners re-execute, and completed
+        # actor-call returns (no lineage) fail fast instead of leaving
+        # their getters blocked forever.
+        for oid in lost_objects:
+            self._publish("objects", {"event": "unavailable",
+                                      "object_id": oid})
         from raytpu.util.events import record_event
 
         with self._lock:
@@ -1223,30 +1396,47 @@ class HeadServer:
         ``_lock`` acquisition. Per spec the reply is ``{"node_id",
         "address"}`` (placed — address included so the driver skips the
         per-task ``list_nodes`` lookup), ``{"err": ...}`` (that spec
-        failed; the others are unaffected), or ``None`` (infeasible now,
-        driver requeues as pending)."""
+        failed; the others are unaffected), or ``{"queued": True}``
+        (infeasible now — the head owns the spec, durably when storage
+        is on, and its pending scheduler dispatches it when capacity
+        appears; the driver stops tracking it as pending)."""
         specs = wire.loads(blob)
         placements: List[Any] = []
         deferred: List[tuple] = []
+        persist: List[str] = []
         with tracing.span("sched.decide") as attrs:
             with self._lock:
                 for spec in specs:
                     self._metrics.tick_schedule()
+                    tid = spec.task_id.hex()
                     try:
                         arg_oids = [o.hex() for o in spec.arg_ref_oids()]
                         node_id = self._schedule_locked(
                             dict(spec.resources or {}), None, 0.5,
-                            spec.task_id.hex(), arg_oids, attrs, deferred)
+                            tid, arg_oids, attrs, deferred)
                     except Exception as e:  # noqa: BLE001 — per-spec fault
                         placements.append({"err": str(e)})
                         continue
                     if node_id is None:
-                        placements.append(None)
+                        # Queue-at-head: the spec survives a head bounce
+                        # (pending_tasks table) and re-drives placement
+                        # from here, not from a driver that may be
+                        # blocked in get() across the bounce.
+                        self._pending_specs[tid] = wire.dumps(spec)
+                        persist.append(tid)
+                        placements.append({"queued": True})
                         continue
+                    if self._pending_specs.pop(tid, None) is not None:
+                        persist.append(tid)
                     entry = self._nodes.get(node_id)
                     placements.append(
                         {"node_id": node_id,
                          "address": entry.address if entry else None})
+            # Persistence runs after the placement lock (RTP013 keeps the
+            # lock-held region compute-only); a crash in the gap merely
+            # re-runs the driver's own retry path.
+            for tid in persist:
+                self._persist_pending_task(tid)
             self._run_eager_pushes(deferred)
             attrs["batch"] = len(placements)
             attrs["node"] = sum(1 for p in placements
@@ -1259,6 +1449,54 @@ class HeadServer:
                             task_events.TaskTransition.SCHEDULED,
                             node_id=p["node_id"])
         return placements
+
+    def _pending_sched_loop(self) -> None:
+        """Re-drive queued-infeasible TaskSpecs — including ones reloaded
+        from durable storage after a bounce — once capacity appears. The
+        head dials the chosen node itself (``submit_task``), so a queued
+        task completes even if its driver spends the whole window blocked
+        in get(); the result flows back through the object directory as
+        usual. Failed dispatches stay queued for the next scan."""
+        while not self._stop.wait(tuning.HEAD_PENDING_SCHED_PERIOD_S):
+            with self._lock:
+                batch = list(self._pending_specs.items())
+            for tid, blob in batch:  # rpc-loop-ok: queued-spec replay, cold path gated on spare capacity
+                if self._stop.is_set():
+                    return
+                try:
+                    spec = wire.loads(blob)
+                    arg_oids = [o.hex() for o in spec.arg_ref_oids()]
+                    node_id = self._schedule_impl(
+                        None, dict(spec.resources or {}), None, 0.5,
+                        tid, arg_oids, None)
+                except Exception as e:
+                    errors.swallow("head.pending_sched", e)
+                    continue
+                if node_id is None:
+                    continue  # still infeasible; _unmet stays fresh
+                with self._lock:
+                    entry = self._nodes.get(node_id)
+                    address = entry.address if entry and entry.alive \
+                        else None
+                if address is None:
+                    continue
+                try:
+                    self._node_client(node_id, address).call(
+                        "submit_task", blob,
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                        breaker=breaker_for(address))
+                except Exception as e:
+                    # Node refused/died: keep the spec queued; the
+                    # optimistic debit is corrected by its heartbeat.
+                    errors.swallow("head.pending_dispatch", e)
+                    continue
+                with self._lock:
+                    self._pending_specs.pop(tid, None)
+                self._persist_pending_task(tid)
+                if task_events.enabled():
+                    task_events.emit("task", tid,
+                                     task_events.TaskTransition.SCHEDULED,
+                                     node_id=node_id)
 
     # -- actor directory ---------------------------------------------------
 
@@ -1273,6 +1511,7 @@ class HeadServer:
                 if key in self._named and self._named[key] != actor_id:
                     raise ValueError(f"actor name {name!r} already taken")
                 self._named[key] = actor_id
+                self._persist_named(key)
             if existing is not None:
                 # Re-registration during a restart: keep restart counters.
                 existing["node_id"] = node_id
@@ -1340,6 +1579,7 @@ class HeadServer:
                 self._actors.pop(actor_id, None)
                 if info.get("name"):
                     self._named.pop((info["namespace"], info["name"]), None)
+                    self._persist_named((info["namespace"], info["name"]))
             self._persist_actor(actor_id)
         if task_events.enabled():
             task_events.emit(
@@ -1411,6 +1651,8 @@ class HeadServer:
                     if info and info.get("name"):
                         self._named.pop(
                             (info["namespace"], info["name"]), None)
+                        self._persist_named(
+                            (info["namespace"], info["name"]))
                     self._persist_actor(actor_id)
                 self._publish("actors", {
                     "event": "dead", "actor_id": actor_id,
@@ -1534,7 +1776,28 @@ class HeadServer:
         PACK: prefer one node, spill; SPREAD/STRICT_SPREAD: distinct nodes
         (STRICT_ fails if impossible). Reservation debits node availability
         until remove_pg (reference: GcsPlacementGroupScheduler 2-phase
-        commit; single head process makes one-phase safe here)."""
+        commit; single head process makes one-phase safe here).
+
+        An infeasible attempt records the PG's bundles as autoscaler
+        demand (reference: GcsAutoscalerStateManager folding pending PGs
+        into the cluster resource state) — the client's create retry loop
+        keeps the entry fresh until a launched node makes it fit."""
+        try:
+            result = self._create_pg_impl(peer, pg_id, bundles, strategy)
+        except PlacementInfeasibleError:
+            with self._lock:
+                self._pg_demand[pg_id] = (
+                    time.monotonic(),
+                    [{str(k): float(v) for k, v in (b or {}).items()}
+                     for b in bundles])
+            raise
+        with self._lock:
+            self._pg_demand.pop(pg_id, None)
+        return result
+
+    def _create_pg_impl(self, peer: Peer, pg_id: str,
+                        bundles: List[Dict[str, float]],
+                        strategy: str) -> dict:
         with self._lock:
             alive = [n for n in self._nodes.values()
                      if n.alive and n.labels.get("role") != "driver"]
@@ -1617,6 +1880,7 @@ class HeadServer:
 
     def _remove_pg(self, peer: Peer, pg_id: str) -> None:
         with self._lock:
+            self._pg_demand.pop(pg_id, None)
             pg = self._pgs.pop(pg_id, None)
             if pg is None:
                 return
@@ -1653,10 +1917,12 @@ class HeadServer:
         self._publish("logs", record)
 
     def _get_demand(self, peer: Peer, window_s: float = 10.0) -> List[dict]:
-        """Aggregated unmet demand in the look-back window plus any
-        explicit ``request_resources`` hint: the input to the
-        autoscaler's get_desired_groups (bundle -> count)."""
+        """Aggregated unmet demand in the look-back window — unschedulable
+        task shapes plus each pending (infeasible) placement group's
+        bundles — plus any explicit ``request_resources`` hint: the input
+        to the autoscaler's get_desired_groups (bundle -> count)."""
         cutoff = time.monotonic() - window_s
+        now = time.monotonic()
         with self._lock:
             self._unmet = {k: v for k, v in self._unmet.items()
                            if v[0] >= cutoff}
@@ -1664,6 +1930,17 @@ class HeadServer:
             for _, b in self._unmet.values():
                 key = tuple(sorted(b.items()))
                 agg[key] = agg.get(key, 0) + 1
+            # Pending PGs: every bundle of an infeasible group is demand
+            # (TTL-bounded — a client that gave up stops refreshing).
+            for pid in [p for p, (t, _) in self._pg_demand.items()
+                        if now - t > tuning.PG_DEMAND_TTL_S]:
+                del self._pg_demand[pid]
+            for _, bundles in self._pg_demand.values():
+                for b in bundles:
+                    if not b:
+                        continue
+                    key = tuple(sorted(b.items()))
+                    agg[key] = agg.get(key, 0) + 1
             # Floor semantics, not additive: per shape, the hint and the
             # queued demand overlap — one group satisfies both a
             # requested {TPU:8} and a queued {TPU:8} task.
@@ -1674,6 +1951,33 @@ class HeadServer:
             for key, n in hint.items():
                 agg[key] = max(agg.get(key, 0), n)
         return [{"bundle": dict(k), "count": n} for k, n in agg.items()]
+
+    def _resource_demands(self, peer: Peer, window_s: float = 10.0) -> dict:
+        """The autoscaler monitor's one-call feed: aggregated
+        queued-infeasible demand (tasks + pending PGs + hints) plus a
+        per-node busy/idle census so the monitor can tell which provider
+        groups are in use and which nodes are safe drain victims
+        (reference: GcsAutoscalerStateManager::GetClusterResourceState)."""
+        demands = self._get_demand(peer, window_s)
+        with self._lock:
+            actors_by_node: Dict[str, int] = {}
+            for info in self._actors.values():
+                if info.get("state") == "alive":
+                    actors_by_node[info["node_id"]] = \
+                        actors_by_node.get(info["node_id"], 0) + 1
+            nodes = []
+            for n in self._nodes.values():
+                busy = bool(actors_by_node.get(n.node_id)) or any(
+                    n.available.get(k, 0.0) < v - 1e-9
+                    for k, v in n.total.items())
+                nodes.append({
+                    "node_id": n.node_id, "alive": n.alive,
+                    "labels": dict(n.labels), "busy": busy,
+                    "actors": actors_by_node.get(n.node_id, 0),
+                })
+            queued = len(self._pending_specs)
+        return {"demands": demands, "nodes": nodes,
+                "queued_tasks": queued}
 
     def _request_resources(self, peer: Peer, bundles: List[dict]) -> int:
         """Explicit demand hint (reference:

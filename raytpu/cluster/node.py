@@ -879,8 +879,8 @@ class NodeServer:
                 handle,
                 f"memory pressure: {desc}; task {tid.hex()[:8]} shed "
                 f"to protect the node", failure=True)
-        except Exception:
-            pass
+        except Exception as e:
+            errors.swallow("node.memory_shed_kill", e)
 
     def stop(self) -> None:
         self._stop.set()
@@ -1056,6 +1056,20 @@ class NodeServer:
                 )
             except Exception as e:
                 errors.swallow("node.reregister_actor", e)
+        # Re-register live borrows: the reloaded head has at best its last
+        # borrow snapshot, and a borrow added inside the loss window must
+        # not vanish — the owner could then free an object a pool worker
+        # still holds. Replays are idempotent set-adds at the head.
+        with self._borrow_lock:
+            borrows = {w: sorted(oids)
+                       for w, oids in self._worker_borrows.items() if oids}
+        for worker_id_hex, oid_hexes in borrows.items():  # rpc-loop-ok: borrow re-registration replay after head restart
+            try:
+                head.call("borrow_added", oid_hexes,
+                          f"{self.node_id.hex()}:{worker_id_hex}",
+                          timeout=tuning.LOCATE_TIMEOUT_S)
+            except Exception as e:
+                errors.swallow("node.reregister_borrows", e)
         # Re-announce object locations as batched deltas, sizes included
         # so the reloaded directory can score locality immediately.
         replay = [["+", oid.hex(), self._object_wire_size(oid)]
@@ -2265,11 +2279,13 @@ def main() -> None:  # pragma: no cover - exercised via subprocess in tests
     ap.add_argument("--num-cpus", type=float, default=None)
     ap.add_argument("--num-tpus", type=int, default=0)
     ap.add_argument("--resources", default="{}")
+    ap.add_argument("--labels", default="{}")
     ap.add_argument("--host", default="127.0.0.1")
     args = ap.parse_args()
     node = NodeServer(
         args.head, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
-        resources=json.loads(args.resources), host=args.host,
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels), host=args.host,
     )
     addr = node.start(adopt_globals=True)
     print(f"raytpu node {node.node_id.hex()[:12]} on {addr}", flush=True)

@@ -403,5 +403,5 @@ class WorkerPool:
             except Exception:
                 try:
                     h.proc.kill()
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("worker_pool.kill_escalation", e)

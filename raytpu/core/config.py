@@ -162,6 +162,24 @@ declare_env("RAYTPU_LOCALITY_EAGER_PUSH",
 declare_env("RAYTPU_OBJ_REPORT_BUFFER_MAX",
             "node-side buffered object-location deltas cap")
 
+# Elastic cluster (cluster/constants.py, cluster/head.py,
+# cluster/client.py, train/trainer.py): durable head failover cadence,
+# driver reconnect budget, autoscaler demand TTLs, elastic-gang timing.
+declare_env("RAYTPU_HEAD_SNAPSHOT_PERIOD_S",
+            "head write-behind snapshot cadence for derived tables (s)")
+declare_env("RAYTPU_HEAD_PENDING_SCHED_PERIOD_S",
+            "head queued-TaskSpec re-schedule scan period (s)")
+declare_env("RAYTPU_HEAD_RECONNECT_TIMEOUT_S",
+            "driver budget to re-dial a bounced head (s)")
+declare_env("RAYTPU_PG_DEMAND_TTL_S",
+            "pending placement group feeds autoscaler demand this long (s)")
+declare_env("RAYTPU_ELASTIC_PROBE_TIMEOUT_S",
+            "elastic fit() capacity-probe budget after a gang failure (s)")
+declare_env("RAYTPU_ELASTIC_PROBE_PERIOD_S",
+            "elastic capacity-probe poll period (s)")
+declare_env("RAYTPU_ELASTIC_UPSCALE_CHECK_PERIOD_S",
+            "running gang's replacement-capacity check period (s)")
+
 # Zero-copy data plane (runtime/serialization.py, runtime/object_store.py,
 # cluster/transfer.py): serialize-into-shm puts, pinned shared-memory
 # views on get, streaming receives into final storage.

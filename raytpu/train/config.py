@@ -28,15 +28,28 @@ class ScalingConfig:
     # _setup_torch_process_group, torch/config.py:65). Cluster mode fills
     # this from the head's address; leave None for single-host.
     coordinator_address: Optional[str] = None
+    # Gang-elastic training (reference analogue: torchelastic's
+    # min/max nnodes): on gang failure with ``elastic=True`` the trainer
+    # may re-form the gang at any world size in
+    # ``[min_workers, num_workers]`` instead of insisting on full
+    # strength, resuming from the latest checkpoint (resharded via pjit
+    # on restore), and scales back up to ``num_workers`` at a checkpoint
+    # boundary once capacity returns. ``min_workers=None`` means the
+    # gang is fixed-size even when ``elastic`` is set.
+    min_workers: Optional[int] = None
+    elastic: bool = False
 
-    def bundle_specs(self) -> List[Dict[str, float]]:
+    def bundle_specs(self, world_size: Optional[int] = None
+                     ) -> List[Dict[str, float]]:
         """One bundle per worker (reference: A6 — the zero-CPU trainer
-        bundle is merged into rank 0)."""
+        bundle is merged into rank 0). ``world_size`` overrides
+        ``num_workers`` for elastic gangs running below full strength."""
         per = dict(self.resources_per_worker or {})
         per.setdefault("CPU", 1)
         if self.use_tpu and self.chips_per_worker:
             per.setdefault("TPU", self.chips_per_worker)
-        return [dict(per) for _ in range(self.num_workers)]
+        n = self.num_workers if world_size is None else world_size
+        return [dict(per) for _ in range(n)]
 
     @property
     def total_chips(self) -> int:

@@ -24,6 +24,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 import raytpu
+from raytpu.cluster import constants as tuning
 from raytpu.train import session as session_mod
 from raytpu.train.checkpoint import Checkpoint, CheckpointManager
 from raytpu.train.config import (
@@ -33,6 +34,7 @@ from raytpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
+from raytpu.util import errors
 
 
 @raytpu.remote(num_cpus=0)
@@ -132,8 +134,8 @@ class TrainWorker:
         if plat:
             try:
                 jax.config.update("jax_platforms", plat)
-            except Exception:
-                pass
+            except Exception as e:
+                errors.swallow("train.gang_teardown", e)
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -289,29 +291,65 @@ class JaxTrainer(BaseTrainer):
                 name=rdzv_name, max_restarts=100).remote()
 
         attempts = rc.failure_config.max_failures + 1
+        elastic = bool(sc.elastic and sc.min_workers
+                       and sc.min_workers < sc.num_workers)
+        floor = max(1, min(sc.min_workers or sc.num_workers,
+                           sc.num_workers))
+        fn_blob = cloudpickle.dumps(self.train_loop_per_worker)
         last_error = None
+        failures = 0
+        world = sc.num_workers
+        history: list = []
         try:
-            for attempt in range(attempts):
+            incarnation = 0  # rendezvous key: unique per gang formed
+            while True:
                 result = self._run_gang(sc, name, run_dir, manager,
-                                        cloudpickle.dumps(
-                                            self.train_loop_per_worker),
-                                        rdzv_name=rdzv_name,
-                                        attempt=attempt)
+                                        fn_blob, rdzv_name=rdzv_name,
+                                        attempt=incarnation,
+                                        world_size=world,
+                                        target_world=(sc.num_workers
+                                                      if elastic else None))
+                incarnation += 1
+                # Continuous history across gang incarnations: a resumed
+                # run is ONE experiment, not N.
+                history.extend(result.metrics_history)
+                if isinstance(result.error, _GangRescale):
+                    # Capacity returned mid-run; the gang parked at a
+                    # checkpoint boundary. Re-form at full strength —
+                    # this is progress, not a failure: no budget burned.
+                    world = result.error.world
+                    self.resume_from_checkpoint = manager.latest()
+                    continue
                 if result.error is None:
-                    return result
+                    return Result(
+                        metrics=history[-1] if history else {},
+                        metrics_history=history,
+                        checkpoint=result.checkpoint,
+                        path=run_dir, error=None)
                 last_error = result.error
+                failures += 1
+                if failures >= attempts:
+                    break
                 # Gang restart from the latest checkpoint (SURVEY.md §7
                 # hard part (d): elastic recovery = checkpoint + gang
                 # restart).
                 self.resume_from_checkpoint = manager.latest()
-            return Result(metrics={}, metrics_history=[], checkpoint=None,
+                if elastic:
+                    # Probe live capacity: the biggest feasible world
+                    # size in [floor, num_workers]. Training resumes
+                    # degraded rather than burning the whole failure
+                    # budget waiting for a full-strength cluster.
+                    world = _probe_world_size(sc, floor,
+                                              sc.num_workers) or world
+            return Result(metrics=history[-1] if history else {},
+                          metrics_history=history, checkpoint=None,
                           path=run_dir, error=last_error)
         finally:
             if rdzv is not None:
                 try:
                     raytpu.kill(rdzv)
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("train.gang_teardown", e)
             # Staged snapshots that were never registered (failed gangs,
             # undrained reports) are garbage once fit() returns.
             import shutil
@@ -324,19 +362,28 @@ class JaxTrainer(BaseTrainer):
     def _run_gang(self, sc: ScalingConfig, name: str, run_dir: str,
                   manager: CheckpointManager, fn_blob: bytes,
                   rdzv_name: Optional[str] = None,
-                  attempt: int = 0) -> Result:
+                  attempt: int = 0,
+                  world_size: Optional[int] = None,
+                  target_world: Optional[int] = None) -> Result:
         from raytpu.core.errors import TaskError
 
+        n = world_size or sc.num_workers
         pg = None
         workers = []
         history = []
         last_ckpt = None
+        # Scale-back-up bookkeeping (elastic gang below full strength):
+        # capacity is probed at most once per check period, and only a
+        # checkpoint boundary may trigger the rescale — re-forming the
+        # gang anywhere else would lose progress since the last save.
+        next_upscale_check = time.monotonic() \
+            + tuning.ELASTIC_UPSCALE_CHECK_PERIOD_S
         try:
-            bundles = sc.bundle_specs()
+            bundles = sc.bundle_specs(n)
             pg = raytpu.placement_group(bundles,
                                         strategy=sc.placement_strategy)
-            shards = _split_datasets(self.datasets, sc.num_workers)
-            for rank in range(sc.num_workers):
+            shards = _split_datasets(self.datasets, n)
+            for rank in range(n):
                 ctx_kwargs = {
                     "experiment_name": name,
                     "storage_path": run_dir,
@@ -345,14 +392,14 @@ class JaxTrainer(BaseTrainer):
                 w = TrainWorker.options(
                     placement_group=pg,
                     placement_group_bundle_index=rank,
-                ).remote(rank, sc.num_workers, ctx_kwargs)
+                ).remote(rank, n, ctx_kwargs)
                 workers.append(w)
             # Gang rendezvous: jax.distributed.initialize runs only when a
             # coordinator address is configured (multi-host cluster mode);
             # in-process workers share one JAX runtime and must skip it.
             raytpu.get([
                 w.setup_distributed.remote(
-                    sc.coordinator_address, sc.num_workers, i,
+                    sc.coordinator_address, n, i,
                     rdzv_name, attempt, self.distributed_backend)
                 for i, w in enumerate(workers)])
             resume = (self.resume_from_checkpoint.path
@@ -370,11 +417,29 @@ class JaxTrainer(BaseTrainer):
                 polls = raytpu.get(
                     [w.poll.remote(0.5 if i == 0 else 0.0)
                      for i, w in enumerate(workers)])
+                ckpt_this_round = False
                 for metrics, ckpt_path in polls[0][0]:  # rank 0 drives
                     history.append(metrics)
                     if ckpt_path:
                         last_ckpt = manager.register(
                             Checkpoint(ckpt_path), metrics)
+                        ckpt_this_round = True
+                if ckpt_this_round and target_world and n < target_world \
+                        and time.monotonic() >= next_upscale_check:
+                    # Checkpoint boundary while degraded: if replacement
+                    # capacity can hold the FULL gang's extra bundles,
+                    # park here and let fit() re-form at full strength,
+                    # resuming from the checkpoint just registered.
+                    next_upscale_check = time.monotonic() \
+                        + tuning.ELASTIC_UPSCALE_CHECK_PERIOD_S
+                    if _world_feasible(sc, target_world, held=n):
+                        return Result(
+                            metrics=history[-1] if history else {},
+                            metrics_history=history,
+                            checkpoint=last_ckpt or manager.latest(),
+                            path=run_dir,
+                            error=_GangRescale(target_world),
+                        )
                 errs = [p[2] for p in polls if p[2]]
                 if errs:
                     error = TaskError("train_loop_per_worker", errs[0])
@@ -409,13 +474,88 @@ class JaxTrainer(BaseTrainer):
             for w in workers:
                 try:
                     raytpu.kill(w)
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("train.gang_teardown", e)
             if pg is not None:
                 try:
                     raytpu.remove_placement_group(pg)
-                except Exception:
-                    pass
+                except Exception as e:
+                    errors.swallow("train.gang_teardown", e)
+
+
+class _GangRescale(Exception):
+    """Internal fit() control flow, never user-visible: an elastic gang
+    running below full strength found capacity for ``world`` workers and
+    parked at a checkpoint boundary so fit() can re-form it bigger."""
+
+    def __init__(self, world: int):
+        super().__init__(f"rescale gang to {world} workers")
+        self.world = world
+
+
+def _world_feasible(sc: ScalingConfig, world: int, held: int = 0) -> bool:
+    """Can a ``world``-worker gang place on the live cluster right now?
+
+    Greedy first-fit of ``sc.bundle_specs(world)`` onto each alive
+    node's available resources — the driver-side mirror of the head's
+    PG packer, cheap enough to poll. ``held``: bundles the CURRENT gang
+    already occupies (released the moment fit() re-forms it), so an
+    upscale probe only needs ``world - held`` fresh bundles. For
+    STRICT_PACK the held bundles are known to sit on one node, and the
+    probe requires a single node covering the full need net of them —
+    slightly optimistic when another node matches, in which case the
+    rescale attempt fails PG creation and the elastic loop recovers.
+    """
+    bundles = sc.bundle_specs(world)
+    if not bundles:
+        return True
+    try:
+        infos = raytpu.nodes()
+    except Exception as e:
+        errors.swallow("train.elastic_probe", e)
+        return False
+    # The cluster client returns reference-style capitalized keys
+    # ("Alive"/"Available"/"Labels"); the local backend lowercase ones.
+    avail = []
+    for i in infos:
+        labels = i.get("Labels") or i.get("labels") or {}
+        if not i.get("Alive", i.get("alive")) \
+                or labels.get("role") == "driver":
+            continue
+        avail.append(dict(i.get("Available") or i.get("available") or {}))
+    if sc.placement_strategy == "STRICT_PACK":
+        need: Dict[str, float] = {}
+        for b in bundles[held:]:
+            for k, v in b.items():
+                need[k] = need.get(k, 0.0) + v
+        return any(all(a.get(k, 0.0) >= v - 1e-9
+                       for k, v in need.items()) for a in avail)
+    for b in bundles[held:]:
+        for a in avail:
+            if all(a.get(k, 0.0) >= v - 1e-9 for k, v in b.items()):
+                for k, v in b.items():
+                    a[k] = a.get(k, 0.0) - v
+                break
+        else:
+            return False
+    return True
+
+
+def _probe_world_size(sc: ScalingConfig, floor: int,
+                      ceiling: int) -> Optional[int]:
+    """Post-failure capacity probe: wait up to ELASTIC_PROBE_TIMEOUT_S
+    for ANY feasible world size in ``[floor, ceiling]``, preferring the
+    biggest. Returns None when nothing fits within the budget — the
+    caller retries at its previous size and lets the gang failure
+    surface normally."""
+    deadline = time.monotonic() + tuning.ELASTIC_PROBE_TIMEOUT_S
+    while True:
+        for world in range(ceiling, floor - 1, -1):
+            if _world_feasible(sc, world):
+                return world
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(tuning.ELASTIC_PROBE_PERIOD_S)
 
 
 def _split_datasets(datasets: Dict[str, Any], n: int):

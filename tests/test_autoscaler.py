@@ -3,6 +3,8 @@ mock providers + test_autoscaler_fake_multinode.py)."""
 
 import time
 
+import pytest
+
 from raytpu.autoscaler import (
     AutoscalerConfig,
     FakeSliceProvider,
@@ -891,5 +893,184 @@ node_groups:
             assert by_bundle[(("CPU", 1.0),)] == 4
             assert by_bundle[(("TPU", 8.0),)] == 1
         finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+
+class TestHeadBridge:
+    """HeadDemandFeed + DrainingProvider: the head's resource_demands
+    census driving scale decisions, and drain-before-terminate on the
+    way down (reference: monitor.py + GcsAutoscalerStateManager)."""
+
+    CPU1 = NodeGroupSpec(name="cpu-1", hosts=1,
+                         resources_per_host={"CPU": 1.0}, max_groups=4)
+
+    def _head(self):
+        from raytpu.cluster.head import HeadServer
+        from raytpu.cluster.protocol import RpcClient
+
+        head = HeadServer()
+        addr = head.start()
+        return head, RpcClient(addr), addr
+
+    def test_feed_demands_and_busy_census(self):
+        from raytpu.autoscaler import GROUP_LABEL, HeadDemandFeed
+
+        head, cli, addr = self._head()
+        feed = HeadDemandFeed(addr, cache_ttl_s=0.0)
+        try:
+            cli.call("register_node", "n-busy", "x:1", {"CPU": 2.0},
+                     {GROUP_LABEL: "g-busy"})
+            cli.call("register_node", "n-idle", "x:2", {"CPU": 2.0},
+                     {GROUP_LABEL: "g-idle"})
+            cli.call("register_node", "n-bare", "x:3", {"CPU": 2.0}, {})
+            cli.call("register_actor", "a1", "n-busy", None, "default")
+            # One queued-infeasible task shape becomes demand.
+            assert cli.call("schedule", {"TPU": 8.0}, None, 0.5,
+                            "task-1") is None
+            demands = feed.demands()
+            assert [(d.bundle, d.count) for d in demands] == \
+                [({"TPU": 8.0}, 1)]
+            # Only the actor-hosting group is busy; the idle group and
+            # the unlabeled node never appear.
+            assert feed.busy_group_ids() == {"g-busy"}
+            assert [n["node_id"]
+                    for n in feed.nodes_in_group("g-idle")] == ["n-idle"]
+        finally:
+            feed.close()
+            cli.close()
+            head.stop()
+
+    def test_draining_provider_refuses_actor_home(self):
+        import pytest as _pytest
+
+        from raytpu.autoscaler import (
+            DrainingProvider,
+            GROUP_LABEL,
+            HeadDemandFeed,
+        )
+
+        head, cli, addr = self._head()
+        feed = HeadDemandFeed(addr, cache_ttl_s=0.0)
+        inner = FakeSliceProvider()
+        prov = DrainingProvider(inner, feed)
+        try:
+            g = inner.create_node_group(self.CPU1)
+            cli.call("register_node", "n1", "x:1", {"CPU": 1.0},
+                     {GROUP_LABEL: g.group_id})
+            cli.call("register_actor", "a1", "n1", None, "default")
+            with _pytest.raises(RuntimeError, match="drain refused"):
+                prov.terminate_node_group(g.group_id)
+            # The cloud group was never touched and the head still
+            # considers the node schedulable: the drain was declined,
+            # not forced.
+            assert inner.terminate_calls == 0
+            state = cli.call("resource_demands")
+            assert {n["node_id"]: n["alive"]
+                    for n in state["nodes"]} == {"n1": True}
+        finally:
+            feed.close()
+            cli.close()
+            head.stop()
+
+    def test_idle_group_drained_before_terminate(self):
+        from raytpu.autoscaler import (
+            DrainingProvider,
+            GROUP_LABEL,
+            HeadDemandFeed,
+        )
+
+        head, cli, addr = self._head()
+        feed = HeadDemandFeed(addr, cache_ttl_s=0.0)
+        inner = FakeSliceProvider()
+        prov = DrainingProvider(inner, feed)
+        g_busy = inner.create_node_group(self.CPU1)
+        g_idle = inner.create_node_group(self.CPU1)
+        try:
+            cli.call("register_node", "n-busy", "x:1", {"CPU": 1.0},
+                     {GROUP_LABEL: g_busy.group_id})
+            cli.call("register_node", "n-idle", "x:2", {"CPU": 1.0},
+                     {GROUP_LABEL: g_idle.group_id})
+            cli.call("register_actor", "a1", "n-busy", None, "default")
+            asc = StandardAutoscaler(
+                AutoscalerConfig(node_groups=[self.CPU1],
+                                 idle_timeout_s=0.1), prov)
+            # First tick adopts the pre-existing groups and starts the
+            # surplus instance's idle clock.
+            asc.update(feed.demands(), feed.busy_group_ids())
+            time.sleep(0.25)
+            for _ in range(3):
+                asc.update(feed.demands(), feed.busy_group_ids())
+            # The idle group was drained at the head FIRST (node marked
+            # dead, nothing schedules onto it mid-teardown), then
+            # terminated at the provider. The actor's home group — busy
+            # in the census — survives with zero demand.
+            assert inner.terminate_calls == 1
+            assert [g.group_id for g in inner.non_terminated_groups()] \
+                == [g_busy.group_id]
+            alive = {n["node_id"]: n["alive"]
+                     for n in cli.call("resource_demands")["nodes"]}
+            assert alive == {"n-busy": True, "n-idle": False}
+        finally:
+            feed.close()
+            cli.close()
+            head.stop()
+
+
+class TestAutoscalerEndToEnd:
+    """The whole loop against a real cluster: queued-infeasible PG ->
+    resource_demands -> StandardAutoscaler -> provider launch -> node
+    joins -> the PG places."""
+
+    @pytest.mark.slow
+    def test_pending_pg_scales_up_and_places(self, monkeypatch):
+        import raytpu
+        from raytpu.autoscaler import GROUP_LABEL, connect_autoscaler
+        from raytpu.cluster import constants as tuning
+        from raytpu.cluster.cluster_utils import Cluster
+        from raytpu.cluster.protocol import RpcClient
+
+        # The create_pg retry loop must outlive one real node boot.
+        monkeypatch.setattr(tuning, "PG_CREATE_TIMEOUT_S", 90.0)
+        cluster = Cluster()
+        raytpu.init(address=cluster.address)
+        spec = NodeGroupSpec(name="cpu-1", hosts=1,
+                             resources_per_host={"CPU": 1.0},
+                             max_groups=2)
+
+        class ClusterProvider(FakeSliceProvider):
+            """FakeSliceProvider whose launches boot REAL node
+            processes, labeled back to the provider group."""
+
+            def create_node_group(self, s):
+                g = super().create_node_group(s)
+                cluster.add_node(num_cpus=1, num_tpus=0,
+                                 labels={GROUP_LABEL: g.group_id})
+                return g
+
+        provider = ClusterProvider()
+        monitor = connect_autoscaler(
+            cluster.address,
+            AutoscalerConfig(node_groups=[spec], idle_timeout_s=3600.0),
+            provider, period_s=0.2)
+        monitor.start()
+        try:
+            # Blocks retrying create_pg; every refused attempt
+            # (re-)records pending-PG demand, the monitor sees it and
+            # launches a node. The call returning at all proves the PG
+            # placed on autoscaled capacity.
+            pg = raytpu.placement_group([{"CPU": 1.0}], strategy="PACK")
+            assert provider.create_calls >= 1
+            head = RpcClient(cluster.address)
+            try:
+                labeled = [n for n in head.call("list_nodes")
+                           if GROUP_LABEL in n["labels"]]
+            finally:
+                head.close()
+            assert labeled and all(n["alive"] for n in labeled)
+            raytpu.remove_placement_group(pg)
+        finally:
+            monitor.stop()
+            monitor.feed.close()
             raytpu.shutdown()
             cluster.shutdown()

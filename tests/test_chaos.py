@@ -617,3 +617,284 @@ class TestChaosRecovery:
             raytpu.shutdown()
             cluster.shutdown()
             failpoints.clear()
+
+
+# -- durable head / elastic cluster (ISSUE 14) -------------------------------
+
+_GCS_CHURN = """
+import sys
+
+from raytpu.cluster.head import GcsStore
+
+store = GcsStore(sys.argv[1])
+print("ready", flush=True)
+i = 0
+while True:
+    store.put("churn", "k%06d" % i, ("v%d" % i).encode())
+    i += 1
+"""
+
+
+class TestDurableHead:
+    def test_gcs_store_survives_sigkill_mid_churn(self, tmp_path):
+        """SIGKILL a process mid put-churn; reopening the store must
+        yield a CLEAN PREFIX of the put sequence — per-put transactions
+        on a WAL store mean no holes and no torn values, which is the
+        property every write-after-mutation table relies on."""
+        import signal
+        import subprocess
+        import sys
+
+        db = str(tmp_path / "gcs.db")
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _GCS_CHURN, db],
+            stdout=subprocess.PIPE, text=True, cwd=repo_root)
+        try:
+            assert proc.stdout.readline().strip() == "ready"
+            time.sleep(0.4)  # let a few hundred puts commit
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.stdout.close()
+
+        from raytpu.cluster.head import GcsStore
+
+        store = GcsStore(db)
+        try:
+            rows = store.load_all("churn")
+        finally:
+            store.close()
+        n = len(rows)
+        assert n > 0, "no put committed before the kill"
+        assert sorted(rows) == ["k%06d" % i for i in range(n)]
+        for i in range(n):
+            assert rows["k%06d" % i] == ("v%d" % i).encode()
+
+    @pytest.mark.slow
+    def test_head_sigkill_inflight_get_completes(self, tmp_path):
+        """SIGKILL the head while the driver blocks in get() on a task
+        a node is still executing. The restarted head reloads its
+        tables from the sqlite store, node and driver run their
+        reconnect paths, and the SAME get() call returns the right
+        value — the bounce is invisible to the caller."""
+        cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1},
+                          head_storage=str(tmp_path / "gcs.db"))
+        cluster.wait_for_nodes(1)
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote
+            def slow_double(x):
+                import time as _t
+                _t.sleep(4.0)
+                return x * 2
+
+            ref = slow_double.remote(21)
+            time.sleep(1.0)  # the task is running on the node
+            box = {}
+
+            def getter():
+                box["value"] = raytpu.get(ref, timeout=120)
+
+            th = threading.Thread(target=getter)
+            th.start()
+            time.sleep(0.5)  # getter blocked on the in-flight task
+            cluster.kill_head()     # SIGKILL, no goodbye
+            cluster.restart_head()  # same address, same store
+            th.join(timeout=120)
+            assert not th.is_alive(), \
+                "get() never returned after the head bounce"
+            assert box["value"] == 42
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+    @pytest.mark.slow
+    def test_head_sigkill_queued_task_replayed(self, tmp_path,
+                                               monkeypatch):
+        """Batch mode: the head durably owns queued-infeasible specs
+        (pending_tasks table). SIGKILL it while one is queued; the
+        restarted head reloads the spec and dispatches it once a node
+        joins — the driver's get(), blocked across the bounce, returns
+        the task's value."""
+        from raytpu.cluster import constants as tuning
+
+        monkeypatch.setattr(tuning, "RPC_BATCH", True)
+        cluster = Cluster(head_storage=str(tmp_path / "gcs.db"))
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote(num_cpus=1)
+            def landed():
+                return "landed"
+
+            ref = landed.remote()  # no node has a CPU yet
+            head = RpcClient(cluster.address)
+            try:
+                deadline = time.monotonic() + 30
+                queued = 0
+                while time.monotonic() < deadline:
+                    queued = head.call("resource_demands")[
+                        "queued_tasks"]
+                    if queued >= 1:
+                        break
+                    time.sleep(0.1)
+                assert queued >= 1, \
+                    "spec never reached the head's durable queue"
+            finally:
+                head.close()
+            cluster.kill_head()
+            cluster.restart_head()
+            cluster.add_node(num_cpus=1)
+            assert raytpu.get(ref, timeout=120) == "landed"
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+
+class TestElasticCluster:
+    @pytest.mark.slow
+    def test_gang_node_loss_resumes_then_rescales(self, tmp_path,
+                                                  monkeypatch):
+        """The full elastic story on a real cluster: SIGKILL one gang
+        node mid-fit(); the trainer re-forms at world size 1 from the
+        latest checkpoint, keeps training, and — once the autoscaler
+        (fed by a request_resources hint) boots a replacement node —
+        scales back up to world size 2 at a checkpoint boundary.
+        fit() returns success with one continuous history."""
+        from raytpu.autoscaler import (
+            AutoscalerConfig,
+            FakeSliceProvider,
+            GROUP_LABEL,
+            NodeGroupSpec,
+            connect_autoscaler,
+        )
+        from raytpu.cluster import constants as tuning
+        from raytpu.train import (
+            Checkpoint,
+            FailureConfig,
+            JaxTrainer,
+            RunConfig,
+            ScalingConfig,
+            get_checkpoint,
+            get_context,
+            report,
+        )
+
+        monkeypatch.setenv("RAYTPU_HEARTBEAT_TIMEOUT_S", "2.0")
+        monkeypatch.setenv("RAYTPU_HEALTH_CHECK_PERIOD_S", "0.5")
+        monkeypatch.setattr(tuning, "ELASTIC_UPSCALE_CHECK_PERIOD_S",
+                            0.5)
+        cluster = Cluster(num_nodes=2, node_resources={"num_cpus": 1})
+        cluster.wait_for_nodes(2)
+        raytpu.init(address=cluster.address)
+        marker = str(tmp_path / "progress.txt")
+
+        def loop(config):
+            import tempfile
+            import time as _t
+
+            world = get_context().world_size
+            ckpt = get_checkpoint()
+            start = 0
+            if ckpt is not None:
+                with open(os.path.join(ckpt.path, "step.txt")) as f:
+                    start = int(f.read()) + 1
+            for step in range(start, 40):
+                _t.sleep(0.1)
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step))
+                with open(config["marker"], "a") as f:
+                    f.write("%d %d\n" % (step, world))
+                report({"step": step, "world": world},
+                       checkpoint=Checkpoint(d))
+
+        spec = NodeGroupSpec(name="cpu-1", hosts=1,
+                             resources_per_host={"CPU": 1.0},
+                             max_groups=4)
+
+        class ClusterProvider(FakeSliceProvider):
+            def create_node_group(self, s):
+                g = super().create_node_group(s)
+                cluster.add_node(num_cpus=1,
+                                 labels={GROUP_LABEL: g.group_id})
+                return g
+
+        provider = ClusterProvider()
+        monitor = connect_autoscaler(
+            cluster.address,
+            AutoscalerConfig(node_groups=[spec], idle_timeout_s=3600.0),
+            provider, period_s=0.3)
+        box = {}
+
+        def worlds_seen():
+            try:
+                with open(marker) as f:
+                    return [int(line.split()[1])
+                            for line in f if line.strip()]
+            except FileNotFoundError:
+                return []
+
+        try:
+            trainer = JaxTrainer(
+                loop, train_loop_config={"marker": marker},
+                scaling_config=ScalingConfig(
+                    num_workers=2, min_workers=1, elastic=True,
+                    resources_per_worker={"CPU": 1.0},
+                    placement_strategy="PACK"),
+                run_config=RunConfig(
+                    storage_path=str(tmp_path / "run"),
+                    failure_config=FailureConfig(max_failures=4)))
+            th = threading.Thread(
+                target=lambda: box.update(r=trainer.fit()))
+            th.start()
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline \
+                    and 2 not in worlds_seen():
+                time.sleep(0.2)
+            assert 2 in worlds_seen(), \
+                "gang never started at full strength"
+
+            # Lose one gang member, hard.
+            cluster.kill_node(cluster.nodes[-1], graceful=False)
+
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline \
+                    and 1 not in worlds_seen():
+                time.sleep(0.2)
+            assert 1 in worlds_seen(), \
+                "gang did not re-form at the degraded world size"
+
+            # Capacity returns: the hint drives the autoscaler, the
+            # autoscaler boots a real replacement node, the trainer
+            # notices at a checkpoint boundary and rescales.
+            monitor.start()
+            cli = RpcClient(cluster.address)
+            try:
+                cli.call("request_resources",
+                         [{"CPU": 1.0}, {"CPU": 1.0}])
+            finally:
+                cli.close()
+            th.join(timeout=180)
+            assert not th.is_alive(), "fit() never finished"
+            result = box["r"]
+            assert result.error is None
+            assert result.metrics["step"] == 39
+            assert provider.create_calls >= 1
+            steps = [m["step"] for m in result.metrics_history]
+            worlds = [m["world"] for m in result.metrics_history]
+            assert steps == sorted(steps)  # never regresses
+            assert set(steps) == set(range(40))
+            assert worlds[0] == 2
+            assert 1 in worlds
+            assert worlds[-1] == 2, \
+                "training never scaled back up to full strength"
+        finally:
+            monitor.stop()
+            monitor.feed.close()
+            raytpu.shutdown()
+            cluster.shutdown()

@@ -647,6 +647,109 @@ class TestMetricRegistry:  # RTP015
         assert res.findings == []
 
 
+class TestSeamSwallowTrainScope:  # RTP009, raytpu/train/ extension
+    def test_planted_gang_teardown_swallow(self):
+        findings = run_rule_on_source(_rule("RTP009"), _src("""
+            def teardown(self, workers):
+                for w in workers:
+                    try:
+                        raytpu.kill(w)
+                    except Exception:
+                        pass
+        """), rel="raytpu/train/trainer.py")
+        assert len(findings) == 1
+        assert "swallowed" in findings[0].message
+
+    def test_clean_recorded_gang_teardown(self):
+        assert run_rule_on_source(_rule("RTP009"), _src("""
+            from raytpu.util import errors
+
+            def teardown(self, workers):
+                for w in workers:
+                    try:
+                        raytpu.kill(w)
+                    except Exception as e:
+                        errors.swallow("train.gang_teardown", e)
+        """), rel="raytpu/train/trainer.py") == []
+
+    def test_out_of_scope_module_ignored(self):
+        # Same planted source outside cluster/ and train/: no finding.
+        assert run_rule_on_source(_rule("RTP009"), _src("""
+            def f(self, c):
+                try:
+                    c.call("x")
+                except Exception:
+                    pass
+        """), rel="raytpu/util/whatever.py") == []
+
+
+class TestPersistCoverage:  # RTP016
+    def test_planted_unpaired_mutation(self):
+        findings = run_rule_on_source(_rule("RTP016"), _src("""
+            class Head:
+                def _register_actor(self, aid, info):
+                    with self._lock:
+                        self._actors[aid] = info
+        """), rel="raytpu/cluster/head.py")
+        assert len(findings) == 1
+        assert "_persist_actor" in findings[0].message
+
+    def test_planted_pop_without_persist(self):
+        findings = run_rule_on_source(_rule("RTP016"), _src("""
+            class Head:
+                def _forget(self, tid):
+                    self._pending_specs.pop(tid, None)
+        """), rel="raytpu/cluster/head.py")
+        assert len(findings) == 1
+        assert "_persist_pending_task" in findings[0].message
+
+    def test_clean_paired_mutation(self):
+        assert run_rule_on_source(_rule("RTP016"), _src("""
+            class Head:
+                def _kv_put(self, key, value):
+                    with self._lock:
+                        self._kv[key] = value
+                    self._persist_kv(key, value)
+        """), rel="raytpu/cluster/head.py") == []
+
+    def test_clean_deferred_persist_after_lock(self):
+        # RTP013 pushes the store write past the lock release; the
+        # pairing only needs to land in the same function.
+        assert run_rule_on_source(_rule("RTP016"), _src("""
+            class Head:
+                def _submit(self, specs):
+                    persist = []
+                    with self._lock:
+                        for tid, blob in specs:
+                            self._pending_specs[tid] = blob
+                            persist.append(tid)
+                    for tid in persist:
+                        self._persist_pending_task(tid)
+        """), rel="raytpu/cluster/head.py") == []
+
+    def test_exempt_reload_and_snapshot(self):
+        assert run_rule_on_source(_rule("RTP016"), _src("""
+            class Head:
+                def _reload(self):
+                    for k, v in self._store.load_all("kv"):
+                        self._kv[k] = v
+
+                def _snapshot(self):
+                    self._actors["tmp"] = {}
+        """), rel="raytpu/cluster/head.py") == []
+
+    def test_other_cluster_modules_out_of_scope(self):
+        assert run_rule_on_source(_rule("RTP016"), _src("""
+            class Node:
+                def f(self):
+                    self._actors["x"] = 1
+        """), rel="raytpu/cluster/node.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP016"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

@@ -170,6 +170,85 @@ class TestJaxTrainer:
                                       np.asarray(tree["w"]))
 
 
+class TestElasticTrainer:
+    def test_gang_downscale_then_upscale(self, trainer_env, monkeypatch):
+        """Elastic fit(): a gang failure at full strength re-forms the
+        gang at the probed (smaller) world size from the latest
+        checkpoint, then scales back up at a checkpoint boundary once
+        capacity returns — one continuous metrics history, no error,
+        and the rescale itself never burns the failure budget."""
+        raytpu, tmp = trainer_env
+        import raytpu.train.trainer as trainer_mod
+        from raytpu.cluster import constants as tuning
+        from raytpu.train import (
+            Checkpoint,
+            FailureConfig,
+            JaxTrainer,
+            RunConfig,
+            ScalingConfig,
+            get_checkpoint,
+            get_context,
+            report,
+        )
+
+        flag = os.path.join(tmp, "capacity-back")
+
+        def feasible(sc, world, held=0):
+            # Capacity oracle: one worker always fits; two fit only
+            # once the (downscaled) train loop drops the flag file.
+            cap = 2 if os.path.exists(flag) else 1
+            return world - held <= cap - held
+
+        monkeypatch.setattr(trainer_mod, "_world_feasible", feasible)
+        monkeypatch.setattr(tuning, "ELASTIC_UPSCALE_CHECK_PERIOD_S",
+                            0.0)
+
+        def loop(config):
+            import tempfile
+            import time as _t
+
+            world = get_context().world_size
+            ckpt = get_checkpoint()
+            start = 0
+            if ckpt is not None:
+                with open(os.path.join(ckpt.path, "step.txt")) as f:
+                    start = int(f.read()) + 1
+            for step in range(start, 20):
+                if step == 2 and world == 2 and start == 0:
+                    raise RuntimeError("simulated gang member loss")
+                _t.sleep(0.05)
+                if step >= 6:
+                    with open(config["flag"], "w") as f:
+                        f.write("up")
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step))
+                report({"step": step, "world": world},
+                       checkpoint=Checkpoint(d))
+
+        result = JaxTrainer(
+            loop, train_loop_config={"flag": flag},
+            scaling_config=ScalingConfig(num_workers=2, min_workers=1,
+                                         elastic=True),
+            run_config=RunConfig(
+                storage_path=tmp,
+                failure_config=FailureConfig(max_failures=1)),
+        ).fit()
+        assert result.error is None
+        assert result.metrics["step"] == 19
+        steps = [m["step"] for m in result.metrics_history]
+        worlds = [m["world"] for m in result.metrics_history]
+        # Continuous across both rescales: never regresses, every step
+        # of the schedule is covered exactly once.
+        assert steps == sorted(steps)
+        assert steps == sorted(set(steps))
+        assert set(steps) == set(range(20))
+        # The run really did shrink and grow back.
+        assert worlds[0] == 2
+        assert 1 in worlds
+        assert worlds[-1] == 2
+
+
 class TestGPT2Model:
     def test_forward_and_loss(self):
         from raytpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn, init_params
