@@ -27,8 +27,19 @@ can't poison the next mode:
 Writes BENCH_r14.json at the repo root and prints the same object as
 one JSON line.
 
+``--standby`` runs the hot-standby A/B instead (PR 16): the same
+failover scenario twice — **failover-restart** (SIGKILL the head,
+respawn it in place: the r14 story) vs **failover-standby** (SIGKILL
+the head, a WAL-tailing follower takes over via lease election, no
+process restart). Both children run a sustained echo-task stream plus
+one in-flight slow get across the kill and report: MTTR (kill → first
+fresh round-trip), the restart window (0 for the standby — the serving
+process already exists), added latency on the in-flight get, and tasks
+landed during a fixed 5 s window after the kill. Writes BENCH_r16.json.
+
 Env: RAYTPU_BENCH_STEPS (default 60), RAYTPU_BENCH_RESTORE_DELAY_S
-(default 5), RAYTPU_BENCH_SLOW_TASK_S (default 3).
+(default 5), RAYTPU_BENCH_SLOW_TASK_S (default 3),
+RAYTPU_BENCH_OUTAGE_WINDOW_S (default 5).
 """
 
 from __future__ import annotations
@@ -48,6 +59,8 @@ STEPS = int(os.environ.get("RAYTPU_BENCH_STEPS", "60"))
 RESTORE_DELAY_S = float(
     os.environ.get("RAYTPU_BENCH_RESTORE_DELAY_S", "5"))
 SLOW_TASK_S = float(os.environ.get("RAYTPU_BENCH_SLOW_TASK_S", "3"))
+OUTAGE_WINDOW_S = float(
+    os.environ.get("RAYTPU_BENCH_OUTAGE_WINDOW_S", "5"))
 
 
 # -- head-bounce MTTR (child) -------------------------------------------------
@@ -112,6 +125,129 @@ def run_head_bounce() -> dict:
             "bounce_added_latency_s": round(
                 inflight_total - sleep_s, 3),
             "mttr_s": round(mttr, 3),
+        }
+    finally:
+        raytpu.shutdown()
+        cluster.shutdown()
+
+
+# -- hot-standby vs restart-in-place failover (child) -------------------------
+
+
+def run_failover(standby: bool) -> dict:
+    import tempfile
+
+    import raytpu
+    from raytpu.cluster import constants as tuning
+    from raytpu.cluster.cluster_utils import Cluster
+    from raytpu.cluster.head import GcsStore
+
+    tmp = tempfile.mkdtemp()
+    addr_file = os.path.join(tmp, "head.addr")
+    # The driver rides redirect-on-failover via the discovery record;
+    # cluster children inherit it through RAYTPU_HEAD_ADDR_FILE.
+    tuning.HEAD_ADDR_FILE = addr_file
+    cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 4},
+                      head_storage=os.path.join(tmp, "gcs.db"),
+                      addr_file=addr_file)
+    cluster.wait_for_nodes(1)
+    if standby:
+        cluster.add_standby()
+        # A never-synced follower refuses election: wait for the lease
+        # row (meta table churns every renewal) to land in the replica.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            peek = GcsStore(cluster._standby_storage)
+            try:
+                state = json.loads(
+                    peek.load_all("standby").get("state", b"{}"))
+            finally:
+                peek.close()
+            if state.get("cursors", {}).get("meta", 0) >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("follower never synced")
+    raytpu.init(address=cluster.address)
+    try:
+        sleep_s = SLOW_TASK_S
+
+        @raytpu.remote
+        def echo(x):
+            return x
+
+        @raytpu.remote
+        def slow_echo(x):
+            import time as _t
+            _t.sleep(sleep_s)
+            return x
+
+        assert raytpu.get(echo.remote(1), timeout=60) == 1  # warm path
+
+        # Sustained stream: one completion timestamp per round-trip.
+        done = []
+        stop = threading.Event()
+
+        def stream():
+            while not stop.is_set():
+                try:
+                    if raytpu.get(echo.remote(0), timeout=15) == 0:
+                        done.append(time.monotonic())
+                except Exception:
+                    time.sleep(0.02)
+
+        th = threading.Thread(target=stream, daemon=True)
+        th.start()
+        time.sleep(1.0)
+        baseline_rate = len(done) / 1.0
+
+        ref = slow_echo.remote(7)  # rides the outage in flight
+        t_submit = time.monotonic()
+        time.sleep(0.5)
+        t_kill = time.monotonic()
+        cluster.kill_head()
+        if standby:
+            cluster.await_takeover(timeout=60)
+            takeover_s = time.monotonic() - t_kill
+            restart_window_s = 0.0  # the serving process already exists
+        else:
+            t0 = time.monotonic()
+            cluster.restart_head()
+            restart_window_s = time.monotonic() - t0
+            takeover_s = time.monotonic() - t_kill
+        t_serving = time.monotonic()  # a head is answering again
+        assert raytpu.get(ref, timeout=120) == 7
+        inflight_total = time.monotonic() - t_submit
+        while time.monotonic() < t_kill + OUTAGE_WINDOW_S:
+            time.sleep(0.05)
+        stop.set()
+        th.join(timeout=30)
+        after = sorted(t for t in done if t > t_kill)
+        mttr = round(after[0] - t_kill, 3) if after else None
+        # r14's head_bounce started its MTTR clock only once the new
+        # head was serving; report the same clock so the A/B against
+        # its 0.27 s is apples-to-apples, alongside the stricter
+        # kill-to-first-completion number above.
+        post = [t for t in after if t > t_serving]
+        mttr_from_serving = (
+            round(post[0] - t_serving, 3) if post
+            else (round(after[0] - t_serving, 3) if after else None))
+        landed = len([t for t in done
+                      if t_kill < t <= t_kill + OUTAGE_WINDOW_S])
+        return {
+            "mode": "failover-standby" if standby
+            else "failover-restart",
+            "mttr_s": mttr,
+            "mttr_from_serving_s": mttr_from_serving,
+            "takeover_s": round(takeover_s, 3),
+            "restart_window_s": round(restart_window_s, 3),
+            "inflight_get_total_s": round(inflight_total, 3),
+            "inflight_task_sleep_s": sleep_s,
+            "inflight_added_latency_s": round(
+                inflight_total - sleep_s, 3),
+            "outage_window_s": OUTAGE_WINDOW_S,
+            "tasks_during_outage_window": landed,
+            "baseline_tasks_per_s": round(baseline_rate, 1),
         }
     finally:
         raytpu.shutdown()
@@ -240,6 +376,19 @@ def _spawn(mode: str) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["RAYTPU_HEARTBEAT_TIMEOUT_S"] = "2.0"
     env["RAYTPU_HEALTH_CHECK_PERIOD_S"] = "0.5"
+    if mode.startswith("failover"):
+        # Failover-detection knobs, identical for both arms of the A/B:
+        # a tight lease so MTTR measures the machinery, not the TTL, and
+        # a fast driver re-dial so neither arm is backoff-bound.
+        env["RAYTPU_HEAD_LEASE_TTL_S"] = "0.15"
+        env["RAYTPU_HEAD_LEASE_RENEW_PERIOD_S"] = "0.05"
+        env["RAYTPU_WAL_SHIP_PERIOD_S"] = "0.02"
+        env["RAYTPU_STANDBY_RECONNECT_DELAY_S"] = "0.02"
+        env["RAYTPU_RECONNECT_BASE_DELAY_S"] = "0.02"
+        # Nodes must notice the dead head promptly too, or the first
+        # post-failover round-trip waits out a 1 s heartbeat gap that
+        # has nothing to do with either recovery mechanism.
+        env["RAYTPU_HEARTBEAT_PERIOD_S"] = "0.05"
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child", mode],
@@ -262,8 +411,35 @@ def main():
             print(json.dumps(run_gang(elastic=True)))
         elif mode == "gang-fixed":
             print(json.dumps(run_gang(elastic=False)))
+        elif mode == "failover-standby":
+            print(json.dumps(run_failover(standby=True)))
+        elif mode == "failover-restart":
+            print(json.dumps(run_failover(standby=False)))
         else:
             raise SystemExit(f"unknown child mode {mode!r}")
+        return
+
+    if "--standby" in sys.argv:
+        sb = _spawn("failover-standby")
+        rs = _spawn("failover-restart")
+        result = {
+            "bench": "hot_standby_failover",
+            "standby": sb,
+            "restart_in_place": rs,
+            # Headline A/B: how long the control plane was gone, and
+            # whether a head process had to be (re)started to end it.
+            "mttr_standby_s": sb["mttr_s"],
+            "mttr_restart_s": rs["mttr_s"],
+            "mttr_from_serving_standby_s": sb["mttr_from_serving_s"],
+            "mttr_from_serving_restart_s": rs["mttr_from_serving_s"],
+            "restart_window_standby_s": sb["restart_window_s"],
+            "restart_window_restart_s": rs["restart_window_s"],
+        }
+        path = os.path.join(REPO_ROOT, "BENCH_r16.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(json.dumps(result))
         return
 
     bounce = _spawn("head_bounce")

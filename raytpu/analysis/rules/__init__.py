@@ -45,6 +45,9 @@ RTP015 metric-registry         every Counter/Gauge/Histogram name is
 RTP016 persist-coverage        every mutation of a persisted head
                                table pairs with its _persist_* call
                                in the same function
+RTP017 wal-ship-coverage       every table persisted via GcsStore in
+                               head.py appears in the WAL_SHIP_TABLES
+                               tuple the wal_ship stream serves
 ====== ======================= ====================================
 """
 
@@ -64,5 +67,6 @@ from raytpu.analysis.rules import (  # noqa: F401
     step_loop_blocking,
     timing_literals,
     transition_coverage,
+    wal_coverage,
     wire_purity,
 )

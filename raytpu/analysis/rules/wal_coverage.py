@@ -1,0 +1,99 @@
+"""RTP017: every table persisted through ``GcsStore`` is covered by the
+WAL-ship stream.
+
+The hot-standby head replicates the active head's durable state by
+tailing the store's WAL over the ``wal_ship`` RPC — but only for the
+tables named in the ``WAL_SHIP_TABLES`` literal. A new persistence call
+site (``self._store.put/delete/snapshot_table("<table>", ...)``) whose
+table is missing from that tuple ships nothing: the standby takes over
+with exactly that table cold, and the gap is invisible until the first
+failover needs the record. This rule makes the coverage mechanical:
+every string-literal table name passed to a ``self._store`` mutation in
+``head.py`` must appear in the ``WAL_SHIP_TABLES`` tuple of the same
+module (the tuple is the ship stream's source of truth — ``_h_wal_ship``
+serves exactly those tables, and ``StandbyHead._apply`` refuses others).
+
+Non-literal table arguments are skipped (unresolvable statically); the
+existing sites all use literals, and a reviewer seeing a computed table
+name at a persistence seam should demand a literal anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from raytpu.analysis.core import Rule, register
+
+_STORE_MUTATORS = {"put", "delete", "snapshot_table"}
+
+
+def _store_table_arg(node) -> Optional[Tuple[ast.AST, str]]:
+    """``self._store.<mutator>("<table>", ...)`` -> (node, table)."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _STORE_MUTATORS):
+        return None
+    recv = node.func.value
+    if not (isinstance(recv, ast.Attribute) and recv.attr == "_store"
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"):
+        return None
+    if not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return node, arg.value
+    return None
+
+
+def _shipped_tables(tree) -> Optional[Set[str]]:
+    """The WAL_SHIP_TABLES literal tuple, or None if absent."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "WAL_SHIP_TABLES":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    return {e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+    return None
+
+
+@register
+class WalCoverage(Rule):
+    id = "RTP017"
+    name = "wal-ship-coverage"
+    invariant = ("every string-literal table persisted via self._store "
+                 "in head.py appears in the WAL_SHIP_TABLES tuple the "
+                 "wal_ship stream serves")
+    rationale = ("a persisted table missing from the ship stream is "
+                 "silently cold on the standby — the gap only surfaces "
+                 "when a failover needs exactly that record")
+    scope = ("raytpu/cluster/head.py",)
+
+    def check(self, mod) -> Iterable:
+        sites: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(mod.tree):
+            hit = _store_table_arg(node)
+            if hit is not None:
+                sites.append(hit)
+        if not sites:
+            return
+        shipped = _shipped_tables(mod.tree)
+        if shipped is None:
+            yield self.finding(
+                mod, sites[0][0],
+                "GcsStore tables are persisted but no WAL_SHIP_TABLES "
+                "literal tuple exists in this module — the hot-standby "
+                "ship stream has no source of truth")
+            return
+        for node, table in sites:
+            if table not in shipped:
+                yield self.finding(
+                    mod, node,
+                    f"table {table!r} is persisted via self._store but "
+                    f"missing from WAL_SHIP_TABLES — the hot-standby "
+                    f"never replicates it and takes over with this "
+                    f"table cold; add it to the ship tuple")

@@ -112,11 +112,16 @@ class HeadDemandFeed:
     def busy_group_ids(self) -> Set[str]:
         """Provider groups hosting at least one busy node. Busy =
         running a live actor or holding allocated task resources (the
-        head computes it; see ``_resource_demands``)."""
+        head computes it; see ``_resource_demands``). Nodes labelled
+        ``role=standby`` (hosting a hot-standby head follower) are
+        always busy: scaling the follower away would silently forfeit
+        zero-restart failover, so the group survives the idle census."""
         busy: Set[str] = set()
         for n in self._state().get("nodes", []):
-            gid = (n.get("labels") or {}).get(GROUP_LABEL)
-            if gid and n.get("alive") and n.get("busy"):
+            labels = n.get("labels") or {}
+            gid = labels.get(GROUP_LABEL)
+            standby = labels.get("role") == "standby"
+            if gid and n.get("alive") and (n.get("busy") or standby):
                 busy.add(gid)
         return busy
 

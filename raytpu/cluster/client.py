@@ -27,8 +27,9 @@ import cloudpickle
 from raytpu.cluster import wire
 
 from raytpu.cluster import constants as tuning
+from raytpu.cluster.head import read_addr_record
 from raytpu.cluster.node import NodeServer
-from raytpu.cluster.protocol import ConnectionLost, RpcClient
+from raytpu.cluster.protocol import ConnectionLost, HeadRedirect, RpcClient
 from raytpu.core.errors import (
     ActorDiedError,
     GetTimeoutError,
@@ -123,6 +124,7 @@ class ClusterBackend:
         self._head_address = address
         self._head_lock = threading.Lock()
         self._head = self._connect(address)
+        self._learn_epoch(self._head)
         self._subscribe_head(self._head)
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
@@ -188,6 +190,23 @@ class ClusterBackend:
 
     # -- plumbing ----------------------------------------------------------
 
+    def _learn_epoch(self, head: RpcClient) -> None:
+        """Learn the head's epoch so subsequent frames carry it ("ep"
+        stamping — a superseded head then rejects us with HeadRedirect
+        instead of silently accepting writes). When batch negotiation
+        already ran, the caps carry it; otherwise one explicit rpc_caps
+        round trip (empty caps dict: the server stays on the unbatched
+        wire). An older head without the capability just leaves frames
+        unstamped."""
+        try:
+            caps = getattr(head, "caps", None) or head.call(
+                "rpc_caps", {}, timeout=tuning.RPC_CONNECT_TIMEOUT_S)
+            if isinstance(caps, dict) and caps.get("head_epoch") \
+                    is not None:
+                head.epoch = int(caps["head_epoch"])
+        except Exception as e:
+            errors.swallow("client.epoch_probe", e)
+
     def _subscribe_head(self, head: RpcClient) -> None:
         """Install this driver's event subscriptions on a head connection
         — at first connect AND on every reconnect (subscriptions are
@@ -220,6 +239,15 @@ class ClusterBackend:
             head = self._head
             try:
                 return head.call(method, *args, **kw)
+            except HeadRedirect as r:
+                # Fenced incumbent (or stale epoch): it told us where
+                # the elected head lives — chase it instead of burning
+                # the reconnect budget on a dead/fenced socket.
+                if self._shutdown_flag:
+                    raise
+                if r.address:
+                    self._head_address = r.address
+                self._reconnect_head(head)
             except ConnectionLost:
                 if self._shutdown_flag:
                     raise
@@ -238,8 +266,16 @@ class ClusterBackend:
                 if self._shutdown_flag:
                     raise WorkerCrashedError("shutdown during head "
                                              "reconnect")
+                # Failover discovery: the record is rewritten by
+                # whichever process serves as head now (a hot standby
+                # publishes it the instant it takes over), so re-read it
+                # every attempt — it can appear mid-backoff.
+                rec = read_addr_record(tuning.HEAD_ADDR_FILE)
+                if rec:
+                    self._head_address = str(rec["address"])
                 try:
                     head = self._connect(self._head_address)
+                    self._learn_epoch(head)
                     self._subscribe_head(head)
                 except Exception:
                     if deadline.expired:

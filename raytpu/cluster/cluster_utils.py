@@ -54,7 +54,8 @@ class Cluster:
     def __init__(self, num_nodes: int = 0,
                  node_resources: Optional[Dict] = None,
                  host: str = "127.0.0.1",
-                 head_storage: Optional[str] = None):
+                 head_storage: Optional[str] = None,
+                 addr_file: Optional[str] = None):
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         # Child processes must import raytpu from the same tree as us even
@@ -64,9 +65,16 @@ class Cluster:
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(raytpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._head_addr_file = addr_file
+        if addr_file:
+            # Every child (nodes, workers) inherits the discovery record
+            # path, so redirect-on-failover works without per-process
+            # configuration.
+            env["RAYTPU_HEAD_ADDR_FILE"] = addr_file
         self._env = env
         self._host = host
         self._head_storage = head_storage
+        self.standby_proc: Optional[subprocess.Popen] = None
         self.head_proc = self._spawn_head(port=0)
         line = _await_banner(self.head_proc, "listening on", "head")
         self.address = line.strip().rsplit(" ", 1)[-1]
@@ -79,6 +87,8 @@ class Cluster:
                "--host", self._host, "--port", str(port)]
         if self._head_storage:
             cmd += ["--storage", self._head_storage]
+        if self._head_addr_file:
+            cmd += ["--addr-file", self._head_addr_file]
         return subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=self._env,
@@ -88,6 +98,93 @@ class Cluster:
         """Chaos hook: SIGKILL the head process (control-plane loss)."""
         self.head_proc.kill()
         self.head_proc.wait(timeout=10)
+
+    def pause_head(self) -> None:
+        """Chaos hook: SIGSTOP the head — alive but silent past any
+        lease TTL (the split-brain half of a failover test)."""
+        self.head_proc.send_signal(signal.SIGSTOP)
+
+    def resume_head(self) -> None:
+        """Resume a SIGSTOP'd head; it must discover it was superseded
+        and self-fence rather than keep acting as the head."""
+        self.head_proc.send_signal(signal.SIGCONT)
+
+    def add_standby(self, storage: Optional[str] = None) -> None:
+        """Spawn a hot-standby head following the current head. Requires
+        ``head_storage`` (the standby tails the head's WAL into its own
+        replica store) and ``addr_file`` (how clients find it after
+        takeover)."""
+        if not self._head_storage:
+            raise RuntimeError("standby requires head_storage")
+        self._standby_storage = storage or f"{self._head_storage}.standby"
+        self.standby_proc = self._spawn_standby()
+
+    def _spawn_standby(self) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "raytpu.cluster.standby",
+               "--head", self.address,
+               "--storage", self._standby_storage,
+               "--host", self._host, "--port", "0"]
+        if self._head_addr_file:
+            cmd += ["--addr-file", self._head_addr_file]
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=self._env,
+        )
+        _await_banner(proc, "standby following", "standby")
+        return proc
+
+    def kill_standby(self) -> None:
+        """Chaos hook: SIGKILL the follower mid-tail."""
+        self.standby_proc.kill()
+        self.standby_proc.wait(timeout=10)
+
+    def restart_standby(self) -> None:
+        """Respawn the follower on its existing replica store — it must
+        resume WAL tailing from its persisted cursor."""
+        if self.standby_proc is not None and self.standby_proc.poll() is None:
+            self.kill_standby()
+        self.standby_proc = self._spawn_standby()
+
+    def await_takeover(self, timeout: float = 30.0) -> str:
+        """Block until the standby takes over (it bound the serving
+        socket and rewrote the discovery record); updates
+        ``self.address``. Prefers polling the addr file — the standby's
+        stdout goes silent while the incumbent is merely paused, and a
+        blocking readline there would ignore ``timeout``."""
+        deadline = time.monotonic() + timeout
+        if self._head_addr_file:
+            while time.monotonic() < deadline:
+                if self.standby_proc.poll() is not None:
+                    raise RuntimeError("standby died before takeover")
+                try:
+                    with open(self._head_addr_file) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    rec = None
+                if rec and rec.get("address") and \
+                        rec["address"] != self.address:
+                    self.address = str(rec["address"])
+                    return self.address
+                time.sleep(0.05)
+            raise RuntimeError(
+                f"standby did not take over within {timeout:g}s "
+                f"(discovery record unchanged)")
+        seen: List[str] = []
+        while time.monotonic() < deadline:
+            if self.standby_proc.poll() is not None:
+                raise RuntimeError(
+                    "standby died before takeover:\n" + "".join(seen))
+            line = self.standby_proc.stdout.readline()
+            if not line:
+                time.sleep(0.05)
+                continue
+            seen.append(line)
+            if "listening on" in line:
+                self.address = line.strip().rsplit(" ", 1)[-1]
+                return self.address
+        raise RuntimeError(
+            f"standby did not take over within {timeout:g}s:\n"
+            + "".join(seen))
 
     def restart_head(self) -> None:
         """Restart the head at the SAME address; requires head_storage for
@@ -165,7 +262,18 @@ class Cluster:
                 n.proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 n.proc.kill()
+        if self.standby_proc is not None and self.standby_proc.poll() is None:
+            self.standby_proc.send_signal(signal.SIGTERM)
+            try:
+                self.standby_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.standby_proc.kill()
         if self.head_proc.poll() is None:
+            # A SIGSTOP'd head cannot handle SIGTERM; wake it first.
+            try:
+                self.head_proc.send_signal(signal.SIGCONT)
+            except Exception:
+                pass
             self.head_proc.send_signal(signal.SIGTERM)
             try:
                 self.head_proc.wait(timeout=5)

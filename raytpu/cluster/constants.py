@@ -29,6 +29,10 @@ def _i(name: str, default: int) -> int:
     return int(os.environ.get(f"RAYTPU_{name}", str(default)))
 
 
+def _s(name: str, default: str) -> str:
+    return os.environ.get(f"RAYTPU_{name}", default)
+
+
 # -- RPC substrate -----------------------------------------------------------
 
 # Default reply budget for RpcClient.call when the caller passes none.
@@ -175,6 +179,32 @@ ELASTIC_UPSCALE_CHECK_PERIOD_S = _f("ELASTIC_UPSCALE_CHECK_PERIOD_S", 2.0)
 # instead of polled forever. FIFO-bounded; eviction only narrows the
 # hang protection for very old refs.
 DONE_RETURN_MEMORY = _i("DONE_RETURN_MEMORY", 4096)
+
+# -- hot-standby head (WAL shipping, lease election, fencing) ----------------
+
+# Lease TTL: the active head must renew its epoch-stamped lease within
+# this window or the standby elects itself. The same value bounds how
+# long a SIGSTOP'd incumbent may be paused before it must assume it has
+# been superseded (it re-reads the discovery record and self-fences).
+HEAD_LEASE_TTL_S = _f("HEAD_LEASE_TTL_S", 3.0)
+# How often the active head rewrites the lease row (must be well under
+# the TTL so one missed renewal doesn't trigger an election).
+HEAD_LEASE_RENEW_PERIOD_S = _f("HEAD_LEASE_RENEW_PERIOD_S", 1.0)
+# Follower poll cadence for the wal_ship RPC. Each successful poll both
+# replicates new WAL entries and proves the incumbent holds its lease.
+WAL_SHIP_PERIOD_S = _f("WAL_SHIP_PERIOD_S", 0.1)
+# Bounded per-table in-memory WAL journal on the head. A follower whose
+# cursor fell behind the journal horizon gets a full-table resync
+# instead of deltas — correct either way, this only sizes the window.
+WAL_JOURNAL_MAX = _i("WAL_JOURNAL_MAX", 4096)
+# Discovery record: a JSON file {"address", "epoch"} rewritten by
+# whichever process currently serves as head. Clients and nodes re-read
+# it on reconnect so failover needs no address reconfiguration. Empty
+# string disables file-based discovery (redirect RPCs still work).
+HEAD_ADDR_FILE = _s("HEAD_ADDR_FILE", "")
+# Follower backoff after a failed wal_ship poll before redialing the
+# incumbent (keeps a dead-head poll loop from spinning).
+STANDBY_RECONNECT_DELAY_S = _f("STANDBY_RECONNECT_DELAY_S", 0.2)
 
 # -- node → head reconnect ---------------------------------------------------
 

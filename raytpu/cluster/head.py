@@ -29,12 +29,18 @@ from __future__ import annotations
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from raytpu.cluster import constants as tuning
 from raytpu.cluster import wire
-from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
+from raytpu.cluster.protocol import (
+    HeadRedirect,
+    Peer,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
 from raytpu.util import failpoints
 from raytpu.util import metrics
 from raytpu.util import task_events
@@ -80,19 +86,61 @@ class GcsStore:
             "tbl TEXT, key TEXT, value BLOB, PRIMARY KEY (tbl, key))")
         self._conn.commit()
         self._lock = threading.Lock()
+        # WAL shipping: per-table monotonic seq + a bounded in-memory
+        # journal of recent mutations. A follower polls ship() with its
+        # per-table cursors; entries past the journal horizon degrade to
+        # a full-table resync. Entry shape: (seq, op, key, value) with
+        # op in {"put", "del", "snap"} ("snap" carries the whole mapping
+        # in ``value`` — only the tiny single-key write-behind tables
+        # use it).
+        self._seqs: Dict[str, int] = {}
+        self._journal: Dict[str, deque] = {}
+        # Tables already on disk start at seq 1 (a "disk baseline" the
+        # empty journal can never cover) so a follower at cursor 0 gets
+        # a full resync instead of being told it is caught up.
+        for (t,) in self._conn.execute(
+                "SELECT DISTINCT tbl FROM tables").fetchall():
+            self._seqs[t] = 1
+        # A fenced (superseded) head freezes its store: every mutation
+        # becomes a no-op so a resumed stale incumbent cannot diverge
+        # its table file from the elected head's.
+        self._frozen = False
+
+    def _journal_append(self, table: str, op: str, key: str,
+                        value: Any) -> None:
+        # Caller holds self._lock.
+        seq = self._seqs.get(table, 0) + 1
+        self._seqs[table] = seq
+        j = self._journal.get(table)
+        if j is None:
+            j = self._journal[table] = deque(maxlen=tuning.WAL_JOURNAL_MAX)
+        j.append((seq, op, key, value))
+
+    def freeze(self) -> None:
+        """Fence this store: all subsequent mutations are silently
+        dropped. Used when the head loses its lease — reads stay live
+        (diagnostics), writes must not race the elected successor."""
+        with self._lock:
+            self._frozen = True
 
     def put(self, table: str, key: str, value: bytes) -> None:
         with self._lock:
+            if self._frozen:
+                return
             self._conn.execute(
                 "INSERT OR REPLACE INTO tables (tbl, key, value) "
                 "VALUES (?, ?, ?)", (table, key, value))
             self._conn.commit()
+            self._journal_append(table, "put", key, value)
 
     def delete(self, table: str, key: str) -> None:
         with self._lock:
+            if self._frozen:
+                return
             self._conn.execute(
                 "DELETE FROM tables WHERE tbl = ? AND key = ?", (table, key))
             self._conn.commit()
+            self._journal_append(table, "del", key, None)
 
     def load_all(self, table: str) -> Dict[str, bytes]:
         with self._lock:
@@ -108,6 +156,8 @@ class GcsStore:
         is their durability contract, and the single transaction means a
         crash mid-snapshot leaves the previous snapshot intact."""
         with self._lock:
+            if self._frozen:
+                return
             self._conn.execute("BEGIN")
             self._conn.execute(
                 "DELETE FROM tables WHERE tbl = ?", (table,))
@@ -116,6 +166,37 @@ class GcsStore:
                 "VALUES (?, ?, ?)",
                 [(table, k, v) for k, v in mapping.items()])
             self._conn.commit()
+            self._journal_append(table, "snap", "", dict(mapping))
+
+    def ship(self, cursors: Dict[str, int],
+             tables: Tuple[str, ...]) -> Dict[str, Any]:
+        """One WAL-ship round: for each table, either the journal
+        entries past the follower's cursor (``{"seq", "entries"}``) or —
+        when the cursor fell behind the bounded journal's horizon (or
+        the follower is brand new) — a full-table resync
+        (``{"seq", "full"}``)."""
+        out: Dict[str, Any] = {}
+        full_needed: List[Tuple[str, int]] = []
+        with self._lock:
+            for table in tables:
+                cur = int(cursors.get(table, 0) or 0)
+                seq = self._seqs.get(table, 0)
+                if cur >= seq:
+                    continue  # follower is caught up on this table
+                j = self._journal.get(table)
+                if j and j[0][0] <= cur + 1:
+                    out[table] = {
+                        "seq": seq,
+                        "entries": [e for e in j if e[0] > cur],
+                    }
+                else:
+                    full_needed.append((table, seq))
+        for table, seq in full_needed:
+            # load_all takes the lock itself; a mutation landing between
+            # the seq read and the load only makes the snapshot fresher
+            # than the seq claims — the follower re-polls and converges.
+            out[table] = {"seq": seq, "full": self.load_all(table)}
+        return out
 
     def compact(self) -> None:
         """Fold the WAL back into the main database file (reload-on-start
@@ -128,6 +209,41 @@ class GcsStore:
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+
+# Every GcsStore table MUST be listed here: this tuple is what the
+# wal_ship stream replicates to the hot standby, and lint RTP017
+# cross-checks it against the persistence call sites so a new table
+# cannot be silently left out of replication. "meta" holds the
+# epoch-stamped head lease and the replicated TSDB sequencing state.
+WAL_SHIP_TABLES = ("kv", "actors", "pgs", "named", "pending_tasks",
+                   "objects", "borrows", "task_events", "meta")
+
+# RPC methods a fenced (superseded) head still answers: negotiation,
+# liveness probes, chaos-test plumbing, and read-only diagnostics.
+# Everything else gets a HeadRedirect to the elected successor.
+_FENCE_EXEMPT = frozenset({
+    "rpc_caps", "ping", "head_info", "failpoint_cfg", "failpoint_clear",
+    "failpoint_stat", "list_events", "trace_dump",
+})
+
+
+def read_addr_record(path: str) -> Optional[dict]:
+    """Parse the head discovery record ``{"address", "epoch"}``; None
+    when the file is absent/unreadable/corrupt (callers fall back to
+    their last known address)."""
+    if not path:
+        return None
+    import json as _json
+
+    try:
+        with open(path, "r") as f:
+            rec = _json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or not rec.get("address"):
+        return None
+    return rec
 
 
 class NodeEntry:
@@ -254,11 +370,33 @@ class _HeadMetrics:
 
 class HeadServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 storage_path: Optional[str] = None):
+                 storage_path: Optional[str] = None,
+                 addr_file: Optional[str] = None,
+                 takeover: bool = False):
         self._rpc = RpcServer(host, port)
         self._lock = threading.RLock()
         self._store: Optional[GcsStore] = (
             GcsStore(storage_path) if storage_path else None)
+        # Hot-standby machinery: discovery record path, fencing state,
+        # and the epoch this incarnation serves under (derived from the
+        # stored lease below — every (re)start bumps it, so a standby
+        # takeover and a restart-in-place both supersede the old epoch).
+        self._addr_file = (addr_file if addr_file is not None
+                           else tuning.HEAD_ADDR_FILE)
+        self._takeover = takeover
+        self._fenced = False
+        self._redirect_to = ""
+        self._redirect_epoch = 0
+        self._epoch = 1
+        self._last_renew = time.monotonic()
+        # Head-dispatched placements, for failover dedup: (task_id hex,
+        # attempt) recorded when the pending scheduler's submit_task RPC
+        # to a node succeeds, shipped to the standby as an indexed log so
+        # a new head neither re-dispatches a queued spec the incumbent
+        # already launched nor re-queues a driver resubmission of one.
+        self._placed: "OrderedDict[Tuple[str, int], bool]" = OrderedDict()
+        self._placed_log: deque = deque(maxlen=tuning.WAL_JOURNAL_MAX)
+        self._placed_idx = 0
         self._nodes: Dict[str, NodeEntry] = {}
         self._kv: Dict[str, bytes] = {}
         # actor_id(hex) -> {"node_id", "name", "namespace", "creation_blob"}
@@ -423,6 +561,10 @@ class HeadServer:
         h("request_resources", self._request_resources)
         h("next_job_id", self._next_job_id)
         h("ping", lambda peer: "pong")
+        # Hot-standby surface: WAL shipping poll (also the incumbent's
+        # liveness proof to the follower) + epoch/fencing introspection.
+        h("wal_ship", self._h_wal_ship)
+        h("head_info", self._h_head_info)
         # Chaos testing: arm/inspect failpoints on this head or, with
         # scope="cluster", on every live node daemon too (reference
         # analogue: Ray's testing-only fault-injection RPCs).
@@ -440,6 +582,26 @@ class HeadServer:
         self._node_clients: Dict[str, Any] = {}
         if self._store is not None:
             self._reload()
+            # Epoch succession: whatever lease is on disk (written by the
+            # previous incarnation, or shipped over from the incumbent
+            # when this store belonged to a standby) is superseded.
+            self._epoch = int(self._load_lease().get("epoch", 0)) + 1
+            # TSDB continuity across failover/restart: per-origin seq
+            # cursors and proc-death tombstones reload so re-shipped
+            # metric frames dedup instead of double-counting and dead
+            # origins stay dead (satellite: TSDB on failover).
+            blob = self._store.load_all("meta").get("tsdb_state")
+            if blob:
+                import json as _json
+
+                try:
+                    self._metric_store.restore_seq_state(_json.loads(blob))
+                except Exception as e:
+                    errors.swallow("head.tsdb_restore", e)
+        # Epoch rides every rpc_caps reply so head clients learn it at
+        # connect time and stamp subsequent frames with it.
+        self._rpc.capabilities["head_epoch"] = self._epoch
+        self._rpc.frame_gate = self._frame_gate
 
     # -- persistence -------------------------------------------------------
 
@@ -575,9 +737,169 @@ class HeadServer:
                 {"borrows": borrows, "pending_free": pending_free}).encode()})
             self._store.snapshot_table("task_events", {
                 "tail": _json.dumps(tail).encode()})
+            # TSDB sequencing state (per-origin seqs + death tombstones)
+            # rides the meta table — a plain put, NOT snapshot_table,
+            # because meta also holds the head lease row.
+            self._store.put("meta", "tsdb_state", _json.dumps(
+                self._metric_store.seq_state()).encode())
             self._last_snapshot = time.monotonic()
         except Exception as e:
             errors.swallow("head.snapshot", e)
+
+    # -- hot standby: lease, fencing, WAL shipping -------------------------
+
+    def _load_lease(self) -> dict:
+        if self._store is None:
+            return {}
+        blob = self._store.load_all("meta").get("head_lease")
+        if not blob:
+            return {}
+        import json as _json
+
+        try:
+            lease = _json.loads(blob)
+        except ValueError:
+            return {}
+        return lease if isinstance(lease, dict) else {}
+
+    def _renew_lease(self) -> None:
+        """Rewrite the epoch-stamped lease row. If this process stalled
+        past its own TTL (SIGSTOP, long GC pause) it may already have
+        been superseded: check the discovery record FIRST and self-fence
+        on a higher epoch instead of writing."""
+        if self._fenced:
+            return
+        if failpoint("head.lease_renew") is DROP:
+            return  # renewal suppressed: the follower sees a stale lease
+        now = time.monotonic()
+        if now - self._last_renew > tuning.HEAD_LEASE_TTL_S:
+            rec = read_addr_record(self._addr_file)
+            if rec and int(rec.get("epoch", 0) or 0) > self._epoch:
+                self._fence(str(rec.get("address", "")),
+                            int(rec["epoch"]))
+                return
+        self._last_renew = now
+        if self._store is not None:
+            import json as _json
+
+            self._store.put("meta", "head_lease", _json.dumps({
+                "epoch": self._epoch,
+                "owner": self.address or "",
+                "ttl": tuning.HEAD_LEASE_TTL_S,
+            }).encode())
+
+    def _lease_loop(self) -> None:
+        while not self._stop.wait(tuning.HEAD_LEASE_RENEW_PERIOD_S):
+            try:
+                self._renew_lease()
+            except Exception as e:
+                errors.swallow("head.lease_renew", e)
+
+    def _fence(self, new_addr: str, new_epoch: int) -> None:
+        """This head has been superseded (epoch ``new_epoch`` observed):
+        freeze the store so a resumed stale incumbent cannot diverge its
+        table file, and redirect all subsequent traffic."""
+        with self._lock:
+            if self._fenced:
+                return
+            self._fenced = True
+            self._redirect_to = new_addr
+            self._redirect_epoch = int(new_epoch)
+        if self._store is not None:
+            self._store.freeze()
+        from raytpu.util.events import record_event as _rec
+
+        self._events.append(_rec(
+            "WARNING", "HEAD_FENCED",
+            f"superseded by head {new_addr!r} (epoch {new_epoch}); "
+            "store frozen, redirecting callers",
+            epoch=int(new_epoch)))
+
+    def _frame_gate(self, peer: Peer, frame: dict):
+        """Split-brain fencing, enforced on every inbound frame: a
+        fenced head redirects (node/driver traffic must not land on a
+        stale incumbent), and an epoch mismatch either redirects the
+        stale peer or — when the PEER has seen a newer head than us —
+        fences this head on the spot."""
+        if self._fenced:
+            if frame.get("m") in _FENCE_EXEMPT:
+                return None
+            return HeadRedirect(self._redirect_to, self._redirect_epoch)
+        ep = frame.get("ep")
+        if ep is None:
+            return None
+        try:
+            ep = int(ep)
+        except (TypeError, ValueError):
+            return None
+        if ep > self._epoch:
+            rec = read_addr_record(self._addr_file)
+            addr = str(rec.get("address", "")) if rec else ""
+            self._fence(addr, ep)
+            return HeadRedirect(self._redirect_to, self._redirect_epoch)
+        if ep < self._epoch:
+            return HeadRedirect(self.address or "", self._epoch)
+        return None
+
+    def _write_addr_file(self) -> None:
+        if not self._addr_file:
+            return
+        import json as _json
+
+        try:
+            tmp = f"{self._addr_file}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                f.write(_json.dumps({"address": self.address,
+                                     "epoch": self._epoch}))
+            os.replace(tmp, self._addr_file)
+        except OSError as e:
+            errors.swallow("head.addr_file", e)
+
+    def _h_wal_ship(self, peer: Peer, cursors: Dict[str, int],
+                    tasks_cursor: int = 0) -> dict:
+        """One follower poll: per-table WAL deltas (or full resyncs)
+        past the follower's cursors, the placed-task log past its task
+        cursor, fresh TSDB sequencing state, and this head's epoch. A
+        successful reply doubles as the incumbent's liveness proof, so
+        the failpoint below denies it by erroring, not by lying."""
+        if failpoint("wire.wal_ship") is DROP:
+            raise RpcError("wal_ship dropped by failpoint")
+        if self._fenced:
+            raise HeadRedirect(self._redirect_to, self._redirect_epoch)
+        out: Dict[str, Any] = {
+            "epoch": self._epoch,
+            "addr": self.address or "",
+            "ttl": tuning.HEAD_LEASE_TTL_S,
+            "tables": {},
+        }
+        if self._store is not None:
+            out["tables"] = self._store.ship(dict(cursors or {}),
+                                             WAL_SHIP_TABLES)
+        try:
+            out["tsdb"] = self._metric_store.seq_state()
+        except Exception as e:
+            errors.swallow("head.wal_ship_tsdb", e)
+        with self._lock:
+            tc = int(tasks_cursor or 0)
+            out["placed"] = [list(e) for e in self._placed_log
+                             if e[0] > tc]
+            out["placed_idx"] = self._placed_idx
+        return out
+
+    def _h_head_info(self, peer: Peer) -> dict:
+        return {"epoch": self._epoch, "address": self.address or "",
+                "fenced": self._fenced}
+
+    def _record_placed(self, tid: str, attempt: int) -> None:
+        """Record a head-dispatched placement (caller holds _lock)."""
+        key = (tid, int(attempt))
+        if key in self._placed:
+            return
+        self._placed[key] = True
+        while len(self._placed) > tuning.WAL_JOURNAL_MAX:
+            self._placed.popitem(last=False)
+        self._placed_idx += 1
+        self._placed_log.append((self._placed_idx, tid, int(attempt)))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -607,6 +929,22 @@ class HeadServer:
             target=self._pending_sched_loop, name="head-pending-sched",
             daemon=True)
         self._pending_sched.start()
+        # Claim the lease under the new epoch and publish the discovery
+        # record before any caller can observe this head, then keep
+        # renewing on a dedicated thread (the health loop's cadence is a
+        # failure-detection knob; lease renewal must not inherit it).
+        self._renew_lease()
+        self._write_addr_file()
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, name="head-lease", daemon=True)
+        self._lease_thread.start()
+        if self._takeover:
+            from raytpu.util.events import record_event as _rec
+
+            self._events.append(_rec(
+                "WARNING", "HEAD_FAILOVER",
+                f"standby took over as epoch {self._epoch} at {addr}",
+                epoch=self._epoch))
         if self._store is not None:
             # Recover reloaded actors: re-enqueue interrupted restarts now;
             # after a node-re-registration grace period, declare actors at
@@ -691,7 +1029,12 @@ class HeadServer:
                              name=labels.get("role") or "node",
                              node_id=node_id)
         self._publish("nodes", {"event": "added", "node": entry.snapshot()})
-        return {"nodes": snap}
+        # Epoch: the node stamps subsequent frames with it (fencing).
+        # warm: this head was a WAL-shipping standby, so it already holds
+        # the object directory — the node skips the full object replay on
+        # re-register and only flushes its recent/unsent deltas.
+        return {"nodes": snap, "epoch": self._epoch,
+                "warm": self._takeover}
 
     def _heartbeat(self, peer: Peer, node_id: str,
                    available: Dict[str, float], seq: int = 0,
@@ -844,6 +1187,10 @@ class HeadServer:
 
     def _health_loop(self) -> None:
         while not self._stop.wait(CHECK_PERIOD_S):
+            if self._fenced:
+                # A superseded head must not keep declaring nodes dead
+                # or firing alerts — the elected head owns the cluster.
+                continue
             self._ingest_local_events()
             self._ingest_local_metrics()
             now = time.monotonic()
@@ -1409,6 +1756,20 @@ class HeadServer:
                 for spec in specs:
                     self._metrics.tick_schedule()
                     tid = spec.task_id.hex()
+                    # Failover dedup: a driver resubmitting across a
+                    # head failover must not double-launch a task this
+                    # head (via WAL-shipped state) already owns queued
+                    # or already dispatched to a node. A HIGHER attempt
+                    # (node-death resubmit) supersedes the queued copy.
+                    attempt = int(getattr(spec, "attempt", 0) or 0)
+                    if (tid, attempt) in self._placed:
+                        placements.append({"queued": True})
+                        continue
+                    if tid in self._pending_specs:
+                        self._pending_specs[tid] = wire.dumps(spec)
+                        persist.append(tid)
+                        placements.append({"queued": True})
+                        continue
                     try:
                         arg_oids = [o.hex() for o in spec.arg_ref_oids()]
                         node_id = self._schedule_locked(
@@ -1458,6 +1819,8 @@ class HeadServer:
         in get(); the result flows back through the object directory as
         usual. Failed dispatches stay queued for the next scan."""
         while not self._stop.wait(tuning.HEAD_PENDING_SCHED_PERIOD_S):
+            if self._fenced:
+                continue  # the elected head owns dispatch now
             with self._lock:
                 batch = list(self._pending_specs.items())
             for tid, blob in batch:  # rpc-loop-ok: queued-spec replay, cold path gated on spare capacity
@@ -1465,6 +1828,19 @@ class HeadServer:
                     return
                 try:
                     spec = wire.loads(blob)
+                    # Failover dedup: the incumbent already dispatched
+                    # this exact attempt (the placed log shipped with
+                    # the WAL) — launching it again would double-run it.
+                    with self._lock:
+                        att = int(getattr(spec, "attempt", 0) or 0)
+                        if (tid, att) in self._placed:
+                            self._pending_specs.pop(tid, None)
+                            dropped_placed = True
+                        else:
+                            dropped_placed = False
+                    if dropped_placed:
+                        self._persist_pending_task(tid)
+                        continue
                     arg_oids = [o.hex() for o in spec.arg_ref_oids()]
                     node_id = self._schedule_impl(
                         None, dict(spec.resources or {}), None, 0.5,
@@ -1491,6 +1867,13 @@ class HeadServer:
                     errors.swallow("head.pending_dispatch", e)
                     continue
                 with self._lock:
+                    # Record the dispatch BEFORE dropping the queued
+                    # copy: if we crash in between, the successor skips
+                    # the spec via the shipped placed log instead of
+                    # replaying it (dedup by task id + attempt).
+                    self._record_placed(tid,
+                                        int(getattr(spec, "attempt", 0)
+                                            or 0))
                     self._pending_specs.pop(tid, None)
                 self._persist_pending_task(tid)
                 if task_events.enabled():
@@ -2010,8 +2393,14 @@ def main() -> None:  # pragma: no cover - exercised via subprocess in tests
     ap.add_argument("--storage", default="",
                     help="durable table storage path (sqlite); empty = "
                          "in-memory only")
+    ap.add_argument("--addr-file", default="",
+                    help="head discovery record path; rewritten with "
+                         "{address, epoch} at startup so clients/nodes "
+                         "find the current head across failovers")
     args = ap.parse_args()
-    head = HeadServer(args.host, args.port, storage_path=args.storage or None)
+    head = HeadServer(args.host, args.port,
+                      storage_path=args.storage or None,
+                      addr_file=args.addr_file or None)
     addr = head.start()
     print(f"raytpu head listening on {addr}", flush=True)
     signal.sigwait({signal.SIGINT, signal.SIGTERM})

@@ -27,7 +27,13 @@ import cloudpickle
 from raytpu.cluster import wire
 
 from raytpu.cluster import constants as tuning
-from raytpu.cluster.protocol import ConnectionLost, Peer, RpcClient, RpcServer
+from raytpu.cluster.protocol import (
+    ConnectionLost,
+    HeadRedirect,
+    Peer,
+    RpcClient,
+    RpcServer,
+)
 from raytpu.core.config import cfg
 from raytpu.util import failpoints
 from raytpu.util import metrics
@@ -702,6 +708,13 @@ class NodeServer:
             maxlen=max(1, tuning.OBJ_REPORT_BUFFER_MAX))
         self._obj_delta_lock = threading.Lock()
         self._obj_flush_lock = threading.Lock()
+        # Recently-announced object locations (monotonic time, oid_hex):
+        # when a WARM standby takes over (it already holds the shipped
+        # object-directory snapshot), re-registration replays only the
+        # announcements younger than the snapshot staleness window
+        # instead of the full store — the zero-restart failover path.
+        self._recent_obj_reports = _deque(
+            maxlen=max(1, tuning.OBJ_REPORT_BUFFER_MAX))
         self._fetching: set = set()
         self._fetch_lock = threading.Lock()
         # oid_hex -> [(loop, future), ...]: workers blocked in
@@ -796,10 +809,14 @@ class NodeServer:
                     daemon=True)
                 self._log_monitor.start()
         self._head = RpcClient(self.head_address)
-        self._head.call(
+        reg = self._head.call(
             "register_node", self.node_id.hex(), self.address,
             self.backend.node.total.to_dict(), self.labels,
         )
+        # Stamp subsequent frames with the head's epoch (split-brain
+        # fencing): a superseded incumbent rejects them with a redirect.
+        if isinstance(reg, dict) and reg.get("epoch") is not None:
+            self._head.epoch = int(reg["epoch"])
         # Producer side of push-based transfer: the head tells us which
         # nodes demanded an object we just reported local.
         self._head.subscribe("push_requests", self._on_push_request)
@@ -973,9 +990,14 @@ class NodeServer:
                     metrics.requeue(mframes, mdropped)
                     raise
                 backoff = 0.0
-            except Exception:
+            except Exception as e:
                 if self._stop.is_set():
                     return
+                # A fenced incumbent answers with a redirect naming the
+                # elected head: chase it directly instead of re-dialing
+                # the stale address.
+                if isinstance(e, HeadRedirect) and e.address:
+                    self.head_address = e.address
                 if self._reconnect_head():
                     backoff = 0.0
                 else:
@@ -1016,10 +1038,18 @@ class NodeServer:
         Returns True on success so the heartbeat loop can reset its
         reconnect backoff."""
         failpoint("node.reconnect.pre")
+        # Failover discovery: whichever process serves as head now (a
+        # hot standby publishes the record the instant it takes over)
+        # wins over the address this node was started with.
+        from raytpu.cluster.head import read_addr_record
+
+        rec = read_addr_record(tuning.HEAD_ADDR_FILE)
+        if rec:
+            self.head_address = str(rec["address"])
         head = None
         try:
             head = RpcClient(self.head_address)
-            head.call(
+            reg = head.call(
                 "register_node", self.node_id.hex(), self.address,
                 self.backend.node.total.to_dict(), self.labels,
                 timeout=tuning.CONTROL_CALL_TIMEOUT_S,
@@ -1031,6 +1061,14 @@ class NodeServer:
                 except Exception:
                     pass
             return False  # head still down; heartbeat loop backs off
+        # Epoch stamping: subsequent frames carry the head's epoch so a
+        # stale (fenced) incumbent this node might still reach rejects
+        # them instead of accepting writes (split-brain fencing).
+        warm = False
+        if isinstance(reg, dict):
+            if reg.get("epoch") is not None:
+                head.epoch = int(reg["epoch"])
+            warm = bool(reg.get("warm"))
         head.subscribe("push_requests", self._on_push_request)
         old = self._head
         self._head = head
@@ -1071,9 +1109,23 @@ class NodeServer:
             except Exception as e:
                 errors.swallow("node.reregister_borrows", e)
         # Re-announce object locations as batched deltas, sizes included
-        # so the reloaded directory can score locality immediately.
-        replay = [["+", oid.hex(), self._object_wire_size(oid)]
-                  for oid in self.backend.store.keys()]
+        # so the reloaded directory can score locality immediately. A
+        # WARM head (a standby that tailed the incumbent's WAL) already
+        # holds the shipped directory snapshot — replay only the
+        # announcements younger than the snapshot staleness window, not
+        # the whole store: that skipped replay IS the zero-restart win.
+        if warm:
+            horizon = time.monotonic() - 2 * tuning.HEAD_SNAPSHOT_PERIOD_S
+            held = {oid.hex() for oid in self.backend.store.keys()}
+            replay = []
+            seen: set = set()
+            for t, oh in self._recent_obj_reports:
+                if t >= horizon and oh in held and oh not in seen:
+                    seen.add(oh)
+                    replay.append(["+", oh, 0])
+        else:
+            replay = [["+", oid.hex(), self._object_wire_size(oid)]
+                      for oid in self.backend.store.keys()]
         for i in range(0, len(replay), 512):  # rpc-loop-ok: re-announce replay after head restart, 512 deltas per frame
             try:
                 head.notify("report_objects", self.node_id.hex(),
@@ -1151,6 +1203,7 @@ class NodeServer:
         self._wake_obj_waiters(oid.hex())
         if self._head is None:
             return
+        self._recent_obj_reports.append((time.monotonic(), oid.hex()))
         self._queue_obj_delta(["+", oid.hex(), self._object_wire_size(oid)])
 
     def _object_wire_size(self, oid: ObjectID) -> int:

@@ -57,6 +57,22 @@ class ConnectionLost(RpcError):
     pass
 
 
+class HeadRedirect(RpcError):
+    """Raised by a fenced or superseded head: the caller should redial
+    ``address`` (the head this process believes is current) and stamp
+    subsequent frames with ``epoch``. Positional args only — the wire's
+    structural exception encoding rebuilds via ``cls(*args)``."""
+
+    def __init__(self, address: str = "", epoch: int = 0):
+        super().__init__(address, epoch)
+        self.address = address
+        self.epoch = int(epoch or 0)
+
+    def __str__(self) -> str:
+        return (f"head redirect: current head is {self.address!r} "
+                f"(epoch {self.epoch})")
+
+
 def _pack(obj: Any, allow_pickle: bool = True) -> bytes:
     payload = wire.dumps(obj, allow_pickle=allow_pickle)
     return _LEN.pack(len(payload)) + payload
@@ -198,6 +214,13 @@ class RpcServer:
         self._server = None
         self._started = threading.Event()
         self._on_disconnect: Optional[Callable[[Peer], None]] = None
+        # Optional fencing hook: called (peer, frame) before every
+        # handler; a non-None return (an exception instance) is sent as
+        # the reply error without running the handler. The hot-standby
+        # head uses this to reject frames carrying a stale epoch and to
+        # redirect node/driver traffic away from a fenced incumbent.
+        self.frame_gate: Optional[
+            Callable[[Peer, dict], Optional[BaseException]]] = None
         self.address: Optional[str] = None
 
     def register(self, name: str, handler: Callable) -> None:
@@ -316,6 +339,10 @@ class RpcServer:
         ttoken = tracing.set_current_trace(tctx) \
             if tctx is not None else None
         try:
+            if self.frame_gate is not None:
+                gate_exc = self.frame_gate(peer, frame)
+                if gate_exc is not None:
+                    raise gate_exc
             if handler is None:
                 raise RpcError(f"no handler for {frame.get('m')!r}")
             if deadline is not None:
@@ -426,6 +453,11 @@ class RpcClient:
                                else bool(batch))
         self._batch = False
         self.caps: Dict[str, Any] = {}
+        # Head epoch this client stamps on outbound frames ("ep").
+        # None until learned (rpc_caps reply, register_node reply, or a
+        # HeadRedirect) — an unstamped frame is accepted by any head, so
+        # pre-failover peers keep working unchanged.
+        self.epoch: Optional[int] = None
         self._send_queue = None
         self._batch_writer: Optional[threading.Thread] = None
         # Pushes dispatch on their own thread: a subscription callback may
@@ -589,6 +621,8 @@ class RpcClient:
                 raise ConnectionLost(f"connection to {self.address} closed")
             self._pending[req_id] = waiter
         frame = {"m": method, "a": args, "i": req_id}
+        if self.epoch is not None:
+            frame["ep"] = self.epoch
         if deadline is not None:
             frame["d"] = deadline.to_wire()
         tc = trace if trace is not None else tracing.current_trace()
@@ -643,7 +677,10 @@ class RpcClient:
 
     def notify(self, method: str, *args) -> None:
         """Fire-and-forget (no response expected)."""
-        self._send({"m": method, "a": args})
+        frame = {"m": method, "a": args}
+        if self.epoch is not None:
+            frame["ep"] = self.epoch
+        self._send(frame)
 
     def _send(self, frame: dict) -> None:
         # drop => the message is silently lost (the call, if any, times

@@ -1,0 +1,304 @@
+"""Hot-standby head: a WAL-shipping follower with lease-based election.
+
+Reference analogue: Ray's GCS fault tolerance story (GCS + external
+Redis: a restarted/failed-over GCS rehydrates from the replicated store
+while raylets reconnect), crossed with the lease/epoch fencing of
+classic primary-backup systems (chubby/raft leader leases): the active
+head renews an epoch-stamped lease; the follower tails the head's
+``GcsStore`` WAL over the ``wal_ship`` RPC into its OWN sqlite store;
+when the incumbent stops proving liveness for a full lease TTL the
+follower bumps the epoch, binds the serving socket, and becomes the
+head with every table already warm — no restart window, no state
+replay from nodes.
+
+Split-brain safety is epoch fencing, not mutual exclusion: the elected
+head's epoch (incumbent epoch + 1, from the shipped lease row) rides
+every subsequent RPC; the stale incumbent — resumed from a SIGSTOP,
+say — sees the higher epoch (discovery record or a stamped frame),
+freezes its store, and answers everything with ``HeadRedirect``.
+
+Liveness detection is the ship stream itself: a successful ``wal_ship``
+reply IS the incumbent's lease renewal proof to this follower (the
+reply carries the TTL), so there is no wall-clock comparison across
+processes — only "how long since the incumbent last answered me".
+
+The follower's cursors (per-table WAL seqs + placed-task log index)
+persist in a follower-local table, so a killed-and-restarted follower
+resumes tailing from its last applied offset instead of re-syncing the
+world.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from raytpu.cluster import constants as tuning
+from raytpu.cluster.head import (
+    GcsStore,
+    HeadServer,
+    WAL_SHIP_TABLES,
+    read_addr_record,
+)
+from raytpu.cluster.protocol import RpcClient
+from raytpu.util import errors
+from raytpu.util.failpoints import DROP, failpoint
+
+# Follower-local state lives in its own table, NOT in a replicated one:
+# a full-table resync of a shipped table must never clobber the cursors
+# that say how far this follower has applied.
+_LOCAL_TABLE = "standby"
+
+
+class StandbyHead:
+    """Follow ``head_address``, replicate its WAL into ``storage_path``,
+    take over as the serving head (binding ``host:port``) when the
+    incumbent's lease lapses."""
+
+    def __init__(self, head_address: str, storage_path: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 addr_file: Optional[str] = None):
+        self.head_address = head_address
+        self.storage_path = storage_path
+        self.host = host
+        self.port = port
+        self.addr_file = (addr_file if addr_file is not None
+                          else tuning.HEAD_ADDR_FILE)
+        self._store = GcsStore(storage_path)
+        self._client: Optional[RpcClient] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Promotion result: the HeadServer this process becomes.
+        self.head: Optional[HeadServer] = None
+        self.took_over = threading.Event()
+        # Shipping state (reloaded so a restarted follower resumes from
+        # its cursor instead of a full resync).
+        self._cursors: Dict[str, int] = {}
+        self._last_epoch = 0
+        self._ttl = tuning.HEAD_LEASE_TTL_S
+        self._tasks_cursor = 0
+        self._placed: List[Tuple[int, str, int]] = []
+        self._tsdb_state: Dict[str, Any] = {}
+        self._synced_once = False
+        self._last_ok = time.monotonic()
+        self._reload_local()
+
+    # -- follower-local persistence ----------------------------------------
+
+    def _reload_local(self) -> None:
+        rows = self._store.load_all(_LOCAL_TABLE)
+        try:
+            state = json.loads(rows.get("state", b"{}"))
+        except ValueError:
+            state = {}
+        self._cursors = {str(k): int(v) for k, v in
+                         (state.get("cursors") or {}).items()}
+        self._last_epoch = int(state.get("epoch", 0) or 0)
+        self._ttl = float(state.get("ttl", tuning.HEAD_LEASE_TTL_S))
+        self._tasks_cursor = int(state.get("tasks_cursor", 0) or 0)
+        self._placed = [(int(i), str(t), int(a))
+                        for i, t, a in (state.get("placed") or ())]
+        self._tsdb_state = state.get("tsdb") or {}
+        self._synced_once = bool(self._cursors)
+
+    def _persist_local(self) -> None:
+        self._store.put(_LOCAL_TABLE, "state", json.dumps({
+            "cursors": self._cursors,
+            "epoch": self._last_epoch,
+            "ttl": self._ttl,
+            "tasks_cursor": self._tasks_cursor,
+            "placed": self._placed[-tuning.WAL_JOURNAL_MAX:],
+            "tsdb": self._tsdb_state,
+        }).encode())
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._follow_loop, name="standby-follow", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+        if self.head is not None:
+            self.head.stop()
+        elif self._store is not None:
+            try:
+                self._store.close()
+            except Exception:
+                pass
+
+    # -- WAL tailing ---------------------------------------------------------
+
+    def _connect(self) -> RpcClient:
+        if self._client is None or self._client.closed:
+            # The incumbent may have moved (we might even be following a
+            # previously-elected standby): the discovery record wins
+            # over the constructor address when it names a higher epoch.
+            rec = read_addr_record(self.addr_file)
+            if rec and int(rec.get("epoch", 0) or 0) >= self._last_epoch \
+                    and rec.get("address"):
+                self.head_address = str(rec["address"])
+            self._client = RpcClient(self.head_address)
+        return self._client
+
+    def _follow_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client = self._connect()
+                reply = client.call(
+                    "wal_ship", dict(self._cursors), self._tasks_cursor,
+                    # A hung (SIGSTOP'd) incumbent must not stall
+                    # election: never wait longer than the lease TTL.
+                    timeout=min(self._ttl,
+                                tuning.CONTROL_CALL_TIMEOUT_S))
+                self._apply(reply)
+                self._last_ok = time.monotonic()
+                self._synced_once = True
+            except Exception as e:
+                errors.swallow("standby.poll", e)
+                if self._client is not None:
+                    try:
+                        self._client.close()
+                    except Exception:
+                        pass
+                    self._client = None
+                if self._elect():
+                    return
+                self._stop.wait(tuning.STANDBY_RECONNECT_DELAY_S)
+                continue
+            if self._elect():
+                return
+            self._stop.wait(tuning.WAL_SHIP_PERIOD_S)
+
+    def _apply(self, reply: Dict[str, Any]) -> None:
+        """Fold one wal_ship reply into the local store. Cursors only
+        advance (and persist) after the rows land, so a crash mid-apply
+        re-pulls the same entries — applies are idempotent (puts and
+        whole-table snaps)."""
+        if failpoint("standby.apply") is DROP:
+            return  # skip the batch: cursors stay, next poll re-pulls
+        epoch = int(reply.get("epoch", 0) or 0)
+        if epoch != self._last_epoch and self._last_epoch:
+            # New head incarnation: its in-memory WAL seqs restarted, so
+            # our cursors are meaningless — resync every table.
+            self._cursors = {}
+            self._tasks_cursor = 0
+        self._last_epoch = max(epoch, self._last_epoch)
+        self._ttl = float(reply.get("ttl", self._ttl) or self._ttl)
+        full = delta = 0
+        for table, payload in (reply.get("tables") or {}).items():
+            if table not in WAL_SHIP_TABLES:
+                continue
+            if "full" in payload:
+                self._store.snapshot_table(table, payload["full"])
+                full += 1
+            else:
+                for _seq, op, key, value in payload.get("entries", ()):
+                    if op == "put":
+                        self._store.put(table, key, value)
+                    elif op == "del":
+                        self._store.delete(table, key)
+                    elif op == "snap":
+                        self._store.snapshot_table(table, value)
+                delta += 1
+            self._cursors[table] = int(payload.get("seq", 0))
+        for entry in reply.get("placed") or ():
+            idx, tid, att = int(entry[0]), str(entry[1]), int(entry[2])
+            if idx > self._tasks_cursor:
+                self._placed.append((idx, tid, att))
+        self._placed = self._placed[-tuning.WAL_JOURNAL_MAX:]
+        self._tasks_cursor = max(self._tasks_cursor,
+                                 int(reply.get("placed_idx", 0) or 0))
+        if reply.get("tsdb"):
+            self._tsdb_state = reply["tsdb"]
+        self._persist_local()
+        if full or delta:
+            print(f"raytpu standby synced tables={full + delta} "
+                  f"full={full} delta={delta}", flush=True)
+
+    # -- election ------------------------------------------------------------
+
+    def _elect(self) -> bool:
+        """Take over iff the incumbent has not answered a ship poll for
+        a full lease TTL (and we have replicated state to serve from)."""
+        if self._stop.is_set() or not self._synced_once:
+            return False
+        if time.monotonic() - self._last_ok <= self._ttl:
+            return False
+        self._takeover()
+        return True
+
+    def _takeover(self) -> None:
+        # kill_process here models "the standby died at the worst
+        # moment": election must be re-runnable by a restarted follower.
+        failpoint("standby.takeover")
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
+        # Hand the sqlite file to the HeadServer's own connection.
+        self._store.close()
+        head = HeadServer(self.host, self.port,
+                          storage_path=self.storage_path,
+                          addr_file=self.addr_file, takeover=True)
+        # Epoch floor: the shipped lease row normally yields incumbent
+        # epoch + 1; if the lease never shipped (storeless incumbent),
+        # still supersede the last epoch observed on the wire.
+        if head._epoch <= self._last_epoch:
+            head._epoch = self._last_epoch + 1
+            head._rpc.capabilities["head_epoch"] = head._epoch
+        # Seed failover-dedup + TSDB sequencing state BEFORE start():
+        # the pending scheduler must see the incumbent's placed log on
+        # its first scan, not one poll later.
+        with head._lock:
+            for idx, tid, att in self._placed:
+                head._placed[(tid, att)] = True
+                head._placed_log.append((idx, tid, att))
+                head._placed_idx = max(head._placed_idx, idx)
+        if self._tsdb_state:
+            head._metric_store.restore_seq_state(self._tsdb_state)
+        addr = head.start()
+        self.head = head
+        self.took_over.set()
+        # Same banner as head.main(): harnesses await "listening on".
+        print(f"raytpu head listening on {addr}", flush=True)
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess in tests
+    import argparse
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True,
+                    help="address of the active head to follow")
+    ap.add_argument("--storage", required=True,
+                    help="follower-local sqlite path for the replica")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="port to bind when taking over (0 = ephemeral)")
+    ap.add_argument("--addr-file", default="",
+                    help="head discovery record; read to chase the "
+                         "current head, rewritten at takeover")
+    args = ap.parse_args()
+    standby = StandbyHead(args.head, args.storage, args.host, args.port,
+                          addr_file=args.addr_file or None)
+    standby.start()
+    print(f"raytpu standby following {args.head}", flush=True)
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    standby.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -69,6 +69,10 @@ FRAME_FIELDS = {
     "b": "batch: list of codec-packed sub-frame bodies (bytes, no "
          "version byte — the super-frame's single version byte covers "
          "all of them)",
+    "ep": "head epoch the sender believes is current (int) — a fenced "
+          "or superseded head rejects mismatched epochs with "
+          "HeadRedirect (split-brain fencing); absent on frames from "
+          "peers that have not yet learned an epoch",
 }
 
 _EXT_STRUCT = 1

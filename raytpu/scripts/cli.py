@@ -99,10 +99,20 @@ def _cmd_top(args) -> int:
     def fmt(v, spec="{:.1f}", scale=1.0):
         return "-" if v is None else spec.format(v * scale)
 
+    def head_epoch():
+        """Current head incarnation (bumps at hot-standby takeover);
+        None against a pre-failover head without the ``head_info`` RPC."""
+        try:
+            return (cli.call("head_info") or {}).get("epoch")
+        except Exception:
+            return None
+
     def draw() -> None:
+        ep = head_epoch()
         lines = [
-            f"raytpu top — {args.address} — "
-            f"{_time.strftime('%H:%M:%S')}",
+            f"raytpu top — {args.address}"
+            + (f" — epoch {ep}" if ep is not None else "")
+            + f" — {_time.strftime('%H:%M:%S')}",
             "",
             "  tasks/s   submitted "
             + fmt(latest("raytpu_tasks_submitted_total", "rate"))

@@ -281,6 +281,37 @@ class MetricStore:
         with self._lock:
             self._dead_procs.discard(str(node_hex12)[:12])
 
+    def seq_state(self) -> Dict:
+        """JSON-safe export of the sequencing state a successor head
+        needs for correctness: per-origin applied seqs (so re-shipped
+        frames dedup instead of double-counting) and proc-death
+        tombstones (so a dead origin's late frames stay rejected).
+        Series data is intentionally NOT exported — it is lossy-bounded
+        telemetry; the seq/tombstone state is what must not regress."""
+        with self._lock:
+            return {"proc_seq": dict(self._proc_seq),
+                    "dead": sorted(self._dead_procs)}
+
+    def restore_seq_state(self, state: Dict) -> None:
+        """Merge a shipped :meth:`seq_state` into this store (takeover /
+        restart path). Merge, not replace: per-origin seqs keep the MAX
+        of both sides and tombstones union, so a restore can only make
+        dedup stricter — never resurrect a dead origin or re-admit an
+        already-applied frame."""
+        if not isinstance(state, dict):
+            return
+        with self._lock:
+            for proc, seq in (state.get("proc_seq") or {}).items():
+                try:
+                    seq = int(seq)
+                except (TypeError, ValueError):
+                    continue
+                proc = str(proc)
+                if seq > self._proc_seq.get(proc, 0):
+                    self._proc_seq[proc] = seq
+            for p in state.get("dead") or ():
+                self._dead_procs.add(str(p)[:12])
+
     # -- query -------------------------------------------------------------
 
     def query(self, name: str, tags: Optional[Dict[str, str]] = None,

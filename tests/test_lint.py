@@ -750,6 +750,74 @@ class TestPersistCoverage:  # RTP016
         assert res.findings == []
 
 
+class TestWalCoverage:  # RTP017
+    def test_planted_unshipped_table(self):
+        findings = run_rule_on_source(_rule("RTP017"), _src("""
+            WAL_SHIP_TABLES = ("kv", "meta")
+
+            class Head:
+                def _persist_actor(self, aid, blob):
+                    self._store.put("actors", aid, blob)
+        """), rel="raytpu/cluster/head.py")
+        assert len(findings) == 1
+        assert "'actors'" in findings[0].message
+        assert "WAL_SHIP_TABLES" in findings[0].message
+
+    def test_planted_unshipped_snapshot(self):
+        findings = run_rule_on_source(_rule("RTP017"), _src("""
+            WAL_SHIP_TABLES = ("kv",)
+
+            class Head:
+                def _snapshot(self):
+                    self._store.snapshot_table("objects", {})
+        """), rel="raytpu/cluster/head.py")
+        assert len(findings) == 1
+        assert "'objects'" in findings[0].message
+
+    def test_missing_ship_tuple_is_a_finding(self):
+        findings = run_rule_on_source(_rule("RTP017"), _src("""
+            class Head:
+                def _kv_put(self, key, value):
+                    self._store.put("kv", key, value)
+        """), rel="raytpu/cluster/head.py")
+        assert len(findings) == 1
+        assert "source of truth" in findings[0].message
+
+    def test_clean_shipped_tables(self):
+        assert run_rule_on_source(_rule("RTP017"), _src("""
+            WAL_SHIP_TABLES = ("kv", "actors")
+
+            class Head:
+                def _kv_put(self, key, value):
+                    self._store.put("kv", key, value)
+
+                def _drop_actor(self, aid):
+                    self._store.delete("actors", aid)
+        """), rel="raytpu/cluster/head.py") == []
+
+    def test_non_literal_table_arg_skipped(self):
+        assert run_rule_on_source(_rule("RTP017"), _src("""
+            WAL_SHIP_TABLES = ("kv",)
+
+            class Head:
+                def _generic(self, table, key, value):
+                    self._store.put(table, key, value)
+        """), rel="raytpu/cluster/head.py") == []
+
+    def test_other_modules_out_of_scope(self):
+        # The standby's follower-local cursor table is deliberately not
+        # shipped; the rule only audits head.py.
+        assert run_rule_on_source(_rule("RTP017"), _src("""
+            class StandbyHead:
+                def _persist_local(self):
+                    self._store.put("standby", "state", b"{}")
+        """), rel="raytpu/cluster/standby.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP017"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

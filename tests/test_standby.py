@@ -1,0 +1,686 @@
+"""Hot-standby head suite: WAL journal/shipping, lease-based election,
+epoch fencing, and the zero-restart failover chaos scenarios.
+
+Layout mirrors the tentpole's layers:
+
+- ``TestWalJournal`` — `GcsStore` journaling and the ``ship`` cursor
+  protocol (delta vs full-resync, disk baseline, freeze). Pure
+  in-process, tier-1.
+- ``TestLeaseEpochFencing`` — epoch succession across restarts and the
+  frame gate (stale epoch redirected, higher epoch self-fences).
+- ``TestStandbyReplication`` — an in-process follower tailing a real
+  head: replication, cursor persistence across follower restarts,
+  election on head death, and the lease/apply failpoints.
+- ``TestTsdbSeqState`` / ``TestPlacedLog`` — the failover-continuity
+  state that rides the ship stream.
+- ``TestStandbyChaos`` (``chaos`` + ``slow``) — real subprocess
+  clusters: SIGKILL the active head under load (takeover with NO head
+  process restart, in-flight get rides the redirect, queued tasks not
+  replayed twice), follower kill/restart cursor resume, and the
+  SIGSTOP split-brain proving epoch fencing keeps the stores
+  convergent.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import raytpu
+from raytpu.cluster import constants as tuning
+from raytpu.cluster.cluster_utils import Cluster
+from raytpu.cluster.head import (
+    GcsStore,
+    HeadServer,
+    WAL_SHIP_TABLES,
+    read_addr_record,
+)
+from raytpu.cluster.protocol import HeadRedirect, RpcClient
+from raytpu.cluster.standby import StandbyHead
+from raytpu.util import failpoints
+from raytpu.util.tsdb import MetricStore
+
+
+# -- GcsStore WAL journal -----------------------------------------------------
+
+
+class TestWalJournal:
+    def test_ship_delta_from_cursor(self, tmp_path):
+        store = GcsStore(str(tmp_path / "a.db"))
+        try:
+            for i in range(3):
+                store.put("kv", f"k{i}", f"v{i}".encode())
+            out = store.ship({"kv": 0}, ("kv",))
+            assert out["kv"]["seq"] == 3
+            assert [e[2] for e in out["kv"]["entries"]] == ["k0", "k1", "k2"]
+            out = store.ship({"kv": 2}, ("kv",))
+            assert [e[2] for e in out["kv"]["entries"]] == ["k2"]
+            # Caught up: the table is omitted entirely.
+            assert store.ship({"kv": 3}, ("kv",)) == {}
+        finally:
+            store.close()
+
+    def test_delete_and_snapshot_ops_ship(self, tmp_path):
+        store = GcsStore(str(tmp_path / "a.db"))
+        try:
+            store.put("kv", "k", b"v")
+            store.delete("kv", "k")
+            store.snapshot_table("objects", {"o1": b"x"})
+            kv = store.ship({}, ("kv",))["kv"]["entries"]
+            assert [(e[1], e[2]) for e in kv] == [("put", "k"), ("del", "k")]
+            obj = store.ship({}, ("objects",))["objects"]["entries"]
+            assert obj[0][1] == "snap" and obj[0][3] == {"o1": b"x"}
+        finally:
+            store.close()
+
+    def test_journal_eviction_forces_full_resync(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setattr(tuning, "WAL_JOURNAL_MAX", 4)
+        store = GcsStore(str(tmp_path / "a.db"))
+        try:
+            for i in range(10):
+                store.put("kv", f"k{i}", b"v")
+            out = store.ship({"kv": 2}, ("kv",))["kv"]
+            # Entries 3..10 no longer all in the bounded journal: whole
+            # table instead, tagged with the current seq.
+            assert out["seq"] == 10
+            assert "entries" not in out
+            assert set(out["full"]) == {f"k{i}" for i in range(10)}
+            # A recent cursor still gets the cheap delta.
+            out = store.ship({"kv": 9}, ("kv",))["kv"]
+            assert [e[2] for e in out["entries"]] == ["k9"]
+        finally:
+            store.close()
+
+    def test_disk_baseline_forces_resync_of_preexisting_tables(
+            self, tmp_path):
+        db = str(tmp_path / "a.db")
+        store = GcsStore(db)
+        store.put("kv", "old", b"1")
+        store.close()
+        store = GcsStore(db)
+        try:
+            # The new incarnation never journaled "old"; a cursor-0
+            # follower must NOT be told it is caught up.
+            out = store.ship({"kv": 0}, ("kv",))["kv"]
+            assert out["full"] == {"old": b"1"}
+            # Post-resync the follower tails deltas as usual.
+            store.put("kv", "new", b"2")
+            out = store.ship({"kv": out["seq"]}, ("kv",))["kv"]
+            assert [e[2] for e in out["entries"]] == ["new"]
+        finally:
+            store.close()
+
+    def test_freeze_makes_mutations_noops(self, tmp_path):
+        store = GcsStore(str(tmp_path / "a.db"))
+        try:
+            store.put("kv", "before", b"1")
+            store.freeze()
+            store.put("kv", "after", b"2")
+            store.delete("kv", "before")
+            store.snapshot_table("kv", {})
+            assert store.load_all("kv") == {"before": b"1"}
+            assert store.ship({"kv": 0}, ("kv",))["kv"]["seq"] == 1
+        finally:
+            store.close()
+
+
+# -- lease epochs + frame gate ------------------------------------------------
+
+
+class TestLeaseEpochFencing:
+    def test_epoch_increments_across_restarts(self, tmp_path):
+        db = str(tmp_path / "gcs.db")
+        af = str(tmp_path / "head.addr")
+        head = HeadServer("127.0.0.1", 0, storage_path=db, addr_file=af)
+        addr = head.start()
+        try:
+            assert head._epoch == 1
+            assert read_addr_record(af) == {"address": addr, "epoch": 1}
+        finally:
+            head.stop()
+        head2 = HeadServer("127.0.0.1", 0, storage_path=db, addr_file=af)
+        try:
+            assert head2._epoch == 2  # lease row survived the restart
+        finally:
+            head2.stop()
+
+    def test_stale_epoch_frame_redirected(self, tmp_path):
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"))
+        addr = head.start()
+        cli = RpcClient(addr)
+        try:
+            cli.epoch = 0  # believes a pre-failover head is current
+            with pytest.raises(HeadRedirect) as ei:
+                cli.call("kv_put", "k", b"v")
+            assert ei.value.address == addr
+            assert ei.value.epoch == head._epoch
+            # The gate fires before the handler: nothing was written.
+            assert "k" not in head._kv
+        finally:
+            cli.close()
+            head.stop()
+
+    def test_higher_epoch_frame_self_fences(self, tmp_path):
+        af = str(tmp_path / "head.addr")
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        addr = head.start()
+        cli = RpcClient(addr)
+        try:
+            # A successor published a higher-epoch discovery record ...
+            with open(af, "w") as f:
+                f.write(json.dumps({"address": "127.0.0.1:1",
+                                    "epoch": 5}))
+            # ... and a peer that learned it touches the stale head.
+            cli.epoch = 5
+            with pytest.raises(HeadRedirect) as ei:
+                cli.call("kv_put", "k", b"v")
+            assert ei.value.epoch == 5
+            assert head._fenced
+            # Everything non-diagnostic now redirects, even fresh peers.
+            fresh = RpcClient(addr)
+            try:
+                with pytest.raises(HeadRedirect):
+                    fresh.call("kv_get", "k")
+                # Diagnostics stay reachable on the fenced incumbent.
+                info = fresh.call("head_info")
+                assert info["fenced"] is True
+                kinds = [e["label"] for e in fresh.call("list_events")]
+                assert "HEAD_FENCED" in kinds
+            finally:
+                fresh.close()
+            # The frozen store shipped nothing after the fence.
+            assert head._store.load_all("kv") == {}
+        finally:
+            cli.close()
+            head.stop()
+
+
+# -- in-process follower ------------------------------------------------------
+
+
+@pytest.fixture
+def fast_lease(monkeypatch):
+    monkeypatch.setattr(tuning, "HEAD_LEASE_TTL_S", 0.6)
+    monkeypatch.setattr(tuning, "HEAD_LEASE_RENEW_PERIOD_S", 0.1)
+    monkeypatch.setattr(tuning, "WAL_SHIP_PERIOD_S", 0.03)
+    monkeypatch.setattr(tuning, "STANDBY_RECONNECT_DELAY_S", 0.05)
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+class TestStandbyReplication:
+    def test_follower_replicates_and_restart_resumes_cursor(
+            self, tmp_path, fast_lease):
+        af = str(tmp_path / "head.addr")
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        addr = head.start()
+        cli = RpcClient(addr)
+        sb = None
+        try:
+            for i in range(3):
+                cli.call("kv_put", f"k{i}", b"v")
+            sb = StandbyHead(addr, str(tmp_path / "replica.db"),
+                             addr_file=af)
+            sb.start()
+            _wait(lambda: sb._cursors.get("kv", 0) >= 3,
+                  msg="kv replication")
+            assert set(sb._store.load_all("kv")) == {"k0", "k1", "k2"}
+            cursors_before = dict(sb._cursors)
+            sb.stop()
+            # A restarted follower resumes from its persisted cursor —
+            # no full resync, and new writes still arrive.
+            sb = StandbyHead(addr, str(tmp_path / "replica.db"),
+                             addr_file=af)
+            assert sb._synced_once
+            assert sb._cursors == cursors_before
+            sb.start()
+            cli.call("kv_put", "late", b"v")
+            _wait(lambda: "late" in sb._store.load_all("kv"),
+                  msg="post-restart delta")
+            assert sb._cursors["kv"] > cursors_before["kv"]
+        finally:
+            cli.close()
+            if sb is not None:
+                sb.stop()
+            head.stop()
+
+    def test_head_death_elects_standby_with_warm_state(self, tmp_path,
+                                                       fast_lease):
+        af = str(tmp_path / "head.addr")
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        addr = head.start()
+        cli = RpcClient(addr)
+        sb = None
+        try:
+            cli.call("kv_put", "durable", b"yes")
+            sb = StandbyHead(addr, str(tmp_path / "replica.db"),
+                             addr_file=af)
+            sb.start()
+            _wait(lambda: sb._cursors.get("kv", 0) >= 1, msg="sync")
+            cli.close()
+            head.stop()
+            assert sb.took_over.wait(timeout=20), "standby never elected"
+            new = RpcClient(sb.head.address)
+            try:
+                assert new.call("kv_get", "durable") == b"yes"
+                info = new.call("head_info")
+                assert info["epoch"] == 2 and not info["fenced"]
+                kinds = [e["label"] for e in new.call("list_events")]
+                assert "HEAD_FAILOVER" in kinds
+            finally:
+                new.close()
+            assert read_addr_record(af)["epoch"] == 2
+        finally:
+            if sb is not None:
+                sb.stop()
+            head.stop()
+
+    @pytest.mark.chaos
+    def test_apply_failpoint_lags_but_never_skips(self, tmp_path,
+                                                  fast_lease):
+        af = str(tmp_path / "head.addr")
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        addr = head.start()
+        cli = RpcClient(addr)
+        sb = None
+        try:
+            failpoints.cfg("standby.apply", "3*drop")
+            sb = StandbyHead(addr, str(tmp_path / "replica.db"),
+                             addr_file=af)
+            sb.start()
+            for i in range(4):
+                cli.call("kv_put", f"k{i}", b"v")
+            # Dropped applies leave the cursors alone, so the next poll
+            # re-pulls: replication lags by 3 polls but loses nothing.
+            _wait(lambda: sb._cursors.get("kv", 0) >= 4,
+                  msg="catch-up after dropped applies")
+            assert failpoints.stat("standby.apply")["fires"] >= 3
+            assert set(sb._store.load_all("kv")) == \
+                {f"k{i}" for i in range(4)}
+        finally:
+            failpoints.clear()
+            cli.close()
+            if sb is not None:
+                sb.stop()
+            head.stop()
+
+    @pytest.mark.chaos
+    def test_lease_renew_drop_alone_does_not_depose(self, tmp_path,
+                                                    fast_lease):
+        """Liveness is the ship stream, not the lease row: a head whose
+        lease WRITES are suppressed but which still answers wal_ship is
+        never deposed (no false failover on a slow store)."""
+        af = str(tmp_path / "head.addr")
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        addr = head.start()
+        sb = None
+        try:
+            failpoints.cfg("head.lease_renew", "drop")
+            sb = StandbyHead(addr, str(tmp_path / "replica.db"),
+                             addr_file=af)
+            sb.start()
+            _wait(lambda: sb._synced_once, msg="first sync")
+            time.sleep(3 * tuning.HEAD_LEASE_TTL_S)
+            assert failpoints.stat("head.lease_renew")["fires"] >= 1
+            assert not sb.took_over.is_set(), \
+                "standby deposed a head that was still shipping"
+        finally:
+            failpoints.clear()
+            if sb is not None:
+                sb.stop()
+            head.stop()
+
+
+# -- failover-continuity state on the ship stream -----------------------------
+
+
+class TestTsdbSeqState:
+    def test_seq_state_roundtrip_merges_conservatively(self):
+        src = MetricStore()
+        src.push([["node:aaaaaaaaaaaa", 7, time.time(),
+                   [["c", "raytpu_tasks_done_total", {}, 3]]]])
+        src.mark_proc_dead("bbbbbbbbbbbb")
+        state = src.seq_state()
+        assert state["proc_seq"] == {"node:aaaaaaaaaaaa": 7}
+        assert state["dead"] == ["bbbbbbbbbbbb"]
+
+        dst = MetricStore()
+        dst.push([["node:aaaaaaaaaaaa", 9, time.time(),
+                   [["c", "raytpu_tasks_done_total", {}, 1]]]])
+        dst.mark_proc_dead("cccccccccccc")
+        dst.restore_seq_state(state)
+        merged = dst.seq_state()
+        # Merge can only make dedup stricter: max seq, union tombstones.
+        assert merged["proc_seq"]["node:aaaaaaaaaaaa"] == 9
+        assert merged["dead"] == ["bbbbbbbbbbbb", "cccccccccccc"]
+        # A replayed pre-failover frame is a duplicate, not a re-count.
+        assert dst.push([["node:aaaaaaaaaaaa", 7, time.time(),
+                          [["c", "raytpu_tasks_done_total", {}, 3]]]]) == 0
+        # Frames from a tombstoned origin stay rejected.
+        assert dst.push([["node:bbbbbbbbbbbb", 1, time.time(),
+                          [["c", "raytpu_tasks_done_total", {}, 1]]]]) == 0
+
+
+class TestPlacedLog:
+    def test_placed_log_ships_past_cursor_and_dedups(self, tmp_path):
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"))
+        try:
+            with head._lock:
+                head._record_placed("t1", 0)
+                head._record_placed("t1", 0)  # idempotent
+                head._record_placed("t2", 1)
+            out = head._h_wal_ship(None, {}, 0)
+            assert out["placed"] == [[1, "t1", 0], [2, "t2", 1]]
+            assert out["placed_idx"] == 2
+            # A follower that already applied idx 1 gets only the tail.
+            out = head._h_wal_ship(None, {}, 1)
+            assert out["placed"] == [[2, "t2", 1]]
+        finally:
+            head.stop()
+
+
+# -- chaos: real subprocess clusters -----------------------------------------
+
+
+def _arm_failover_env(monkeypatch, addr_file):
+    """Timing knobs for subprocess failover tests: children read the
+    env; the driver (this process) needs the tuning attrs patched too
+    since constants were already imported."""
+    for k, v in (("RAYTPU_HEAD_LEASE_TTL_S", "1.0"),
+                 ("RAYTPU_HEAD_LEASE_RENEW_PERIOD_S", "0.2"),
+                 ("RAYTPU_WAL_SHIP_PERIOD_S", "0.05"),
+                 ("RAYTPU_HEARTBEAT_TIMEOUT_S", "2.0"),
+                 ("RAYTPU_HEALTH_CHECK_PERIOD_S", "0.5")):
+        monkeypatch.setenv(k, v)
+    monkeypatch.setattr(tuning, "HEAD_LEASE_TTL_S", 1.0)
+    monkeypatch.setattr(tuning, "HEAD_ADDR_FILE", addr_file)
+
+
+def _replica_cursors(db_path):
+    """The follower's persisted per-table cursors, read from its replica
+    sqlite (concurrent WAL readers are fine)."""
+    peek = GcsStore(db_path)
+    try:
+        raw = peek.load_all("standby").get("state", b"{}")
+        return json.loads(raw).get("cursors", {})
+    finally:
+        peek.close()
+
+
+def _wait_follower_synced(cluster, table="kv", seq=1):
+    """Block until the follower has replicated ``table`` up to ``seq``.
+    A follower that has never completed a poll refuses election (it has
+    no state to serve), so every fault-injection below must first let
+    it catch up — exactly what an operator's runbook would require."""
+    _wait(lambda: _replica_cursors(cluster._standby_storage)
+          .get(table, 0) >= seq, msg=f"follower sync of {table}")
+
+
+@pytest.mark.chaos
+class TestStandbyChaos:
+    @pytest.mark.slow
+    def test_sigkill_head_standby_takeover_inflight_get(
+            self, tmp_path, monkeypatch):
+        """SIGKILL the active head while the driver blocks in get() on
+        a task a node is still executing. The standby takes over with
+        NO head process restart; the same get() rides HeadRedirect +
+        the discovery record to the new head and returns the value."""
+        af = str(tmp_path / "head.addr")
+        _arm_failover_env(monkeypatch, af)
+        cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1},
+                          head_storage=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        cluster.wait_for_nodes(1)
+        cluster.add_standby()
+        _wait_follower_synced(cluster, table="meta")
+        old_addr = cluster.address
+        old_head_proc = cluster.head_proc
+        raytpu.init(address=cluster.address)
+        try:
+            @raytpu.remote
+            def slow_double(x):
+                import time as _t
+                _t.sleep(4.0)
+                return x * 2
+
+            ref = slow_double.remote(21)
+            time.sleep(1.0)  # task running on the node
+            box = {}
+
+            def getter():
+                box["value"] = raytpu.get(ref, timeout=120)
+
+            th = threading.Thread(target=getter)
+            th.start()
+            time.sleep(0.5)
+            cluster.kill_head()
+            new_addr = cluster.await_takeover(timeout=30)
+            assert new_addr != old_addr
+            th.join(timeout=120)
+            assert not th.is_alive(), \
+                "get() never returned after the failover"
+            assert box["value"] == 42
+            # Zero restart window: the serving process IS the standby —
+            # the killed head was never respawned.
+            assert cluster.head_proc is old_head_proc
+            assert old_head_proc.poll() is not None
+            assert cluster.standby_proc.poll() is None
+            head = RpcClient(new_addr)
+            try:
+                info = head.call("head_info")
+                assert info["epoch"] == 2 and not info["fenced"]
+                kinds = [e["label"] for e in head.call("list_events")]
+                assert "HEAD_FAILOVER" in kinds
+            finally:
+                head.close()
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+    @pytest.mark.slow
+    def test_queued_tasks_not_replayed_twice_across_takeover(
+            self, tmp_path, monkeypatch):
+        """Sustained stream of head-queued tasks (the node's one CPU is
+        blocked, so specs sit in the durable pending table and ship to
+        the follower). SIGKILL the head while the pending scheduler is
+        mid-stream: the successor replays the queue but skips placements
+        already in the shipped placed-log — every task runs EXACTLY
+        once (side-effect marker counted), and every get() resolves."""
+        af = str(tmp_path / "head.addr")
+        _arm_failover_env(monkeypatch, af)
+        cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1},
+                          head_storage=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        cluster.wait_for_nodes(1)
+        cluster.add_standby()
+        _wait_follower_synced(cluster, table="meta")
+        raytpu.init(address=cluster.address)
+        marker = str(tmp_path / "ran.txt")
+        try:
+            @raytpu.remote(num_cpus=1)
+            def blocker():
+                import time as _t
+                _t.sleep(2.0)
+                return "done"
+
+            @raytpu.remote(num_cpus=1)
+            def tracked(i, path):
+                import time as _t
+                with open(path, "a") as f:
+                    f.write(f"{i}\n")
+                _t.sleep(0.4)
+                return i
+
+            bref = blocker.remote()
+            time.sleep(0.3)  # blocker occupies the only CPU
+            refs = [tracked.remote(i, marker) for i in range(6)]
+            # Blocker ends at ~2.0s, the pending loop starts draining
+            # the queue; kill the head mid-drain.
+            time.sleep(3.0)
+            cluster.kill_head()
+            cluster.await_takeover(timeout=30)
+            assert raytpu.get(bref, timeout=120) == "done"
+            assert sorted(raytpu.get(refs, timeout=180)) == list(range(6))
+            with open(marker) as f:
+                runs = [line.strip() for line in f if line.strip()]
+            assert sorted(runs) == sorted(set(runs)), \
+                f"task(s) replayed twice across the takeover: {runs}"
+            assert len(runs) == 6
+        finally:
+            raytpu.shutdown()
+            cluster.shutdown()
+
+    @pytest.mark.slow
+    def test_follower_killed_and_restarted_resumes_tailing(
+            self, tmp_path, monkeypatch):
+        """SIGKILL the follower mid-tail; its restarted incarnation must
+        resume from the persisted cursor (state survives in the replica
+        sqlite), catch up on writes it missed, and still win the
+        election when the head later dies."""
+        af = str(tmp_path / "head.addr")
+        _arm_failover_env(monkeypatch, af)
+        cluster = Cluster(head_storage=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        cluster.add_standby()
+        replica = cluster._standby_storage
+        head = RpcClient(cluster.address)
+        try:
+            for i in range(5):
+                head.call("kv_put", f"pre{i}", b"v")
+
+            def replica_state():
+                peek = GcsStore(replica)
+                try:
+                    raw = peek.load_all("standby").get("state", b"{}")
+                    return json.loads(raw)
+                finally:
+                    peek.close()
+
+            _wait(lambda: replica_state().get("cursors", {})
+                  .get("kv", 0) >= 5, msg="follower sync before kill")
+            cluster.kill_standby()
+            c1 = replica_state()["cursors"]["kv"]
+            assert c1 >= 5
+            for i in range(5):
+                head.call("kv_put", f"mid{i}", b"v")  # follower is down
+            cluster.restart_standby()
+            _wait(lambda: replica_state().get("cursors", {})
+                  .get("kv", 0) > c1, msg="cursor resume after restart")
+            # Same head incarnation -> the cursor advanced, never reset.
+            assert replica_state()["epoch"] == 1
+            head.close()
+            cluster.kill_head()
+            new_addr = cluster.await_takeover(timeout=30)
+            head = RpcClient(new_addr)
+            for i in range(5):
+                assert head.call("kv_get", f"pre{i}") == b"v"
+                assert head.call("kv_get", f"mid{i}") == b"v"
+        finally:
+            head.close()
+            cluster.shutdown()
+
+    @pytest.mark.slow
+    def test_sigstop_split_brain_epoch_fencing(self, tmp_path,
+                                               monkeypatch):
+        """The split-brain half: SIGSTOP (not kill) the active head past
+        the lease TTL so the standby takes over while the incumbent is
+        still alive. On SIGCONT the stale incumbent must self-fence —
+        reads/writes raise HeadRedirect, its store stays frozen (no
+        divergence vs the new head's store), and the node re-registers
+        with the successor."""
+        af = str(tmp_path / "head.addr")
+        _arm_failover_env(monkeypatch, af)
+        cluster = Cluster(num_nodes=1, node_resources={"num_cpus": 1},
+                          head_storage=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        cluster.wait_for_nodes(1)
+        cluster.add_standby()
+        old_addr = cluster.address
+        seed = RpcClient(old_addr)
+        seed.call("kv_put", "seeded", b"1")
+        node_id = next(n["node_id"] for n in seed.call("list_nodes")
+                       if n["labels"].get("role") != "driver")
+        seed.close()
+        try:
+            _wait_follower_synced(cluster, table="kv")
+            cluster.pause_head()
+            new_addr = cluster.await_takeover(timeout=30)
+            cluster.resume_head()
+            # The resumed incumbent notices its renewal gap, reads the
+            # discovery record, and fences itself within a renew period.
+            old = RpcClient(old_addr)
+            try:
+                deadline = time.monotonic() + 15
+                fenced = False
+                while time.monotonic() < deadline:
+                    try:
+                        old.call("kv_get", "seeded")
+                    except HeadRedirect as r:
+                        assert r.address == new_addr
+                        assert r.epoch == 2
+                        fenced = True
+                        break
+                    time.sleep(0.1)
+                assert fenced, "stale incumbent never self-fenced"
+                # Writes to the deposed head are rejected, not applied.
+                with pytest.raises(HeadRedirect):
+                    old.call("kv_put", "split", b"lost")
+                assert old.call("head_info")["fenced"] is True
+            finally:
+                old.close()
+            # The cluster keeps working through the successor ...
+            new = RpcClient(new_addr)
+            try:
+                new.call("kv_put", "post-failover", b"2")
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    nodes = {n["node_id"]: n
+                             for n in new.call("list_nodes")}
+                    if nodes.get(node_id, {}).get("alive"):
+                        break
+                    time.sleep(0.2)
+                assert nodes.get(node_id, {}).get("alive"), \
+                    "node never followed the redirect to the new head"
+            finally:
+                new.close()
+            # ... and the two sqlite stores never diverged: the frozen
+            # incumbent's kv is a strict subset of the successor's.
+            old_kv = _read_kv(str(tmp_path / "gcs.db"))
+            new_kv = _read_kv(cluster._standby_storage)
+            assert "split" not in old_kv
+            assert "post-failover" in new_kv
+            assert set(old_kv).issubset(set(new_kv))
+            for k, v in old_kv.items():
+                assert new_kv[k] == v
+        finally:
+            cluster.shutdown()
+
+
+def _read_kv(db_path):
+    store = GcsStore(db_path)
+    try:
+        return store.load_all("kv")
+    finally:
+        store.close()
