@@ -763,22 +763,23 @@ class HeadServer:
         return lease if isinstance(lease, dict) else {}
 
     def _renew_lease(self) -> None:
-        """Rewrite the epoch-stamped lease row. If this process stalled
-        past its own TTL (SIGSTOP, long GC pause) it may already have
-        been superseded: check the discovery record FIRST and self-fence
-        on a higher epoch instead of writing."""
+        """Rewrite the epoch-stamped lease row. Every renewal first
+        re-validates the discovery record and self-fences on a higher
+        epoch instead of writing: checking only when a renewal gap
+        betrays a stall (SIGSTOP, long GC pause) is not enough — an
+        election can race the resume and rewrite the record a moment
+        AFTER the one gap check passed, leaving two heads serving
+        (nodes still attached here stamp the matching old epoch, so
+        the frame gate alone would never fence)."""
         if self._fenced:
             return
         if failpoint("head.lease_renew") is DROP:
             return  # renewal suppressed: the follower sees a stale lease
-        now = time.monotonic()
-        if now - self._last_renew > tuning.HEAD_LEASE_TTL_S:
-            rec = read_addr_record(self._addr_file)
-            if rec and int(rec.get("epoch", 0) or 0) > self._epoch:
-                self._fence(str(rec.get("address", "")),
-                            int(rec["epoch"]))
-                return
-        self._last_renew = now
+        rec = read_addr_record(self._addr_file)
+        if rec and int(rec.get("epoch", 0) or 0) > self._epoch:
+            self._fence(str(rec.get("address", "")), int(rec["epoch"]))
+            return
+        self._last_renew = time.monotonic()
         if self._store is not None:
             import json as _json
 
@@ -881,8 +882,23 @@ class HeadServer:
             errors.swallow("head.wal_ship_tsdb", e)
         with self._lock:
             tc = int(tasks_cursor or 0)
-            out["placed"] = [list(e) for e in self._placed_log
-                             if e[0] > tc]
+            oldest = (self._placed_log[0][0] if self._placed_log
+                      else self._placed_idx + 1)
+            if tc + 1 < oldest:
+                # The bounded log evicted entries past the follower's
+                # cursor (long disconnect): deltas would silently omit
+                # placements and a successor could double-dispatch.
+                # Ship the whole dedup map instead — insertion order is
+                # index order and each insert incremented _placed_idx,
+                # so true indices are the trailing len(_placed) ones.
+                base = self._placed_idx - len(self._placed) + 1
+                out["placed_full"] = [
+                    [base + i, tid, att]
+                    for i, (tid, att) in enumerate(self._placed)]
+                out["placed"] = []
+            else:
+                out["placed"] = [list(e) for e in self._placed_log
+                                 if e[0] > tc]
             out["placed_idx"] = self._placed_idx
         return out
 

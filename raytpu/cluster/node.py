@@ -1109,23 +1109,10 @@ class NodeServer:
             except Exception as e:
                 errors.swallow("node.reregister_borrows", e)
         # Re-announce object locations as batched deltas, sizes included
-        # so the reloaded directory can score locality immediately. A
-        # WARM head (a standby that tailed the incumbent's WAL) already
-        # holds the shipped directory snapshot — replay only the
-        # announcements younger than the snapshot staleness window, not
-        # the whole store: that skipped replay IS the zero-restart win.
-        if warm:
-            horizon = time.monotonic() - 2 * tuning.HEAD_SNAPSHOT_PERIOD_S
-            held = {oid.hex() for oid in self.backend.store.keys()}
-            replay = []
-            seen: set = set()
-            for t, oh in self._recent_obj_reports:
-                if t >= horizon and oh in held and oh not in seen:
-                    seen.add(oh)
-                    replay.append(["+", oh, 0])
-        else:
-            replay = [["+", oid.hex(), self._object_wire_size(oid)]
-                      for oid in self.backend.store.keys()]
+        # so the reloaded directory can score locality immediately; a
+        # WARM head gets only the recent window (see _reregister_replay)
+        # — that skipped replay IS the zero-restart win.
+        replay = self._reregister_replay(warm)
         for i in range(0, len(replay), 512):  # rpc-loop-ok: re-announce replay after head restart, 512 deltas per frame
             try:
                 head.notify("report_objects", self.node_id.hex(),
@@ -1205,6 +1192,33 @@ class NodeServer:
             return
         self._recent_obj_reports.append((time.monotonic(), oid.hex()))
         self._queue_obj_delta(["+", oid.hex(), self._object_wire_size(oid)])
+
+    def _reregister_replay(self, warm: bool) -> list:
+        """Location deltas to re-announce after (re-)registering. Cold
+        heads get the whole store. A WARM head (standby that tailed the
+        WAL) already holds the shipped directory snapshot, so only the
+        announcements younger than the snapshot staleness window replay
+        — UNLESS the bounded recents deque evicted entries that are
+        still inside that window (its oldest retained entry is younger
+        than the horizon while full): eviction then means coverage of
+        the window can't be proven, so fall back to the full replay."""
+        held = {oid.hex(): oid for oid in self.backend.store.keys()}
+        if warm:
+            horizon = time.monotonic() - 2 * tuning.HEAD_SNAPSHOT_PERIOD_S
+            recents = list(self._recent_obj_reports)
+            saturated = (len(recents) == self._recent_obj_reports.maxlen
+                         and recents and recents[0][0] > horizon)
+            if not saturated:
+                replay = []
+                seen: set = set()
+                for t, oh in recents:
+                    if t >= horizon and oh in held and oh not in seen:
+                        seen.add(oh)
+                        replay.append(
+                            ["+", oh, self._object_wire_size(held[oh])])
+                return replay
+        return [["+", oh, self._object_wire_size(oid)]
+                for oh, oid in held.items()]
 
     def _object_wire_size(self, oid: ObjectID) -> int:
         """Wire bytes of a locally-held object, for the head's locality

@@ -159,9 +159,9 @@ class StandbyHead:
                     # election: never wait longer than the lease TTL.
                     timeout=min(self._ttl,
                                 tuning.CONTROL_CALL_TIMEOUT_S))
-                self._apply(reply)
+                if self._apply(reply):
+                    self._synced_once = True
                 self._last_ok = time.monotonic()
-                self._synced_once = True
             except Exception as e:
                 errors.swallow("standby.poll", e)
                 if self._client is not None:
@@ -178,19 +178,32 @@ class StandbyHead:
                 return
             self._stop.wait(tuning.WAL_SHIP_PERIOD_S)
 
-    def _apply(self, reply: Dict[str, Any]) -> None:
-        """Fold one wal_ship reply into the local store. Cursors only
-        advance (and persist) after the rows land, so a crash mid-apply
-        re-pulls the same entries — applies are idempotent (puts and
-        whole-table snaps)."""
+    def _apply(self, reply: Dict[str, Any]) -> bool:
+        """Fold one wal_ship reply into the local store; True iff the
+        reply was applied. Cursors only advance (and persist) after the
+        rows land, so a crash mid-apply re-pulls the same entries —
+        applies are idempotent (puts and whole-table snaps)."""
         if failpoint("standby.apply") is DROP:
-            return  # skip the batch: cursors stay, next poll re-pulls
+            return False  # skip the batch: cursors stay, next poll re-pulls
         epoch = int(reply.get("epoch", 0) or 0)
-        if epoch != self._last_epoch and self._last_epoch:
+        if self._last_epoch and epoch != self._last_epoch:
+            if epoch < self._last_epoch:
+                # A not-yet-fenced stale incumbent answered: its data
+                # predates state we already applied — drop the reply.
+                return False
             # New head incarnation: its in-memory WAL seqs restarted, so
-            # our cursors are meaningless — resync every table.
+            # this reply was computed against our now-stale cursors (it
+            # may carry deltas where a full resync is required — a
+            # takeover head numbers its disk tables from seq 1). Do NOT
+            # apply it: zero the cursors, persist, and let the next poll
+            # pull correct full resyncs. Election is re-gated on that
+            # fresh sync so we never serve a half-old-epoch replica.
             self._cursors = {}
             self._tasks_cursor = 0
+            self._last_epoch = epoch
+            self._synced_once = False
+            self._persist_local()
+            return False
         self._last_epoch = max(epoch, self._last_epoch)
         self._ttl = float(reply.get("ttl", self._ttl) or self._ttl)
         full = delta = 0
@@ -210,10 +223,17 @@ class StandbyHead:
                         self._store.snapshot_table(table, value)
                 delta += 1
             self._cursors[table] = int(payload.get("seq", 0))
-        for entry in reply.get("placed") or ():
-            idx, tid, att = int(entry[0]), str(entry[1]), int(entry[2])
-            if idx > self._tasks_cursor:
-                self._placed.append((idx, tid, att))
+        placed_full = reply.get("placed_full")
+        if placed_full is not None:
+            # The head's placed journal evicted past our cursor — the
+            # reply carries its whole dedup map; replace, don't merge.
+            self._placed = [(int(i), str(t), int(a))
+                            for i, t, a in placed_full]
+        else:
+            for entry in reply.get("placed") or ():
+                idx, tid, att = int(entry[0]), str(entry[1]), int(entry[2])
+                if idx > self._tasks_cursor:
+                    self._placed.append((idx, tid, att))
         self._placed = self._placed[-tuning.WAL_JOURNAL_MAX:]
         self._tasks_cursor = max(self._tasks_cursor,
                                  int(reply.get("placed_idx", 0) or 0))
@@ -223,6 +243,7 @@ class StandbyHead:
         if full or delta:
             print(f"raytpu standby synced tables={full + delta} "
                   f"full={full} delta={delta}", flush=True)
+        return True
 
     # -- election ------------------------------------------------------------
 
@@ -261,6 +282,7 @@ class StandbyHead:
         # the pending scheduler must see the incumbent's placed log on
         # its first scan, not one poll later.
         with head._lock:
+            head._placed_idx = max(head._placed_idx, self._tasks_cursor)
             for idx, tid, att in self._placed:
                 head._placed[(tid, att)] = True
                 head._placed_log.append((idx, tid, att))

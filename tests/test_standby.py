@@ -11,8 +11,13 @@ Layout mirrors the tentpole's layers:
 - ``TestStandbyReplication`` — an in-process follower tailing a real
   head: replication, cursor persistence across follower restarts,
   election on head death, and the lease/apply failpoints.
-- ``TestTsdbSeqState`` / ``TestPlacedLog`` — the failover-continuity
-  state that rides the ship stream.
+- ``TestEpochChangeResync`` — a reply from a different head epoch is
+  dropped whole (higher: cursors reset for a clean resync; lower:
+  stale incumbent ignored), never applied over stale cursors.
+- ``TestTsdbSeqState`` / ``TestPlacedLog`` / ``TestWarmReplay`` — the
+  failover-continuity state that rides the ship stream, including the
+  full-map/full-replay fallbacks when bounded buffers evicted past a
+  cursor or the staleness window.
 - ``TestStandbyChaos`` (``chaos`` + ``slow``) — real subprocess
   clusters: SIGKILL the active head under load (takeover with NO head
   process restart, in-flight get rides the redirect, queued tasks not
@@ -162,6 +167,28 @@ class TestLeaseEpochFencing:
             assert "k" not in head._kv
         finally:
             cli.close()
+            head.stop()
+
+    def test_renewal_revalidates_record_without_a_gap(self, tmp_path):
+        """A resumed incumbent whose gap check raced the election (the
+        record was rewritten a moment AFTER the one stall-detection
+        read) must still fence: every renewal re-validates the
+        discovery record, not only the gap iteration."""
+        af = str(tmp_path / "head.addr")
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"),
+                          addr_file=af)
+        head.start()
+        try:
+            with open(af, "w") as f:
+                f.write(json.dumps({"address": "127.0.0.1:1",
+                                    "epoch": 7}))
+            head._renew_lease()  # no renewal gap — record alone fences
+            assert head._fenced
+            assert head._redirect_epoch == 7
+            head._store.put("kv", "k", b"v")  # frozen: no-op
+            assert head._store.load_all("kv") == {}
+        finally:
             head.stop()
 
     def test_higher_epoch_frame_self_fences(self, tmp_path):
@@ -352,6 +379,59 @@ class TestStandbyReplication:
             head.stop()
 
 
+class TestEpochChangeResync:
+    def test_new_epoch_reply_dropped_cursors_reset(self, tmp_path):
+        """A reply from a NEW head incarnation was computed against our
+        now-stale cursors (a takeover head numbers disk tables from seq
+        1, journals from 2): applying it would skip the disk baseline
+        and silently diverge. It must be dropped whole — the next poll
+        with zeroed cursors gets correct full resyncs."""
+        sb = StandbyHead("127.0.0.1:1", str(tmp_path / "replica.db"),
+                         addr_file=str(tmp_path / "head.addr"))
+        try:
+            assert sb._apply({"epoch": 1, "ttl": 1.0, "tables": {
+                "kv": {"entries": [[5, "put", "old", b"1"]], "seq": 5}}})
+            assert sb._cursors == {"kv": 5}
+            sb._synced_once = True
+            # Epoch bumped to 2: the in-hand delta must NOT land.
+            assert not sb._apply({"epoch": 2, "ttl": 1.0, "tables": {
+                "kv": {"entries": [[6, "put", "part", b"2"]], "seq": 6}}})
+            assert "part" not in sb._store.load_all("kv")
+            assert sb._cursors == {} and sb._tasks_cursor == 0
+            assert sb._last_epoch == 2
+            # Election is re-gated on a fresh sync at the new epoch —
+            # never serve a half-old-epoch replica.
+            assert not sb._synced_once
+            # The reset persisted: a restarted follower resyncs too.
+            sb._reload_local()
+            assert sb._cursors == {} and sb._last_epoch == 2
+            # Next poll full-resyncs and tailing resumes normally.
+            assert sb._apply({"epoch": 2, "ttl": 1.0, "tables": {
+                "kv": {"full": {"base": b"3"}, "seq": 2}}})
+            assert sb._store.load_all("kv") == {"base": b"3"}
+            assert sb._cursors == {"kv": 2}
+        finally:
+            sb.stop()
+
+    def test_lower_epoch_reply_from_stale_incumbent_dropped(
+            self, tmp_path):
+        sb = StandbyHead("127.0.0.1:1", str(tmp_path / "replica.db"),
+                         addr_file=str(tmp_path / "head.addr"))
+        try:
+            sb._last_epoch = 2
+            sb._cursors = {"kv": 2}
+            # A not-yet-fenced pre-failover head answers: drop, keep
+            # the cursors that track the CURRENT epoch.
+            assert not sb._apply({"epoch": 1, "ttl": 1.0, "tables": {
+                "kv": {"entries": [[9, "put", "stale", b"x"]],
+                       "seq": 9}}})
+            assert sb._store.load_all("kv") == {}
+            assert sb._cursors == {"kv": 2}
+            assert sb._last_epoch == 2
+        finally:
+            sb.stop()
+
+
 # -- failover-continuity state on the ship stream -----------------------------
 
 
@@ -399,6 +479,111 @@ class TestPlacedLog:
             assert out["placed"] == [[2, "t2", 1]]
         finally:
             head.stop()
+
+    def test_evicted_log_ships_full_map_not_silent_gap(self, tmp_path):
+        """A cursor behind the bounded log's eviction horizon cannot be
+        served deltas — the dropped placements would be silently
+        omitted and a successor could double-dispatch. The whole dedup
+        map ships instead (the placed analogue of a table resync)."""
+        from collections import deque
+
+        head = HeadServer("127.0.0.1", 0,
+                          storage_path=str(tmp_path / "gcs.db"))
+        try:
+            with head._lock:
+                head._placed_log = deque(maxlen=4)
+                for i in range(6):
+                    head._record_placed(f"t{i}", 0)
+            # Log retains 3..6: a cursor inside it still gets deltas.
+            out = head._h_wal_ship(None, {}, 4)
+            assert out["placed"] == [[5, "t4", 0], [6, "t5", 0]]
+            assert "placed_full" not in out
+            # Cursor at the exact horizon (oldest retained - 1): the
+            # retained entries cover everything past it — still deltas.
+            out = head._h_wal_ship(None, {}, 2)
+            assert out["placed"] == [[3, "t2", 0], [4, "t3", 0],
+                                     [5, "t4", 0], [6, "t5", 0]]
+            # Cursor 1 predates the horizon (entry 2 evicted): full map
+            # with true indices.
+            out = head._h_wal_ship(None, {}, 1)
+            assert out["placed"] == []
+            assert out["placed_full"] == [[i + 1, f"t{i}", 0]
+                                          for i in range(6)]
+            assert out["placed_idx"] == 6
+        finally:
+            head.stop()
+
+    def test_full_map_replaces_follower_placed(self, tmp_path):
+        sb = StandbyHead("127.0.0.1:1", str(tmp_path / "replica.db"),
+                         addr_file=str(tmp_path / "head.addr"))
+        try:
+            sb._placed = [(1, "ancient", 0)]
+            sb._tasks_cursor = 1
+            assert sb._apply({"epoch": 1, "ttl": 1.0, "tables": {},
+                              "placed_full": [[3, "t3", 0], [4, "t4", 1]],
+                              "placed_idx": 4})
+            # Replace, not merge: the map IS the head's complete state.
+            assert sb._placed == [(3, "t3", 0), (4, "t4", 1)]
+            assert sb._tasks_cursor == 4
+        finally:
+            sb.stop()
+
+
+class _Oid:
+    def __init__(self, h):
+        self._h = h
+
+    def hex(self):
+        return self._h
+
+
+class TestWarmReplay:
+    """Node-side re-registration replay into a warm (standby) head."""
+
+    def _replay(self, hexes, sizes, reports, maxlen, warm=True):
+        from collections import deque
+        from types import SimpleNamespace
+
+        from raytpu.cluster.node import NodeServer
+
+        oids = [_Oid(h) for h in hexes]
+        fake = SimpleNamespace(
+            backend=SimpleNamespace(
+                store=SimpleNamespace(keys=lambda: list(oids))),
+            _recent_obj_reports=deque(reports, maxlen=maxlen),
+            _object_wire_size=lambda oid: sizes[oid.hex()],
+        )
+        return NodeServer._reregister_replay(fake, warm)
+
+    def test_warm_replay_carries_wire_sizes(self):
+        now = time.monotonic()
+        out = self._replay(["aa", "bb"], {"aa": 100, "bb": 200},
+                           reports=[(now, "aa")], maxlen=8)
+        # Only the recent announcement replays — with its real size so
+        # the warm head's locality scorer isn't fed zeros.
+        assert out == [["+", "aa", 100]]
+
+    def test_saturated_recents_fall_back_to_full_replay(self):
+        now = time.monotonic()
+        # The bounded deque is full and its oldest retained entry is
+        # younger than the horizon: announcements inside the window
+        # were provably evicted, so coverage can't be shown — the
+        # whole store replays (aa included despite eviction).
+        out = self._replay(["aa", "bb", "cc"],
+                           {"aa": 1, "bb": 2, "cc": 3},
+                           reports=[(now, "bb"), (now, "cc")], maxlen=2)
+        assert sorted(e[1] for e in out) == ["aa", "bb", "cc"]
+        assert all(e[2] > 0 for e in out)
+
+    def test_unsaturated_recents_filter_by_window(self):
+        now = time.monotonic()
+        stale = now - 2 * tuning.HEAD_SNAPSHOT_PERIOD_S - 60
+        # Room to spare in the deque: nothing was evicted, the window
+        # filter is sound, and pre-window announcements stay skipped
+        # (the shipped snapshot already covers them).
+        out = self._replay(["aa", "bb"], {"aa": 1, "bb": 2},
+                           reports=[(stale, "aa"), (now, "bb")], maxlen=8)
+        assert out == [["+", "bb", 2]]
 
 
 # -- chaos: real subprocess clusters -----------------------------------------
