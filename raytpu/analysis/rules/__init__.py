@@ -48,6 +48,10 @@ RTP016 persist-coverage        every mutation of a persisted head
 RTP017 wal-ship-coverage       every table persisted via GcsStore in
                                head.py appears in the WAL_SHIP_TABLES
                                tuple the wal_ship stream serves
+RTP018 tenant-stamping         every TaskSpec(...) construction passes
+                               tenant= explicitly or carries an inline
+                               suppression naming the channel the
+                               tenant rides instead
 ====== ======================= ====================================
 """
 
@@ -65,6 +69,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     seam_swallow,
     server_span,
     step_loop_blocking,
+    tenant_stamping,
     timing_literals,
     transition_coverage,
     wal_coverage,

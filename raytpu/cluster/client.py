@@ -43,9 +43,11 @@ from raytpu.util.errors import (
     NodeVanishedError,
     PlacementInfeasibleError,
     RpcTimeoutError,
+    TenantThrottled,
 )
 from raytpu.util import metrics as _metrics
 from raytpu.util import task_events
+from raytpu.util import tenancy
 from raytpu.util import tracing
 from raytpu.util.resilience import Deadline, RetryPolicy, breaker_for
 from raytpu.core.ids import (
@@ -134,6 +136,11 @@ class ClusterBackend:
         self._actor_inflight: Dict[ActorID, List[TaskSpec]] = {}
         self._dead_actors: Dict[ActorID, str] = {}      # actor -> reason
         self._pending: List[TaskSpec] = []              # no feasible node yet
+        # Admission-shed specs parked until the head's retry_after
+        # elapses (ready-at monotonic time). The pending loop promotes
+        # due entries back into _pending — honoring the shed instead of
+        # hammering an overloaded head every poll period.
+        self._throttled: List[Tuple[float, TaskSpec]] = []
         self._pgs: Dict[PlacementGroupID, dict] = {}
         self._my_actors: Dict[ActorID, bool] = {}       # actor -> detached
         # Lineage: return oid -> creating spec for plain tasks, so a result
@@ -467,6 +474,17 @@ class ClusterBackend:
             return node_id
         # Arg oids let the head score feasible nodes by the bytes they
         # already hold (appended param — older heads ignore it).
+        # The tenant rides the frame ("tn"), not the args, and this call
+        # often runs on a background thread (pending loop, lineage
+        # reconstruction) whose ambient tenant is empty — re-anchor from
+        # the spec so retries book against the submitting tenant instead
+        # of arriving untenanted and bypassing its quota.
+        if spec.tenant:
+            with tenancy.tenant_scope(spec.tenant):
+                return self._head_call(
+                    "schedule", self._required_resources(spec), None, 0.5,
+                    spec.task_id.hex(),
+                    [o.hex() for o in spec.arg_ref_oids()])
         return self._head_call(
             "schedule", self._required_resources(spec), None, 0.5,
             spec.task_id.hex(), [o.hex() for o in spec.arg_ref_oids()])
@@ -573,6 +591,12 @@ class ClusterBackend:
             if isinstance(p, dict) and p.get("err"):
                 self._fail_refs(spec, RuntimeError(p["err"]))
                 continue
+            if isinstance(p, dict) and p.get("throttled") is not None:
+                # Admission control shed this spec: park it until the
+                # head's retry_after elapses, then resubmit — never
+                # fail it (TenantThrottled is retryable by contract).
+                self._defer_throttled(spec, p.get("throttled"))
+                continue
             if isinstance(p, dict) and p.get("queued"):
                 # The head owns this spec now (durably when storage is
                 # on): its pending scheduler dispatches it when capacity
@@ -676,16 +700,38 @@ class ClusterBackend:
             except Exception as e:
                 errors.swallow("client.free_loop", e)
 
+    def _defer_throttled(self, spec: TaskSpec, retry_after_s) -> None:
+        """Park an admission-shed spec until the head's retry_after
+        elapses; the pending loop promotes it back then."""
+        delay = max(float(retry_after_s or 0.0),
+                    tuning.TENANT_RETRY_DELAY_S)
+        with self._lock:
+            self._throttled.append((time.monotonic() + delay, spec))
+        if task_events.enabled():
+            task_events.emit("task", spec.task_id.hex(),
+                             task_events.TaskTransition.PENDING_SCHED,
+                             name=spec.name, attempt=spec.attempt,
+                             error=f"tenant throttled; retry in "
+                                   f"{delay:.3f}s")
+
     def _pending_loop(self) -> None:
         while not self._shutdown_flag:
             time.sleep(tuning.PENDING_POLL_PERIOD_S)
+            now = time.monotonic()
             with self._lock:
+                if self._throttled:
+                    due = [s for t, s in self._throttled if t <= now]
+                    self._throttled = [(t, s) for t, s in self._throttled
+                                       if t > now]
+                    self._pending.extend(due)
                 pending, self._pending = self._pending, []
             for spec in pending:
                 if self._shutdown_flag:
                     return
                 try:
                     self._route_task(spec)
+                except TenantThrottled as e:
+                    self._defer_throttled(spec, e.retry_after_s)
                 except Exception as e:
                     self._fail_refs(spec, e)
             self._sweep_completed()

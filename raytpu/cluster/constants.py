@@ -86,6 +86,35 @@ LOCALITY_EAGER_PUSH = _i("LOCALITY_EAGER_PUSH", 1) != 0
 # awaiting a coalesced report_objects flush or heartbeat piggyback.
 OBJ_REPORT_BUFFER_MAX = _i("OBJ_REPORT_BUFFER_MAX", 8192)
 
+# -- multi-tenant scheduling -------------------------------------------------
+
+# Master switch for tenant-aware scheduling (quotas, weighted fair
+# queueing, priority preemption, admission shedding). Off by default —
+# with TENANTS=0 placement decisions are decision-identical to the
+# tenant-blind scheduler (the RAYTPU_LOCALITY=0 contract).
+TENANTS = _i("TENANTS", 0) != 0
+# Stride weight for a tenant with no explicit row (higher = larger
+# fair share of the pending-queue replay).
+TENANT_DEFAULT_WEIGHT = _f("TENANT_DEFAULT_WEIGHT", 1.0)
+# Static quota bootstrap parsed at head start, merged under any rows
+# already persisted in the tenants table. Grammar:
+#   "tenantA=CPU:4,TPU:8;tenantB=CPU:2"  (resource ceilings per tenant)
+TENANT_QUOTAS = _s("TENANT_QUOTAS", "")
+# Admission control: a tenant with this many queued (pending/infeasible)
+# specs has further submissions shed with TenantThrottled instead of
+# growing the head's queues unboundedly.
+TENANT_MAX_QUEUED = _i("TENANT_MAX_QUEUED", 1024)
+# retry_after hint carried on TenantThrottled; the client's RetryPolicy
+# sleeps at least this long before re-submitting.
+TENANT_RETRY_DELAY_S = _f("TENANT_RETRY_DELAY_S", 0.5)
+# Priority preemption (within TENANTS): a starved higher-priority
+# tenant may cancel the lowest-priority preemptible running task of an
+# over-quota tenant (lineage re-executes it later).
+TENANT_PREEMPT = _i("TENANT_PREEMPT", 1) != 0
+# Preemptions issued per pending-queue scan — bounds preemption storms
+# to the scan cadence (HEAD_PENDING_SCHED_PERIOD_S).
+TENANT_PREEMPT_MAX_PER_SCAN = _i("TENANT_PREEMPT_MAX_PER_SCAN", 1)
+
 # -- control-plane calls -----------------------------------------------------
 
 # Small metadata RPCs (heartbeat, register, locate, free, failpoint

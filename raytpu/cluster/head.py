@@ -44,10 +44,11 @@ from raytpu.cluster.protocol import (
 from raytpu.util import failpoints
 from raytpu.util import metrics
 from raytpu.util import task_events
+from raytpu.util import tenancy
 from raytpu.util import tracing
 from raytpu.util import tsdb
 from raytpu.util import errors
-from raytpu.util.errors import PlacementInfeasibleError
+from raytpu.util.errors import PlacementInfeasibleError, TenantThrottled
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.resilience import breaker_for
 
@@ -217,7 +218,7 @@ class GcsStore:
 # cannot be silently left out of replication. "meta" holds the
 # epoch-stamped head lease and the replicated TSDB sequencing state.
 WAL_SHIP_TABLES = ("kv", "actors", "pgs", "named", "pending_tasks",
-                   "objects", "borrows", "task_events", "meta")
+                   "objects", "borrows", "task_events", "tenants", "meta")
 
 # RPC methods a fenced (superseded) head still answers: negotiation,
 # liveness probes, chaos-test plumbing, and read-only diagnostics.
@@ -274,6 +275,10 @@ class NodeEntry:
 # must also inherit the set of series needing zeroing.
 _published_resources: set = set()
 
+# Tenant tag values published by the LAST queue-gauge refresh (same
+# zero-on-vanish contract as _published_resources above).
+_published_tenants: set = set()
+
 
 class _HeadMetrics:
     """Built-in cluster metrics on the head's Prometheus registry.
@@ -290,6 +295,8 @@ class _HeadMetrics:
         self.nodes = self.actors = self.pgs = None
         self.resources = self.available = None
         self.schedules = self.tasks_done = self.tasks_submitted = None
+        self.tenant_placed = self.tenant_throttled = None
+        self.tenant_preempted = self.tenant_queued = None
         try:
             from raytpu.util.metrics import Counter, Gauge
 
@@ -317,6 +324,22 @@ class _HeadMetrics:
             self.tasks_submitted = Counter(
                 "raytpu_tasks_submitted_total",
                 "Task specs accepted for scheduling")
+            self.tenant_placed = Counter(
+                "raytpu_tenant_tasks_placed_total",
+                "Placements per tenant",
+                tag_keys=("tenant",))
+            self.tenant_throttled = Counter(
+                "raytpu_tenant_throttled_total",
+                "Submissions shed by admission control per tenant",
+                tag_keys=("tenant",))
+            self.tenant_preempted = Counter(
+                "raytpu_tenant_preempted_total",
+                "Running tasks preempted per (victim) tenant",
+                tag_keys=("tenant",))
+            self.tenant_queued = Gauge(
+                "raytpu_tenant_queued",
+                "Specs queued at the head per tenant",
+                tag_keys=("tenant",))
         except Exception:  # pragma: no cover — metrics are best-effort
             self.nodes = None
 
@@ -358,6 +381,29 @@ class _HeadMetrics:
 
     def tick_task_done(self) -> None:
         self._inc(self.tasks_done)
+
+    def tick_tenant(self, counter, tenant: str) -> None:
+        if counter is not None and tenant:
+            try:
+                counter.inc(1, {"tenant": tenant})
+            except Exception:  # pragma: no cover
+                pass
+
+    def refresh_tenant_queues(self, queued: Dict[str, int]) -> None:
+        """Gauge the per-tenant head backlog. Tenants that drained must
+        read 0, not their last value — the TSDB's staleness rules only
+        retire a series the process stops publishing entirely."""
+        if self.tenant_queued is None:
+            return
+        try:
+            global _published_tenants
+            for t in _published_tenants - set(queued):
+                self.tenant_queued.set(0, {"tenant": t})
+            _published_tenants = set(queued)
+            for t, n in queued.items():
+                self.tenant_queued.set(n, {"tenant": t})
+        except Exception:  # pragma: no cover
+            pass
 
     @staticmethod
     def _inc(counter) -> None:
@@ -489,6 +535,19 @@ class HeadServer:
         # whose submit_batch call died mid-flight may resubmit a spec
         # the head also recovered.
         self._pending_specs: Dict[str, bytes] = {}
+        # Multi-tenant scheduling state. ``_tenants`` rows ("t:<name>" in
+        # the WAL-shipped "tenants" table) hold the durable knobs — quota
+        # ceilings, WFQ weight, priority — plus the fair-queue virtual
+        # pass, so shares don't invert across a standby takeover.
+        # ``_tenant_running`` ("r:<tid>" rows) records in-flight
+        # placements; usage is DERIVED from it on reload, so the hot
+        # path never writes usage rows. ``_pending_meta`` mirrors
+        # ``_pending_specs`` with (tenant, priority) so WFQ ordering
+        # doesn't decode every blob each scan.
+        self._tenants: Dict[str, dict] = {}
+        self._tenant_running: Dict[str, dict] = {}
+        self._tenant_usage: Dict[str, Dict[str, float]] = {}
+        self._pending_meta: Dict[str, Tuple[str, int]] = {}
         # Pending (infeasible) placement groups feed the autoscaler's
         # demand export until the client's retry loop succeeds or gives
         # up; TTL-pruned in _get_demand, never persisted.
@@ -551,6 +610,11 @@ class HeadServer:
         h("metrics_stats", self._h_metrics_stats)
         h("metrics_set_alert_rules", self._h_metrics_set_alert_rules)
         h("metrics_alerts", self._h_metrics_alerts)
+        # Multi-tenant surface: quota/weight/priority upserts and the
+        # per-tenant usage/backlog view behind ``raytpu top --tenants``.
+        h("tenant_set_quota", self._h_tenant_set_quota)
+        h("tenant_info", self._h_tenant_info)
+        h("tenant_list", self._h_tenant_list)
         h("create_pg", self._create_pg)
         h("remove_pg", self._remove_pg)
         h("pg_info", self._pg_info)
@@ -598,6 +662,10 @@ class HeadServer:
                     self._metric_store.restore_seq_state(_json.loads(blob))
                 except Exception as e:
                     errors.swallow("head.tsdb_restore", e)
+        # Env-declared quotas seed tenants the store doesn't know yet;
+        # persisted rows win (an operator's set-quota RPC outlives the
+        # env of whichever incarnation happened to boot first).
+        self._bootstrap_tenants()
         # Epoch rides every rpc_caps reply so head clients learn it at
         # connect time and stamp subsequent frames with it.
         self._rpc.capabilities["head_epoch"] = self._epoch
@@ -628,6 +696,30 @@ class HeadServer:
         # Queued-infeasible specs: the pending scheduler thread replays
         # them once nodes re-register.
         self._pending_specs = dict(self._store.load_all("pending_tasks"))
+        for tid, blob in self._pending_specs.items():
+            try:
+                spec = wire.loads(blob)
+                self._pending_meta[tid] = (
+                    str(getattr(spec, "tenant", "") or ""),
+                    int(getattr(spec, "priority", 0) or 0))
+            except Exception:
+                self._pending_meta[tid] = ("", 0)
+        # Tenant rows + in-flight placement records. Usage is recomputed
+        # from the running records (not persisted per-mutation), so a
+        # takeover restores quota accounting without the placement hot
+        # path ever writing usage rows.
+        for key, blob in self._store.load_all("tenants").items():
+            try:
+                row = _json.loads(blob)
+            except ValueError:
+                continue
+            if not isinstance(row, dict):
+                continue
+            if key.startswith("t:"):
+                self._tenants[key[2:]] = row
+            elif key.startswith("r:"):
+                self._tenant_running[key[2:]] = row
+        self._recompute_tenant_usage()
         # Object directory snapshot: locations for nodes that never
         # re-register are filtered by the alive check in _locate_object
         # and dropped by _mark_dead / the next snapshot; meanwhile a
@@ -708,6 +800,215 @@ class HeadServer:
             self._store.delete("pending_tasks", task_id)
         else:
             self._store.put("pending_tasks", task_id, blob)
+
+    def _persist_tenant(self, name: str) -> None:
+        if self._store is None:
+            return
+        import json as _json
+
+        row = self._tenants.get(name)
+        if row is None:
+            self._store.delete("tenants", f"t:{name}")
+        else:
+            self._store.put("tenants", f"t:{name}",
+                            _json.dumps(row).encode())
+
+    def _persist_tenant_run(self, task_id: str) -> None:
+        if self._store is None:
+            return
+        import json as _json
+
+        rec = self._tenant_running.get(task_id)
+        if rec is None:
+            self._store.delete("tenants", f"r:{task_id}")
+        else:
+            self._store.put("tenants", f"r:{task_id}",
+                            _json.dumps(rec).encode())
+
+    # -- multi-tenant scheduling -------------------------------------------
+
+    def _bootstrap_tenants(self) -> None:
+        """Seed quota rows from ``RAYTPU_TENANT_QUOTAS`` (grammar:
+        ``"a=CPU:4,TPU:8;b=CPU:2"``) for tenants the store has no row
+        for. Malformed clauses are skipped loudly, not fatally — a typo
+        in an env var must not keep the control plane down."""
+        spec = (tuning.TENANT_QUOTAS or "").strip()
+        if not spec:
+            return
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            name, sep, body = clause.partition("=")
+            name = name.strip()
+            if not sep or not name or name in self._tenants:
+                continue
+            quota: Dict[str, float] = {}
+            ok = bool(body.strip())
+            for part in body.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                res, sep2, val = part.partition(":")
+                if not sep2:
+                    ok = False
+                    break
+                try:
+                    quota[res.strip()] = float(val)
+                except ValueError:
+                    ok = False
+                    break
+            if not ok:
+                from raytpu.util.events import record_event as _rec
+
+                self._events.append(_rec(
+                    "ERROR", "TENANT_QUOTA_CONFIG",
+                    f"ignoring malformed RAYTPU_TENANT_QUOTAS clause "
+                    f"{clause!r}"))
+                continue
+            self._tenants[name] = {"quota": quota,
+                                   "weight": tuning.TENANT_DEFAULT_WEIGHT,
+                                   "priority": 0, "pass": 0.0}
+            self._persist_tenant(name)
+
+    def _tenant_row(self, name: str) -> dict:
+        """Caller holds ``self._lock``. First sight of a tenant creates
+        its row with default weight, no quota (unlimited), and a virtual
+        pass clamped to the current minimum among active tenants — an
+        idle tenant must not bank credit and then monopolize the queue."""
+        row = self._tenants.get(name)
+        if row is None:
+            floor = min((float(r.get("pass", 0.0))
+                         for r in self._tenants.values()), default=0.0)
+            row = {"quota": {}, "weight": tuning.TENANT_DEFAULT_WEIGHT,
+                   "priority": 0, "pass": floor}
+            self._tenants[name] = row
+        return row
+
+    def _recompute_tenant_usage(self) -> None:
+        """Caller holds ``self._lock`` (or runs pre-start). Rebuild the
+        derived usage map from the running records."""
+        usage: Dict[str, Dict[str, float]] = {}
+        for rec in self._tenant_running.values():
+            t = rec.get("tenant") or ""
+            if not t:
+                continue
+            u = usage.setdefault(t, {})
+            for k, v in (rec.get("resources") or {}).items():
+                u[k] = u.get(k, 0.0) + float(v)
+        self._tenant_usage = usage
+
+    def _tenant_over_quota(self, name: str,
+                           requested: Dict[str, float]) -> bool:
+        """Caller holds ``self._lock``. True when placing ``requested``
+        would push any resource past the tenant's ceiling. No quota row
+        (or an empty quota) means unlimited."""
+        row = self._tenants.get(name)
+        quota = (row or {}).get("quota") or {}
+        if not quota:
+            return False
+        usage = self._tenant_usage.get(name, {})
+        for res, ceiling in quota.items():
+            if usage.get(res, 0.0) + requested.get(res, 0.0) \
+                    > float(ceiling) + 1e-9:
+                return True
+        return False
+
+    def _tenant_debit(self, tid: str, tenant_ctx: dict,
+                      resources: Dict[str, float], node_id: str) -> None:
+        """Caller holds ``self._lock``. Record an in-flight placement and
+        debit the tenant's usage (in-memory; the caller persists the
+        ``r:`` row after the lock drops)."""
+        name = tenant_ctx.get("tenant") or ""
+        self._tenant_running[tid] = {
+            "tenant": name, "resources": dict(resources),
+            "node": node_id,
+            "priority": int(tenant_ctx.get("priority", 0) or 0),
+            "preemptible": bool(tenant_ctx.get("preemptible", True)),
+        }
+        u = self._tenant_usage.setdefault(name, {})
+        for k, v in resources.items():
+            u[k] = u.get(k, 0.0) + float(v)
+
+    def _tenant_credit(self, tid: str) -> bool:
+        """Caller holds ``self._lock``. Retire a running record and
+        credit its tenant's usage back. Returns True when a record
+        existed (the caller persists the deletion after the lock)."""
+        rec = self._tenant_running.pop(tid, None)
+        if rec is None:
+            return False
+        name = rec.get("tenant") or ""
+        u = self._tenant_usage.get(name)
+        if u is not None:
+            for k, v in (rec.get("resources") or {}).items():
+                u[k] = u.get(k, 0.0) - float(v)
+                if u[k] <= 1e-9:
+                    u.pop(k, None)
+            if not u:
+                self._tenant_usage.pop(name, None)
+        return True
+
+    def _tenant_queued_counts(self) -> Dict[str, int]:
+        """Caller holds ``self._lock``."""
+        counts: Dict[str, int] = {}
+        for t, _prio in self._pending_meta.values():
+            if t:
+                counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def _note_queued(self, tid: str, tenant: str, priority: int) -> None:
+        """Caller holds ``self._lock``. Track a queued spec's tenant and
+        clamp a newly-active tenant's pass (see ``_tenant_row``)."""
+        self._pending_meta[tid] = (tenant, int(priority))
+        if tuning.TENANTS and tenant:
+            self._tenant_row(tenant)
+
+    def _h_tenant_set_quota(self, peer: Peer, tenant: str,
+                            quota: Optional[Dict[str, float]] = None,
+                            weight: Optional[float] = None,
+                            priority: Optional[int] = None) -> dict:
+        if not tenant or not isinstance(tenant, str):
+            raise ValueError("tenant name required")
+        with self._lock:
+            row = self._tenant_row(tenant)
+            if quota is not None:
+                row["quota"] = {str(k): float(v)
+                                for k, v in dict(quota).items()}
+            if weight is not None:
+                w = float(weight)
+                if w <= 0:
+                    raise ValueError("tenant weight must be > 0")
+                row["weight"] = w
+            if priority is not None:
+                row["priority"] = int(priority)
+            out = dict(row)
+        self._persist_tenant(tenant)
+        return out
+
+    def _tenant_view_locked(self, name: str) -> dict:
+        row = self._tenants.get(name, {})
+        queued = sum(1 for t, _p in self._pending_meta.values()
+                     if t == name)
+        running = sum(1 for r in self._tenant_running.values()
+                      if (r.get("tenant") or "") == name)
+        return {"tenant": name,
+                "quota": dict(row.get("quota") or {}),
+                "weight": float(row.get("weight",
+                                        tuning.TENANT_DEFAULT_WEIGHT)),
+                "priority": int(row.get("priority", 0)),
+                "pass": float(row.get("pass", 0.0)),
+                "usage": dict(self._tenant_usage.get(name, {})),
+                "queued": queued, "running": running}
+
+    def _h_tenant_info(self, peer: Peer, tenant: str) -> dict:
+        with self._lock:
+            return self._tenant_view_locked(tenant)
+
+    def _h_tenant_list(self, peer: Peer) -> List[dict]:
+        with self._lock:
+            names = set(self._tenants) | set(self._tenant_usage)
+            names.update(t for t, _p in self._pending_meta.values() if t)
+            return [self._tenant_view_locked(n) for n in sorted(names)]
 
     def _snapshot(self) -> None:
         """Write-behind durability for the derived/hot tables: the object
@@ -1239,6 +1540,15 @@ class HeadServer:
                 aid for aid, info in self._actors.items()
                 if info["node_id"] == node_id and info["state"] == "alive"
             ]
+            # Tenant usage held by the dead node's in-flight tasks is
+            # freed now — task_done will never arrive for them, and a
+            # leaked debit would throttle the tenant forever.
+            credited_runs = [
+                tid for tid, rec in self._tenant_running.items()
+                if rec.get("node") == node_id
+            ]
+            for tid in credited_runs:
+                self._tenant_credit(tid)
             lost_objects = []
             for oid in list(self._objects):
                 self._objects[oid].discard(node_id)
@@ -1255,6 +1565,8 @@ class HeadServer:
                         (None if n == node_id else n) for n in pg["nodes"]
                     ]
                     self._persist_pg(pg_id)
+        for tid in credited_runs:
+            self._persist_tenant_run(tid)
         if task_events.enabled():
             task_events.emit("node", node_id,
                              task_events.TaskTransition.NODE_DIED,
@@ -1327,6 +1639,10 @@ class HeadServer:
     def _task_done(self, peer: Peer, task_id_hex: str,
                    node_id: str) -> None:
         self._metrics.tick_task_done()
+        with self._lock:
+            credited = self._tenant_credit(task_id_hex)
+        if credited:
+            self._persist_tenant_run(task_id_hex)
         self._publish("tasks", {"event": "done", "task_id": task_id_hex,
                                 "node_id": node_id})
 
@@ -1582,6 +1898,24 @@ class HeadServer:
         ``arg_oids`` (appended param, older clients omit it) lets the
         locality scorer steer the decision toward the feasible node
         already holding the most argument bytes."""
+        if tuning.TENANTS:
+            # Admission control on the per-call path mirrors the batched
+            # one: a tenant whose head backlog is at its queued budget
+            # gets a typed retryable shed (the client's RetryPolicy
+            # honors retry_after_s) instead of deepening the overload.
+            t = tenancy.current_tenant()
+            if t:
+                with self._lock:
+                    backlog = sum(
+                        1 for tt, _p in self._pending_meta.values()
+                        if tt == t)
+                if failpoint("head.admission") is DROP or \
+                        backlog >= tuning.TENANT_MAX_QUEUED:
+                    self._metrics.tick_tenant(
+                        self._metrics.tenant_throttled, t)
+                    raise TenantThrottled(
+                        t, tuning.TENANT_RETRY_DELAY_S,
+                        "tenant backlog at head queue budget")
         # The decision span links a driver's submit span to the chosen
         # node's execution span; the outcome rides as an attribute.
         with tracing.span("sched.decide") as attrs:
@@ -1602,14 +1936,28 @@ class HeadServer:
                        spread_threshold: float = 0.5,
                        req_id: Optional[str] = None,
                        arg_oids: Optional[List[str]] = None,
-                       attrs: Optional[dict] = None) -> Optional[str]:
+                       attrs: Optional[dict] = None,
+                       tenant_ctx: Optional[dict] = None) -> Optional[str]:
         self._metrics.tick_schedule()
+        if tenant_ctx is None and tuning.TENANTS:
+            # Bare schedule() RPC: the tenant rides the frame ("tn"),
+            # re-anchored per dispatch, not the call signature.
+            t = tenancy.current_tenant()
+            if t:
+                tenant_ctx = {"tenant": t, "priority": 0,
+                              "preemptible": True}
         deferred: List[tuple] = []
         with self._lock:
             node_id = self._schedule_locked(resources, node_hint,
                                             spread_threshold, req_id,
-                                            arg_oids, attrs, deferred)
+                                            arg_oids, attrs, deferred,
+                                            tenant_ctx)
         self._run_eager_pushes(deferred)
+        if node_id is not None and req_id and tuning.TENANTS and \
+                tenant_ctx and tenant_ctx.get("tenant"):
+            self._persist_tenant_run(req_id)
+            self._metrics.tick_tenant(self._metrics.tenant_placed,
+                                      tenant_ctx["tenant"])
         return node_id
 
     def _schedule_locked(self, resources: Dict[str, float],
@@ -1618,13 +1966,32 @@ class HeadServer:
                          req_id: Optional[str] = None,
                          arg_oids: Optional[List[str]] = None,
                          attrs: Optional[dict] = None,
-                         deferred: Optional[List[tuple]] = None
+                         deferred: Optional[List[tuple]] = None,
+                         tenant_ctx: Optional[dict] = None
                          ) -> Optional[str]:
         """One placement decision. Caller holds ``self._lock`` — the
         batched submit path places a whole burst under one acquisition.
         Pure compute by contract (lint rule RTP013): side effects the
         decision wants (eager arg pushes) are appended to ``deferred``
-        for the caller to fire after the lock is released."""
+        for the caller to fire after the lock is released.
+
+        ``tenant_ctx`` (``{"tenant", "priority", "preemptible"}``) arms
+        the quota gate: an over-ceiling tenant's request reads as
+        infeasible (queued, not failed — capacity its peers free up
+        re-admits it), and a placement is debited against the tenant's
+        in-flight usage. ``RAYTPU_TENANTS=0`` never reaches this branch,
+        so the decision sequence is identical to the blind scheduler."""
+        tenant = (tenant_ctx or {}).get("tenant") or "" \
+            if tuning.TENANTS else ""
+        if tenant:
+            forced = failpoint("sched.quota_check") is DROP
+            if forced or self._tenant_over_quota(tenant, resources):
+                key = req_id or os.urandom(8).hex()
+                self._unmet[key] = (time.monotonic(), dict(resources))
+                if attrs is not None:
+                    attrs["quota_hit"] = \
+                        int(attrs.get("quota_hit") or 0) + 1
+                return None
         feasible = []
         for entry in self._nodes.values():
             if not entry.alive or entry.labels.get("role") == "driver":
@@ -1676,6 +2043,11 @@ class HeadServer:
         if deferred is not None and arg_oids and tuning.LOCALITY and \
                 tuning.LOCALITY_EAGER_PUSH:
             self._queue_eager_pushes(best.node_id, arg_oids, deferred)
+        if tenant and req_id:
+            # In-memory debit only; the caller persists the r: row after
+            # the lock drops (RTP013 keeps this region compute-only).
+            self._tenant_debit(req_id, tenant_ctx, resources,
+                               best.node_id)
         return best.node_id
 
     def _locality_filter(self, feasible: List["NodeEntry"],
@@ -1767,11 +2139,24 @@ class HeadServer:
         placements: List[Any] = []
         deferred: List[tuple] = []
         persist: List[str] = []
+        persist_runs: List[str] = []
+        shed: List[str] = []
         with tracing.span("sched.decide") as attrs:
             with self._lock:
+                queued_counts = self._tenant_queued_counts() \
+                    if tuning.TENANTS else {}
                 for spec in specs:
                     self._metrics.tick_schedule()
                     tid = spec.task_id.hex()
+                    tenant = str(getattr(spec, "tenant", "") or "")
+                    priority = int(getattr(spec, "priority", 0) or 0)
+                    tenant_ctx = None
+                    if tuning.TENANTS and tenant:
+                        tenant_ctx = {
+                            "tenant": tenant, "priority": priority,
+                            "preemptible": bool(getattr(
+                                spec, "preemptible", True)),
+                        }
                     # Failover dedup: a driver resubmitting across a
                     # head failover must not double-launch a task this
                     # head (via WAL-shipped state) already owns queued
@@ -1783,14 +2168,33 @@ class HeadServer:
                         continue
                     if tid in self._pending_specs:
                         self._pending_specs[tid] = wire.dumps(spec)
+                        self._note_queued(tid, tenant, priority)
                         persist.append(tid)
                         placements.append({"queued": True})
                         continue
+                    if tenant_ctx is not None:
+                        # Admission control: a tenant whose head backlog
+                        # is already at its queued-spec budget is shed
+                        # with a typed retry-after instead of growing
+                        # the pending table without bound (overload
+                        # protection, not fairness — the WFQ replay
+                        # handles fairness among admitted work). Dedup
+                        # ran first: resubmissions of specs this head
+                        # already owns never read as new load.
+                        forced = failpoint("head.admission") is DROP
+                        if forced or queued_counts.get(tenant, 0) \
+                                >= tuning.TENANT_MAX_QUEUED:
+                            placements.append({
+                                "throttled":
+                                    tuning.TENANT_RETRY_DELAY_S,
+                                "tenant": tenant})
+                            shed.append(tenant)
+                            continue
                     try:
                         arg_oids = [o.hex() for o in spec.arg_ref_oids()]
                         node_id = self._schedule_locked(
                             dict(spec.resources or {}), None, 0.5,
-                            tid, arg_oids, attrs, deferred)
+                            tid, arg_oids, attrs, deferred, tenant_ctx)
                     except Exception as e:  # noqa: BLE001 — per-spec fault
                         placements.append({"err": str(e)})
                         continue
@@ -1800,11 +2204,18 @@ class HeadServer:
                         # from here, not from a driver that may be
                         # blocked in get() across the bounce.
                         self._pending_specs[tid] = wire.dumps(spec)
+                        self._note_queued(tid, tenant, priority)
+                        if tenant:
+                            queued_counts[tenant] = \
+                                queued_counts.get(tenant, 0) + 1
                         persist.append(tid)
                         placements.append({"queued": True})
                         continue
                     if self._pending_specs.pop(tid, None) is not None:
+                        self._pending_meta.pop(tid, None)
                         persist.append(tid)
+                    if tenant_ctx is not None:
+                        persist_runs.append(tid)
                     entry = self._nodes.get(node_id)
                     placements.append(
                         {"node_id": node_id,
@@ -1814,6 +2225,16 @@ class HeadServer:
             # re-runs the driver's own retry path.
             for tid in persist:
                 self._persist_pending_task(tid)
+            for tid in persist_runs:
+                self._persist_tenant_run(tid)
+            for spec, p in zip(specs, placements):
+                if isinstance(p, dict) and p.get("node_id") and \
+                        getattr(spec, "tenant", ""):
+                    self._metrics.tick_tenant(self._metrics.tenant_placed,
+                                              spec.tenant)
+            for tenant in shed:
+                self._metrics.tick_tenant(self._metrics.tenant_throttled,
+                                          tenant)
             self._run_eager_pushes(deferred)
             attrs["batch"] = len(placements)
             attrs["node"] = sum(1 for p in placements
@@ -1827,18 +2248,143 @@ class HeadServer:
                             node_id=p["node_id"])
         return placements
 
+    def _wfq_order_locked(self) -> List[Tuple[str, bytes]]:
+        """Caller holds ``self._lock``. Order the queued specs for one
+        replay scan. Tenancy off (or everything untenanted): insertion
+        order — byte-identical to the historical FIFO. Tenancy on:
+        weighted fair queueing by stride — each tenant carries a virtual
+        ``pass``; the scan interleaves tenants lowest-pass-first,
+        advancing a scratch pass by 1/weight per spec taken, FIFO within
+        a tenant. The COMMITTED pass only advances on successful
+        dispatch (below), so a scan that places nothing reorders
+        nothing. Starvation-free: every dispatch pushes the winner's
+        pass up, so the minimum rotates; a newly-active tenant starts at
+        the current floor (``_tenant_row``) and cannot monopolize with
+        banked idle credit. Untenanted specs keep their FIFO position
+        under the reserved empty-name tenant at weight 1."""
+        items = list(self._pending_specs.items())
+        if not tuning.TENANTS or len(items) < 2:
+            return items
+        by_tenant: Dict[str, List[Tuple[str, bytes]]] = {}
+        for tid, blob in items:
+            t, _prio = self._pending_meta.get(tid, ("", 0))
+            by_tenant.setdefault(t, []).append((tid, blob))
+        if len(by_tenant) < 2:
+            return items
+        scratch: Dict[str, float] = {}
+        stride: Dict[str, float] = {}
+        for t in by_tenant:
+            row = self._tenants.get(t) or {}
+            scratch[t] = float(row.get("pass", 0.0))
+            stride[t] = 1.0 / max(
+                float(row.get("weight", tuning.TENANT_DEFAULT_WEIGHT)),
+                1e-6)
+        ordered: List[Tuple[str, bytes]] = []
+        queues = {t: deque(q) for t, q in by_tenant.items()}
+        while queues:
+            t = min(queues, key=lambda n: (scratch[n], n))
+            ordered.append(queues[t].popleft())
+            scratch[t] += stride[t]
+            if not queues[t]:
+                del queues[t]
+        return ordered
+
+    def _tenant_at_quota_locked(self, name: str) -> bool:
+        """Caller holds ``self._lock``. True when the tenant has a quota
+        and its usage has reached (or exceeded) the ceiling on any
+        quota'd resource — it holds its full entitlement."""
+        row = self._tenants.get(name)
+        quota = (row or {}).get("quota") or {}
+        if not quota:
+            return False
+        usage = self._tenant_usage.get(name, {})
+        return any(usage.get(res, 0.0) >= float(ceiling) - 1e-9
+                   for res, ceiling in quota.items())
+
+    def _pick_preempt_victim_locked(
+            self, tenant: str, priority: int) -> Optional[Tuple[str, dict]]:
+        """Caller holds ``self._lock``. A queued spec of ``tenant`` at
+        ``priority`` found no capacity: pick the lowest-priority
+        preemptible running task belonging to another tenant that is at
+        or over its quota, with strictly lower priority. At-quota is the
+        fairness predicate — a tenant still inside its ceiling keeps
+        what it placed; preemption only claws back capacity held at or
+        beyond a tenant's full entitlement."""
+        best: Optional[Tuple[str, dict]] = None
+        for tid, rec in self._tenant_running.items():
+            vt = rec.get("tenant") or ""
+            if not rec.get("preemptible") or vt == tenant:
+                continue
+            if int(rec.get("priority", 0)) >= priority:
+                continue
+            if not self._tenant_at_quota_locked(vt):
+                continue
+            if best is None or (
+                    int(rec.get("priority", 0)),
+                    tid) < (int(best[1].get("priority", 0)), best[0]):
+                best = (tid, rec)
+        return best
+
+    def _preempt_for(self, tid: str, spec) -> bool:
+        """Issue at most one preemption on behalf of a starved queued
+        spec: cancel the victim on its node (lineage re-execution
+        recovers the victim's work later) and credit its usage so the
+        next scan sees the freed quota. Returns True when a cancel was
+        dispatched."""
+        tenant, priority = self._pending_meta.get(tid, ("", 0))
+        if not tenant or priority <= 0:
+            return False
+        with self._lock:
+            victim = self._pick_preempt_victim_locked(tenant, priority)
+            if victim is None:
+                return False
+            vtid, rec = victim
+            entry = self._nodes.get(rec.get("node") or "")
+            address = entry.address if entry and entry.alive else None
+            # Credit now, not at task_done: the cancel's failure path
+            # doesn't report done, and a double-credit is impossible
+            # because the record is popped here.
+            self._tenant_credit(vtid)
+        self._persist_tenant_run(vtid)
+        self._metrics.tick_tenant(self._metrics.tenant_preempted,
+                                  rec.get("tenant") or "")
+        from raytpu.util.events import record_event
+
+        with self._lock:
+            self._events.append(record_event(
+                "WARNING", "TENANT_PREEMPTED",
+                f"task {vtid[:8]} of tenant {rec.get('tenant')!r} "
+                f"preempted for tenant {tenant!r} (priority {priority})",
+                tenant=rec.get("tenant"), for_tenant=tenant))
+        if address is None:
+            return True  # victim's node already gone; usage freed
+        try:
+            self._node_client(rec["node"], address).call(
+                "cancel_task", bytes.fromhex(vtid),
+                timeout=tuning.CONTROL_CALL_TIMEOUT_S,
+                breaker=breaker_for(address))
+        except Exception as e:
+            errors.swallow("head.preempt_cancel", e)
+        return True
+
     def _pending_sched_loop(self) -> None:
         """Re-drive queued-infeasible TaskSpecs — including ones reloaded
         from durable storage after a bounce — once capacity appears. The
         head dials the chosen node itself (``submit_task``), so a queued
         task completes even if its driver spends the whole window blocked
         in get(); the result flows back through the object directory as
-        usual. Failed dispatches stay queued for the next scan."""
+        usual. Failed dispatches stay queued for the next scan. With
+        tenancy on the scan order is weighted-fair (``_wfq_order_locked``)
+        and a starved high-priority spec may preempt (``_preempt_for``),
+        capped per scan so one hot tenant cannot mass-evict a cluster."""
         while not self._stop.wait(tuning.HEAD_PENDING_SCHED_PERIOD_S):
             if self._fenced:
                 continue  # the elected head owns dispatch now
             with self._lock:
-                batch = list(self._pending_specs.items())
+                batch = self._wfq_order_locked()
+            preempts_left = tuning.TENANT_PREEMPT_MAX_PER_SCAN \
+                if tuning.TENANTS and tuning.TENANT_PREEMPT else 0
+            pass_dirty: Set[str] = set()
             for tid, blob in batch:  # rpc-loop-ok: queued-spec replay, cold path gated on spare capacity
                 if self._stop.is_set():
                     return
@@ -1851,26 +2397,43 @@ class HeadServer:
                         att = int(getattr(spec, "attempt", 0) or 0)
                         if (tid, att) in self._placed:
                             self._pending_specs.pop(tid, None)
+                            self._pending_meta.pop(tid, None)
                             dropped_placed = True
                         else:
                             dropped_placed = False
                     if dropped_placed:
                         self._persist_pending_task(tid)
                         continue
+                    tenant_ctx = None
+                    if tuning.TENANTS and \
+                            getattr(spec, "tenant", ""):
+                        tenant_ctx = {
+                            "tenant": spec.tenant,
+                            "priority": int(getattr(spec, "priority", 0)
+                                            or 0),
+                            "preemptible": bool(getattr(
+                                spec, "preemptible", True)),
+                        }
                     arg_oids = [o.hex() for o in spec.arg_ref_oids()]
                     node_id = self._schedule_impl(
                         None, dict(spec.resources or {}), None, 0.5,
-                        tid, arg_oids, None)
+                        tid, arg_oids, None, tenant_ctx)
                 except Exception as e:
                     errors.swallow("head.pending_sched", e)
                     continue
                 if node_id is None:
-                    continue  # still infeasible; _unmet stays fresh
+                    # Still infeasible; _unmet stays fresh. A priority
+                    # tenant's starved spec may claw back capacity from
+                    # an over-quota lower-priority one.
+                    if preempts_left > 0 and self._preempt_for(tid, spec):
+                        preempts_left -= 1
+                    continue
                 with self._lock:
                     entry = self._nodes.get(node_id)
                     address = entry.address if entry and entry.alive \
                         else None
                 if address is None:
+                    self._undo_tenant_dispatch(tid, tenant_ctx)
                     continue
                 try:
                     self._node_client(node_id, address).call(
@@ -1881,6 +2444,7 @@ class HeadServer:
                     # Node refused/died: keep the spec queued; the
                     # optimistic debit is corrected by its heartbeat.
                     errors.swallow("head.pending_dispatch", e)
+                    self._undo_tenant_dispatch(tid, tenant_ctx)
                     continue
                 with self._lock:
                     # Record the dispatch BEFORE dropping the queued
@@ -1891,11 +2455,41 @@ class HeadServer:
                                         int(getattr(spec, "attempt", 0)
                                             or 0))
                     self._pending_specs.pop(tid, None)
+                    self._pending_meta.pop(tid, None)
+                    if tenant_ctx is not None:
+                        # Commit the fair-queue debt only for work that
+                        # actually dispatched; the scratch ordering pass
+                        # is discarded every scan.
+                        row = self._tenant_row(tenant_ctx["tenant"])
+                        row["pass"] = float(row.get("pass", 0.0)) + \
+                            1.0 / max(float(row.get(
+                                "weight",
+                                tuning.TENANT_DEFAULT_WEIGHT)), 1e-6)
+                        pass_dirty.add(tenant_ctx["tenant"])
                 self._persist_pending_task(tid)
                 if task_events.enabled():
                     task_events.emit("task", tid,
                                      task_events.TaskTransition.SCHEDULED,
                                      node_id=node_id)
+            for t in pass_dirty:
+                self._persist_tenant(t)
+            if tuning.TENANTS:
+                with self._lock:
+                    counts = self._tenant_queued_counts()
+                self._metrics.refresh_tenant_queues(counts)
+
+    def _undo_tenant_dispatch(self, tid: str,
+                              tenant_ctx: Optional[dict]) -> None:
+        """A placement decision was made (and debited) but the dispatch
+        never reached a node: roll the tenant's in-flight debit back so
+        the quota doesn't leak — the spec stays queued and will debit
+        again when it actually goes out."""
+        if tenant_ctx is None:
+            return
+        with self._lock:
+            existed = self._tenant_credit(tid)
+        if existed:
+            self._persist_tenant_run(tid)
 
     # -- actor directory ---------------------------------------------------
 
@@ -2192,12 +2786,28 @@ class HeadServer:
             raise
         with self._lock:
             self._pg_demand.pop(pg_id, None)
+            stamped = f"pg:{pg_id}" in self._tenant_running
+        if stamped:
+            self._persist_tenant_run(f"pg:{pg_id}")
         return result
 
     def _create_pg_impl(self, peer: Peer, pg_id: str,
                         bundles: List[Dict[str, float]],
                         strategy: str) -> dict:
+        # PG reservations count against the requesting tenant's quota —
+        # an over-ceiling reservation reads as infeasible (retried by
+        # the client's bounded create loop, admitted when peers release
+        # capacity), exactly like a task placement would.
+        tenant = tenancy.current_tenant() if tuning.TENANTS else ""
+        pg_total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in (b or {}).items():
+                pg_total[k] = pg_total.get(k, 0.0) + float(v)
         with self._lock:
+            if tenant and self._tenant_over_quota(tenant, pg_total):
+                raise PlacementInfeasibleError(
+                    f"tenant {tenant!r} over quota for placement group "
+                    f"{pg_id[:8]}")
             alive = [n for n in self._nodes.values()
                      if n.alive and n.labels.get("role") != "driver"]
             placement: List[Optional[str]] = [None] * len(bundles)
@@ -2273,8 +2883,16 @@ class HeadServer:
                 self._nodes[node_id].available = avail
             self._pgs[pg_id] = {"bundles": list(bundles),
                                 "nodes": placement,
-                                "strategy": strategy}
+                                "strategy": strategy,
+                                "tenant": tenant}
             self._persist_pg(pg_id)
+            if tenant:
+                # Reservations are never preemptible (tasks inside the
+                # group are cancelled individually, not the group).
+                self._tenant_debit(f"pg:{pg_id}",
+                                   {"tenant": tenant, "priority": 0,
+                                    "preemptible": False},
+                                   pg_total, "")
             return {"nodes": placement}
 
     def _remove_pg(self, peer: Peer, pg_id: str) -> None:
@@ -2289,6 +2907,9 @@ class HeadServer:
                 if entry is not None and entry.alive:
                     for k, v in b.items():
                         entry.available[k] = entry.available.get(k, 0.0) + v
+            credited = self._tenant_credit(f"pg:{pg_id}")
+        if credited:
+            self._persist_tenant_run(f"pg:{pg_id}")
 
     def _pg_info(self, peer: Peer, pg_id: str) -> Optional[dict]:
         with self._lock:

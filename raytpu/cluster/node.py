@@ -38,6 +38,7 @@ from raytpu.core.config import cfg
 from raytpu.util import failpoints
 from raytpu.util import metrics
 from raytpu.util import task_events
+from raytpu.util import tenancy
 from raytpu.util import tracing
 from raytpu.util.failpoints import DROP, failpoint
 from raytpu.util.events import record_event
@@ -1509,6 +1510,7 @@ class NodeServer:
             args=_xlang_args(args),
             num_returns=max(1, int(num_returns)),
             resources={"CPU": float(num_cpus)} if num_cpus else {},
+            tenant=tenancy.current_tenant(),
         )
         self.backend.submit_task(spec)
         return [oid.hex() for oid in spec.return_ids()]
@@ -1525,7 +1527,11 @@ class NodeServer:
         from raytpu.runtime.task_spec import ActorCreationSpec
 
         actor_id = ActorID.from_random()
-        spec = TaskSpec(
+        # System-internal path: the caller's tenant rides the anchored
+        # frame context into the nested register_actor/kv_put head calls
+        # (RpcClient re-stamps "tn" from the contextvar), so the actor is
+        # billed to its creator without a spec-level field here.
+        spec = TaskSpec(  # raytpulint: disable=RTP018 tenant rides the anchored frame context
             task_id=TaskID.for_actor_creation(actor_id),
             job_id=self.backend.worker.job_id,
             name=name or f"xlang-actor::{class_ref}",
@@ -1556,7 +1562,11 @@ class NodeServer:
         from raytpu.core.ids import ActorID, TaskID
 
         actor_id = ActorID.from_hex(actor_id_hex)
-        spec = TaskSpec(
+        # System-internal path: an actor method executes on the already-
+        # placed actor process — accounting follows the actor's creation
+        # tenant, and a per-call stamp here would let a caller re-bill an
+        # actor's work to a different tenant mid-life.
+        spec = TaskSpec(  # raytpulint: disable=RTP018 accounting follows the actor's creation tenant
             task_id=TaskID.from_random(),
             job_id=self.backend.worker.job_id,
             name=f"xlang::{actor_id_hex[:8]}.{method}",
@@ -1638,6 +1648,10 @@ class NodeServer:
     def _h_cancel_task(self, peer: Peer, task_id_bin: bytes) -> None:
         from raytpu.core.ids import TaskID
 
+        # The head's priority-preemption path rides this same RPC; the
+        # failpoint lets chaos tests force mid-preemption death (the
+        # victim keeps running, lineage re-execution must still converge).
+        failpoint("node.preempt_task")
         self.backend.cancel_task(TaskID(task_id_bin))
 
     def _h_fetch_object(self, peer: Peer, oid_hex: str) -> Optional[bytes]:

@@ -33,6 +33,7 @@ from raytpu.cluster import constants as tuning
 from raytpu.util import errors
 from raytpu.util.errors import DeadlineExceeded, RpcTimeoutError
 from raytpu.util.failpoints import DROP, failpoint
+from raytpu.util import tenancy
 from raytpu.util import tracing
 from raytpu.util.resilience import (
     Deadline,
@@ -338,6 +339,12 @@ class RpcServer:
                 if isinstance(tc_wire, (list, tuple)) else None)
         ttoken = tracing.set_current_trace(tctx) \
             if tctx is not None else None
+        # A "tn" field is the caller's tenant identity. Same per-task
+        # anchoring: handlers (admission, quota accounting, xlang spec
+        # construction) read it via tenancy.current_tenant().
+        tenant = tenancy.from_wire(frame.get("tn"))
+        tntoken = tenancy.set_current_tenant(tenant) \
+            if tenant is not None else None
         try:
             if self.frame_gate is not None:
                 gate_exc = self.frame_gate(peer, frame)
@@ -363,6 +370,8 @@ class RpcServer:
                 reset_current_deadline(token)
             if ttoken is not None:
                 tracing.reset_current_trace(ttoken)
+            if tntoken is not None:
+                tenancy.reset_current_tenant(tntoken)
         if req_id is not None and not peer.closed:
             if peer.meta.get("rpc_batch"):
                 # Batch-capable peer: replies ride the coalescing outbox,
@@ -625,6 +634,9 @@ class RpcClient:
             frame["ep"] = self.epoch
         if deadline is not None:
             frame["d"] = deadline.to_wire()
+        tn = tenancy.to_wire()
+        if tn is not None:
+            frame["tn"] = tn
         tc = trace if trace is not None else tracing.current_trace()
         if not tracing.enabled():
             # Untraced hop in a traced request: forward the inbound
@@ -680,6 +692,9 @@ class RpcClient:
         frame = {"m": method, "a": args}
         if self.epoch is not None:
             frame["ep"] = self.epoch
+        tn = tenancy.to_wire()
+        if tn is not None:
+            frame["tn"] = tn
         self._send(frame)
 
     def _send(self, frame: dict) -> None:

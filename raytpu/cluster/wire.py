@@ -73,6 +73,9 @@ FRAME_FIELDS = {
           "or superseded head rejects mismatched epochs with "
           "HeadRedirect (split-brain fencing); absent on frames from "
           "peers that have not yet learned an epoch",
+    "tn": "tenant identity (str, tenancy.to_wire — primitives only); "
+          "absent when the sender has no ambient tenant, so the "
+          "untenanted wire stays byte-identical to the pre-tenancy wire",
 }
 
 _EXT_STRUCT = 1

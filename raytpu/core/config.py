@@ -128,6 +128,24 @@ declare_env("RAYTPU_BREAKER_RESET_TIMEOUT_S", "circuit-breaker half-open delay (
 declare_env("RAYTPU_USAGE_STATS_ENABLED", "opt-in anonymous usage stats (bool)")
 declare_env("RAYTPU_USAGE_STATS_PATH", "override usage-stats spool path")
 
+# Tenancy (util/tenancy.py, cluster/constants.py): the identity is read
+# at import (before any config snapshot) so worker subprocesses inherit
+# their driver's tenant; the scheduler knobs are cluster constants.
+declare_env("RAYTPU_TENANT", "default tenant identity for this process tree")
+declare_env("RAYTPU_TENANTS",
+            "master switch: tenant-aware scheduling (quotas/WFQ/preemption)")
+declare_env("RAYTPU_TENANT_DEFAULT_WEIGHT", "fair-queue weight for unknown tenants")
+declare_env("RAYTPU_TENANT_QUOTAS",
+            "static quota bootstrap: 'a=CPU:4,TPU:8;b=CPU:2'")
+declare_env("RAYTPU_TENANT_MAX_QUEUED",
+            "queued-spec depth per tenant before admission sheds")
+declare_env("RAYTPU_TENANT_RETRY_DELAY_S", "retry_after hint on TenantThrottled")
+declare_env("RAYTPU_TENANT_PREEMPT", "enable priority preemption (bool)")
+declare_env("RAYTPU_TENANT_PREEMPT_MAX_PER_SCAN",
+            "preemptions per pending-queue scan")
+declare_env("RAYTPU_METRIC_TENANT_RESERVED",
+            "reserved series headroom for tenant-tagged metrics")
+
 # Head / node boot flags (cluster/head.py, cluster/node.py,
 # cluster/topology.py): consumed during process bring-up, before the
 # head's config snapshot has been shipped.

@@ -24,6 +24,7 @@ from raytpu.runtime.remote_function import (
     validate_options,
 )
 from raytpu.runtime.task_spec import ActorCreationSpec, TaskSpec
+from raytpu.util import tenancy
 
 
 def method_meta_from_class(cls: type) -> Dict[str, Dict[str, Any]]:
@@ -138,6 +139,7 @@ class ActorHandle:
             backpressure=backpressure,
             owner_address=worker.worker_id.binary(),
             concurrency_group=concurrency_group,
+            tenant=tenancy.current_tenant(),
         )
         refs = backend.submit_actor_task(spec)
         del keepalive
@@ -248,6 +250,9 @@ class ActorClass:
                 concurrency_groups=groups,
             ),
             owner_address=worker.worker_id.binary(),
+            tenant=opts.get("tenant") or tenancy.current_tenant(),
+            priority=int(opts.get("priority", 0) or 0),
+            preemptible=bool(opts.get("preemptible", False)),
         )
         backend.create_actor(spec)
         del keepalive

@@ -26,6 +26,7 @@ from raytpu.runtime.task_spec import (
     TaskArg,
     TaskSpec,
 )
+from raytpu.util import tenancy
 
 _VALID_OPTIONS = {
     "num_cpus", "num_tpus", "num_gpus", "resources", "num_returns",
@@ -34,6 +35,7 @@ _VALID_OPTIONS = {
     "placement_group_capture_child_tasks", "runtime_env", "max_restarts",
     "max_concurrency", "lifetime", "namespace", "max_task_retries",
     "concurrency_groups", "memory", "generator_backpressure_num_objects",
+    "tenant", "priority", "preemptible",
 }
 
 
@@ -190,6 +192,9 @@ class RemoteFunction:
             streaming=streaming,
             backpressure=backpressure,
             owner_address=worker.worker_id.binary(),
+            tenant=opts.get("tenant") or tenancy.current_tenant(),
+            priority=int(opts.get("priority", 0) or 0),
+            preemptible=bool(opts.get("preemptible", True)),
         )
         refs = backend.submit_task(spec)
         del keepalive  # submitted-task refs are registered now

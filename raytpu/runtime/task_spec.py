@@ -102,6 +102,16 @@ class TaskSpec:
     # descriptors): "module:qual.name" resolved by import on the worker
     # when function_blob is empty. Appended field — wire-schema safe.
     function_ref: str = ""
+    # Multi-tenant identity and isolation hints (appended fields — old
+    # decoders see the defaults, an untenanted spec encodes as before).
+    # ``tenant`` keys quota/fair-queue accounting on the head (stamped
+    # from the ambient tenancy contextvar — lint rule RTP018 enforces
+    # every construction seam carries it); ``priority`` orders
+    # preemption (higher wins); ``preemptible=False`` exempts the task
+    # from priority preemption entirely.
+    tenant: str = ""
+    priority: int = 0
+    preemptible: bool = True
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i)

@@ -164,6 +164,31 @@ def _cmd_top(args) -> int:
                     + "  "
                     + fmt(latest("raytpu_node_pending_tasks", "max", t),
                           "{:>6.0f}"))
+        if getattr(args, "tenants", False):
+            try:
+                rows = cli.call("tenant_list") or []
+            except Exception:
+                rows = []
+            if rows:
+                lines += ["", "  tenant            weight  prio  queued  "
+                              "running  usage / quota"]
+                for tv in rows:
+                    usage = ",".join(
+                        f"{k}:{v:g}"
+                        for k, v in sorted((tv.get("usage") or {}).items())
+                        if v) or "-"
+                    quota = ",".join(
+                        f"{k}:{v:g}"
+                        for k, v in sorted((tv.get("quota") or {}).items())
+                    ) or "unlimited"
+                    name = tv.get("tenant") or "default"
+                    lines.append(
+                        f"  {name[:16]:<16}  "
+                        f"{float(tv.get('weight', 1.0)):>6.2f}  "
+                        f"{int(tv.get('priority', 0)):>4d}  "
+                        f"{int(tv.get('queued', 0)):>6d}  "
+                        f"{int(tv.get('running', 0)):>7d}  "
+                        f"{usage} / {quota}")
         if not args.no_clear:
             sys.stdout.write("\x1b[2J\x1b[H")
         print("\n".join(lines), flush=True)
@@ -178,6 +203,42 @@ def _cmd_top(args) -> int:
             _time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
+    finally:
+        cli.close()
+    return 0
+
+
+def _cmd_tenant(args) -> int:
+    """Tenant quota/weight administration over the head's durable
+    ``tenants`` table (survives hot-standby takeover: the table rides
+    the WAL ship stream)."""
+    from raytpu.cluster.protocol import RpcClient
+
+    cli = RpcClient(args.address)
+    try:
+        if args.tenant_cmd == "set-quota":
+            quota = {}
+            for item in args.quota or []:
+                res, sep, val = item.partition("=")
+                if not sep or not res:
+                    print(f"bad quota {item!r}; expected RESOURCE=CEILING",
+                          file=sys.stderr)
+                    return 2
+                try:
+                    quota[res] = float(val)
+                except ValueError:
+                    print(f"bad quota ceiling {val!r} in {item!r}",
+                          file=sys.stderr)
+                    return 2
+            row = cli.call("tenant_set_quota", args.name,
+                           quota or None, args.weight, args.priority)
+            print(json.dumps(row, indent=2, sort_keys=True))
+        elif args.tenant_cmd == "info":
+            print(json.dumps(cli.call("tenant_info", args.name),
+                             indent=2, sort_keys=True))
+        else:  # list
+            rows = cli.call("tenant_list") or []
+            print(json.dumps(rows, indent=2, sort_keys=True))
     finally:
         cli.close()
     return 0
@@ -610,7 +671,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop after N redraws (0 = until Ctrl-C)")
     s.add_argument("--no-clear", action="store_true",
                    help="append instead of clearing the screen")
+    s.add_argument("--tenants", action="store_true",
+                   help="add a per-tenant quota/usage/queue pane")
     s.set_defaults(fn=_cmd_top)
+
+    s = sub.add_parser("tenant", help="tenant quotas, weights, priorities")
+    tsub = s.add_subparsers(dest="tenant_cmd", required=True)
+    ts = tsub.add_parser("set-quota",
+                         help="set/update one tenant's quota row")
+    ts.add_argument("--address", required=True)
+    ts.add_argument("name", help="tenant name")
+    ts.add_argument("quota", nargs="*",
+                    help="resource ceilings, e.g. CPU=4 TPU=8 "
+                         "(omit to keep/clear ceilings)")
+    ts.add_argument("--weight", type=float, default=None,
+                    help="fair-share weight (> 0)")
+    ts.add_argument("--priority", type=int, default=None,
+                    help="scheduling priority (higher may preempt)")
+    ts.set_defaults(fn=_cmd_tenant)
+    ts = tsub.add_parser("info", help="one tenant's quota/usage view")
+    ts.add_argument("--address", required=True)
+    ts.add_argument("name")
+    ts.set_defaults(fn=_cmd_tenant)
+    ts = tsub.add_parser("list", help="all known tenants")
+    ts.add_argument("--address", required=True)
+    ts.set_defaults(fn=_cmd_tenant)
 
     s = sub.add_parser("timeline", help="dump chrome-trace timeline")
     s.add_argument("--address", default=None)

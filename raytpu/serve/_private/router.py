@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Tuple
 
 import raytpu
 from raytpu.serve._private.controller import CONTROLLER_NAME
+from raytpu.util import tenancy
 
 BACKOFF_S = 0.02
 MAX_BACKOFF_S = 0.5
@@ -133,6 +134,46 @@ class ReplicaSet:
             backoff = min(backoff * 2, MAX_BACKOFF_S)
 
 
+_request_counter = None
+_request_counter_tried = False
+
+
+def _tick_request(deployment: str, tenant: str) -> None:
+    """Per-tenant serve demand, visible on the cluster TSDB. Best-effort
+    (metrics must never fail a request); the tenant tag rides the
+    reserved headroom in the cardinality cap, so a busy deployment's
+    free-form series cannot silently fold tenant evidence away."""
+    global _request_counter, _request_counter_tried
+    if _request_counter is None and not _request_counter_tried:
+        _request_counter_tried = True
+        try:
+            from raytpu.util.metrics import Counter
+
+            _request_counter = Counter(
+                "raytpu_serve_requests_total",
+                "Serve requests routed, by deployment and tenant",
+                tag_keys=("deployment", "tenant"))
+        except Exception:
+            _request_counter = None
+    if _request_counter is not None:
+        try:
+            _request_counter.inc(1, {"deployment": deployment,
+                                     "tenant": tenant or "default"})
+        except Exception:
+            pass
+
+
+def _stamp_tenant(request_meta: Optional[dict]) -> dict:
+    """The ambient tenant rides request metadata to the replica (the
+    wire's "tn" frame field covers the actor-call hop; the meta copy is
+    what replica-side user code and access logs read)."""
+    meta = dict(request_meta or {})
+    t = tenancy.current_tenant()
+    if t and "tenant" not in meta:
+        meta["tenant"] = t
+    return meta
+
+
 class Router:
     """One per DeploymentHandle; owns the replica set and assigns requests."""
 
@@ -159,8 +200,10 @@ class Router:
     ):
         """Returns an ObjectRef for the replica's response."""
         replica = self._replica_set.choose(timeout_s=timeout_s)
+        meta = _stamp_tenant(request_meta)
+        _tick_request(self._full_name, meta.get("tenant", ""))
         return replica.handle_request.remote(
-            method_name, args, kwargs, request_meta or {}
+            method_name, args, kwargs, meta
         )
 
     def probe_asgi(self, timeout_s: float = 30.0) -> bool:
@@ -172,8 +215,9 @@ class Router:
                             request_meta: Optional[dict] = None,
                             timeout_s: float = 30.0):
         replica = self._replica_set.choose(timeout_s=timeout_s)
-        return replica.handle_request_asgi.remote(scope, body,
-                                                  request_meta or {})
+        meta = _stamp_tenant(request_meta)
+        _tick_request(self._full_name, meta.get("tenant", ""))
+        return replica.handle_request_asgi.remote(scope, body, meta)
 
     def assign_request_streaming(
         self,
@@ -185,9 +229,11 @@ class Router:
     ):
         """Returns an ObjectRefGenerator of the replica's response chunks."""
         replica = self._replica_set.choose(timeout_s=timeout_s)
+        meta = _stamp_tenant(request_meta)
+        _tick_request(self._full_name, meta.get("tenant", ""))
         return replica.handle_request_streaming.options(
             num_returns="streaming"
-        ).remote(method_name, args, kwargs, request_meta or {})
+        ).remote(method_name, args, kwargs, meta)
 
     @classmethod
     def reset_all(cls):

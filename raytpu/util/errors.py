@@ -115,6 +115,33 @@ class RpcTimeoutError(RetryableError, TimeoutError):
         super().__init__(msg)
 
 
+class TenantThrottled(RetryableError):
+    """Admission control shed this submission: the tenant's queued-spec
+    budget on the head is exhausted (``RAYTPU_TENANT_MAX_QUEUED``).
+    Carries ``retry_after_s`` so the client's
+    :class:`~raytpu.util.resilience.RetryPolicy` backs off at least that
+    long before re-submitting instead of hammering an overloaded head.
+
+    ``args`` is kept positional-and-primitive — the wire rebuilds
+    exceptions via ``cls(*args)``, and ``retry_after_s`` must survive
+    the hop because the client acts on it."""
+
+    def __init__(self, tenant: str = "", retry_after_s: float = 0.0,
+                 detail: str = ""):
+        self.tenant = tenant
+        self.retry_after_s = float(retry_after_s or 0.0)
+        self.detail = detail
+        super().__init__(tenant, self.retry_after_s, detail)
+
+    def __str__(self) -> str:
+        msg = f"tenant {self.tenant or '?'} throttled"
+        if self.retry_after_s:
+            msg += f" (retry after {self.retry_after_s:.3f}s)"
+        if self.detail:
+            msg += f": {self.detail}"
+        return msg
+
+
 _SWALLOWED: "Dict[str, int]" = {}
 
 

@@ -68,6 +68,10 @@ DECLARED_METRICS: Dict[str, str] = {
     "raytpu_schedule_requests_total": "scheduling requests handled",
     "raytpu_tasks_done_total": "tasks finished cluster-wide",
     "raytpu_tasks_submitted_total": "task specs accepted for scheduling",
+    "raytpu_tenant_preempted_total": "running tasks preempted per tenant",
+    "raytpu_tenant_queued": "specs queued at the head per tenant",
+    "raytpu_tenant_tasks_placed_total": "placements per tenant",
+    "raytpu_tenant_throttled_total": "admission-shed submissions per tenant",
     # -- inference serving ---------------------------------------------
     "raytpu_infer_decode_tokens_per_s": "decode throughput",
     "raytpu_infer_decode_tokens_total": "decode tokens generated",
@@ -89,6 +93,9 @@ DECLARED_METRICS: Dict[str, str] = {
     "raytpu_node_running_tasks": "tasks executing on the node",
     "raytpu_node_shm_capacity_bytes": "shared-memory arena capacity",
     "raytpu_node_shm_used_bytes": "shared-memory arena bytes in use",
+    # -- serve ---------------------------------------------------------
+    "raytpu_serve_requests_total":
+        "serve requests routed, by deployment and tenant",
     # -- metrics pipeline itself ---------------------------------------
     "raytpu_metrics_series_dropped_total":
         "tag-sets folded into <other> by the cardinality cap",
@@ -101,6 +108,14 @@ DECLARED_METRICS: Dict[str, str] = {
 ENV_MAX_SERIES = "RAYTPU_METRIC_MAX_SERIES"
 _MAX_SERIES = int(os.environ.get(ENV_MAX_SERIES, "") or 128)
 OTHER_TAG_VALUE = "<other>"
+
+# Reserved headroom past the cap for series carrying a REAL "tenant"
+# tag value: per-tenant SLO series (quota throttles, fairness, serve
+# latency) must not silently fold into ``<other>`` just because a
+# free-form tag family (task names, resources) filled the table first —
+# a folded tenant series reads as "tenant is fine" on every dashboard.
+ENV_TENANT_RESERVED = "RAYTPU_METRIC_TENANT_RESERVED"
+_TENANT_RESERVED = int(os.environ.get(ENV_TENANT_RESERVED, "") or 32)
 
 
 def _sanitize(name: str) -> str:
@@ -159,9 +174,20 @@ class _Metric:
     def _fold(self, key: Tuple, table: Dict) -> Tuple[Tuple, bool]:
         """Cardinality cap (caller holds ``self._lock``): a key beyond
         ``_MAX_SERIES`` distinct tag-sets folds into the ``<other>``
-        series so one runaway tag can't bloat frames or the head store."""
+        series so one runaway tag can't bloat frames or the head store.
+        Keys whose "tenant" tag carries a real value get the reserved
+        headroom (``_TENANT_RESERVED``) before folding — tenant series
+        are the isolation story's evidence and must outlive free-form
+        tag churn. Every fold still counts in
+        ``raytpu_metrics_series_dropped_total`` tagged with the metric
+        name, so the evicted family is named, never silent."""
         if not self._tag_keys or key in table or len(table) < _MAX_SERIES:
             return key, False
+        if "tenant" in self._tag_keys and \
+                len(table) < _MAX_SERIES + _TENANT_RESERVED:
+            tv = key[self._tag_keys.index("tenant")]
+            if tv and tv != OTHER_TAG_VALUE:
+                return key, False
         return (OTHER_TAG_VALUE,) * len(self._tag_keys), True
 
     def _delta_rows(self) -> List[list]:

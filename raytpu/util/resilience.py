@@ -217,6 +217,13 @@ class RetryPolicy:
                            self.base_delay_s
                            * (self.multiplier ** (attempt - 1)))
                 delay = base * (1.0 + self.jitter * rng.random())
+                # A server-directed backoff floors the computed delay:
+                # errors like TenantThrottled carry retry_after_s — the
+                # head said when it is worth coming back, and retrying
+                # sooner just deepens the overload being shed.
+                hint = float(getattr(e, "retry_after_s", 0.0) or 0.0)
+                if hint > delay:
+                    delay = hint
                 if deadline is not None and deadline.remaining() <= delay:
                     raise  # sleeping would outlive the budget
                 if on_retry is not None:

@@ -1,0 +1,112 @@
+"""Tenant identity propagation.
+
+Reference analogue: Ray's multi-tenancy story is job-granular (one GCS
+per cluster, per-job workers); large fleets layer *logical tenants* on
+top — a namespace that quotas, fair-queueing, and billing key off.
+raytpu makes the tenant a first-class ambient identity, carried exactly
+like the PR-3 trace context:
+
+- A driver (or any process) declares its tenant via the
+  ``RAYTPU_TENANT`` env var, or scopes one dynamically with
+  :func:`tenant_scope`.
+- Every outbound RPC frame stamps the ambient tenant into the ``"tn"``
+  envelope field (see :mod:`raytpu.cluster.protocol`), primitives-only
+  so it survives the strict no-pickle wire.
+- ``RpcServer._dispatch`` re-anchors ``"tn"`` into this module's
+  contextvar per dispatch task, so head handlers (admission, quota
+  checks) and node handlers (cross-language TaskSpec construction) see
+  the *caller's* tenant without any parameter threading.
+- :class:`~raytpu.runtime.task_spec.TaskSpec` carries ``tenant`` /
+  ``priority`` / ``preemptible`` as appended wire-schema-safe fields;
+  construction sites stamp them from here (lint rule RTP018 enforces
+  that no seam forgets).
+
+Cost model mirrors :mod:`raytpu.util.tracing`: with no tenant declared
+anywhere, :func:`current_tenant` is one contextvar read plus one module
+string read, and frames carry no extra field. The scheduler-side
+semantics (quotas, weighted fair queueing, preemption, shedding) live in
+``cluster/head.py`` behind the ``RAYTPU_TENANTS`` master switch.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+from contextlib import contextmanager
+from typing import Any, Optional
+
+ENV_VAR = "RAYTPU_TENANT"
+
+# The accounting bucket for traffic that declares no tenant at all.
+# With RAYTPU_TENANTS=1 the head books untenanted work here so system
+# traffic and legacy drivers still fall under *some* quota row.
+DEFAULT_TENANT = "default"
+
+# Process-level default, read once at import (workers and cluster
+# daemons inherit os.environ, the failpoints/tracing arming pattern).
+_env_default = os.environ.get(ENV_VAR, "") or ""
+
+_current: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("raytpu_tenant", default=None)
+
+
+def current_tenant() -> str:
+    """The ambient tenant identity: innermost :func:`tenant_scope` or
+    re-anchored frame value, else the process ``RAYTPU_TENANT`` default,
+    else ``""`` (untenanted)."""
+    t = _current.get()
+    if t is not None:
+        return t
+    return _env_default
+
+
+def set_current_tenant(tenant: Optional[str]):
+    """Anchor ``tenant`` as the ambient identity; returns a reset token
+    (``RpcServer._dispatch`` re-anchors per dispatch task with this)."""
+    return _current.set(tenant)
+
+
+def reset_current_tenant(token) -> None:
+    _current.reset(token)
+
+
+def set_process_tenant(tenant: str, env: bool = False) -> None:
+    """Set the process-level default tenant. ``env=True`` additionally
+    exports it so subprocesses spawned afterwards inherit it (the
+    ``cfg(env=True)`` pattern from failpoints/tracing)."""
+    global _env_default
+    _env_default = str(tenant or "")
+    if env:
+        if _env_default:
+            os.environ[ENV_VAR] = _env_default
+        else:
+            os.environ.pop(ENV_VAR, None)
+
+
+@contextmanager
+def tenant_scope(tenant: str):
+    """Scope a tenant identity over a block of driver code::
+
+        with tenancy.tenant_scope("team-interactive"):
+            ref = f.remote()          # spec + frames carry the tenant
+    """
+    token = _current.set(str(tenant))
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+def to_wire() -> Optional[str]:
+    """The ``"tn"`` frame stamp: a plain str (strict-wire primitive), or
+    None when no tenant is ambient (the field is then omitted — the
+    untenanted wire is byte-identical to the pre-tenancy wire)."""
+    t = current_tenant()
+    return t or None
+
+
+def from_wire(value: Any) -> Optional[str]:
+    """Validate an inbound ``"tn"`` field (untrusted peer bytes)."""
+    if isinstance(value, str) and value:
+        return value
+    return None

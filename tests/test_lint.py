@@ -818,6 +818,58 @@ class TestWalCoverage:  # RTP017
         assert res.findings == []
 
 
+class TestTenantStamping:  # RTP018
+    def test_planted_unstamped_spec(self):
+        findings = run_rule_on_source(_rule("RTP018"), _src("""
+            def submit(self, fn_ref, args):
+                spec = TaskSpec(
+                    task_id=TaskID.from_random(),
+                    function_ref=fn_ref,
+                    args=args,
+                )
+                return spec
+        """), rel="raytpu/runtime/remote_function.py")
+        assert len(findings) == 1
+        assert "tenant=" in findings[0].message
+
+    def test_clean_explicit_tenant(self):
+        assert run_rule_on_source(_rule("RTP018"), _src("""
+            def submit(self, fn_ref, args):
+                return TaskSpec(
+                    task_id=TaskID.from_random(),
+                    function_ref=fn_ref,
+                    tenant=tenancy.current_tenant(),
+                )
+        """), rel="raytpu/runtime/remote_function.py") == []
+
+    def test_inline_suppression_with_reason(self):
+        assert run_rule_on_source(_rule("RTP018"), _src("""
+            def rebuild(self, fields):
+                spec = TaskSpec(  # raytpulint: disable=RTP018 tenant rides the frame
+                    task_id=fields['tid'],
+                )
+                return spec
+        """), rel="raytpu/cluster/node.py") == []
+
+    def test_double_star_forward_is_clean(self):
+        # Decode/clone paths forward an already-stamped spec; the
+        # mapping is opaque statically and must not false-positive.
+        assert run_rule_on_source(_rule("RTP018"), _src("""
+            def clone(self, spec):
+                return TaskSpec(**spec.as_dict())
+        """), rel="raytpu/runtime/remote_function.py") == []
+
+    def test_definition_module_exempt(self):
+        assert run_rule_on_source(_rule("RTP018"), _src("""
+            def _decode(fields):
+                return TaskSpec(fields[0], fields[1])
+        """), rel="raytpu/runtime/task_spec.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP018"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

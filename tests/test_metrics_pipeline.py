@@ -373,6 +373,45 @@ class TestCardinalityCap:
         g.set(1.0, tags={"k": "b"})   # folds; must not recurse
         assert metrics._series_dropped is not None
 
+    def test_tenant_series_get_reserved_headroom(self, shipper,
+                                                 monkeypatch):
+        """Tenant-tagged series are the isolation story's evidence and
+        must not silently fold into <other> just because free-form tags
+        (deployment names, proc ids) churned the family to the cap:
+        keys carrying a real tenant value get reserved headroom."""
+        monkeypatch.setattr(metrics, "_MAX_SERIES", 2)
+        # Reserve 3: the <other> fold series itself holds a table slot,
+        # leaving headroom for two real tenant series.
+        monkeypatch.setattr(metrics, "_TENANT_RESERVED", 3)
+        c = metrics.Counter("tp_card_tenant_total", "t",
+                            tag_keys=("deployment", "tenant"))
+        # Untenanted churn fills the base cap and starts folding.
+        for i in range(4):
+            c.inc(tags={"deployment": f"d{i}", "tenant": ""})
+        with c._lock:
+            keys = set(c._values)
+        assert (metrics.OTHER_TAG_VALUE,) * 2 in keys
+        # Real tenants still land their own series via the headroom...
+        c.inc(tags={"deployment": "d9", "tenant": "acme"})
+        c.inc(tags={"deployment": "d9", "tenant": "globex"})
+        with c._lock:
+            keys = set(c._values)
+        assert ("d9", "acme") in keys and ("d9", "globex") in keys
+        # ...until the headroom itself is exhausted — then they fold
+        # too (bounded memory beats unbounded evidence), and the drop
+        # counter names the evicted family, never a silent gap.
+        before = metrics._series_dropped.value
+        c.inc(tags={"deployment": "d9", "tenant": "initech"})
+        with c._lock:
+            assert ("d9", "initech") not in set(c._values)
+        assert metrics._series_dropped.value == before + 1
+        # An <other>-valued tenant tag never rides the headroom.
+        c.inc(tags={"deployment": "dA",
+                    "tenant": metrics.OTHER_TAG_VALUE})
+        with c._lock:
+            assert ("dA", metrics.OTHER_TAG_VALUE) not in set(c._values)
+        assert c.value == 8.0  # folding never loses increments
+
 
 # -- one-flag-check disabled cost (AST) ---------------------------------------
 
