@@ -1,0 +1,185 @@
+"""Continuous-profiling overhead — the "always-on" deployability bar.
+
+The PR's claim: with ``RAYTPU_PROFILE_CONTINUOUS=1`` the duty-cycled
+sampler plus RPC stage timing cost < 3% per task end to end, and with
+the flag off the cost is exactly one boolean check per emission site
+(not measurable; asserted by lint rule RTP019 instead).
+
+Two measurements, each best-of-``REPEATS`` to shave scheduler noise:
+
+(a) cluster per-task overhead: a real subprocess head + node cluster
+    runs ``TASKS`` trivial remote tasks in submission waves, profiling
+    off vs on at the shipped default duty cycle (the ~45 s leg spans
+    several full periods); overhead is the relative per-task wall-time
+    delta;
+(b) RPC stage-timing overhead: an in-process RpcServer/RpcClient pair
+    answers ``CALLS`` unary calls, profiling off vs on; overhead is
+    the relative per-call delta (recv/decode/queue/handler/encode/send
+    monotonic marks + one histogram observe per call).
+
+Writes BENCH_r18.json at the repo root and prints the same object as
+one JSON line:
+  {"metric": "profiling_on_task_overhead_pct", "value": ...,
+   "vs_baseline": <value / 3.0>}   (vs_baseline <= 1.0 meets the bar)
+
+Env: RAYTPU_PROF_BENCH_TASKS (default 100), _CALLS (default 2000),
+_REPEATS (best-of, default 3; per-task latency on a small container
+is polling-cadence dominated and noisy — best-of-N is load-bearing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+OVERHEAD_BAR_PCT = 3.0
+
+TASKS = int(os.environ.get("RAYTPU_PROF_BENCH_TASKS", 100))
+CALLS = int(os.environ.get("RAYTPU_PROF_BENCH_CALLS", 2000))
+REPEATS = int(os.environ.get("RAYTPU_PROF_BENCH_REPEATS", 3))
+
+# The claim under test is the cost of the SHIPPED default duty cycle
+# (one 1 s burst per 10 s period) — so profiling is enabled with no
+# knob overrides. Compressing the period to fit more bursts into the
+# window multiplies the per-burst fixed costs (snapshot, frame,
+# heartbeat payload, store push) beyond what the default ever pays and
+# overstates the overhead ~10x; the ~45 s cluster leg spans several
+# full duty cycles as-is.
+_PROFILE_ENV = {
+    "RAYTPU_PROFILE_CONTINUOUS": "1",
+}
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+_CHILD = r"""
+import json, sys, time
+import raytpu
+
+def main():
+    tasks = int(sys.argv[1])
+    from raytpu.cluster.cluster_utils import Cluster
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2, num_tpus=0)
+        cluster.wait_for_nodes(1)
+        raytpu.init(address=cluster.address)
+
+        @raytpu.remote
+        def noop(i):
+            return i
+
+        # Warm the dispatch path (compile/import costs out of band).
+        assert raytpu.get([noop.remote(i) for i in range(20)],
+                          timeout=60) == list(range(20))
+        t0 = time.perf_counter()
+        out = raytpu.get([noop.remote(i) for i in range(tasks)],
+                         timeout=300)
+        dt = time.perf_counter() - t0
+        assert out == list(range(tasks))
+        print("RESULT " + json.dumps({"wall_s": dt, "tasks": tasks}))
+    finally:
+        raytpu.shutdown()
+        cluster.shutdown()
+
+main()
+"""
+
+
+def _cluster_run(profile_on: bool) -> float:
+    """One cluster round in a fresh interpreter (env decides the mode
+    for every process the harness spawns); returns seconds per task."""
+    env = dict(os.environ)
+    for k in _PROFILE_ENV:
+        env.pop(k, None)
+    if profile_on:
+        env.update(_PROFILE_ENV)
+    out = subprocess.run([sys.executable, "-c", _CHILD, str(TASKS)],
+                         capture_output=True, text=True, timeout=600,
+                         env=env, cwd=REPO_ROOT)
+    if out.returncode != 0:
+        raise RuntimeError(f"cluster child failed:\n{out.stderr[-2000:]}")
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            rec = json.loads(line[len("RESULT "):])
+            return rec["wall_s"] / rec["tasks"]
+    raise RuntimeError("cluster child printed no RESULT line")
+
+
+def _rpc_run(profile_on: bool) -> float:
+    """In-process unary-call microbench; returns seconds per call."""
+    from raytpu.cluster.protocol import RpcClient, RpcServer
+    from raytpu.util import profiler
+
+    if profile_on:
+        profiler.enable_profiling()
+    else:
+        profiler.disable_profiling()
+    srv = RpcServer()
+    srv.register("echo", lambda peer, x: x)
+    addr = srv.start()
+    cli = RpcClient(addr)
+    try:
+        for i in range(50):  # warm
+            cli.call("echo", i)
+        t0 = time.perf_counter()
+        for i in range(CALLS):
+            cli.call("echo", i)
+        dt = time.perf_counter() - t0
+    finally:
+        cli.close()
+        srv.stop()
+        profiler.disable_profiling()
+    return dt / CALLS
+
+
+def _best(fn, *args) -> float:
+    return min(fn(*args) for _ in range(REPEATS))
+
+
+def _pct(on: float, off: float) -> float:
+    return round((on - off) / off * 100.0, 2)
+
+
+def main() -> None:
+    _force_cpu()
+    task_off = _best(_cluster_run, False)
+    task_on = _best(_cluster_run, True)
+    rpc_off = _best(_rpc_run, False)
+    rpc_on = _best(_rpc_run, True)
+    task_pct = _pct(task_on, task_off)
+    rpc_pct = _pct(rpc_on, rpc_off)
+    result = {
+        "bench": "continuous_profiling_overhead",
+        "tasks": TASKS,
+        "rpc_calls": CALLS,
+        "repeats": REPEATS,
+        "per_task_off_ms": round(task_off * 1e3, 3),
+        "per_task_on_ms": round(task_on * 1e3, 3),
+        "task_overhead_pct": task_pct,
+        "per_call_off_us": round(rpc_off * 1e6, 2),
+        "per_call_on_us": round(rpc_on * 1e6, 2),
+        "rpc_stage_timing_overhead_pct": rpc_pct,
+        "overhead_bar_pct": OVERHEAD_BAR_PCT,
+        "task_overhead_within_bar": task_pct < OVERHEAD_BAR_PCT,
+        "metric": "profiling_on_task_overhead_pct",
+        "value": task_pct,
+        "vs_baseline": round(max(task_pct, 0.0) / OVERHEAD_BAR_PCT, 4),
+    }
+    path = os.path.join(REPO_ROOT, "BENCH_r18.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,9 @@ RTP018 tenant-stamping         every TaskSpec(...) construction passes
                                tenant= explicitly or carries an inline
                                suppression naming the channel the
                                tenant rides instead
+RTP019 profile-site-purity     every continuous-profiler emission call
+                               sits inside an if testing exactly one
+                               profiling_enabled() check
 ====== ======================= ====================================
 """
 
@@ -64,6 +67,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     jit_in_builders,
     metric_registry,
     persist_coverage,
+    profile_purity,
     rpc_loop,
     sched_purity,
     seam_swallow,

@@ -1,0 +1,106 @@
+"""RTP019: continuous-profiler emission sites pay exactly one flag check.
+
+The always-on profiler's disabled cost budget is ONE boolean check per
+emission site (``RAYTPU_PROFILE_CONTINUOUS=0`` must be free): every
+call that produces or ships profile data — snapshotting, draining the
+ship buffer, RPC stage-histogram observation, step/HBM attribution,
+starting the sampler thread — must be lexically inside an ``if`` whose
+test calls ``profiling_enabled()`` exactly once (``and``-combining with
+other cheap conditions is fine: ``if marks is not None and
+profiling_enabled():``).
+
+Two failure modes are flagged:
+
+- an emission call with no guarding ``if profiling_enabled()`` ancestor
+  (includes the early-return style ``if not profiling_enabled():
+  return`` — the if-wrapped form is mandated so the guard is visible at
+  the emission site itself);
+- a single guard test calling ``profiling_enabled()`` more than once
+  (a double check silently doubles the disabled cost).
+
+Loss-accounting calls (``prof_requeue``/``prof_discard``/``prof_ingest``)
+are deliberately NOT emission sites: they must run even when the local
+flag is off, so a relay never eats another process's frames.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raytpu.analysis.core import Rule, register
+
+_FLAG = "profiling_enabled"
+_EMISSION = {
+    "prof_snapshot",
+    "prof_drain",
+    "observe_rpc_stages",
+    "_observe_rpc_stages",
+    "observe_step",
+    "observe_hbm",
+    "start_continuous",
+}
+
+
+def _callee(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _flag_calls(node) -> int:
+    """Count ``profiling_enabled()`` calls anywhere in an expression."""
+    n = 0
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _callee(sub) == _FLAG:
+            n += 1
+    return n
+
+
+@register
+class ProfileSitePurity(Rule):
+    id = "RTP019"
+    name = "profile-site-purity"
+    invariant = ("every continuous-profiler emission call is inside an "
+                 "if whose test calls profiling_enabled() exactly once")
+    rationale = ("the always-on profiler is only deployable if disabling "
+                 "it costs one flag check per site — an unguarded "
+                 "emission samples/ships when off, and a double check "
+                 "doubles the disabled cost nobody budgeted")
+    scope = ("raytpu/",)
+    exempt = ()
+
+    def check(self, mod):
+        yield from self._visit(mod, mod.tree, False)
+
+    def _visit(self, mod, node, guarded):
+        if isinstance(node, ast.If):
+            n = _flag_calls(node.test)
+            if n > 1:
+                yield self.finding(
+                    mod, node,
+                    f"{_FLAG}() called {n} times in one guard test — "
+                    f"emission sites pay exactly one flag check")
+            # Calls inside the test itself are evaluated regardless of
+            # the branch taken: the OUTER guard state applies to them.
+            yield from self._visit(mod, node.test, guarded)
+            # A double-checked test still guards at runtime — it gets
+            # the one finding above, not a second "unguarded" one.
+            inner = guarded or n >= 1
+            for child in node.body:
+                yield from self._visit(mod, child, inner)
+            for child in node.orelse:
+                yield from self._visit(mod, child, guarded)
+            return
+        if isinstance(node, ast.Call):
+            name = _callee(node)
+            if name in _EMISSION and not guarded:
+                yield self.finding(
+                    mod, node,
+                    f"profiler emission {name}() outside an "
+                    f"`if {_FLAG}()` guard — wrap the call site in an "
+                    f"if whose test calls {_FLAG}() exactly once")
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(mod, child, guarded)

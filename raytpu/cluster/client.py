@@ -46,6 +46,7 @@ from raytpu.util.errors import (
     TenantThrottled,
 )
 from raytpu.util import metrics as _metrics
+from raytpu.util import profiler as _profiler
 from raytpu.util import task_events
 from raytpu.util import tenancy
 from raytpu.util import tracing
@@ -1472,6 +1473,16 @@ class ClusterBackend:
                         timeout=tuning.CONTROL_CALL_TIMEOUT_S)
             except Exception as e:
                 errors.swallow("client.metrics_final_flush", e)
+        # Same terminal flush for continuous-profile frames.
+        if _profiler.profiling_enabled():
+            try:
+                frames, dropped = _profiler.prof_drain()
+                if frames or dropped:
+                    self._head.call(
+                        "profile_push", frames, dropped,
+                        timeout=tuning.CONTROL_CALL_TIMEOUT_S)
+            except Exception as e:
+                errors.swallow("client.profile_final_flush", e)
         try:
             if self._node is not None:
                 self._node.stop()

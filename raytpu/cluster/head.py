@@ -43,6 +43,7 @@ from raytpu.cluster.protocol import (
 )
 from raytpu.util import failpoints
 from raytpu.util import metrics
+from raytpu.util import profiler
 from raytpu.util import task_events
 from raytpu.util import tenancy
 from raytpu.util import tracing
@@ -50,6 +51,7 @@ from raytpu.util import tsdb
 from raytpu.util import errors
 from raytpu.util.errors import PlacementInfeasibleError, TenantThrottled
 from raytpu.util.failpoints import DROP, failpoint
+from raytpu.util.profstore import ProfileStore
 from raytpu.util.resilience import breaker_for
 
 # Env-overridable so chaos tests (and small dev clusters) can tighten the
@@ -495,6 +497,14 @@ class HeadServer:
             coarse_step_s=float(_cfg.metrics_coarse_step_s),
             coarse_slots=int(_cfg.metrics_coarse_slots))
         metrics.set_shipper_identity("head")
+        # Cluster profile store (the TSDB's sibling): shipped
+        # collapsed-stack snapshots from every process, behind the
+        # profile_query/profile_stats RPC surface.
+        self._profile_store = ProfileStore(
+            max_bytes=int(_cfg.profile_store_max_bytes),
+            ring_slots=int(_cfg.profile_ring_slots))
+        if profiler.profiling_enabled():
+            profiler.start_continuous()
         # SLO alerts: threshold/duration rules over the TSDB, evaluated
         # on the health-loop cadence, fired into the ops-event ring. A
         # malformed rule string must not take the control plane down —
@@ -610,6 +620,12 @@ class HeadServer:
         h("metrics_stats", self._h_metrics_stats)
         h("metrics_set_alert_rules", self._h_metrics_set_alert_rules)
         h("metrics_alerts", self._h_metrics_alerts)
+        # Continuous-profiling surface: merged / diff cluster
+        # flamegraphs over the profile store, and its per-proc
+        # ship inventory (``raytpu top --profile``).
+        h("profile_push", self._h_profile_push)
+        h("profile_query", self._h_profile_query)
+        h("profile_stats", self._h_profile_stats)
         # Multi-tenant surface: quota/weight/priority upserts and the
         # per-tenant usage/backlog view behind ``raytpu top --tenants``.
         h("tenant_set_quota", self._h_tenant_set_quota)
@@ -1337,9 +1353,10 @@ class HeadServer:
             peer.meta["node_id"] = node_id
             self._nodes[node_id] = entry
             snap = [n.snapshot() for n in self._nodes.values() if n.alive]
-        # A (re-)registered node sheds any metric tombstone so shipping
-        # resumes after a head bounce or transient partition.
+        # A (re-)registered node sheds any metric/profile tombstone so
+        # shipping resumes after a head bounce or transient partition.
         self._metric_store.revive_proc(node_id[:12])
+        self._profile_store.revive_proc(node_id[:12])
         if task_events.enabled():
             task_events.emit("node", node_id,
                              task_events.TaskTransition.NODE_ADDED,
@@ -1359,7 +1376,9 @@ class HeadServer:
                    dropped: int = 0,
                    obj_deltas: Optional[List[list]] = None,
                    mframes: Optional[List[list]] = None,
-                   mdropped: int = 0) -> None:
+                   mdropped: int = 0,
+                   pframes: Optional[List[list]] = None,
+                   pdropped: int = 0) -> None:
         # drop => the head never saw this heartbeat; enough consecutive
         # drops and the health loop declares the node dead. The node
         # requeues the piggybacked event batch on call failure, so a
@@ -1387,6 +1406,14 @@ class HeadServer:
             # ride the same beat into the TSDB.
             self._metric_store.note_upstream_drops(int(mdropped or 0))
             self._metric_store.push(mframes or [])
+        if pframes or pdropped:
+            # Profile snapshots (node's own + relayed worker frames)
+            # ride the same beat into the profile store; drops are
+            # attributed to the shipping carrier so ``raytpu top
+            # --profile`` can name the lossy proc.
+            self._profile_store.note_upstream_drops(
+                int(pdropped or 0), proc=f"node:{node_id[:12]}")
+            self._profile_store.push(pframes or [])
 
     def _resource_update(self, peer: Peer, node_id: str,
                          available: Dict[str, float],
@@ -1510,6 +1537,7 @@ class HeadServer:
                 continue
             self._ingest_local_events()
             self._ingest_local_metrics()
+            self._ingest_local_profile()
             now = time.monotonic()
             dead = []
             with self._lock:
@@ -1588,10 +1616,11 @@ class HeadServer:
                 f"node {node_id[:8]} removed: {reason}",
                 node_id=node_id, reason=reason))
         self._drop_borrower_prefix(node_id)
-        # Tombstone the dead node's metric procs (daemon + its workers):
-        # their series drop and any late frame is rejected, so the death
-        # can't resurrect stale series.
+        # Tombstone the dead node's metric/profile procs (daemon + its
+        # workers): their series and stack rings drop and any late frame
+        # is rejected, so the death can't resurrect stale series.
         self._metric_store.mark_proc_dead(node_id[:12])
+        self._profile_store.mark_proc_dead(node_id[:12])
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id} {reason}",
                                    no_restart=False)
@@ -1728,11 +1757,47 @@ class HeadServer:
         if frames:
             self._metric_store.push(frames)
 
+    def _ingest_local_profile(self) -> None:
+        """Fold the head's OWN continuous-profile snapshots into the
+        profile store (health loop + lazily before profile queries).
+        One flag check when profiling is disabled."""
+        if profiler.profiling_enabled():
+            frames, dropped = profiler.prof_drain()
+            if dropped:
+                self._profile_store.note_upstream_drops(dropped,
+                                                        proc="head")
+            if frames:
+                self._profile_store.push(frames)
+
+    def _h_profile_query(self, peer: Peer, mode: str = "merged",
+                         since_s: float = 600.0, until_s: float = 0.0,
+                         recent_s: float = 120.0,
+                         procs: Optional[List[str]] = None) -> dict:
+        self._ingest_local_profile()
+        if mode == "diff":
+            return self._profile_store.diff(float(recent_s))
+        return self._profile_store.merged(float(since_s),
+                                          float(until_s), procs=procs)
+
+    def _h_profile_stats(self, peer: Peer) -> dict:
+        self._ingest_local_profile()
+        return {"store": self._profile_store.stats(),
+                "procs": self._profile_store.proc_rows()}
+
     def _h_metrics_push(self, peer: Peer, frames: List[list],
                         dropped: int = 0) -> int:
         if dropped:
             self._metric_store.note_upstream_drops(int(dropped))
         return self._metric_store.push(frames or [])
+
+    def _h_profile_push(self, peer: Peer, frames: List[list],
+                        dropped: int = 0) -> int:
+        """Direct profile-frame ingest off the heartbeat path — the
+        driver's final flush at shutdown (its embedded node's heartbeat
+        loop is already gone by then)."""
+        if dropped:
+            self._profile_store.note_upstream_drops(int(dropped))
+        return self._profile_store.push(frames or [])
 
     def _h_metrics_query(self, peer: Peer, name: str,
                          tags: Optional[Dict[str, str]] = None,

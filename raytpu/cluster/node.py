@@ -37,6 +37,7 @@ from raytpu.cluster.protocol import (
 from raytpu.core.config import cfg
 from raytpu.util import failpoints
 from raytpu.util import metrics
+from raytpu.util import profiler
 from raytpu.util import task_events
 from raytpu.util import tenancy
 from raytpu.util import tracing
@@ -532,6 +533,10 @@ class _NodeMetrics:
             "raytpu_node_shm_used_bytes", "shared-memory arena bytes in use")
         self.shm_capacity = metrics.Gauge(
             "raytpu_node_shm_capacity_bytes", "shared-memory arena capacity")
+        self.shm_used_hw = metrics.Gauge(
+            "raytpu_node_shm_used_highwater_bytes",
+            "shared-memory arena high-water mark since daemon start")
+        self._shm_hw = 0.0
         self.pending = metrics.Gauge(
             "raytpu_node_pending_tasks", "tasks queued on the node")
         self.running = metrics.Gauge(
@@ -552,8 +557,14 @@ class _NodeMetrics:
             if rss_kb is not None:
                 self.rss.set(rss_kb * 1024.0)
             if node.shm is not None:
-                self.shm_used.set(float(node.shm.used_bytes()))
+                used = float(node.shm.used_bytes())
+                self.shm_used.set(used)
                 self.shm_capacity.set(float(node.shm.capacity()))
+                # High-water only observed at refresh cadence — an exact
+                # peak would need a hook inside every allocation.
+                if used > self._shm_hw:
+                    self._shm_hw = used
+                self.shm_used_hw.set(self._shm_hw)
             with node.backend._lock:
                 self.pending.set(float(len(node.backend._tasks)))
                 self.running.set(float(len(node.backend._running)))
@@ -671,6 +682,10 @@ class NodeServer:
         # single ship path as task events).
         h("report_metrics", self._h_report_metrics)
         h("metrics_query", self._h_metrics_query)
+        # Continuous profiling: pool workers drain their collapsed-stack
+        # frame buffers here; the frames relay head-ward on the next
+        # heartbeat (same single ship path as metrics).
+        h("report_profile", self._h_report_profile)
         # Worker-process plane
         h("register_worker", self._h_register_worker)
         h("task_blocked", self._h_task_blocked)
@@ -782,6 +797,8 @@ class NodeServer:
         metrics.set_shipper_identity(
             ("driver:" if self.labels.get("role") == "driver" else "node:")
             + self.node_id.hex()[:12])
+        if profiler.profiling_enabled():
+            profiler.start_continuous()
         if self._worker_processes:
             from raytpu.cluster.worker_pool import WorkerPool
 
@@ -979,16 +996,28 @@ class NodeServer:
                     mframes, mdropped = metrics.drain()
                 else:
                     mframes, mdropped = [], 0
+                # Profile snapshots ride the same beat. The ship
+                # failpoint models a lost leg: the drained batch is
+                # discarded INTO the drop counter, so accounting stays
+                # exact even when chaos eats the frames.
+                pframes, pdropped = [], 0
+                if profiler.profiling_enabled():
+                    pframes, pdropped = profiler.prof_drain()
+                    if pframes and failpoint("profile.ship") is DROP:
+                        profiler.prof_discard(pframes, pdropped)
+                        pframes, pdropped = [], 0
                 try:
                     self._head.call(
                         "heartbeat", self.node_id.hex(), avail, seq,
                         batch, dropped, obj_deltas, mframes, mdropped,
+                        pframes, pdropped,
                         timeout=tuning.CONTROL_CALL_TIMEOUT_S,
                     )
                 except Exception:
                     task_events.requeue(batch, dropped)
                     self._requeue_obj_deltas(obj_deltas)
                     metrics.requeue(mframes, mdropped)
+                    profiler.prof_requeue(pframes, pdropped)
                     raise
                 backoff = 0.0
             except Exception as e:
@@ -1175,6 +1204,14 @@ class NodeServer:
         """Fold a pool worker's drained metric frames into this daemon's
         buffer; the next heartbeat relays them to the head's TSDB."""
         metrics.ingest(frames or [], dropped or 0)
+
+    def _h_report_profile(self, peer: Peer, frames: List[list],
+                          dropped: int = 0) -> None:
+        """Fold a pool worker's drained profile frames into this
+        daemon's buffer; the next heartbeat relays them to the head's
+        ProfileStore (ingest is unconditional: the relay must not eat a
+        worker's frames just because this daemon's flag is off)."""
+        profiler.prof_ingest(frames or [], dropped or 0)
 
     def _h_metrics_query(self, peer: Peer, name: str, tags=None,
                          agg: str = "sum", since_s: float = 600.0,

@@ -37,6 +37,7 @@ from raytpu.cluster import wire
 from raytpu.cluster.protocol import Peer, RpcClient, RpcServer
 from raytpu.util import errors
 from raytpu.util import metrics
+from raytpu.util import profiler
 from raytpu.util import task_events, tracing
 from raytpu.util.failpoints import failpoint
 from raytpu.core.errors import ActorDiedError, TaskError
@@ -414,6 +415,20 @@ class _WorkerHost:
         except Exception:
             metrics.requeue(frames, dropped)
 
+    def flush_profile(self) -> None:
+        """Ship this worker's continuous-profile snapshot frames to the
+        node daemon (relayed on its next heartbeat — same single ship
+        path as metrics). A failed notify requeues, so frames survive a
+        daemon hiccup."""
+        if profiler.profiling_enabled():
+            frames, dropped = profiler.prof_drain()
+            if not frames and not dropped:
+                return
+            try:
+                self.node.notify("report_profile", frames, dropped)
+            except Exception:
+                profiler.prof_requeue(frames, dropped)
+
     def create_actor(self, spec: TaskSpec) -> dict:
         self.actor_spec = spec
         try:
@@ -562,6 +577,8 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
                                      worker_id=args.worker_id)
     metrics.set_shipper_identity(
         f"worker:{args.node_id[:12]}.{args.worker_id[:12]}")
+    if profiler.profiling_enabled():
+        profiler.start_continuous()
 
     host = _WorkerHost(
         args.node, args.shm or None,
@@ -674,6 +691,8 @@ def main() -> None:  # pragma: no cover - runs as a subprocess
         time.sleep(tuning.PENDING_POLL_PERIOD_S)
         if metrics.enabled():
             host.flush_metrics()
+        if profiler.profiling_enabled():
+            host.flush_profile()
     os._exit(0)
 
 

@@ -229,6 +229,22 @@ declare_env("RAYTPU_METRIC_MAX_SERIES",
 declare_env("RAYTPU_METRICS_BUFFER_MAX",
             "per-process pending metric-frame buffer cap")
 
+# Continuous profiling (util/profiler.py): read at import so the
+# duty-cycled sampler is configured before any cluster config exists.
+declare_env("RAYTPU_PROFILE_CONTINUOUS",
+            "always-on duty-cycled sampling profiler (bool, default off)")
+declare_env("RAYTPU_PROFILE_PERIOD_S",
+            "seconds between continuous-profiler sampling bursts")
+declare_env("RAYTPU_PROFILE_WINDOW_S",
+            "duration of one continuous-profiler sampling burst")
+declare_env("RAYTPU_PROFILE_HZ", "continuous-profiler sampling rate")
+declare_env("RAYTPU_PROFILE_BUFFER_MAX",
+            "per-process pending profile-frame buffer cap")
+declare_env("RAYTPU_PROFILE_STACKS_MAX",
+            "hottest stacks kept per profile snapshot before (other)")
+declare_env("RAYTPU_CHIP_PEAK_FLOPS",
+            "per-chip peak FLOP/s override for MFU accounting")
+
 # --- Declared knobs (reference: ray_config_def.h) ----------------------------
 
 # Scheduling. Hybrid policy packs nodes until utilization crosses this
@@ -329,6 +345,13 @@ declare("metrics_fine_slots", 120)
 declare("metrics_coarse_step_s", 30.0)
 declare("metrics_coarse_slots", 120)
 # SLO alert rules evaluated on the head over the TSDB, ';'-separated,
-# e.g. "raytpu_infer_ttft_seconds:p95 > 2.0 for 30s". Fires into the
-# ops-event log (state.list_events / post-mortem dumps).
+# e.g. "raytpu_infer_ttft_seconds:p95 > 2.0 for 30s" or with tag
+# selectors "raytpu_tenant_queued{tenant=a} > 100 for 30s". Fires into
+# the ops-event log (state.list_events / post-mortem dumps).
 declare("metrics_alert_rules", "")
+
+# Head-side cluster profile store (util/profstore.py): per-proc rings of
+# shipped collapsed-stack snapshots under one byte cap, FIFO-evicted
+# like the TSDB.
+declare("profile_store_max_bytes", 4 * 1024 * 1024)
+declare("profile_ring_slots", 120)

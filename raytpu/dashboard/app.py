@@ -196,6 +196,22 @@ class DashboardServer:
                 if n.get("Alive")
                 and n.get("Labels", {}).get("role") != "driver"]
 
+    def _store_profile(self, mode: str, since_s: float,
+                       recent_s: float) -> Optional[Dict[str, Any]]:
+        """Continuous-profile store query against the head's
+        ``profile_query`` RPC; None when not in cluster mode or the
+        head is unreachable."""
+        from raytpu.runtime import api as rt_api
+
+        b = rt_api._backend
+        if b is None or type(b).__name__ != "ClusterBackend":
+            return None
+        try:
+            return b._head.call("profile_query", mode, since_s, 0.0,
+                                recent_s)
+        except Exception:
+            return None
+
     def _cluster_prometheus(self) -> Optional[str]:
         """Cluster-aggregated exposition text from the head TSDB; None
         when not in cluster mode or the head is unreachable (callers
@@ -526,6 +542,57 @@ class DashboardServer:
                               f"{total:,} KiB traced (weights = KiB)")
             return web.Response(text=svg, content_type="image/svg+xml")
 
+        async def api_profile(request):
+            """Continuous-profile store view (the head's ProfileStore,
+            fed by every process while RAYTPU_PROFILE_CONTINUOUS=1 —
+            no on-demand sampling). Query params: ?mode=merged|diff,
+            ?since=<s, merged window>, ?recent=<s, diff window>,
+            ?format=json|svg|collapsed."""
+            from raytpu.util.profiler import (flamegraph_svg,
+                                              to_collapsed_text)
+
+            q = request.query
+            mode = q.get("mode", "merged")
+            if mode not in ("merged", "diff"):
+                return web.Response(status=400,
+                                    text="mode must be merged|diff")
+            try:
+                since_s = float(q.get("since", 600.0))
+                recent_s = float(q.get("recent", 120.0))
+            except ValueError:
+                return web.Response(
+                    status=400, text="since/recent must be numbers")
+            loop = asyncio.get_running_loop()
+            data = await loop.run_in_executor(
+                None, self._store_profile, mode, since_s, recent_s)
+            if data is None:
+                return web.Response(
+                    status=503,
+                    text="profile store unavailable (not cluster mode "
+                         "or head unreachable)")
+            fmt = q.get("format", "json")
+            if fmt == "json":
+                return web.json_response(data)
+            collapsed = (data.get("delta") if mode == "diff"
+                         else data.get("collapsed")) or {}
+            if fmt == "collapsed":
+                return web.Response(
+                    text=to_collapsed_text(collapsed),
+                    content_type="text/plain",
+                    headers={"Content-Disposition":
+                             "attachment; filename=profile.collapsed"})
+            if mode == "diff":
+                title = (f"cluster profile diff — last {recent_s:g}s "
+                         f"minus prior {recent_s:g}s")
+            else:
+                title = (f"cluster profile — last {since_s:g}s, "
+                         f"{data.get('samples', 0)} samples, "
+                         f"{len(data.get('procs') or [])} proc(s)")
+            # SVG weights must be positive; a diff keeps what got hotter.
+            pos = {k: v for k, v in collapsed.items() if v > 0}
+            return web.Response(text=flamegraph_svg(pos, title=title),
+                                content_type="image/svg+xml")
+
         async def api_state_list(request):
             """Flight-recorder state listings (reference: the state API
             REST endpoints over GcsTaskManager). ?state= ?node= ?name=
@@ -611,6 +678,7 @@ class DashboardServer:
         app.router.add_get("/api/trace", api_trace)
         app.router.add_get("/api/metrics/query", api_metrics_query)
         app.router.add_get("/api/metrics/series", api_metrics_series)
+        app.router.add_get("/api/profile", api_profile)
         app.router.add_get("/api/state/summary/{kind}", api_state_summary)
         app.router.add_get("/api/state/timeline/{entity_id}",
                            api_state_timeline)

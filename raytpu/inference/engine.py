@@ -39,6 +39,8 @@ from raytpu.inference.sampling import SamplingParams, sample_token
 from raytpu.inference.scheduler import Scheduler, Sequence
 from raytpu.util import tracing
 from raytpu.util.metrics import Counter, Gauge, Histogram
+from raytpu.util.profiler import profiling_enabled
+from raytpu.util.stepprof import cost_analysis_flops, step_profiler
 
 _running_gauge = Gauge("raytpu_infer_running_requests",
                        "Sequences currently decoding")
@@ -178,6 +180,7 @@ class InferenceEngine:
         self._decode_tokens = 0
         self._arrival_ts: Dict[str, float] = {}
         self._ttft_window = collections.deque(maxlen=256)
+        self._hbm_tick = 0
         self._jnp = jax.numpy
         self._prefill_fn = self._build_prefill_fn(jax)
         self._chunk_fn = self._build_chunk_prefill_fn(jax)
@@ -409,6 +412,7 @@ class InferenceEngine:
             [s.request_id for s in seqs], P, batch=bucket)
         if self.paged_attn_impl == "reference":
             self._pages_gathered += bucket * P
+        t_dec = time.perf_counter()
         with tracing.span("infer.decode", {"batch": b, "bucket": bucket}):
             logits, ks, vs = self._decode_fn(
                 self._params, self.cache.k, self.cache.v,
@@ -416,7 +420,23 @@ class InferenceEngine:
                 jnp.asarray(dests), jnp.asarray(tables),
                 jnp.asarray(context_lens))
             self.cache.k, self.cache.v = ks, vs
-        logits_np = np.asarray(logits)
+        logits_np = np.asarray(logits)  # host sync: dt covers the real step
+        if profiling_enabled():
+            prof = step_profiler("infer")
+            # FLOPs from XLA's own cost model, computed once per
+            # (batch bucket x table width) program — lower() reuses the
+            # jit cache, so this never triggers a second compile.
+            flops = prof.ensure_flops(
+                ("decode", bucket, P),
+                lambda: cost_analysis_flops(
+                    self._decode_fn, self._params, self.cache.k,
+                    self.cache.v, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(dests),
+                    jnp.asarray(tables), jnp.asarray(context_lens)))
+            prof.observe_step(time.perf_counter() - t_dec, flops=flops)
+            self._hbm_tick += 1
+            if self._hbm_tick % 32 == 1:
+                prof.observe_hbm()
         for i, seq in enumerate(seqs):
             seq.cached_len += 1
             token = sample_token(logits_np[i], seq.sampling, seq.rng)

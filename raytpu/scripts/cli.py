@@ -127,6 +127,16 @@ def _cmd_top(args) -> int:
             + fmt(latest("raytpu_node_push_rx_bytes_total", "rate"),
                   "{:.2f}", 1 / 2**20) + " MB/s",
         ]
+        mfu_t = latest("raytpu_train_mfu", "max")
+        st_t = latest("raytpu_train_step_seconds", "p50")
+        mfu_i = latest("raytpu_infer_decode_mfu", "max")
+        st_i = latest("raytpu_infer_step_seconds", "p50")
+        if any(v is not None for v in (mfu_t, st_t, mfu_i, st_i)):
+            lines.append(
+                "  mfu       train " + fmt(mfu_t, "{:.1f}", 100.0)
+                + "%  step p50 " + fmt(st_t, "{:.0f}", 1e3) + " ms"
+                + "   infer " + fmt(mfu_i, "{:.1f}", 100.0)
+                + "%  step p50 " + fmt(st_i, "{:.1f}", 1e3) + " ms")
         kv = latest("raytpu_infer_kv_page_utilization", "max")
         ttft = latest("raytpu_infer_ttft_seconds", "p95")
         if kv is not None or ttft is not None:
@@ -189,6 +199,32 @@ def _cmd_top(args) -> int:
                         f"{int(tv.get('queued', 0)):>6d}  "
                         f"{int(tv.get('running', 0)):>7d}  "
                         f"{usage} / {quota}")
+        if getattr(args, "profile", False):
+            try:
+                pstats = cli.call("profile_stats") or {}
+            except Exception:
+                pstats = {}
+            rows = pstats.get("procs") or []
+            if rows:
+                lines += ["", "  profile proc                   frames"
+                              "  samples  dropped"]
+                for r in rows:
+                    lines.append(
+                        f"  {str(r.get('proc', ''))[:28]:<28} "
+                        f"{int(r.get('frames', 0)):>7d} "
+                        f"{int(r.get('samples', 0)):>8d} "
+                        f"{int(r.get('dropped', 0)):>8d}")
+            store = pstats.get("store") or {}
+            if store:
+                lines.append(
+                    f"  profile store: {int(store.get('bytes', 0)):,} B"
+                    f" / {int(store.get('max_bytes', 0)):,} B,"
+                    f" evicted {int(store.get('frames_evicted', 0))},"
+                    f" upstream drops "
+                    f"{int(store.get('upstream_drops', 0))}")
+            elif not rows:
+                lines += ["", "  profile store empty "
+                              "(RAYTPU_PROFILE_CONTINUOUS=1?)"]
         if not args.no_clear:
             sys.stdout.write("\x1b[2J\x1b[H")
         print("\n".join(lines), flush=True)
@@ -389,6 +425,9 @@ def _cmd_state(args) -> int:
         print(f"no recorded {args.kind} matching {args.entity_id!r} "
               f"(is RAYTPU_TASK_EVENTS=1 set?)", file=sys.stderr)
         return 1
+    if getattr(args, "detail", False):
+        rec = dict(rec)
+        rec["rpc_stages"] = state.rpc_stage_summary()
     print(json.dumps(rec, indent=2, default=str))
     return 0
 
@@ -432,14 +471,69 @@ def _cmd_stack(args) -> int:
     return 0
 
 
+def _profile_from_store(args) -> int:
+    """Read the head's continuous-profile store — no on-demand sampling;
+    the frames were shipped over heartbeats by every process while
+    ``RAYTPU_PROFILE_CONTINUOUS=1`` was set."""
+    from raytpu.cluster.protocol import RpcClient
+    from raytpu.util.profiler import flamegraph_svg, to_collapsed_text
+
+    cli = RpcClient(args.address)
+    try:
+        if args.diff is not None:
+            res = cli.call("profile_query", "diff", 0.0, 0.0, args.diff)
+            collapsed = res.get("delta") or {}
+            recent = res.get("recent") or {}
+            title = (f"cluster profile diff — last {args.diff:g}s minus "
+                     f"prior {args.diff:g}s")
+            print(f"{len(collapsed)} changed stack(s); recent window: "
+                  f"{recent.get('samples', 0)} samples from "
+                  f"{len(recent.get('procs') or [])} proc(s)",
+                  file=sys.stderr)
+        else:
+            res = cli.call("profile_query", "merged", args.since)
+            collapsed = res.get("collapsed") or {}
+            procs = res.get("procs") or []
+            title = (f"cluster profile — last {args.since:g}s, "
+                     f"{res.get('samples', 0)} samples, "
+                     f"{len(procs)} proc(s)")
+            print(f"{res.get('frames', 0)} frame(s) / "
+                  f"{res.get('samples', 0)} samples from "
+                  f"{len(procs)} proc(s)", file=sys.stderr)
+    finally:
+        cli.close()
+    if not collapsed:
+        print("profile store is empty (is RAYTPU_PROFILE_CONTINUOUS=1 "
+              "set on the cluster?)", file=sys.stderr)
+        return 1
+    if args.out.endswith(".collapsed") or args.out == "-":
+        text = to_collapsed_text(collapsed)
+        if args.out == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.out, "w") as f:
+                f.write(text)
+    else:
+        # SVG weights must be positive; a diff keeps only what got
+        # hotter (the full signed delta is in the .collapsed output).
+        pos = {k: v for k, v in collapsed.items() if v > 0}
+        with open(args.out, "w") as f:
+            f.write(flamegraph_svg(pos, title=title))
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
 def _cmd_profile(args) -> int:
     """Sample CPU profiles of live workers and write a flamegraph SVG
     (reference: ``ray``'s dashboard py-spy flamegraphs;
-    profile_manager.py:79)."""
+    profile_manager.py:79). With ``--continuous``/``--diff``, read the
+    head's always-on profile store instead of sampling now."""
     from raytpu.util.profiler import (flamegraph_svg, merge_collapsed,
                                       to_collapsed_text)
     from raytpu.util.stack_dump import fanout_node_call
 
+    if args.continuous or args.diff is not None:
+        return _profile_from_store(args)
     results = fanout_node_call(
         _cluster_worker_nodes(args.address), "worker_profile",
         args.worker, args.duration, args.hz, args.idle,
@@ -673,6 +767,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append instead of clearing the screen")
     s.add_argument("--tenants", action="store_true",
                    help="add a per-tenant quota/usage/queue pane")
+    s.add_argument("--profile", action="store_true",
+                   help="add a per-proc continuous-profile pane "
+                        "(frames/samples/ship drops)")
     s.set_defaults(fn=_cmd_top)
 
     s = sub.add_parser("tenant", help="tenant quotas, weights, priorities")
@@ -767,6 +864,10 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--kind", default="task",
                     choices=("task", "actor", "object", "node"))
     st.add_argument("--address", default=None)
+    st.add_argument("--detail", action="store_true",
+                    help="attach cluster RPC per-stage timing columns "
+                         "(recv/decode/queue/handler/encode/send "
+                         "p50/p95)")
     st.set_defaults(fn=_cmd_state)
 
     s = sub.add_parser(
@@ -787,6 +888,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--hz", type=float, default=50.0)
     s.add_argument("--idle", action="store_true",
                    help="keep parked threads in the profile")
+    s.add_argument("--continuous", action="store_true",
+                   help="read the head's always-on profile store "
+                        "(RAYTPU_PROFILE_CONTINUOUS=1) instead of "
+                        "sampling now")
+    s.add_argument("--since", type=float, default=600.0,
+                   help="store window seconds (with --continuous)")
+    s.add_argument("--diff", type=float, default=None, metavar="S",
+                   help="store diff flamegraph: last S seconds minus "
+                        "the prior S (implies --continuous)")
     s.add_argument("--out", default="profile.svg",
                    help="output path (.svg, .collapsed, or '-')")
     s.add_argument("worker", nargs="?", default=None,

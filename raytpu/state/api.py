@@ -347,6 +347,31 @@ def list_metric_series(prefix: Optional[str] = None) -> \
     return _local_metric_store().series(prefix)
 
 
+def rpc_stage_summary(since_s: float = 600.0) -> Dict[str, Any]:
+    """Per-stage RPC dispatch timing — recv/decode/queue/handler/encode/
+    send p50/p95 seconds from ``raytpu_rpc_stage_seconds``, grouped
+    ``{method: {stage: {"p50", "p95"}}}``. Empty until a process with
+    ``RAYTPU_PROFILE_CONTINUOUS=1`` has served RPCs (the histogram only
+    moves while stage timing is armed)."""
+    series = list_metric_series("raytpu_rpc_stage_seconds") or []
+    combos = sorted({(s["tags"].get("stage", ""),
+                      s["tags"].get("method", "")) for s in series})
+    out: Dict[str, Any] = {}
+    for stage, method in combos:
+        if not stage:
+            continue
+        tags = {"stage": stage, "method": method}
+        row: Dict[str, Any] = {}
+        for q in ("p50", "p95"):
+            res = query_metrics("raytpu_rpc_stage_seconds", tags=tags,
+                                agg=q, since_s=since_s)
+            pts = [p for p in (res or {}).get("points") or []
+                   if p[1] is not None]
+            row[q] = pts[-1][1] if pts else None
+        out.setdefault(method, {})[stage] = row
+    return out
+
+
 # -- summaries & timelines ----------------------------------------------------
 
 

@@ -25,6 +25,7 @@ from raytpu.train.torch_trainer import (TorchTrainer, prepare_data_loader,
 from raytpu.train.trainer import (BaseTrainer,
                                   DataParallelTrainer,
                                   JaxTrainer)
+from raytpu.util.stepprof import StepProfiler, cost_analysis_flops
 
 __all__ = [
     "BaseTrainer",
@@ -46,6 +47,8 @@ __all__ = [
     "get_context",
     "get_checkpoint",
     "get_dataset_shard",
+    "StepProfiler",
+    "cost_analysis_flops",
 ]
 
 from raytpu.util import usage_stats as _usage_stats

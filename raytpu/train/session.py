@@ -13,6 +13,9 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from raytpu.util.profiler import profiling_enabled
+from raytpu.util.stepprof import step_profiler
+
 
 @dataclass
 class TrainContext:
@@ -51,6 +54,17 @@ class _Session:
         self.dataset_shards = dataset_shards or {}
 
     def report(self, metrics: Dict[str, Any], checkpoint=None):
+        # Step attribution: consecutive report() calls bound one step.
+        # MFU needs the loop to pass its per-step FLOPs (key "flops" or
+        # "step_flops", e.g. from stepprof.cost_analysis_flops); without
+        # it only the step-time histogram moves.
+        if profiling_enabled():
+            prof = step_profiler("train")
+            dt = prof.mark()
+            if dt is not None:
+                f = metrics.get("flops") or metrics.get("step_flops")
+                prof.observe_step(dt, flops=float(f) if f else None)
+                prof.observe_hbm()
         # Only rank 0's checkpoint is persisted by the trainer (single-
         # controller design) — dropping the others here avoids staging a
         # full copy per worker per report that nobody ever drains.

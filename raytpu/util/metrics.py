@@ -73,6 +73,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "raytpu_tenant_tasks_placed_total": "placements per tenant",
     "raytpu_tenant_throttled_total": "admission-shed submissions per tenant",
     # -- inference serving ---------------------------------------------
+    "raytpu_infer_decode_mfu": "model FLOPs utilization per decode step",
     "raytpu_infer_decode_tokens_per_s": "decode throughput",
     "raytpu_infer_decode_tokens_total": "decode tokens generated",
     "raytpu_infer_kv_page_utilization": "KV page pool utilization 0..1",
@@ -83,6 +84,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "raytpu_infer_prefix_hits_total": "prefix cache lookup hits",
     "raytpu_infer_prefix_lookups_total": "prefix cache lookups",
     "raytpu_infer_running_requests": "requests in the running batch",
+    "raytpu_infer_step_seconds": "decode step wall time",
     "raytpu_infer_ttft_seconds": "time-to-first-token distribution",
     "raytpu_infer_waiting_requests": "requests queued for admission",
     # -- node daemon ---------------------------------------------------
@@ -93,6 +95,16 @@ DECLARED_METRICS: Dict[str, str] = {
     "raytpu_node_running_tasks": "tasks executing on the node",
     "raytpu_node_shm_capacity_bytes": "shared-memory arena capacity",
     "raytpu_node_shm_used_bytes": "shared-memory arena bytes in use",
+    "raytpu_node_shm_used_highwater_bytes":
+        "shared-memory arena high-water mark since daemon start",
+    # -- continuous profiling / performance attribution ----------------
+    "raytpu_hbm_peak_bytes": "device memory high-water mark",
+    "raytpu_hbm_used_bytes": "device memory in use",
+    "raytpu_rpc_stage_seconds":
+        "server dispatch wall time per stage (recv/decode/queue/"
+        "handler/encode/send)",
+    "raytpu_train_mfu": "model FLOPs utilization per train step",
+    "raytpu_train_step_seconds": "train step wall time",
     # -- serve ---------------------------------------------------------
     "raytpu_serve_requests_total":
         "serve requests routed, by deployment and tenant",

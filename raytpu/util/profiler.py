@@ -25,7 +25,8 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 # Leaf code names that mean "this thread is parked, not burning CPU" —
 # a heuristic (py-spy uses native-state instead), documented as such.
@@ -35,6 +36,25 @@ _IDLE_LEAVES = {
     "_wait_for_tstate_lock", "wait_for", "run_forever", "_run_once",
     "select_poll", "flowcontrol",
 }
+
+# Frame labels cached per code object (function-level granularity:
+# ``name (file:def-line)``). Formatting a label per frame per thread
+# per sample is THE sampling cost — with a dozen threads it puts
+# milliseconds of GIL stall into every sample — and def-line keys are
+# also stabler across runs than instruction-pointer lines, so diff
+# flamegraphs churn less. Code objects are interned for the process
+# lifetime; the cache is bounded by the live code set.
+_code_labels: Dict[object, str] = {}
+
+
+def _code_label(code) -> str:
+    label = _code_labels.get(code)
+    if label is None:
+        label = (f"{code.co_name} "
+                 f"({os.path.basename(code.co_filename)}:"
+                 f"{code.co_firstlineno})")
+        _code_labels[code] = label
+    return label
 
 
 def sample_for(duration_s: float = 2.0, hz: float = 50.0,
@@ -51,23 +71,22 @@ def sample_for(duration_s: float = 2.0, hz: float = 50.0,
     collapsed: Dict[str, int] = {}
     samples = 0
     me = threading.get_ident()
+    # Thread names once per burst, not per sample (enumerate allocates
+    # under a lock); a thread born mid-burst keys as ``thread-<tid>``.
+    names = {t.ident: t.name for t in threading.enumerate()}
     deadline = time.monotonic() + duration_s
     while True:
         now = time.monotonic()
         if now >= deadline:
             break
         frames = sys._current_frames()
-        names = {t.ident: t.name for t in threading.enumerate()}
         for tid, frame in frames.items():
             if tid == me:
                 continue  # never profile the profiler
             stack: List[str] = []
             f = frame
             while f is not None:
-                code = f.f_code
-                stack.append(f"{code.co_name} "
-                             f"({os.path.basename(code.co_filename)}:"
-                             f"{f.f_lineno})")
+                stack.append(_code_label(f.f_code))
                 f = f.f_back
             if not stack:
                 continue
@@ -85,12 +104,40 @@ def sample_for(duration_s: float = 2.0, hz: float = 50.0,
             "duration_s": duration_s, "hz": hz, "pid": os.getpid()}
 
 
-def merge_collapsed(profiles) -> Dict[str, int]:
-    """Merge several ``collapsed`` dicts (e.g. one per worker)."""
+def fold_threads(collapsed: Dict[str, int]) -> Dict[str, int]:
+    """Strip the leading thread-name segment and aggregate same-stack
+    frames across threads, iterating sorted keys so the result is
+    byte-identical across runs. Thread names carry unstable serials
+    (``ThreadPoolExecutor-0_3``), so an unfolded merge makes every diff
+    flamegraph churn on pool-thread identity instead of code."""
+    out: Dict[str, int] = {}
+    for key in sorted(collapsed or {}):
+        folded = key.split(";", 1)[1] if ";" in key else key
+        out[folded] = out.get(folded, 0) + int(collapsed[key])
+    return {k: out[k] for k in sorted(out)}
+
+
+def merge_collapsed(profiles, fold: bool = False) -> Dict[str, int]:
+    """Merge several ``collapsed`` dicts (e.g. one per worker) with
+    deterministic (sorted-key) aggregation; ``fold=True`` additionally
+    folds same-stack frames across threads via :func:`fold_threads`."""
     out: Dict[str, int] = {}
     for p in profiles:
-        for k, v in (p or {}).items():
-            out[k] = out.get(k, 0) + int(v)
+        src = fold_threads(p) if fold else (p or {})
+        for k in sorted(src):
+            out[k] = out.get(k, 0) + int(src[k])
+    return {k: out[k] for k in sorted(out)}
+
+
+def diff_collapsed(recent: Dict[str, int],
+                   baseline: Dict[str, int]) -> Dict[str, int]:
+    """Signed per-stack delta ``recent - baseline`` (zero rows elided,
+    sorted keys). Positive = the stack grew; negative = it shrank."""
+    out: Dict[str, int] = {}
+    for k in sorted(set(recent or {}) | set(baseline or {})):
+        d = int((recent or {}).get(k, 0)) - int((baseline or {}).get(k, 0))
+        if d:
+            out[k] = d
     return out
 
 
@@ -189,3 +236,242 @@ def profile_to_svg(profile: dict, title: Optional[str] = None) -> str:
                           title or f"pid {profile.get('pid', '?')}, "
                                    f"{profile.get('samples', 0)} samples "
                                    f"@ {profile.get('hz', 0):g} Hz")
+
+
+# ---------------------------------------------------------------------------
+# Continuous profiling: always-on duty-cycled sampling + cluster shipping.
+#
+# Mirrors the metrics-shipping contract exactly (util/metrics.py): a
+# bounded per-process frame buffer, per-origin monotonic seq for
+# idempotent re-ship, watermark drop accounting across drain/requeue,
+# and a relay ``ingest`` so worker frames ride the node's heartbeat.
+#
+# Frame shape (strict-wire primitives only):
+#   [proc_id, seq, ts, collapsed, samples, window_s]
+# with ``collapsed`` a thread-folded {stack: count} dict already capped
+# to the top RAYTPU_PROFILE_STACKS_MAX stacks (remainder under "(other)").
+# ---------------------------------------------------------------------------
+
+ENV_PROFILE = "RAYTPU_PROFILE_CONTINUOUS"
+ENV_PROFILE_PERIOD = "RAYTPU_PROFILE_PERIOD_S"
+ENV_PROFILE_WINDOW = "RAYTPU_PROFILE_WINDOW_S"
+ENV_PROFILE_HZ = "RAYTPU_PROFILE_HZ"
+ENV_PROFILE_BUFFER_MAX = "RAYTPU_PROFILE_BUFFER_MAX"
+ENV_PROFILE_STACKS_MAX = "RAYTPU_PROFILE_STACKS_MAX"
+
+# Duty cycle: one PROFILE_WINDOW_S sampling burst at PROFILE_HZ every
+# PROFILE_PERIOD_S — ~1e-3 duty at the defaults, so the always-on cost
+# is the burst amortized to noise (BENCH_r18 pins it < 3%).
+_PROFILE_PERIOD_S = float(os.environ.get(ENV_PROFILE_PERIOD, "") or 10.0)
+_PROFILE_WINDOW_S = float(os.environ.get(ENV_PROFILE_WINDOW, "") or 1.0)
+_PROFILE_HZ = float(os.environ.get(ENV_PROFILE_HZ, "") or 25.0)
+_PROF_BUFFER_MAX = int(os.environ.get(ENV_PROFILE_BUFFER_MAX, "") or 64)
+_PROF_STACKS_MAX = int(os.environ.get(ENV_PROFILE_STACKS_MAX, "") or 200)
+
+_profile_enabled = os.environ.get(ENV_PROFILE, "") in ("1", "true", "True")
+_prof_lock = threading.Lock()
+_prof_frames: Deque[list] = deque()
+_prof_dropped_total = 0
+_prof_dropped_shipped = 0  # watermark: drops already reported downstream
+_prof_seq = 0
+
+
+def profiling_enabled() -> bool:
+    """THE flag check: every continuous-profiler emission site guards
+    with exactly this call (lint rule RTP019), so the default-off mode
+    costs one boolean read per site."""
+    return _profile_enabled
+
+
+def enable_profiling(env: bool = False) -> None:
+    global _profile_enabled
+    _profile_enabled = True
+    if env:
+        os.environ[ENV_PROFILE] = "1"
+
+
+def disable_profiling(env: bool = False) -> None:
+    global _profile_enabled
+    _profile_enabled = False
+    if env:
+        os.environ[ENV_PROFILE] = "0"
+
+
+def _cap_stacks(collapsed: Dict[str, int],
+                max_stacks: int) -> Dict[str, int]:
+    """Bound one snapshot to the hottest ``max_stacks`` stacks (ties
+    broken by key, so the cap is deterministic); everything below the
+    cut folds into ``(other)`` — totals stay exact."""
+    if len(collapsed) <= max_stacks:
+        return collapsed
+    ranked = sorted(collapsed.items(), key=lambda kv: (-kv[1], kv[0]))
+    out = dict(sorted(ranked[:max_stacks]))
+    rest = sum(v for _, v in ranked[max_stacks:])
+    if rest:
+        out["(other)"] = out.get("(other)", 0) + rest
+    return out
+
+
+def prof_snapshot(window_s: Optional[float] = None,
+                  hz: Optional[float] = None) -> bool:
+    """Sample one duty-cycle window and enqueue a bounded, thread-folded
+    frame. Returns True iff a frame was produced."""
+    from raytpu.util.failpoints import DROP, failpoint
+    if failpoint("profile.snapshot") is DROP:
+        return False
+    w = _PROFILE_WINDOW_S if window_s is None else float(window_s)
+    h = _PROFILE_HZ if hz is None else float(hz)
+    prof = sample_for(w, h, include_idle=True)
+    collapsed = _cap_stacks(fold_threads(prof["collapsed"]),
+                            _PROF_STACKS_MAX)
+    if not collapsed:
+        return False
+    from raytpu.util import metrics as _metrics
+    global _prof_seq, _prof_dropped_total
+    with _prof_lock:
+        _prof_seq += 1
+        frame = [_metrics.shipper_identity(), _prof_seq, time.time(),
+                 collapsed, int(prof["samples"]), w]
+        if len(_prof_frames) >= _PROF_BUFFER_MAX:
+            _prof_frames.popleft()
+            _prof_dropped_total += 1
+        _prof_frames.append(frame)
+    return True
+
+
+def prof_drain() -> Tuple[List[list], int]:
+    """Take everything pending plus the not-yet-reported drop delta; on
+    ship failure hand both back via :func:`prof_requeue` (the watermark
+    arithmetic keeps drop counts exact across retries)."""
+    global _prof_dropped_shipped
+    with _prof_lock:
+        frames = list(_prof_frames)
+        _prof_frames.clear()
+        dropped_delta = _prof_dropped_total - _prof_dropped_shipped
+        _prof_dropped_shipped = _prof_dropped_total
+    return frames, dropped_delta
+
+
+def prof_requeue(frames: List[list], dropped: int = 0) -> None:
+    """Put a failed ship back at the FRONT of the buffer (oldest-first
+    order preserved); overflow drops the oldest of the requeued batch."""
+    if not frames and not dropped:
+        return
+    global _prof_dropped_total, _prof_dropped_shipped
+    with _prof_lock:
+        _prof_dropped_shipped -= dropped
+        space = _PROF_BUFFER_MAX - len(_prof_frames)
+        if len(frames) > space:
+            lost = len(frames) - max(space, 0)
+            frames = frames[lost:]
+            _prof_dropped_total += lost
+        _prof_frames.extendleft(reversed(frames))
+
+
+def prof_discard(frames: List[list], dropped: int = 0) -> None:
+    """A drained batch was LOST in flight (e.g. the ``profile.ship``
+    failpoint dropped it): fold the lost frames into the drop counter
+    and re-owe the already-watermarked drop delta, so the next
+    successful drain reports every loss exactly once."""
+    global _prof_dropped_total, _prof_dropped_shipped
+    with _prof_lock:
+        _prof_dropped_total += len(frames or ())
+        _prof_dropped_shipped -= int(dropped or 0)
+
+
+def prof_ingest(frames: List[list], dropped: int = 0) -> None:
+    """Relay path: a node daemon absorbs a worker's drained frames into
+    its own buffer; they ride the next heartbeat to the head."""
+    global _prof_dropped_total
+    with _prof_lock:
+        _prof_dropped_total += int(dropped or 0)
+        for f in frames or ():
+            if len(_prof_frames) >= _PROF_BUFFER_MAX:
+                _prof_frames.popleft()
+                _prof_dropped_total += 1
+            _prof_frames.append(f)
+
+
+def prof_pending() -> int:
+    with _prof_lock:
+        return len(_prof_frames)
+
+
+def prof_peek() -> List[list]:
+    """Non-destructive copy of the pending buffer (post-mortem dumps:
+    a crashing process's unshipped tail is evidence, not inventory)."""
+    with _prof_lock:
+        return list(_prof_frames)
+
+
+def reset_prof_shipping() -> None:
+    """Test isolation: clear the buffer, counters, and seq."""
+    global _prof_dropped_total, _prof_dropped_shipped, _prof_seq
+    with _prof_lock:
+        _prof_frames.clear()
+        _prof_dropped_total = 0
+        _prof_dropped_shipped = 0
+        _prof_seq = 0
+
+
+class ContinuousProfiler:
+    """Duty-cycled background sampler: one short ``sample_for`` burst
+    every ``period_s``, snapshotting into the shipping buffer. The
+    thread exists only when started; with profiling disabled it idles
+    on the flag check and samples nothing."""
+
+    def __init__(self, period_s: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 hz: Optional[float] = None):
+        self.period_s = _PROFILE_PERIOD_S if period_s is None \
+            else float(period_s)
+        self.window_s = _PROFILE_WINDOW_S if window_s is None \
+            else float(window_s)
+        self.hz = _PROFILE_HZ if hz is None else float(hz)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="raytpu-profiler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.window_s + 1.0)
+        self._thread = None
+        self._stop = threading.Event()
+
+    def _run(self) -> None:
+        wait = max(0.05, self.period_s - self.window_s)
+        while not self._stop.wait(wait):
+            if profiling_enabled():
+                prof_snapshot(self.window_s, self.hz)
+
+
+_continuous: List[Optional[ContinuousProfiler]] = [None]
+
+
+def start_continuous(period_s: Optional[float] = None,
+                     window_s: Optional[float] = None,
+                     hz: Optional[float] = None) -> ContinuousProfiler:
+    """Idempotent per process: head/node/worker entry points call this
+    once (behind the flag) and share the singleton sampler."""
+    with _prof_lock:
+        cp = _continuous[0]
+        if cp is None:
+            cp = _continuous[0] = ContinuousProfiler(period_s, window_s, hz)
+    cp.start()
+    return cp
+
+
+def stop_continuous() -> None:
+    with _prof_lock:
+        cp = _continuous[0]
+        _continuous[0] = None
+    if cp is not None:
+        cp.stop()

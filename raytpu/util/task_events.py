@@ -497,6 +497,19 @@ def write_postmortem(log_dir: str, reason: str,
             payload["events_dropped"] = _events.dropped_count()
         except Exception:
             payload["recent_events"] = []
+        try:
+            from raytpu.util import profiler as _profiler
+
+            if _profiler.profiling_enabled():
+                frames = _profiler.prof_peek()
+                payload["profile"] = {
+                    "collapsed": _profiler.merge_collapsed(
+                        [f[3] for f in frames]),
+                    "frames": len(frames),
+                    "samples": sum(int(f[4]) for f in frames),
+                }
+        except Exception:
+            pass
         os.makedirs(log_dir, exist_ok=True)
         path = os.path.join(
             log_dir, f"postmortem_{os.getpid()}_{int(time.time())}.json")

@@ -557,8 +557,28 @@ def _bucket_quantile(counts: List[int], boundaries: Tuple[float, ...],
 # ---------------------------------------------------------------------------
 
 _RULE_RE = re.compile(
-    r"^\s*([A-Za-z_]\w*)\s*(?::\s*(\w+))?\s*([<>]=?)\s*"
+    r"^\s*([A-Za-z_]\w*)\s*(?:\{([^}]*)\})?\s*(?::\s*(\w+))?\s*([<>]=?)\s*"
     r"([-+0-9.eE]+)\s*(?:for\s+([0-9.]+)\s*s?)?\s*$")
+
+
+def _parse_selector(body: str) -> Dict[str, str]:
+    """Parse the ``{k=v,...}`` tag selector of an alert-rule spec
+    (e.g. ``raytpu_tenant_queued{tenant=acme} > 100 for 30s``).
+    Values may be bare tokens or quoted; an empty body means no
+    tag filter."""
+    tags: Dict[str, str] = {}
+    for pair in body.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        if "=" not in pair:
+            raise ValueError(f"bad tag selector {pair!r}")
+        k, v = pair.split("=", 1)
+        k, v = k.strip(), v.strip().strip("'\"")
+        if not k or not v:
+            raise ValueError(f"bad tag selector {pair!r}")
+        tags[k] = v
+    return tags
 
 
 class AlertRule:
@@ -581,7 +601,11 @@ class AlertRule:
 
     @property
     def name(self) -> str:
-        return (f"{self.metric}:{self.agg} {self.op} "
+        sel = ""
+        if self.tags:
+            sel = "{" + ",".join(
+                f"{k}={self.tags[k]}" for k in sorted(self.tags)) + "}"
+        return (f"{self.metric}{sel}:{self.agg} {self.op} "
                 f"{_fmt(self.threshold)} for {_fmt(self.for_s)}s")
 
     def breached(self, value: float) -> bool:
@@ -602,9 +626,10 @@ def parse_alert_rules(spec: str) -> List[AlertRule]:
         m = _RULE_RE.match(part)
         if not m:
             raise ValueError(f"bad alert rule: {part!r}")
-        metric, agg, op, thr, for_s = m.groups()
+        metric, sel, agg, op, thr, for_s = m.groups()
         rules.append(AlertRule(metric, op, float(thr), agg=agg or "max",
-                               for_s=float(for_s) if for_s else 0.0))
+                               for_s=float(for_s) if for_s else 0.0,
+                               tags=_parse_selector(sel) if sel else None))
     return rules
 
 

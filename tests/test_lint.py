@@ -870,6 +870,79 @@ class TestTenantStamping:  # RTP018
         assert res.findings == []
 
 
+class TestProfileSitePurity:  # RTP019
+    def test_planted_unguarded_emission(self):
+        findings = run_rule_on_source(_rule("RTP019"), _src("""
+            def flush(self):
+                frames, dropped = profiler.prof_drain()
+                self.node.notify("report_profile", frames, dropped)
+        """), rel="raytpu/cluster/x.py")
+        assert len(findings) == 1
+        assert "prof_drain" in findings[0].message
+
+    def test_clean_guarded_emission(self):
+        assert run_rule_on_source(_rule("RTP019"), _src("""
+            def flush(self):
+                if profiler.profiling_enabled():
+                    frames, dropped = profiler.prof_drain()
+                    self.node.notify("report_profile", frames, dropped)
+        """), rel="raytpu/cluster/x.py") == []
+
+    def test_clean_anded_guard_and_nested_if(self):
+        assert run_rule_on_source(_rule("RTP019"), _src("""
+            def dispatch(self, marks, method):
+                if marks is not None and profiling_enabled():
+                    if method != "ping":
+                        _observe_rpc_stages(method, marks)
+        """), rel="raytpu/cluster/x.py") == []
+
+    def test_early_return_style_is_flagged(self):
+        # `if not profiling_enabled(): return` leaves the emission
+        # outside the guard's body — the if-wrapped form is mandated.
+        findings = run_rule_on_source(_rule("RTP019"), _src("""
+            def flush(self):
+                if not profiling_enabled():
+                    return
+                prof_snapshot()
+        """), rel="raytpu/cluster/x.py")
+        assert len(findings) == 1
+        assert "prof_snapshot" in findings[0].message
+
+    def test_double_flag_check_is_flagged(self):
+        findings = run_rule_on_source(_rule("RTP019"), _src("""
+            def flush(self):
+                if profiling_enabled() and profiling_enabled():
+                    prof_snapshot()
+        """), rel="raytpu/cluster/x.py")
+        assert len(findings) == 1
+        assert "2 times" in findings[0].message
+
+    def test_else_branch_is_not_guarded(self):
+        findings = run_rule_on_source(_rule("RTP019"), _src("""
+            def flush(self):
+                if profiling_enabled():
+                    prof_snapshot()
+                else:
+                    prof_drain()
+        """), rel="raytpu/cluster/x.py")
+        assert len(findings) == 1
+        assert "prof_drain" in findings[0].message
+
+    def test_loss_accounting_calls_need_no_guard(self):
+        # requeue/discard/ingest must run even when the local flag is
+        # off (a relay never eats another process's frames).
+        assert run_rule_on_source(_rule("RTP019"), _src("""
+            def on_ship_failed(self, frames, dropped):
+                profiler.prof_requeue(frames, dropped)
+                profiler.prof_discard([], 0)
+                profiler.prof_ingest(frames, dropped)
+        """), rel="raytpu/cluster/x.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP019"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 
