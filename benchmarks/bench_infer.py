@@ -34,6 +34,15 @@ background stepping loop pumps the engine), three scenarios —
 Writes the scenario table to BENCH_r07.json at the repo root and prints
 the same object as one JSON line.
 
+``--load`` then runs the MULTI-REPLICA phase (BENCH_r19.json): two
+replica deployments behind the real prefix-routing policy
+(``serve._private.prefix_router``), 8x the single-replica stream count,
+each stream sharing one of two 48-token system prompts. The same
+workload runs twice — blind power-of-two routing vs prefix-cache-aware
+routing — reporting aggregate generated tokens/s, client TTFT p50/p95,
+and the cross-replica cache-hit rate (prefix-hit tokens / prompt
+tokens). The headline is the on/off TTFT-p95 win and hit-rate gap.
+
 ``--decode-sweep`` runs the PAGED-ATTENTION decode sweep: single
 decode-step latency and tokens/s vs context length {128..4096} x batch
 {1, 8} on a tiny Llama, for three implementations —
@@ -210,6 +219,117 @@ def _run_load_scenario(name, prompts, *, enable_prefix_cache, new_tokens):
     return out
 
 
+def _run_multi_replica_phase(prefix_routing, *, replicas, streams,
+                             new_tokens):
+    """One A/B arm of the multi-replica phase: ``streams`` concurrent
+    clients over ``replicas`` fresh deployments, routed client-side by
+    the REAL prefix-routing policy (or blind power-of-two when off).
+
+    Each stream shares one of two 48-token system prompts, so routing
+    quality shows up directly as the cross-replica cache-hit rate: the
+    aware policy keeps each system prompt's pages on one replica, the
+    blind policy smears both prompts across both replicas and re-pays
+    their prefill."""
+    import random as random_mod
+    import threading
+
+    from raytpu import serve
+    from raytpu.serve._private import prefix_router
+
+    page_size = 8
+    deps = [serve.LLMDeployment._target(engine_options={
+        "page_size": page_size, "max_num_seqs": streams,
+        "max_model_len": 128}) for _ in range(replicas)]
+    rng = random_mod.Random(19)
+    try:
+        systems = [list(range(1, 49)), list(range(201, 249))]
+        prompts = [systems[i % 2] + [300 + 3 * i, 301 + 3 * i, 302 + 3 * i]
+                   for i in range(streams)]
+
+        # Compile warm with SAME-length, disjoint-token prompts: jit
+        # caches go hot, prefix caches stay cold for the measured pass.
+        for dep in deps:
+            list(dep.generate(list(range(400, 400 + len(prompts[0]))),
+                              max_new_tokens=new_tokens))
+
+        def qlen(dep):
+            st = dep.stats()
+            return st["running"] + st["waiting"]
+
+        def choose(prompt):
+            if prefix_routing:
+                summaries = []
+                for i, dep in enumerate(deps):
+                    s = dep.prefix_summary()
+                    summaries.append((f"r{i}", dep, s["digests"]))
+                pick = prefix_router.select_replica(
+                    prefix_router.prompt_digests(prompt, page_size),
+                    summaries, qlen, 10 ** 9, rng)
+                if pick is not None:
+                    return pick
+            a, b = rng.sample(deps, 2)
+            return a if qlen(a) <= qlen(b) else b
+
+        # Seed pass: one completed request per system prompt registers
+        # its pages on the replica the policy picked, mirroring a warm
+        # production fleet.
+        for p in prompts[:2]:
+            list(choose(p).generate(p, max_new_tokens=new_tokens))
+
+        hit0 = sum(d.stats()["prefix_cache"]["hit_tokens"] for d in deps)
+        pre0 = sum(d.stats()["prefill_tokens"] for d in deps)
+        ttfts, counts = [], []
+        lock = threading.Lock()
+
+        def consume(dep, prompt):
+            t0 = time.perf_counter()
+            gen = dep.generate(prompt, max_new_tokens=new_tokens)
+            next(gen)
+            dt = time.perf_counter() - t0
+            n = 1 + sum(1 for _ in gen)
+            with lock:
+                ttfts.append(dt)
+                counts.append(n)
+
+        measured = prompts[2:]
+        threads = []
+        t0 = time.perf_counter()
+        for p in measured:
+            th = threading.Thread(target=consume, args=(choose(p), p))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        elapsed = time.perf_counter() - t0
+
+        hits = sum(d.stats()["prefix_cache"]["hit_tokens"]
+                   for d in deps) - hit0
+        prefills = sum(d.stats()["prefill_tokens"] for d in deps) - pre0
+        prompt_tokens = sum(len(p) for p in measured)
+        return {
+            "prefix_routing": bool(prefix_routing),
+            "replicas": replicas,
+            "streams": len(measured),
+            "generated_tokens_per_s": round(
+                sum(counts) / max(elapsed, 1e-9), 2),
+            "ttft_p50_s": round(_quantile(ttfts, 0.5), 4),
+            "ttft_p95_s": round(_quantile(ttfts, 0.95), 4),
+            # Fraction of prompt tokens whose prefill was skipped via a
+            # cross-replica cache hit. Derived from prefill_tokens, not
+            # the hit_tokens counter: blocked admissions re-run the
+            # prefix match every step, so hit_tokens over-counts under
+            # exactly the contention this phase creates.
+            "cache_hit_rate": round(
+                1.0 - prefills / max(prompt_tokens, 1), 3),
+            "prefix_hit_tokens": hits,
+            "prefill_tokens": prefills,
+            "elapsed_s": round(elapsed, 3),
+        }
+    finally:
+        for dep in deps:
+            dep.shutdown()
+
+
 def main_load() -> None:
     _force_cpu()
     streams = int(os.environ.get("RAYTPU_INFER_LOAD_STREAMS", 8))
@@ -245,6 +365,39 @@ def main_load() -> None:
         json.dump(result, f, indent=2)
         f.write("\n")
     print(json.dumps(result))
+
+    # Multi-replica phase: prefix-routing A/B at 8x the stream count.
+    multi_streams = 8 * streams
+    arms = {
+        "routing_off": _run_multi_replica_phase(
+            False, replicas=2, streams=multi_streams,
+            new_tokens=NEW_TOKENS),
+        "routing_on": _run_multi_replica_phase(
+            True, replicas=2, streams=multi_streams,
+            new_tokens=NEW_TOKENS),
+    }
+    off_arm, on_arm = arms["routing_off"], arms["routing_on"]
+    multi = {
+        "metric": "infer_multi_replica_load",
+        "unit": "aggregate generated tokens/s + client TTFT quantiles + "
+                "cross-replica prefix-cache hit rate, 2 replicas, "
+                "client-side prefix_router policy A/B (tiny llama, CPU "
+                "reference attention)",
+        "arms": arms,
+        "headline": {
+            "prefix_routing_ttft_p95_win": round(
+                off_arm["ttft_p95_s"] / max(on_arm["ttft_p95_s"], 1e-9),
+                2),
+            "cache_hit_rate_on": on_arm["cache_hit_rate"],
+            "cache_hit_rate_off": off_arm["cache_hit_rate"],
+            "prefill_tokens_saved":
+                off_arm["prefill_tokens"] - on_arm["prefill_tokens"],
+        },
+    }
+    with open(os.path.join(root, "BENCH_r19.json"), "w") as f:
+        json.dump(multi, f, indent=2)
+        f.write("\n")
+    print(json.dumps(multi))
 
 
 def _decode_once(fn, params, ks, vs, inputs):

@@ -55,6 +55,9 @@ RTP018 tenant-stamping         every TaskSpec(...) construction passes
 RTP019 profile-site-purity     every continuous-profiler emission call
                                sits inside an if testing exactly one
                                profiling_enabled() check
+RTP020 no-materialized-KV-     KV handoff seams never flatten pool KV
+       shipping                (.tobytes(), whole-pool/layer gathers,
+                               bytes join, pickle.dumps)
 ====== ======================= ====================================
 """
 
@@ -65,6 +68,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     contextvar_crossing,
     env_registry,
     jit_in_builders,
+    kv_shipping,
     metric_registry,
     persist_coverage,
     profile_purity,

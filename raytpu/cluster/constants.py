@@ -245,3 +245,29 @@ RECONNECT_MAX_DELAY_S = _f("RECONNECT_MAX_DELAY_S", 5.0)
 # control-plane notifications (object/actor announcements) to replay
 # after re-registering; older entries are dropped oldest-first.
 HEAD_NOTIFY_BUFFER_MAX = _i("HEAD_NOTIFY_BUFFER_MAX", 1024)
+
+# -- serving plane: router probes, prefix routing, KV handoff ----------------
+
+# Queue-length / prefix-summary probe budget for the serve router. A
+# replica that can't answer within this is scored worst-queue for the
+# pick — NEVER assumed idle (a wedged replica that looked like a
+# zero-length queue would attract every request).
+SERVE_PROBE_TIMEOUT_S = _f("SERVE_PROBE_TIMEOUT_S", 2.0)
+# Prefix-cache-aware routing master switch (0 = blind power-of-two
+# choices, decision-identical to the pre-r19 router). Read at call
+# time so tests can flip it without re-importing the router.
+PREFIX_ROUTING = _i("PREFIX_ROUTING", 0)
+# How long a router may reuse a replica's prefix-summary probe before
+# re-fetching it. Longer = cheaper routing, staler match decisions.
+PREFIX_SUMMARY_TTL_S = _f("PREFIX_SUMMARY_TTL_S", 1.0)
+# Cap on digests per replica prefix summary (bounds probe payloads on
+# replicas with huge caches; oldest registrations are dropped first).
+PREFIX_SUMMARY_MAX = _i("PREFIX_SUMMARY_MAX", 1024)
+# Chunk size for streaming KV pages between replicas during a
+# disaggregated prefill→decode handoff. Each chunk is admitted through
+# the process-wide transfer ByteWindow, so aggregate in-flight handoff
+# bytes stay bounded alongside ordinary object transfers.
+KV_STREAM_CHUNK_BYTES = _i("KV_STREAM_CHUNK_BYTES", 262144)
+# How long a prefill replica keeps an opened-but-unfinished KV export
+# pinned before assuming the decode peer died and freeing the pages.
+KV_HANDOFF_TTL_S = _f("KV_HANDOFF_TTL_S", 30.0)

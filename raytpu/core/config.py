@@ -245,6 +245,20 @@ declare_env("RAYTPU_PROFILE_STACKS_MAX",
 declare_env("RAYTPU_CHIP_PEAK_FLOPS",
             "per-chip peak FLOP/s override for MFU accounting")
 
+# Disaggregated serving plane (serve router + inference/disagg.py).
+declare_env("RAYTPU_SERVE_PROBE_TIMEOUT_S",
+            "serve router queue-length/prefix-summary probe budget")
+declare_env("RAYTPU_PREFIX_ROUTING",
+            "prefix-cache-aware replica routing (bool, default off)")
+declare_env("RAYTPU_PREFIX_SUMMARY_TTL_S",
+            "router-side cache TTL for replica prefix summaries")
+declare_env("RAYTPU_PREFIX_SUMMARY_MAX",
+            "max page-chain digests per replica prefix summary")
+declare_env("RAYTPU_KV_STREAM_CHUNK_BYTES",
+            "chunk size for cross-replica KV-page streaming")
+declare_env("RAYTPU_KV_HANDOFF_TTL_S",
+            "orphaned KV-export pin TTL on the prefill replica")
+
 # --- Declared knobs (reference: ray_config_def.h) ----------------------------
 
 # Scheduling. Hybrid policy packs nodes until utilization crosses this

@@ -103,7 +103,8 @@ class InferenceEngine:
                  prefill_buckets: Optional[SequenceT[int]] = None,
                  decode_buckets: Optional[SequenceT[int]] = None,
                  prefill_chunk: Optional[int] = None,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 tp: int = 1, mesh=None):
         import jax
 
         from raytpu.models.gpt2 import GPT2Config
@@ -142,6 +143,36 @@ class InferenceEngine:
         self.cache = PagedKVCache(
             model_config.n_layer, num_pages, page_size, kv_heads, head_dim,
             dtype=model_config.dtype)
+        # Tensor parallelism: shard the weights with the proven
+        # parallel-layer rule table and the KV pools along the kv-head
+        # axis. Both jit sites then compile to one SPMD program whose
+        # per-shard body is the unmodified single-chip computation over
+        # a head slice — the paged-attention kernel never notices.
+        self.mesh = mesh
+        if self.mesh is None and tp > 1:
+            from raytpu.parallel.mesh import build_mesh
+            devices = jax.devices()
+            if len(devices) < tp:
+                raise ValueError(
+                    f"tp={tp} needs {tp} devices, have {len(devices)}")
+            self.mesh = build_mesh({"tp": tp}, devices[:tp])
+        self._kv_sharding = None
+        self._repl_sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from raytpu.parallel.sharding import shard_params
+            tp_size = dict(self.mesh.shape).get("tp", 1)
+            if tp_size > 1 and kv_heads % tp_size:
+                raise ValueError(
+                    f"n_kv_head={kv_heads} not divisible by tp={tp_size}")
+            self._params = shard_params(self._params, self.mesh)
+            self._kv_sharding = NamedSharding(
+                self.mesh, PartitionSpec(None, None, "tp", None))
+            self._repl_sharding = NamedSharding(self.mesh, PartitionSpec())
+            self.cache.k = [jax.device_put(a, self._kv_sharding)
+                            for a in self.cache.k]
+            self.cache.v = [jax.device_put(a, self._kv_sharding)
+                            for a in self.cache.v]
         self.prefix_cache = (PrefixCache(self.cache)
                              if enable_prefix_cache else None)
         self.scheduler = Scheduler(self.cache, max_num_seqs=max_num_seqs,
@@ -182,6 +213,7 @@ class InferenceEngine:
         self._ttft_window = collections.deque(maxlen=256)
         self._hbm_tick = 0
         self._jnp = jax.numpy
+        self._jax = jax
         self._prefill_fn = self._build_prefill_fn(jax)
         self._chunk_fn = self._build_chunk_prefill_fn(jax)
         self._decode_fn = self._build_decode_fn(jax)
@@ -191,6 +223,7 @@ class InferenceEngine:
     def _build_prefill_fn(self, jax):
         cfg, fwd = self._config, self._prefill_fwd
         compiles = self._prefill_compiles
+        kv_sh = self._kv_sharding
 
         def _prefill(params, ks, vs, tokens, dests):
             # Trace-time only: counts XLA compiles per length bucket.
@@ -204,6 +237,13 @@ class InferenceEngine:
                     nk[0].astype(kc.dtype)).reshape(kc.shape))
                 vs2.append(vc.reshape((flat,) + vc.shape[2:]).at[dests].set(
                     nv[0].astype(vc.dtype)).reshape(vc.shape))
+            if kv_sh is not None:
+                # Pin the pool sharding through the update: the pools
+                # must come back kv-head-sharded, never resharded.
+                ks2 = [jax.lax.with_sharding_constraint(x, kv_sh)
+                       for x in ks2]
+                vs2 = [jax.lax.with_sharding_constraint(x, kv_sh)
+                       for x in vs2]
             return logits[0], ks2, vs2
 
         return jax.jit(_prefill)
@@ -211,20 +251,28 @@ class InferenceEngine:
     def _build_chunk_prefill_fn(self, jax):
         cfg, fwd = self._config, self._chunk_fwd
         compiles = self._chunk_compiles
+        kv_sh = self._kv_sharding
 
         def _chunk(params, ks, vs, tokens, positions, dests, block_tables):
             # Length bucket x trimmed block-table width: each combo is
             # one XLA program.
             bucket = f"{tokens.shape[1]}x{block_tables.shape[1]}"
             compiles[bucket] = compiles.get(bucket, 0) + 1
-            return fwd(cfg, params, tokens, positions, dests, block_tables,
-                       ks, vs)
+            logits, ks2, vs2 = fwd(cfg, params, tokens, positions, dests,
+                                   block_tables, ks, vs)
+            if kv_sh is not None:
+                ks2 = [jax.lax.with_sharding_constraint(x, kv_sh)
+                       for x in ks2]
+                vs2 = [jax.lax.with_sharding_constraint(x, kv_sh)
+                       for x in vs2]
+            return logits, ks2, vs2
 
         return jax.jit(_chunk)
 
     def _build_decode_fn(self, jax):
         cfg, fwd = self._config, self._decode_fwd
         compiles = self._decode_compiles
+        kv_sh = self._kv_sharding
 
         def _decode(params, ks, vs, tokens, positions, dests, block_tables,
                     context_lens):
@@ -232,10 +280,24 @@ class InferenceEngine:
             # one XLA program.
             bucket = f"{tokens.shape[0]}x{block_tables.shape[1]}"
             compiles[bucket] = compiles.get(bucket, 0) + 1
-            return fwd(cfg, params, tokens, positions, dests, block_tables,
-                       context_lens, ks, vs)
+            logits, ks2, vs2 = fwd(cfg, params, tokens, positions, dests,
+                                   block_tables, context_lens, ks, vs)
+            if kv_sh is not None:
+                ks2 = [jax.lax.with_sharding_constraint(x, kv_sh)
+                       for x in ks2]
+                vs2 = [jax.lax.with_sharding_constraint(x, kv_sh)
+                       for x in vs2]
+            return logits, ks2, vs2
 
         return jax.jit(_decode)
+
+    def _put(self, x):
+        """Host array → device input. Under a tp mesh, inputs are
+        committed replicated — jit rejects a mix of mesh-sharded params
+        and default-device-committed arrays."""
+        if self._repl_sharding is not None:
+            return self._jax.device_put(x, self._repl_sharding)
+        return self._jnp.asarray(x)
 
     # ---- request lifecycle ------------------------------------------
 
@@ -330,7 +392,6 @@ class InferenceEngine:
 
     def _prefill_full(self, seq: Sequence, plen: int,
                       out: List[StepOutput]) -> int:
-        jnp = self._jnp
         bucket = _bucket_for(plen, self.prefill_buckets)
         tokens = np.zeros((1, bucket), dtype=np.int32)
         tokens[0, :plen] = seq.tokens[:plen]
@@ -340,7 +401,7 @@ class InferenceEngine:
                 "bucket": bucket}):
             logits, ks, vs = self._prefill_fn(
                 self._params, self.cache.k, self.cache.v,
-                jnp.asarray(tokens), jnp.asarray(dests))
+                self._put(tokens), self._put(dests))
             self.cache.k, self.cache.v = ks, vs
         seq.cached_len = plen
         self._register_prefix(seq)
@@ -355,7 +416,6 @@ class InferenceEngine:
 
     def _prefill_one_chunk(self, seq: Sequence, start: int, plen: int,
                            out: List[StepOutput]) -> int:
-        jnp = self._jnp
         take = min(self.prefill_chunk, plen - start)
         bucket = _bucket_for(take, self.chunk_buckets)
         tokens = np.zeros((1, bucket), dtype=np.int32)
@@ -375,8 +435,8 @@ class InferenceEngine:
                 "take": take, "bucket": bucket}):
             logits, ks, vs = self._chunk_fn(
                 self._params, self.cache.k, self.cache.v,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(dests), jnp.asarray(tables))
+                self._put(tokens), self._put(positions),
+                self._put(dests), self._put(tables))
             self.cache.k, self.cache.v = ks, vs
         seq.cached_len = start + take
         self._register_prefix(seq)
@@ -390,7 +450,6 @@ class InferenceEngine:
 
     def _run_decode(self, seqs: List[Sequence],
                     out: List[StepOutput]) -> int:
-        jnp = self._jnp
         b = len(seqs)
         bucket = _bucket_for(b, self.decode_buckets)
         # Trim the block tables to the batch's actual max page count
@@ -416,9 +475,9 @@ class InferenceEngine:
         with tracing.span("infer.decode", {"batch": b, "bucket": bucket}):
             logits, ks, vs = self._decode_fn(
                 self._params, self.cache.k, self.cache.v,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(dests), jnp.asarray(tables),
-                jnp.asarray(context_lens))
+                self._put(tokens), self._put(positions),
+                self._put(dests), self._put(tables),
+                self._put(context_lens))
             self.cache.k, self.cache.v = ks, vs
         logits_np = np.asarray(logits)  # host sync: dt covers the real step
         if profiling_enabled():
@@ -430,9 +489,9 @@ class InferenceEngine:
                 ("decode", bucket, P),
                 lambda: cost_analysis_flops(
                     self._decode_fn, self._params, self.cache.k,
-                    self.cache.v, jnp.asarray(tokens),
-                    jnp.asarray(positions), jnp.asarray(dests),
-                    jnp.asarray(tables), jnp.asarray(context_lens)))
+                    self.cache.v, self._put(tokens),
+                    self._put(positions), self._put(dests),
+                    self._put(tables), self._put(context_lens)))
             prof.observe_step(time.perf_counter() - t_dec, flops=flops)
             self._hbm_tick += 1
             if self._hbm_tick % 32 == 1:

@@ -54,6 +54,22 @@ def _page_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
     return h.digest()
 
 
+def chain_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
+    """Chain hashes for every FULL page of ``tokens``.
+
+    Module-level so routers and the disaggregation plane can compute a
+    prompt's page chain without holding a cache (the hashes depend only
+    on the token ids and the page size, never on pool state) — a client
+    and every replica therefore agree on the chain byte-for-byte.
+    """
+    out: List[bytes] = []
+    prev = b"raytpu-prefix"
+    for i in range(len(tokens) // page_size):
+        prev = _page_hash(prev, tokens[i * page_size:(i + 1) * page_size])
+        out.append(prev)
+    return out
+
+
 class PrefixCache:
     """Content-addressed index of full prompt pages in a PagedKVCache.
 
@@ -77,13 +93,7 @@ class PrefixCache:
 
     def page_hashes(self, tokens: Sequence[int]) -> List[bytes]:
         """Chain hashes for every FULL page of ``tokens``."""
-        ps = self.page_size
-        out: List[bytes] = []
-        prev = b"raytpu-prefix"
-        for i in range(len(tokens) // ps):
-            prev = _page_hash(prev, tokens[i * ps:(i + 1) * ps])
-            out.append(prev)
-        return out
+        return chain_hashes(tokens, self.page_size)
 
     def match(self, tokens: Sequence[int],
               max_pages: Optional[int] = None) -> List[int]:
@@ -127,6 +137,39 @@ class PrefixCache:
             self._hash_of[page] = h
             added += 1
         return added
+
+    def adopt(self, pages: Sequence[int], hashes: Sequence[bytes]) -> int:
+        """Index externally-filled pages (a streamed KV handoff) under
+        pre-computed chain hashes. The caller must hold references on
+        ``pages`` (a pin sequence) and have fully written their KV —
+        adoption makes them matchable exactly like locally-prefilled
+        pages, so when the pin is freed they park retained instead of
+        returning to the free list. First writer wins, same as
+        :meth:`register`: a hash already indexed keeps its mapping and
+        the duplicate incoming page simply stays un-indexed (its pin
+        release returns it to the free list). Returns pages adopted."""
+        added = 0
+        for page, h in zip(pages, hashes):
+            if h in self._by_hash or page in self._hash_of:
+                continue
+            self._by_hash[h] = page
+            self._hash_of[page] = h
+            added += 1
+        return added
+
+    def summary(self, max_entries: int = 1024) -> List[str]:
+        """Compact digest list for router-side prefix matching: the
+        first 8 bytes of each registered chain hash, hex-encoded.
+        Truncation keeps probe payloads small; 64 bits of a blake2b
+        chain digest leaves collisions negligible for routing (a wrong
+        route costs one redundant prefill, never correctness). Capped
+        at ``max_entries`` digests, insertion order (oldest first)."""
+        out: List[str] = []
+        for h in self._by_hash:
+            out.append(h[:8].hex())
+            if len(out) >= max_entries:
+                break
+        return out
 
     # ---- retainer protocol (driven by PagedKVCache) -----------------
 

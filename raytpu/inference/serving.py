@@ -22,6 +22,16 @@ whose ``finally`` aborts the request — freeing its KV pages.
 The loop also maintains a lock-free ``engine_pressure()`` snapshot
 (waiting depth, KV-page occupancy, TTFT p95) that the replica exports
 through ``get_metrics`` for engine-pressure autoscaling.
+
+Disaggregated serving (r19): a deployment may be built with
+``role="prefill"`` (serves ``kv_export_*`` — prefills prompts on
+demand, pins the finished pages, streams them out chunk by chunk) or
+``role="decode"`` with ``prefill=<handle or sibling deployment>`` (on
+each request, pulls the prompt's KV prefix from the prefill peer into
+the local prefix cache before admission, so the engine grafts the
+pages and starts at ``cached_len`` without re-prefilling). Routers can
+also probe :meth:`LLMDeployment.prefix_summary` for prefix-cache-aware
+replica selection. See :mod:`raytpu.inference.disagg`.
 """
 
 from __future__ import annotations
@@ -31,9 +41,50 @@ import uuid
 from collections import deque
 from typing import Dict, Optional
 
+from raytpu.cluster import constants as tuning
+from raytpu.inference import disagg
 from raytpu.inference.engine import InferenceEngine
 from raytpu.inference.sampling import SamplingParams
 from raytpu.serve.deployment import deployment
+
+
+class _HandlePeer:
+    """``kv_export_*`` over a serve DeploymentHandle, sticky to ONE
+    prefill replica — every chunk of a handoff must hit the replica
+    that pinned the pages, so the power-of-two router is consulted
+    once per peer, not once per chunk. Any failure drops the sticky
+    pick (the next request re-chooses a live replica)."""
+
+    def __init__(self, handle):
+        self._handle = handle
+        self._replica = None
+
+    def _actor(self):
+        if self._replica is None:
+            router = self._handle._get_router()
+            self._replica = router._replica_set.choose()
+        return self._replica
+
+    def _call(self, method: str, args: tuple):
+        import raytpu
+
+        try:
+            return raytpu.get(self._actor().handle_request.remote(
+                method, args, {}, {}))
+        except Exception:
+            self._replica = None
+            raise
+
+    def kv_export_begin(self, prompt, max_pages=None):
+        return self._call("kv_export_begin", (prompt, max_pages))
+
+    def kv_export_read(self, handoff_id, offset, length):
+        return self._call("kv_export_read", (handoff_id, offset, length))
+
+    def kv_export_end(self, handoff_id):
+        if self._replica is None:
+            return False
+        return self._call("kv_export_end", (handoff_id,))
 
 
 @deployment
@@ -50,10 +101,18 @@ class LLMDeployment:
             enable_prefix_cache, ...).
         seed: parameter-init seed — two replicas (or a test building a
             reference model) with the same seed hold identical weights.
+        role: None (serve everything, the default), "prefill" (KV
+            factory: prefills + exports pages, normally not routed user
+            traffic), or "decode" (pulls prompt KV from ``prefill``
+            before admission and decodes).
+        prefill: the prefill peer for ``role="decode"`` — a
+            DeploymentHandle (serve composition) or any object with the
+            ``kv_export_*`` trio (direct-instantiation tests).
     """
 
     def __init__(self, model: str = "llama", model_config=None,
-                 engine_options: Optional[dict] = None, seed: int = 0):
+                 engine_options: Optional[dict] = None, seed: int = 0,
+                 role: Optional[str] = None, prefill=None):
         import dataclasses
 
         import jax.numpy as jnp
@@ -76,8 +135,14 @@ class LLMDeployment:
             model_config = cfg_cls(**model_config)
         params = init(model_cls(model_config), model_config, seed=seed,
                       batch=1)
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(f"unknown replica role: {role!r}")
+        self._role = role
+        self._prefill = prefill
+        self._peer = None
         self._engine = InferenceEngine(model_config, params,
                                        **(engine_options or {}))
+        self._handoff_source = disagg.KVHandoffSource(self._engine)
         # One condition serializes engine mutation (add/abort/step) and
         # carries wakeups both ways: producers signal "new work" to the
         # loop, the loop signals "new tokens" to consumers.
@@ -123,6 +188,7 @@ class LLMDeployment:
         """Stop the stepping loop (used by direct-instantiation tests;
         replica teardown kills the daemon thread with the process)."""
         with self._cv:
+            self._handoff_source.abort_all()
             self._closed = True
             self._cv.notify_all()
         self._step_thread.join(timeout=5.0)
@@ -137,6 +203,13 @@ class LLMDeployment:
         sampling = SamplingParams(
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, seed=seed, stop_token_ids=tuple(stop_token_ids))
+        prompt = [int(t) for t in prompt]
+        if self._role == "decode" and self._prefill is not None:
+            # Disaggregated prefill: graft the prompt's KV prefix from
+            # the prefill peer before admission. Best-effort by design
+            # — on any failure the request simply prefills here (the
+            # colocated-retry path), never errors out.
+            self._maybe_pull_prefix(prompt)
         request_id = uuid.uuid4().hex
         with self._cv:
             self._engine.add_request(request_id, prompt, sampling)
@@ -177,6 +250,93 @@ class LLMDeployment:
         sched = self._engine.scheduler
         return (any(s.request_id == request_id for s in sched.running)
                 or any(s.request_id == request_id for s in sched.waiting))
+
+    # ---- disaggregated prefill/decode (see inference/disagg.py) -----
+
+    def _peer_obj(self):
+        if self._peer is None:
+            from raytpu.serve.handle import DeploymentHandle
+
+            peer = self._prefill
+            # hasattr is useless on a DeploymentHandle (its __getattr__
+            # manufactures a method wrapper for ANY name), so the wire
+            # case is matched by type; everything else duck-types.
+            self._peer = (_HandlePeer(peer)
+                          if isinstance(peer, DeploymentHandle) else peer)
+        return self._peer
+
+    def _maybe_pull_prefix(self, prompt) -> int:
+        """Pull the prompt's full-page KV prefix from the prefill peer
+        unless the local prefix cache already covers it. Returns tokens
+        grafted (0 = nothing pulled; local prefill covers the rest)."""
+        eng = self._engine
+        if eng.prefix_cache is None:
+            return 0
+        cap = (len(prompt) - 1) // eng.page_size
+        if cap <= 0:
+            return 0
+        with self._cv:
+            local = len(eng.prefix_cache.match(prompt, max_pages=cap))
+        if local >= cap:
+            return 0
+        return disagg.pull_kv_prefix(eng, self._cv, self._peer_obj(),
+                                     prompt)
+
+    def kv_export_begin(self, prompt, max_pages=None):
+        """Open a KV export of ``prompt``'s full-page prefix, running a
+        (chunked) prefill first when it isn't cached yet — the prefill
+        replica's whole job. Returns the handoff meta dict, or None
+        when there is nothing to export."""
+        if self._role == "decode":
+            raise RuntimeError("decode replicas do not export KV")
+        eng = self._engine
+        if eng.prefix_cache is None:
+            return None
+        prompt = [int(t) for t in prompt]
+        cap = (len(prompt) - 1) // eng.page_size
+        if max_pages is not None:
+            cap = min(cap, int(max_pages))
+        if cap <= 0:
+            return None
+        with self._cv:
+            have = len(eng.prefix_cache.match(prompt, max_pages=cap))
+        if have < cap:
+            # Prefill through the normal request path (chunked per the
+            # engine's prefill_chunk), which registers the prompt's
+            # full pages as a side effect; one sampled-and-discarded
+            # token is the price of reusing the engine seam unmodified.
+            for _ in self.generate(prompt, max_new_tokens=1):
+                pass
+        with self._cv:
+            return self._handoff_source.begin(prompt, max_pages=cap)
+
+    def kv_export_read(self, handoff_id, offset, length):
+        """Serve one chunk of an open export (lock-free: reads only
+        pinned pages, so a slow puller never blocks the step loop)."""
+        return self._handoff_source.read(handoff_id, offset, length)
+
+    def kv_export_end(self, handoff_id) -> bool:
+        with self._cv:
+            return self._handoff_source.end(handoff_id)
+
+    def prefix_summary(self) -> dict:
+        """Compact routing summary for the prefix-aware router:
+        registered page-chain digests plus the load signals (the same
+        KV-occupancy/TTFT numbers that ride the TSDB gauges)."""
+        eng = self._engine
+        digests = []
+        if eng.prefix_cache is not None:
+            with self._cv:
+                digests = eng.prefix_cache.summary(
+                    tuning.PREFIX_SUMMARY_MAX)
+        pressure = self.engine_pressure()
+        return {
+            "digests": digests,
+            "page_size": eng.page_size,
+            "role": self._role,
+            "kv_utilization": pressure.get("kv_utilization", 0.0),
+            "ttft_p95_s": pressure.get("ttft_p95_s", 0.0),
+        }
 
     # ---- introspection ----------------------------------------------
 

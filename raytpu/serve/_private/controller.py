@@ -436,6 +436,8 @@ class ServeController(LongPollHost):
             # actual config, not the handle-constructor default.
             "max_ongoing": state.replica_config.deployment_config
             .max_ongoing_requests,
+            # Disaggregation topology: None / "prefill" / "decode".
+            "role": state.replica_config.deployment_config.role,
         }
         self.notify_changed(f"replicas::{state.full_name}", snapshot)
 

@@ -103,6 +103,21 @@ class Replica:
         """Probe used by the power-of-two-choices router."""
         return self._num_ongoing + self._num_queued
 
+    def get_prefix_summary(self) -> Optional[Dict[str, Any]]:
+        """Routing probe: the deployment's prefix-cache digest summary
+        (see serve/_private/prefix_router.py). Bypasses the request
+        queue/semaphore like ``get_queue_len`` so a saturated replica
+        can still advertise its cache; returns None for deployments
+        that don't expose one. Never raises — a broken summary must
+        degrade routing to blind power-of-two, not fail the request."""
+        fn = getattr(self._callable, "prefix_summary", None)
+        if not callable(fn):
+            return None
+        try:
+            return fn()
+        except Exception:
+            return None
+
     def get_metrics(self) -> Dict[str, float]:
         now = time.monotonic()
         self._metric_samples = [

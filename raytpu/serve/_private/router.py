@@ -17,16 +17,18 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 import raytpu
+from raytpu.cluster import constants as tuning
+from raytpu.serve._private import prefix_router
 from raytpu.serve._private.controller import CONTROLLER_NAME
 from raytpu.util import tenancy
 
 BACKOFF_S = 0.02
 MAX_BACKOFF_S = 0.5
-# Queue-length probe budget. A replica that can't answer within this is
-# scored worst-queue for the pick — NEVER assumed idle: a wedged replica
-# that looks like a zero-length queue would attract every request the
-# power-of-two pick routes.
-PROBE_TIMEOUT_S = 2.0
+# Queue-length probe budget (RAYTPU_SERVE_PROBE_TIMEOUT_S). A replica
+# that can't answer within this is scored worst-queue for the pick —
+# NEVER assumed idle: a wedged replica that looks like a zero-length
+# queue would attract every request the power-of-two pick routes.
+PROBE_TIMEOUT_S = tuning.SERVE_PROBE_TIMEOUT_S
 
 
 class ReplicaSet:
@@ -189,6 +191,63 @@ class Router:
                 rs = ReplicaSet(self._controller, full_name, max_ongoing)
                 Router._sets[full_name] = rs
         self._replica_set = rs
+        self._summaries = prefix_router.PrefixSummaryCache(
+            self._fetch_summary)
+
+    # -- prefix-cache-aware selection (RAYTPU_PREFIX_ROUTING) ---------
+
+    def _fetch_summary(self, handle) -> Optional[dict]:
+        return raytpu.get(handle.get_prefix_summary.remote(),
+                          timeout=PROBE_TIMEOUT_S)
+
+    def _probe_qlen(self, handle) -> float:
+        try:
+            return raytpu.get(handle.get_queue_len.remote(),
+                              timeout=PROBE_TIMEOUT_S)
+        except Exception:
+            return float("inf")
+
+    def _choose(self, args: tuple, kwargs: dict,
+                timeout_s: float) -> object:
+        """Replica pick for one request: prefix-aware when the flag is
+        on AND the policy finds a cache match, blind power-of-two
+        otherwise. With ``RAYTPU_PREFIX_ROUTING`` unset this method is
+        a tail call into ``ReplicaSet.choose`` — no digests, no
+        summary probes, no RNG draws — so decisions are identical to
+        the pre-disaggregation router."""
+        if tuning.PREFIX_ROUTING:
+            replica = self._choose_by_prefix(args, kwargs)
+            if replica is not None:
+                return replica
+        return self._replica_set.choose(timeout_s=timeout_s)
+
+    def _choose_by_prefix(self, args: tuple, kwargs: dict):
+        prompt = kwargs.get("prompt", args[0] if args else None)
+        if prompt is None or not hasattr(prompt, "__len__"):
+            return None
+        try:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            return None
+        replicas = self._replica_set.snapshot()
+        if len(replicas) < 2:
+            return None  # single replica: blind pick is already optimal
+        summaries = []
+        page_size = None
+        for rid, handle in replicas:
+            s = self._summaries.get(rid, handle)
+            if page_size is None and s.get("page_size"):
+                page_size = int(s["page_size"])
+            summaries.append((rid, handle, s.get("digests", ())))
+        if not page_size:
+            return None
+        try:
+            digests = prefix_router.prompt_digests(prompt, page_size)
+        except Exception:
+            return None
+        return prefix_router.select_replica(
+            digests, summaries, self._probe_qlen,
+            self._replica_set._max_ongoing, random)
 
     def assign_request(
         self,
@@ -199,7 +258,7 @@ class Router:
         timeout_s: float = 30.0,
     ):
         """Returns an ObjectRef for the replica's response."""
-        replica = self._replica_set.choose(timeout_s=timeout_s)
+        replica = self._choose(args, kwargs, timeout_s)
         meta = _stamp_tenant(request_meta)
         _tick_request(self._full_name, meta.get("tenant", ""))
         return replica.handle_request.remote(
@@ -228,7 +287,7 @@ class Router:
         timeout_s: float = 30.0,
     ):
         """Returns an ObjectRefGenerator of the replica's response chunks."""
-        replica = self._replica_set.choose(timeout_s=timeout_s)
+        replica = self._choose(args, kwargs, timeout_s)
         meta = _stamp_tenant(request_meta)
         _tick_request(self._full_name, meta.get("tenant", ""))
         return replica.handle_request_streaming.options(

@@ -74,12 +74,20 @@ class DeploymentConfig:
     # Resources per replica. TPU chips are the first-class accelerator here.
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     max_queued_requests: int = -1  # -1 == unbounded
+    # Disaggregated serving role: None (monolithic), "prefill" (serves
+    # KV exports, never decodes for clients) or "decode" (pulls its
+    # prompt prefixes from a prefill deployment). Published with the
+    # replica snapshot so routers and operators can see the topology.
+    role: Optional[str] = None
 
     def __post_init__(self):
         if self.num_replicas < 0:
             raise ValueError("num_replicas must be >= 0")
         if self.max_ongoing_requests <= 0:
             raise ValueError("max_ongoing_requests must be > 0")
+        if self.role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"role must be None, 'prefill' or 'decode', got {self.role!r}")
         if isinstance(self.autoscaling_config, dict):
             self.autoscaling_config = AutoscalingConfig(**self.autoscaling_config)
 

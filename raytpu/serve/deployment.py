@@ -28,6 +28,7 @@ class Deployment:
             "graceful_shutdown_timeout_s", "graceful_shutdown_wait_loop_s",
             "health_check_period_s", "health_check_timeout_s",
             "autoscaling_config", "ray_actor_options", "max_queued_requests",
+            "role",
         }
         name = kwargs.pop("name", self.name)
         updates = {k: v for k, v in kwargs.items() if k in cfg_fields}
@@ -141,6 +142,7 @@ def deployment(
     health_check_timeout_s: float = 30.0,
     graceful_shutdown_timeout_s: float = 20.0,
     max_queued_requests: int = -1,
+    role: Optional[str] = None,
 ) -> Any:
     """``@serve.deployment`` (reference: ``python/ray/serve/api.py``)."""
 
@@ -160,6 +162,7 @@ def deployment(
             health_check_timeout_s=health_check_timeout_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             max_queued_requests=max_queued_requests,
+            role=role,
         )
         return Deployment(target, name or target.__name__, cfg)
 

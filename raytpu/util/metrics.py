@@ -76,6 +76,14 @@ DECLARED_METRICS: Dict[str, str] = {
     "raytpu_infer_decode_mfu": "model FLOPs utilization per decode step",
     "raytpu_infer_decode_tokens_per_s": "decode throughput",
     "raytpu_infer_decode_tokens_total": "decode tokens generated",
+    "raytpu_infer_handoff_aborts_total":
+        "KV handoffs aborted mid-stream (peer death, TTL sweep)",
+    "raytpu_infer_handoff_bytes_total":
+        "payload bytes streamed in cross-replica KV handoffs",
+    "raytpu_infer_handoff_fallbacks_total":
+        "disaggregated pulls that fell back to a local prefill",
+    "raytpu_infer_handoff_pages_total":
+        "KV pages grafted via disaggregated prefill->decode handoff",
     "raytpu_infer_kv_page_utilization": "KV page pool utilization 0..1",
     "raytpu_infer_prefill_tokens_per_s": "prefill throughput",
     "raytpu_infer_prefill_tokens_total": "prefill tokens processed",

@@ -943,6 +943,75 @@ class TestProfileSitePurity:  # RTP019
         assert res.findings == []
 
 
+class TestKVShipping:  # RTP020
+    def test_planted_tobytes(self):
+        findings = run_rule_on_source(_rule("RTP020"), _src("""
+            def read(self, hid, offset, length):
+                page = self.engine.cache.k[0][3]
+                return page.tobytes()
+        """), rel="raytpu/inference/disagg.py")
+        assert len(findings) == 1
+        assert ".tobytes()" in findings[0].message
+
+    def test_planted_whole_pool_gather(self):
+        findings = run_rule_on_source(_rule("RTP020"), _src("""
+            import numpy as np
+
+            def snapshot(cache):
+                whole = np.asarray(cache.k)
+                layer = np.ascontiguousarray(cache.v[0])
+                return whole, layer
+        """), rel="raytpu/inference/disagg.py")
+        assert len(findings) == 2
+        assert all("whole-pool" in f.message for f in findings)
+
+    def test_planted_join_and_dumps(self):
+        findings = run_rule_on_source(_rule("RTP020"), _src("""
+            import pickle
+
+            def assemble(chunks, pool):
+                blob = b"".join(chunks)
+                payload = pickle.dumps(pool)
+                return blob, payload
+        """), rel="raytpu/serve/_private/prefix_router.py")
+        assert len(findings) == 2
+        assert "join" in findings[0].message
+        assert "pickle.dumps" in findings[1].message
+
+    def test_page_granular_read_not_flagged(self):
+        # Two subscripts deep == one page: the sanctioned streaming
+        # grain (this is what disagg._segment_view actually does).
+        assert run_rule_on_source(_rule("RTP020"), _src("""
+            import numpy as np
+
+            def segment(cache, layer, page):
+                return np.ascontiguousarray(
+                    np.asarray(cache.k[layer][page])).view(np.uint8)
+        """), rel="raytpu/inference/disagg.py") == []
+
+    def test_wire_framing_to_bytes_not_flagged(self):
+        assert run_rule_on_source(_rule("RTP020"), _src("""
+            def frame(n):
+                return int(n).to_bytes(4, "little")
+        """), rel="raytpu/inference/disagg.py") == []
+
+    def test_sanctioned_line_passes(self):
+        assert run_rule_on_source(_rule("RTP020"), _src("""
+            def debug_dump(page):
+                return page.tobytes()  # kv-ship-ok: offline debug tool, one page
+        """), rel="raytpu/inference/disagg.py") == []
+
+    def test_out_of_scope_module_ignored(self):
+        assert run_rule_on_source(_rule("RTP020"), _src("""
+            def flatten(arr):
+                return arr.tobytes()
+        """), rel="raytpu/runtime/serialization.py") == []
+
+    def test_real_tree_is_clean(self):
+        res = run_lint(select=["RTP020"], use_baseline=False)
+        assert res.findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 
