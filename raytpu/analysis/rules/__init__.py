@@ -58,6 +58,11 @@ RTP019 profile-site-purity     every continuous-profiler emission call
 RTP020 no-materialized-KV-     KV handoff seams never flatten pool KV
        shipping                (.tobytes(), whole-pool/layer gathers,
                                bytes join, pickle.dumps)
+RTP021 request-transition-     every declared RequestTransition is
+       coverage                emitted under raytpu/, and every
+                               emit_request() sits inside an if
+                               testing request_events_enabled()
+                               exactly once
 ====== ======================= ====================================
 """
 
@@ -72,6 +77,7 @@ from raytpu.analysis.rules import (  # noqa: F401
     metric_registry,
     persist_coverage,
     profile_purity,
+    request_coverage,
     rpc_loop,
     sched_purity,
     seam_swallow,

@@ -1,0 +1,153 @@
+"""RTP021: request-transition coverage + emission-site purity.
+
+Two invariants over the serving-plane request timeline (PR r20),
+mirroring the pair the task flight recorder already enforces:
+
+- **Coverage** (RTP003's shape): every ``RequestTransition`` member
+  declared in ``raytpu/util/task_events.py`` is referenced (emitted)
+  somewhere under ``raytpu/`` outside its defining module. A lifecycle
+  state in the vocabulary that no seam emits is a lie — ``raytpu serve
+  requests --state X`` filters on it and silently returns nothing.
+- **Purity** (RTP019's shape): every ``emit_request(...)`` call sits
+  lexically inside an ``if`` whose test calls
+  ``request_events_enabled()`` exactly once. The feature's
+  disabled-and-idle budget is ONE boolean check per emission site
+  (``RAYTPU_REQUEST_EVENTS=0`` must be free on the token hot path);
+  an unguarded emission builds the event dict when off, and a
+  double-checked guard doubles the cost nobody budgeted.
+  ``and``-combining with other cheap conditions is fine
+  (``if request_events_enabled() and request_id:``).
+
+The defining module is exempt from both scans: it trivially references
+every member and hosts the (internally guarded) ``emit_request``
+definition itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from raytpu.analysis.core import Rule, register
+
+_DEFINING = "raytpu/util/task_events.py"
+_FLAG = "request_events_enabled"
+_EMISSION = {"emit_request"}
+
+
+def request_transitions_referenced(tree) -> Set[str]:
+    """``RequestTransition.X`` member names referenced in a module
+    (unvalidated — callers intersect with the declared set)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            v = node.value
+            if ((isinstance(v, ast.Name) and v.id == "RequestTransition")
+                    or (isinstance(v, ast.Attribute)
+                        and v.attr == "RequestTransition")):
+                out.add(node.attr)
+    return out
+
+
+def declared_request_transitions() -> Set[str]:
+    from raytpu.util.task_events import RequestTransition
+
+    return set(RequestTransition.ALL)
+
+
+def _callee(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _flag_calls(node) -> int:
+    """Count ``request_events_enabled()`` calls in an expression."""
+    n = 0
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _callee(sub) == _FLAG:
+            n += 1
+    return n
+
+
+@register
+class RequestCoverage(Rule):
+    id = "RTP021"
+    name = "request-transition-coverage"
+    invariant = ("every RequestTransition member is emitted somewhere "
+                 "under raytpu/, and every emit_request() call sits "
+                 "inside an if testing request_events_enabled() exactly "
+                 "once")
+    rationale = ("a request lifecycle state nobody emits makes timeline "
+                 "filters silently empty, and the feature is only "
+                 "deployable on the token hot path if disabling it costs "
+                 "one flag check per emission site")
+    scope = ("raytpu/",)
+    exempt = (_DEFINING,)
+
+    def __init__(self):
+        self._seen: Set[str] = set()
+
+    def applies(self, mod):
+        if mod.rel.startswith("raytpu/analysis/"):
+            return False
+        return super().applies(mod)
+
+    def check(self, mod):
+        # Cheap text pre-filter: the vast majority of modules never
+        # mention the request vocabulary — skip both AST walks (the
+        # whole-tree lint budget is tight, and a rule that rewalks 200
+        # untouched files buys nothing).
+        has_ref = "RequestTransition" in mod.source
+        has_emit = any(name in mod.source for name in _EMISSION)
+        if not has_ref and not has_emit:
+            return
+        if has_ref:
+            self._seen |= request_transitions_referenced(mod.tree)
+        if has_emit:
+            yield from self._visit(mod, mod.tree, False)
+
+    def _visit(self, mod, node, guarded):
+        if isinstance(node, ast.If):
+            n = _flag_calls(node.test)
+            if n > 1:
+                yield self.finding(
+                    mod, node,
+                    f"{_FLAG}() called {n} times in one guard test — "
+                    f"emission sites pay exactly one flag check")
+            # Calls inside the test itself run regardless of the branch
+            # taken: the OUTER guard state applies to them.
+            yield from self._visit(mod, node.test, guarded)
+            inner = guarded or n >= 1
+            for child in node.body:
+                yield from self._visit(mod, child, inner)
+            for child in node.orelse:
+                yield from self._visit(mod, child, guarded)
+            return
+        if isinstance(node, ast.Call):
+            name = _callee(node)
+            if name in _EMISSION and not guarded:
+                yield self.finding(
+                    mod, node,
+                    f"request emission {name}() outside an "
+                    f"`if {_FLAG}()` guard — wrap the call site in an "
+                    f"if whose test calls {_FLAG}() exactly once")
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(mod, child, guarded)
+
+    def finalize(self, modules):
+        if not modules:
+            return
+        from raytpu.analysis.core import Finding
+
+        # Anchor coverage gaps to the defining module (stable
+        # fingerprint) even though it is exempt from the scans.
+        for member in sorted(declared_request_transitions() - self._seen):
+            yield Finding(
+                self.id, _DEFINING, 1, 0,
+                f"RequestTransition.{member} is declared but never "
+                f"emitted under raytpu/ — instrument the seam or drop "
+                f"the member")

@@ -263,6 +263,13 @@ PREFIX_SUMMARY_TTL_S = _f("PREFIX_SUMMARY_TTL_S", 1.0)
 # Cap on digests per replica prefix summary (bounds probe payloads on
 # replicas with huge caches; oldest registrations are dropped first).
 PREFIX_SUMMARY_MAX = _i("PREFIX_SUMMARY_MAX", 1024)
+# Upper age bound on a controller-pushed prefix summary before the
+# router stops trusting it and falls back to a unicast probe. Pushed
+# summaries ride health replies (one per health_check_period_s), so
+# this must comfortably exceed that period; past it, a silent
+# controller (partition, failover) degrades to per-replica probes
+# instead of routing on a frozen view of the caches.
+PREFIX_PUSH_MAX_AGE_S = _f("PREFIX_PUSH_MAX_AGE_S", 30.0)
 # Chunk size for streaming KV pages between replicas during a
 # disaggregated prefill→decode handoff. Each chunk is admitted through
 # the process-wide transfer ByteWindow, so aggregate in-flight handoff

@@ -1706,7 +1706,7 @@ class HeadServer:
         health loop and lazily before every state query, so head-emitted
         transitions (NODE_*/SCHEDULED/actor lifecycle) are never staler
         than one query."""
-        if not task_events.enabled():
+        if not task_events.ship_enabled():
             return
         batch, dropped = task_events.drain()
         if batch or dropped:

@@ -981,7 +981,7 @@ class NodeServer:
                 # failed. A failed call requeues both so records and
                 # directory updates survive a head bounce.
                 obj_deltas = self._drain_obj_deltas()
-                if task_events.enabled():
+                if task_events.ship_enabled():
                     batch, dropped = task_events.drain()
                 else:
                     batch, dropped = [], 0

@@ -384,6 +384,7 @@ class _WorkerHost:
                                  task_events.TaskTransition.FAILED,
                                  name=spec.name, attempt=spec.attempt,
                                  error=f"{type(err).__name__}: {err}"[:256])
+        if task_events.ship_enabled():
             self.flush_task_events()
         return {"results": self.collect_results(spec),
                 "borrows": self.collect_borrows(spec),
@@ -482,6 +483,7 @@ class _WorkerHost:
                 name=spec.name, attempt=spec.attempt,
                 error=None if err is None
                 else f"{type(err).__name__}: {err}"[:256])
+        if task_events.ship_enabled():
             self.flush_task_events()
         return {"results": self.collect_results(spec),
                 "borrows": self.collect_borrows(spec),

@@ -110,6 +110,8 @@ declare_env("RAYTPU_TRACE_BUFFER", "per-process span ring-buffer size")
 # Task-event flight recorder (util/task_events.py).
 declare_env("RAYTPU_TASK_EVENTS", "enable the task-event flight recorder (bool)")
 declare_env("RAYTPU_TASK_EVENTS_RING", "per-process task-event ring size")
+declare_env("RAYTPU_REQUEST_EVENTS",
+            "enable serving-plane request lifecycle events (bool)")
 
 # Fault injection (util/failpoints.py): armed via env so child worker
 # processes inherit the failure plan without any RPC.

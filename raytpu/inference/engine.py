@@ -37,7 +37,7 @@ from raytpu.inference.kv_cache import PagedKVCache
 from raytpu.inference.prefix_cache import PrefixCache
 from raytpu.inference.sampling import SamplingParams, sample_token
 from raytpu.inference.scheduler import Scheduler, Sequence
-from raytpu.util import tracing
+from raytpu.util import task_events, tracing
 from raytpu.util.metrics import Counter, Gauge, Histogram
 from raytpu.util.profiler import profiling_enabled
 from raytpu.util.stepprof import cost_analysis_flops, step_profiler
@@ -211,6 +211,10 @@ class InferenceEngine:
         self._decode_tokens = 0
         self._arrival_ts: Dict[str, float] = {}
         self._ttft_window = collections.deque(maxlen=256)
+        # Request ids whose PREFILL_START was emitted but not yet paired
+        # with PREFILL_END (chunked prefills span steps; preemption-
+        # resume prefills are excluded — RESUMED covers them).
+        self._prefill_announced: set = set()
         self._hbm_tick = 0
         self._jnp = jax.numpy
         self._jax = jax
@@ -321,6 +325,7 @@ class InferenceEngine:
 
     def abort(self, request_id: str) -> bool:
         self._arrival_ts.pop(request_id, None)
+        self._prefill_announced.discard(request_id)
         return self.scheduler.abort(request_id)
 
     def has_unfinished(self) -> bool:
@@ -376,9 +381,27 @@ class InferenceEngine:
         """
         plen = seq.prefill_len
         start = seq.cached_len
+        if task_events.request_events_enabled() and not seq.generated \
+                and seq.request_id not in self._prefill_announced:
+            self._prefill_announced.add(seq.request_id)
+            task_events.emit_request(
+                seq.request_id,
+                task_events.RequestTransition.PREFILL_START,
+                deployment=seq.deployment, tenant=seq.tenant,
+                data={"prompt_tokens": len(seq.prompt), "cached": start})
         if start == 0 and plen <= self.prefill_chunk:
-            return self._prefill_full(seq, plen, out)
-        return self._prefill_one_chunk(seq, start, plen, out)
+            n = self._prefill_full(seq, plen, out)
+        else:
+            n = self._prefill_one_chunk(seq, start, plen, out)
+        if task_events.request_events_enabled() \
+                and seq.cached_len >= plen \
+                and seq.request_id in self._prefill_announced:
+            self._prefill_announced.discard(seq.request_id)
+            task_events.emit_request(
+                seq.request_id,
+                task_events.RequestTransition.PREFILL_END,
+                deployment=seq.deployment, tenant=seq.tenant)
+        return n
 
     def _register_prefix(self, seq: Sequence) -> None:
         """Index every fully-written full PROMPT page for sharing.
@@ -512,6 +535,11 @@ class InferenceEngine:
                 ttft = time.perf_counter() - t0
                 _ttft_hist.observe(ttft)
                 self._ttft_window.append(ttft)
+            if task_events.request_events_enabled():
+                task_events.emit_request(
+                    seq.request_id,
+                    task_events.RequestTransition.FIRST_TOKEN,
+                    deployment=seq.deployment, tenant=seq.tenant)
         reason = None
         if token in seq.sampling.stop_token_ids:
             reason = "stop"

@@ -46,6 +46,7 @@ from raytpu.inference import disagg
 from raytpu.inference.engine import InferenceEngine
 from raytpu.inference.sampling import SamplingParams
 from raytpu.serve.deployment import deployment
+from raytpu.util import serve_slo, task_events
 
 
 class _HandlePeer:
@@ -149,6 +150,11 @@ class LLMDeployment:
         self._cv = threading.Condition()
         self._buffers: Dict[str, deque] = {}
         self._finished: Dict[str, str] = {}
+        # O(1) request-liveness: ids currently registered with the
+        # engine, plus their serving attribution. Replaces the O(n)
+        # waiting+running scan `_engine_knows` used to do per wakeup.
+        self._live: set = set()
+        self._req_info: Dict[str, dict] = {}
         self._closed = False
         # Lock-free pressure snapshot: the loop REPLACES the dict, so
         # readers never see a half-written one (GIL-atomic store).
@@ -204,16 +210,32 @@ class LLMDeployment:
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, seed=seed, stop_token_ids=tuple(stop_token_ids))
         prompt = [int(t) for t in prompt]
+        # Router-stamped identity rides the replica's request context:
+        # the engine sequence keeps the CLIENT's request id, so one id
+        # stitches the whole cross-process waterfall. Direct callers
+        # (no router) fall back to a fresh id.
+        from raytpu.serve._private.replica import get_request_context
+
+        ctx = get_request_context()
+        request_id = str(ctx.get("request_id") or uuid.uuid4().hex)
+        deployment_name = str(ctx.get("deployment") or "")
+        tenant = str(ctx.get("tenant") or "")
         if self._role == "decode" and self._prefill is not None:
             # Disaggregated prefill: graft the prompt's KV prefix from
             # the prefill peer before admission. Best-effort by design
             # — on any failure the request simply prefills here (the
             # colocated-retry path), never errors out.
-            self._maybe_pull_prefix(prompt)
-        request_id = uuid.uuid4().hex
+            self._maybe_pull_prefix(prompt, request_id=request_id,
+                                    deployment=deployment_name,
+                                    tenant=tenant)
         with self._cv:
-            self._engine.add_request(request_id, prompt, sampling)
+            seq = self._engine.add_request(request_id, prompt, sampling)
+            seq.deployment = deployment_name
+            seq.tenant = tenant
             self._buffers[request_id] = deque()
+            self._live.add(request_id)
+            self._req_info[request_id] = {"deployment": deployment_name,
+                                          "tenant": tenant}
             self._cv.notify_all()  # wake the stepping loop
         try:
             while True:
@@ -226,6 +248,8 @@ class LLMDeployment:
                 self._engine.abort(request_id)  # no-op if finished
                 self._buffers.pop(request_id, None)
                 self._finished.pop(request_id, None)
+                self._live.discard(request_id)
+                self._req_info.pop(request_id, None)
                 self._cv.notify_all()
 
     def _next_token(self, request_id: str) -> Optional[int]:
@@ -247,9 +271,10 @@ class LLMDeployment:
                 self._cv.wait(timeout=1.0)
 
     def _engine_knows(self, request_id: str) -> bool:
-        sched = self._engine.scheduler
-        return (any(s.request_id == request_id for s in sched.running)
-                or any(s.request_id == request_id for s in sched.waiting))
+        # O(1) live-set membership — the consumer wakeup path checks
+        # this every notify; scanning waiting+running was O(n) per
+        # wakeup per stream.
+        return request_id in self._live
 
     # ---- disaggregated prefill/decode (see inference/disagg.py) -----
 
@@ -265,7 +290,8 @@ class LLMDeployment:
                           if isinstance(peer, DeploymentHandle) else peer)
         return self._peer
 
-    def _maybe_pull_prefix(self, prompt) -> int:
+    def _maybe_pull_prefix(self, prompt, request_id: str = "",
+                           deployment: str = "", tenant: str = "") -> int:
         """Pull the prompt's full-page KV prefix from the prefill peer
         unless the local prefix cache already covers it. Returns tokens
         grafted (0 = nothing pulled; local prefill covers the rest)."""
@@ -279,8 +305,25 @@ class LLMDeployment:
             local = len(eng.prefix_cache.match(prompt, max_pages=cap))
         if local >= cap:
             return 0
-        return disagg.pull_kv_prefix(eng, self._cv, self._peer_obj(),
-                                     prompt)
+        if task_events.request_events_enabled() and request_id:
+            task_events.emit_request(
+                request_id, task_events.RequestTransition.HANDOFF_START,
+                deployment=deployment, tenant=tenant,
+                data={"pages_wanted": cap - local})
+        pulled = disagg.pull_kv_prefix(eng, self._cv, self._peer_obj(),
+                                       prompt)
+        if pulled == 0:
+            # Failed pull: the whole prompt goes back through local
+            # prefill — book the recompute in the goodput ledger.
+            serve_slo.wasted("handoff_fallback", len(prompt), deployment,
+                             tenant)
+        if task_events.request_events_enabled() and request_id:
+            task_events.emit_request(
+                request_id, task_events.RequestTransition.HANDOFF_END,
+                deployment=deployment, tenant=tenant,
+                data={"tokens_grafted": pulled,
+                      "fallback": pulled == 0})
+        return pulled
 
     def kv_export_begin(self, prompt, max_pages=None):
         """Open a KV export of ``prompt``'s full-page prefix, running a
@@ -353,5 +396,11 @@ class LLMDeployment:
     def abort(self, request_id: str) -> bool:
         with self._cv:
             ok = self._engine.abort(request_id)
+            if ok:
+                # Out-of-band abort: drop liveness now so blocked
+                # consumers end their streams on the next wakeup
+                # (generate's finally re-discards harmlessly).
+                self._live.discard(request_id)
+                self._req_info.pop(request_id, None)
             self._cv.notify_all()
             return ok

@@ -432,6 +432,53 @@ def _cmd_state(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    """Request-centric serving observability: list in-flight/finished
+    serve requests, or render one request's stitched lifecycle
+    waterfall (router -> replica -> engine -> client), keyed by the
+    id the router stamped on the stream."""
+    import raytpu
+    from raytpu.state import api as state
+
+    raytpu.init(address=args.address, ignore_reinit_error=True)
+    if args.detail:
+        rec = state.get_request_timeline(args.detail)
+        if rec is None:
+            print(f"no recorded request matching {args.detail!r} "
+                  f"(is RAYTPU_REQUEST_EVENTS=1 set?)", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rec, indent=2, default=str))
+            return 0
+        print(f"request {rec['id']}  deployment={rec.get('deployment') or '-'}"
+              f"  tenant={rec.get('tenant') or '-'}  "
+              f"state={rec.get('state', '-')}")
+        events = rec.get("events") or []
+        t0 = events[0]["ts"] if events else 0.0
+        for ev in events:
+            extra = ""
+            if ev.get("data"):
+                extra = "  " + json.dumps(ev["data"], default=str)
+            if ev.get("error"):
+                extra += f"  error={ev['error']}"
+            print(f"  +{ev['ts'] - t0:9.4f}s  "
+                  f"{str(ev.get('transition', '?')):14s}"
+                  f"{extra}")
+        return 0
+    rows = state.list_serve_requests(deployment=args.deployment,
+                                     tenant=args.tenant,
+                                     state=args.state, limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    for r in rows:
+        print(f"{str(r.get('id', '?'))[:16]:16s} "
+              f"{str(r.get('state', '-')):14s} "
+              f"{str(r.get('deployment') or '-'):28s} "
+              f"{str(r.get('tenant') or '-')}")
+    return 0
+
+
 def _cluster_worker_nodes(address: str):
     """Live non-driver nodes from the head: ``[(node_id, addr), ...]``
     (shared by every fan-out command so they always agree on targets)."""
@@ -869,6 +916,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "(recv/decode/queue/handler/encode/send "
                          "p50/p95)")
     st.set_defaults(fn=_cmd_state)
+
+    s = sub.add_parser(
+        "serve", help="serve request timelines and listings "
+                      "(request-centric observability; needs "
+                      "RAYTPU_REQUEST_EVENTS=1)")
+    s.add_argument("--address", default=None)
+    s.add_argument("--detail", default=None, metavar="REQUEST_ID",
+                   help="render one request's lifecycle waterfall "
+                        "(unique id prefix accepted)")
+    s.add_argument("--deployment", default=None,
+                   help="filter: full deployment name (app#Deployment)")
+    s.add_argument("--tenant", default=None, help="filter: tenant")
+    s.add_argument("--state", default=None,
+                   help="filter: lifecycle state (e.g. FINISHED, FAILED)")
+    s.add_argument("--limit", type=int, default=100)
+    s.add_argument("--json", action="store_true",
+                   help="emit records as JSON")
+    s.set_defaults(fn=_cmd_serve)
 
     s = sub.add_parser(
         "stack", help="live stack dump of cluster workers (reference: "

@@ -40,6 +40,9 @@ class ReplicaWrapper:
         self.healthy = True
         self.last_health_check = time.monotonic()
         self.draining = False
+        # Latest prefix-cache advertisement piggybacked on this
+        # replica's health reply (None until it advertises one).
+        self.prefix_summary = None
 
 
 class DeploymentState:
@@ -53,6 +56,9 @@ class DeploymentState:
         self.replicas: Dict[str, ReplicaWrapper] = {}
         self._counter = 0
         self.deleting = False
+        # Last prefix-summary snapshot pushed to long-poll subscribers
+        # (change-only publication; None = never published).
+        self.last_prefix_snapshot = None
         cfg = replica_config.deployment_config.autoscaling_config
         self.autoscaler = AutoscalingPolicyManager(cfg) if cfg else None
 
@@ -297,13 +303,38 @@ class ServeController(LongPollHost):
                 continue
             rep.last_health_check = now
             try:
-                await asyncio.wait_for(
+                reply = await asyncio.wait_for(
                     _await_ref(rep.handle.check_health.remote()),
                     timeout=state.replica_config.deployment_config
                     .health_check_timeout_s,
                 )
             except Exception:
                 rep.healthy = False
+                continue
+            # Modern replicas piggyback their prefix-cache summary on
+            # the health reply; legacy replicas return a bare bool.
+            if isinstance(reply, dict):
+                rep.prefix_summary = reply.get("prefix_summary")
+        self._publish_prefix_summaries(state)
+
+    def _publish_prefix_summaries(self, state: DeploymentState):
+        """Change-only broadcast of the deployment's per-replica
+        prefix-cache summaries to ``prefix::<full_name>`` long-poll
+        subscribers. Unhealthy replicas and replicas that never
+        advertised are excluded — routers unicast-probe those instead
+        of trusting missing evidence. Steady state (no cache drift)
+        publishes nothing, so idle clusters wake zero routers."""
+        snap = {
+            r.replica_id: r.prefix_summary
+            for r in state.replicas.values()
+            if r.healthy and r.prefix_summary is not None
+        }
+        if snap == state.last_prefix_snapshot:
+            return
+        state.last_prefix_snapshot = {
+            rid: dict(s) if isinstance(s, dict) else s
+            for rid, s in snap.items()}
+        self.notify_changed(f"prefix::{state.full_name}", snap)
 
     async def record_handle_demand(self, full_name: str, n: float = 1.0):
         self._pending_demand.setdefault(full_name, []).append(

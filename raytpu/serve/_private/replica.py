@@ -18,6 +18,8 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from raytpu.util import serve_slo, task_events
+
 # Ambient per-request context (reference: serve.context._serve_request_context)
 _request_context: contextvars.ContextVar[Dict[str, Any]] = contextvars.ContextVar(
     "raytpu_serve_request_context", default={}
@@ -82,13 +84,19 @@ class Replica:
     async def reconfigure(self, user_config: Any) -> None:
         self._apply_user_config(user_config)
 
-    async def check_health(self) -> bool:
+    async def check_health(self) -> Dict[str, Any]:
         fn = getattr(self._callable, "check_health", None)
         if fn is not None:
             out = fn()
             if inspect.isawaitable(out):
                 await out
-        return True
+        # Piggyback the prefix-cache advertisement on the health reply:
+        # the controller already pays this round-trip every
+        # health_check_period_s, so the broadcast path costs zero extra
+        # RPCs. The controller also accepts the legacy bare-bool reply
+        # (mid-upgrade replicas keep their health checks).
+        return {"healthy": True,
+                "prefix_summary": self.get_prefix_summary()}
 
     async def prepare_for_shutdown(self, wait_loop_s: float, timeout_s: float) -> None:
         """Drain: refuse new work, wait for ongoing requests to finish."""
@@ -290,17 +298,33 @@ class Replica:
                 f"replica {self._replica_id}: {self._num_queued} queued >= "
                 f"max_queued_requests={self._max_queued}"
             )
+        meta = dict(request_meta or {})
+        rid = str(meta.get("request_id") or "")
+        dep = str(meta.get("deployment") or "")
+        tenant = str(meta.get("tenant") or "")
         self._num_queued += 1
+        enqueue_t = time.monotonic()
+        if task_events.request_events_enabled() and rid:
+            task_events.emit_request(
+                rid, task_events.RequestTransition.QUEUED,
+                deployment=dep, tenant=tenant,
+                data={"queued": self._num_queued,
+                      "ongoing": self._num_ongoing})
         dequeued = False
         try:
             async with self._sem:
                 self._num_queued -= 1
                 dequeued = True
                 self._num_ongoing += 1
+                if rid:
+                    # Queue wait = enqueue → semaphore grant, once per
+                    # request, under the request's own deployment tags.
+                    serve_slo.observe_queue(
+                        time.monotonic() - enqueue_t, dep, tenant)
                 self._metric_samples.append(
                     (time.monotonic(), self._num_ongoing + self._num_queued)
                 )
-                token = _request_context.set(dict(request_meta or {}))
+                token = _request_context.set(meta)
                 try:
                     result = await self._invoke_stream(
                         method_name, request_args, request_kwargs
@@ -317,6 +341,13 @@ class Replica:
                         # checks keep running between chunks.
                         it = iter(result)
                         loop = asyncio.get_event_loop()
+                        # run_in_executor does NOT propagate contextvars,
+                        # and a generator body only runs at next() — on
+                        # the executor thread. Carry the request context
+                        # over explicitly so the handler (and the engine
+                        # underneath it) sees the router-stamped request
+                        # id; sequential ctx.run() re-entry is legal.
+                        ctx = contextvars.copy_context()
 
                         def _next_chunk():
                             try:
@@ -327,7 +358,7 @@ class Replica:
                         try:
                             while True:
                                 ok, chunk = await loop.run_in_executor(
-                                    self._executor, _next_chunk)
+                                    self._executor, ctx.run, _next_chunk)
                                 if not ok:
                                     break
                                 yield chunk

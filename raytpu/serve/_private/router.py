@@ -14,13 +14,14 @@ from __future__ import annotations
 import random
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 import raytpu
 from raytpu.cluster import constants as tuning
 from raytpu.serve._private import prefix_router
 from raytpu.serve._private.controller import CONTROLLER_NAME
-from raytpu.util import tenancy
+from raytpu.util import task_events, tenancy
 
 BACKOFF_S = 0.02
 MAX_BACKOFF_S = 0.5
@@ -42,6 +43,10 @@ class ReplicaSet:
         self._lock = threading.Lock()
         self._replicas: List[Tuple[str, object]] = []
         self._version = -1
+        # Controller-pushed prefix summaries (rid -> (recv_mono, summary)),
+        # refreshed by the same long-poll thread; see pushed_summary().
+        self._pushed_summaries: Dict[str, Tuple[float, dict]] = {}
+        self._prefix_version = -1
         self._stopped = False
         self._have_replicas = threading.Event()
         self._thread = threading.Thread(
@@ -52,16 +57,28 @@ class ReplicaSet:
 
     def _poll_loop(self):
         key = f"replicas::{self._full_name}"
+        prefix_key = f"prefix::{self._full_name}"
         while not self._stopped:
             try:
                 updates = raytpu.get(
-                    self._controller.listen_for_change.remote({key: self._version})
+                    self._controller.listen_for_change.remote(
+                        {key: self._version,
+                         prefix_key: self._prefix_version})
                 )
             except Exception:
                 if self._stopped:
                     return
                 time.sleep(0.1)
                 continue
+            if prefix_key in updates:
+                upd = updates[prefix_key]
+                snap = upd.object_snapshot
+                now = time.monotonic()
+                with self._lock:
+                    self._prefix_version = upd.snapshot_id
+                    if isinstance(snap, dict):
+                        self._pushed_summaries = {
+                            rid: (now, s) for rid, s in snap.items()}
             if key in updates:
                 upd = updates[key]
                 snap = upd.object_snapshot
@@ -83,6 +100,21 @@ class ReplicaSet:
 
     def stop(self):
         self._stopped = True
+
+    def pushed_summary(self, replica_id: str) -> Optional[dict]:
+        """The controller-pushed prefix summary for one replica, or
+        None when there isn't one fresh enough to trust. Staleness is
+        bounded by ``RAYTPU_PREFIX_PUSH_MAX_AGE_S``: a partitioned or
+        failed-over controller stops refreshing pushes, and routing on
+        a frozen cache view is worse than paying the unicast probe."""
+        with self._lock:
+            entry = self._pushed_summaries.get(replica_id)
+        if entry is None:
+            return None
+        ts, summary = entry
+        if time.monotonic() - ts > tuning.PREFIX_PUSH_MAX_AGE_S:
+            return None
+        return summary
 
     def snapshot(self) -> List[Tuple[str, object]]:
         with self._lock:
@@ -235,7 +267,11 @@ class Router:
         summaries = []
         page_size = None
         for rid, handle in replicas:
-            s = self._summaries.get(rid, handle)
+            # Controller-pushed advertisement first (zero RPCs, refreshed
+            # on health cadence); unicast TTL-cached probe as fallback.
+            s = self._replica_set.pushed_summary(rid)
+            if s is None:
+                s = self._summaries.get(rid, handle)
             if page_size is None and s.get("page_size"):
                 page_size = int(s["page_size"])
             summaries.append((rid, handle, s.get("digests", ())))
@@ -287,12 +323,33 @@ class Router:
         timeout_s: float = 30.0,
     ):
         """Returns an ObjectRefGenerator of the replica's response chunks."""
-        replica = self._choose(args, kwargs, timeout_s)
         meta = _stamp_tenant(request_meta)
-        _tick_request(self._full_name, meta.get("tenant", ""))
-        return replica.handle_request_streaming.options(
+        # Mint the request's identity HERE — the one id every process
+        # (router, replica, engine scheduler, client-side generator)
+        # stitches its timeline events under.
+        rid = meta.setdefault("request_id", uuid.uuid4().hex)
+        meta.setdefault("deployment", self._full_name)
+        tenant = meta.get("tenant", "")
+        if task_events.request_events_enabled():
+            task_events.emit_request(
+                rid, task_events.RequestTransition.RECEIVED,
+                deployment=self._full_name, tenant=tenant,
+                data={"method": method_name})
+        replica = self._choose(args, kwargs, timeout_s)
+        if task_events.request_events_enabled():
+            task_events.emit_request(
+                rid, task_events.RequestTransition.ROUTED,
+                deployment=self._full_name, tenant=tenant)
+        _tick_request(self._full_name, tenant)
+        gen = replica.handle_request_streaming.options(
             num_returns="streaming"
         ).remote(method_name, args, kwargs, meta)
+        # Client-side SLO accounting (TTFT/TPOT/goodput) reads this off
+        # the stream object — see handle.DeploymentResponseGenerator.
+        gen._raytpu_request_meta = {"request_id": rid,
+                                    "deployment": self._full_name,
+                                    "tenant": tenant}
+        return gen
 
     @classmethod
     def reset_all(cls):

@@ -10,10 +10,12 @@ another deployment call (composition without materializing on the caller).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, Dict, Optional
 
 import raytpu
 from raytpu.runtime.object_ref import ObjectRef
+from raytpu.util import serve_slo, task_events
 
 
 class DeploymentResponse:
@@ -34,16 +36,85 @@ class DeploymentResponse:
 
 class DeploymentResponseGenerator:
     """Iterator over a streaming deployment response's *values* (each chunk
-    the handler yielded), wrapping the underlying ObjectRefGenerator."""
+    the handler yielded), wrapping the underlying ObjectRefGenerator.
+
+    This is the consumer-side SLO seam: the router stamps the request's
+    identity onto the ref generator (``_raytpu_request_meta``), and this
+    wrapper books TTFT at the first chunk, TPOT/e2e/delivered exactly
+    once at clean exhaustion, and — when the stream dies mid-flight —
+    closes the timeline with FAILED and books every chunk already
+    received as ``abort`` waste (the consumer restarts from scratch;
+    those tokens bought nothing)."""
 
     def __init__(self, ref_gen):
         self._gen = ref_gen
+        self._meta = dict(
+            getattr(ref_gen, "_raytpu_request_meta", None) or {})
+        self._n = 0
+        self._t_start = time.monotonic()
+        self._t_first = 0.0
+        self._t_last = 0.0
+        self._settled = False  # SLOs/waste booked (exactly once)
+
+    @property
+    def request_id(self) -> str:
+        """Router-stamped identity of this stream's request (empty for
+        streams that never crossed a router)."""
+        return str(self._meta.get("request_id") or "")
 
     def __iter__(self) -> "DeploymentResponseGenerator":
         return self
 
     def __next__(self) -> Any:
-        return raytpu.get(next(self._gen))
+        try:
+            val = raytpu.get(next(self._gen))
+        except StopIteration:
+            self._settle_ok()
+            raise
+        except Exception as e:
+            self._settle_failed(e)
+            raise
+        self._n += 1
+        now = time.monotonic()
+        self._t_last = now
+        if self._n == 1:
+            self._t_first = now
+            if self._meta:
+                serve_slo.observe_ttft(now - self._t_start,
+                                       self._meta.get("deployment", ""),
+                                       self._meta.get("tenant", ""))
+        return val
+
+    def _settle_ok(self) -> None:
+        if self._settled or not self._meta:
+            return
+        self._settled = True
+        dep = self._meta.get("deployment", "")
+        tenant = self._meta.get("tenant", "")
+        now = time.monotonic()
+        serve_slo.observe_e2e(now - self._t_start, dep, tenant)
+        if self._n >= 2:
+            # Mean inter-token gap, one observation per request — the
+            # per-token loop never touches a histogram.
+            serve_slo.observe_tpot(
+                (self._t_last - self._t_first) / (self._n - 1),
+                dep, tenant)
+        else:
+            serve_slo.observe_tpot(0.0, dep, tenant)
+        serve_slo.delivered(self._n, dep, tenant)
+
+    def _settle_failed(self, exc: BaseException) -> None:
+        if self._settled or not self._meta:
+            return
+        self._settled = True
+        dep = self._meta.get("deployment", "")
+        tenant = self._meta.get("tenant", "")
+        serve_slo.wasted("abort", self._n, dep, tenant)
+        if task_events.request_events_enabled():
+            task_events.emit_request(
+                self.request_id, task_events.RequestTransition.FAILED,
+                deployment=dep, tenant=tenant,
+                data={"tokens_received": self._n}, error=str(exc))
 
     def __aiter__(self) -> "DeploymentResponseGenerator":
         return self
@@ -67,6 +138,10 @@ class DeploymentResponseGenerator:
         ``finally`` cleanup — e.g. an LLM replica freeing the
         sequence's KV pages). Safe to call twice; iteration after
         close raises StopIteration."""
+        # A cancelled stream is neither delivered nor failed from the
+        # client's side — the replica's abort path owns the timeline
+        # (ABORTED); don't let a post-close StopIteration book SLOs.
+        self._settled = True
         close_fn = getattr(self._gen, "close", None)
         if close_fn is not None:
             close_fn()

@@ -1,6 +1,7 @@
 """raytpu.state — cluster introspection (reference: python/ray/util/state/)."""
 
 from raytpu.state.api import (
+    get_request_timeline,
     get_timeline,
     list_actors,
     list_events,
@@ -8,6 +9,7 @@ from raytpu.state.api import (
     list_metric_series,
     list_objects,
     list_placement_groups,
+    list_serve_requests,
     list_tasks,
     object_summary,
     query_metrics,
@@ -17,8 +19,9 @@ from raytpu.state.api import (
 )
 
 __all__ = [
-    "get_timeline", "list_actors", "list_events", "list_metric_series",
-    "list_nodes", "list_objects", "list_placement_groups", "list_tasks",
+    "get_request_timeline", "get_timeline", "list_actors", "list_events",
+    "list_metric_series", "list_nodes", "list_objects",
+    "list_placement_groups", "list_serve_requests", "list_tasks",
     "object_summary", "query_metrics", "summarize_tasks", "summary_actors",
     "summary_tasks",
 ]

@@ -64,7 +64,7 @@ def _recorder_list(kind: str, state: Optional[str] = None,
             return None
     from raytpu.util import task_events
 
-    if not task_events.enabled() and not task_events.get_events():
+    if not task_events.ship_enabled() and not task_events.get_events():
         return None
     return _local_store().list(kind, state=state, node=node, name=name,
                                limit=limit, detail=detail)
@@ -407,6 +407,33 @@ def get_timeline(entity_id: str, kind: str = "task") -> Optional[dict]:
         except Exception:
             return None
     return _local_store().get(kind, entity_id)
+
+
+def get_request_timeline(request_id: str) -> Optional[dict]:
+    """One serve request's stitched lifecycle waterfall: every
+    RECEIVED→…→FINISHED/ABORTED/FAILED transition any process emitted
+    under this id, ts-sorted, with deployment/tenant attribution.
+    Accepts a unique id prefix (what a CLI user pastes)."""
+    return get_timeline(request_id, kind="request")
+
+
+def list_serve_requests(deployment: Optional[str] = None,
+                        tenant: Optional[str] = None,
+                        state: Optional[str] = None,
+                        limit: int = 100,
+                        detail: bool = False) -> List[Dict[str, Any]]:
+    """Serve request records from the flight recorder, newest first,
+    filtered by deployment/tenant/lifecycle state."""
+    recs = _recorder_list("request", state=state, limit=0,
+                          detail=detail) or []
+    out = []
+    for r in recs:
+        if deployment and r.get("deployment") != deployment:
+            continue
+        if tenant and r.get("tenant") != tenant:
+            continue
+        out.append(r)
+    return out[:max(0, int(limit))] if limit else out
 
 
 def summarize_tasks() -> Dict[str, int]:

@@ -116,6 +116,18 @@ DECLARED_METRICS: Dict[str, str] = {
     # -- serve ---------------------------------------------------------
     "raytpu_serve_requests_total":
         "serve requests routed, by deployment and tenant",
+    "raytpu_serve_ttft_seconds":
+        "request time-to-first-token, by deployment and tenant",
+    "raytpu_serve_tpot_seconds":
+        "inter-token latency (time per output token)",
+    "raytpu_serve_e2e_seconds":
+        "request end-to-end latency, by deployment and tenant",
+    "raytpu_serve_queue_seconds":
+        "replica queue wait (enqueue to semaphore)",
+    "raytpu_serve_tokens_delivered_total":
+        "tokens streamed to consumers, by deployment and tenant",
+    "raytpu_serve_tokens_wasted_total":
+        "tokens whose work was discarded, by cause",
     # -- metrics pipeline itself ---------------------------------------
     "raytpu_metrics_series_dropped_total":
         "tag-sets folded into <other> by the cardinality cap",

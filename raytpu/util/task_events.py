@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 ENV_VAR = "RAYTPU_TASK_EVENTS"
 RING_ENV_VAR = "RAYTPU_TASK_EVENTS_RING"
+REQUEST_ENV_VAR = "RAYTPU_REQUEST_EVENTS"
 
 
 def _env_truthy(name: str) -> bool:
@@ -95,12 +96,41 @@ class TaskTransition:
     )
 
 
-KINDS = ("task", "actor", "object", "node")
+class RequestTransition:
+    """Serving-plane request lifecycle. Same closed-vocabulary contract
+    as :class:`TaskTransition`: lint rule RTP021 asserts every member is
+    emitted somewhere under ``raytpu/`` — a state nobody emits makes
+    ``raytpu serve requests --state X`` silently empty."""
+
+    RECEIVED = "RECEIVED"            # handle/router accepted the call
+    ROUTED = "ROUTED"                # router picked a replica
+    QUEUED = "QUEUED"                # replica enqueued (pre-semaphore)
+    ADMITTED = "ADMITTED"            # scheduler admitted to a batch
+    PREFILL_START = "PREFILL_START"  # prompt compute dispatched
+    PREFILL_END = "PREFILL_END"      # prompt KV materialised
+    HANDOFF_START = "HANDOFF_START"  # pulling prefilled KV from a peer
+    HANDOFF_END = "HANDOFF_END"      # pull done (data: pages, fallback)
+    FIRST_TOKEN = "FIRST_TOKEN"      # first output token sampled
+    PREEMPTED = "PREEMPTED"          # evicted to recompute (KV freed)
+    RESUMED = "RESUMED"              # re-admitted after preemption
+    FINISHED = "FINISHED"            # terminal success (data: tokens_out)
+    ABORTED = "ABORTED"              # consumer cancelled
+    FAILED = "FAILED"                # stream died (error summary rides)
+
+    ALL: Tuple[str, ...] = (
+        RECEIVED, ROUTED, QUEUED, ADMITTED, PREFILL_START, PREFILL_END,
+        HANDOFF_START, HANDOFF_END, FIRST_TOKEN, PREEMPTED, RESUMED,
+        FINISHED, ABORTED, FAILED,
+    )
+
+
+KINDS = ("task", "actor", "object", "node", "request")
 
 _RING = max(64, _env_int(RING_ENV_VAR, 8192))
 _ring: "deque[dict]" = deque(maxlen=_RING)
 _lock = threading.Lock()
 _enabled = _env_truthy(ENV_VAR)
+_request_enabled = _env_truthy(REQUEST_ENV_VAR)
 _dropped_total = 0    # monotonic: events lost locally OR reported by
 _dropped_shipped = 0  # an upstream emitter; shipped-watermark for drain
 # [node_id, worker_id] — mutated in place (tracing._identity pattern) so
@@ -135,6 +165,37 @@ def disable_task_events(env: bool = False) -> None:
     if env:
         os.environ.pop(ENV_VAR, None)
         os.environ.pop(RING_ENV_VAR, None)
+
+
+def request_events_enabled() -> bool:
+    """The request-timeline flag — independent of :func:`enabled` so a
+    serving cluster records request waterfalls without paying for the
+    task/actor/object firehose (and vice versa)."""
+    return _request_enabled
+
+
+def enable_request_events(env: bool = False) -> None:
+    """Arm request-lifecycle recording. ``env=True`` exports
+    ``RAYTPU_REQUEST_EVENTS`` so spawned daemons/replicas inherit."""
+    global _request_enabled
+    _request_enabled = True
+    if env:
+        os.environ[REQUEST_ENV_VAR] = "1"
+
+
+def disable_request_events(env: bool = False) -> None:
+    global _request_enabled
+    _request_enabled = False
+    if env:
+        os.environ.pop(REQUEST_ENV_VAR, None)
+
+
+def ship_enabled() -> bool:
+    """True when ANY event class is armed — the shipping seams (node
+    heartbeat drain, worker post-task flush, head ingest) gate on this,
+    not on :func:`enabled`, so request events reach the head even when
+    the task firehose is off."""
+    return _enabled or _request_enabled
 
 
 def set_emitter_identity(node_id: str = "", worker_id: str = "") -> None:
@@ -174,6 +235,48 @@ def emit(kind: str, entity_id: str, transition: str, *,
         ev["error"] = str(error)[:256]
     if parent_task_id is not None:
         ev["parent_task_id"] = str(parent_task_id)
+    try:
+        from raytpu.util import tracing
+
+        tc = tracing.current_trace()
+        if tc is not None and tc.sampled:
+            ev["trace_id"] = tc.trace_id
+    except Exception:
+        pass
+    with _lock:
+        if len(_ring) == _ring.maxlen:
+            _dropped_total += 1
+        _ring.append(ev)
+
+
+def emit_request(request_id: str, transition: str, *,
+                 deployment: str = "", tenant: str = "",
+                 data: Optional[Dict[str, Any]] = None,
+                 error: Optional[str] = None, attempt: int = 0) -> None:
+    """Record one request lifecycle transition (primitives only — the
+    batch crosses strict ``allow_pickle=False`` wire surfaces). Same
+    never-block contract as :func:`emit`; call sites guard with
+    ``if task_events.request_events_enabled():`` (RTP021 enforces the
+    one-flag-check budget) and :func:`emit_request` double-checks."""
+    global _dropped_total
+    if not _request_enabled:
+        return
+    ev: Dict[str, Any] = {
+        "kind": "request",
+        "id": str(request_id),
+        "transition": transition,
+        "ts": time.time(),
+        "mono": time.monotonic(),
+        "node_id": _identity[0],
+        "worker_id": _identity[1],
+        "attempt": int(attempt),
+        "deployment": str(deployment or ""),
+        "tenant": str(tenant or ""),
+    }
+    if data is not None:
+        ev["data"] = data
+    if error is not None:
+        ev["error"] = str(error)[:256]
     try:
         from raytpu.util import tracing
 
@@ -315,6 +418,12 @@ class TaskEventStore:
                    "parent_task_id": None, "first_ts": ev.get("ts"),
                    "last_ts": ev.get("ts"), "_state_ts": ev.get("ts"),
                    "events": []}
+            if kind == "request":
+                # Serving-plane attribution rides the record so list
+                # queries filter by deployment/tenant without walking
+                # event lists. Other kinds keep their existing shape.
+                rec["deployment"] = None
+                rec["tenant"] = None
             table[eid] = rec
             index.setdefault(transition, set()).add(eid)
         else:
@@ -340,6 +449,11 @@ class TaskEventStore:
             rec["first_ts"] = min(rec["first_ts"] or ts, ts)
         if ev.get("name"):
             rec["name"] = ev["name"]
+        if kind == "request":
+            if ev.get("deployment"):
+                rec["deployment"] = ev["deployment"]
+            if ev.get("tenant"):
+                rec["tenant"] = ev["tenant"]
         if ev.get("node_id"):
             rec["node_id"] = ev["node_id"]
         if ev.get("worker_id"):

@@ -633,6 +633,37 @@ def parse_alert_rules(spec: str) -> List[AlertRule]:
     return rules
 
 
+def serve_slo_preset_rules(spec: str, for_s: float = 30.0) -> List[AlertRule]:
+    """Expand a per-tenant TTFT SLO preset (``tenant=seconds;...``) into
+    alert rules. ``"acme=0.5; free-tier=2"`` becomes two p95 rules over
+    ``raytpu_serve_ttft_seconds``, each scoped to its tenant's tag so a
+    breach fires on the breaching tenant only. Tenant names may contain
+    characters the generic rule grammar rejects (hyphens, dots), which
+    is why this builds ``AlertRule`` objects directly instead of
+    round-tripping through ``parse_alert_rules``. Malformed entries
+    raise — same loud-startup policy as the generic parser."""
+    rules: List[AlertRule] = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad SLO preset (want tenant=seconds): {part!r}")
+        tenant, thr = part.split("=", 1)
+        tenant, thr = tenant.strip(), thr.strip()
+        if not tenant or not thr:
+            raise ValueError(f"bad SLO preset (want tenant=seconds): {part!r}")
+        try:
+            threshold = float(thr)
+        except ValueError:
+            raise ValueError(
+                f"bad SLO preset threshold (want seconds): {part!r}")
+        rules.append(AlertRule(
+            "raytpu_serve_ttft_seconds", ">", threshold,
+            agg="p95", for_s=for_s, tags={"tenant": tenant}))
+    return rules
+
+
 class AlertEvaluator:
     """Tick on the head's health-loop cadence; a rule fires once when
     its breach has been sustained ``for_s`` seconds and resolves when
